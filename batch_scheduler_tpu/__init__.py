@@ -1,0 +1,28 @@
+"""batch_scheduler_tpu — a TPU-native gang/batch scheduling framework.
+
+A ground-up rebuild of the capabilities of ``tenstack/batch-scheduler`` (a
+Kubernetes scheduler-framework plugin providing all-or-nothing PodGroup gang
+scheduling; surveyed in SURVEY.md) re-centred on a pure, batched, jit-compiled
+JAX bin-packing oracle: instead of serial per-pod O(groups)+O(nodes) Go loops
+(reference ``pkg/scheduler/core/core.go:595-739``), all pending PodGroups ×
+all cluster nodes are scored in one XLA computation on TPU, data-parallel
+across chips over ICI via ``jax.sharding``/``shard_map``.
+
+Layout (mirrors the reference's component inventory, SURVEY.md §2):
+
+- ``api``        PodGroup/Pod/Node data model, phases, quantities, lanes (C2)
+- ``client``     in-memory API server, typed clientset, informers, fake (C3-C5)
+- ``cache``      PodGroup status cache + TTL match caches (C6)
+- ``core``       gang scheduling semantics: PreFilter/Filter/Permit/... (C7)
+- ``ops``        the jitted oracle kernels — the TPU hot path (C7a)
+- ``parallel``   device mesh, shardings, multi-chip collectives
+- ``framework``  embedded mini scheduling framework (queue, cycles, waiting)
+- ``plugin``     framework plugin adapter + reconcile + leader gate (C8, C10)
+- ``controller`` PodGroup phase-machine reconciler (C9)
+- ``service``    sidecar oracle service with a packed-array data plane
+- ``sim``        KWOK-style simulated clusters and scenario harness
+- ``models``     synthetic cluster/workload model zoo for sim + bench
+- ``utils``      merge patch, labels, TTL cache, errors (C11)
+"""
+
+__version__ = "0.1.0"

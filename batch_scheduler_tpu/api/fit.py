@@ -1,0 +1,35 @@
+"""Placement-fit primitives shared by the serial path and the oracle
+snapshot builder: node-selector matching and taint toleration
+(reference pkg/scheduler/core/core.go:741-759 via k8s predicates
+PodMatchNodeSelector + PodToleratesNodeTaints).
+
+Kept in one place so the serial and batched paths can never diverge on
+which nodes a gang may use.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable
+
+from .types import Taint, Toleration
+
+__all__ = ["BLOCKING_TAINT_EFFECTS", "selector_matches", "tolerates_all"]
+
+# PreferNoSchedule never blocks placement (k8s semantics).
+BLOCKING_TAINT_EFFECTS = ("NoSchedule", "NoExecute")
+
+
+def selector_matches(selector: Dict[str, str], labels: Dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def tolerates_all(
+    tolerations: Iterable[Toleration], taints: Iterable[Taint]
+) -> bool:
+    tols = list(tolerations)
+    for taint in taints:
+        if taint.effect not in BLOCKING_TAINT_EFFECTS:
+            continue
+        if not any(t.tolerates(taint) for t in tols):
+            return False
+    return True

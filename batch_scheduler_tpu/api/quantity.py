@@ -1,0 +1,114 @@
+"""Kubernetes-style resource quantity parsing.
+
+Parses the quantity grammar used in pod/node resource lists (``100m``,
+``1.5``, ``64Mi``, ``2G``, ``1e3``) into exact canonical integers:
+
+- ``cpu`` is canonicalised to **millicores** (``"1" -> 1000``, ``"250m" -> 250``),
+- everything else to its base unit rounded **up** for requests/limits and
+  **down** for capacities, so that integer comparisons stay conservative.
+
+The reference relies on k8s ``resource.MustParse`` + ``nodeinfo.Resource``
+int64 fields (reference pkg/scheduler/core/core_test.go:34-66,
+core.go:656-668); this module is the equivalent exact-arithmetic layer,
+implemented with ``fractions.Fraction`` so binary and decimal suffixes are
+lossless.
+"""
+
+from __future__ import annotations
+
+import re
+from fractions import Fraction
+
+__all__ = [
+    "parse_quantity",
+    "canonicalize",
+    "parse_resource_list",
+    "format_quantity",
+]
+
+_BINARY_SUFFIXES = {
+    "Ki": 1024,
+    "Mi": 1024**2,
+    "Gi": 1024**3,
+    "Ti": 1024**4,
+    "Pi": 1024**5,
+    "Ei": 1024**6,
+}
+
+_DECIMAL_SUFFIXES = {
+    "n": Fraction(1, 10**9),
+    "u": Fraction(1, 10**6),
+    "m": Fraction(1, 1000),
+    "k": 1000,
+    "M": 10**6,
+    "G": 10**9,
+    "T": 10**12,
+    "P": 10**15,
+    "E": 10**18,
+}
+
+_QUANTITY_RE = re.compile(
+    r"^(?P<sign>[+-]?)(?P<num>\d+(?:\.\d*)?|\.\d+)"
+    r"(?:[eE](?P<exp>[+-]?\d+))?"
+    r"(?P<suffix>Ki|Mi|Gi|Ti|Pi|Ei|n|u|m|k|M|G|T|P|E)?$"
+)
+
+
+def parse_quantity(value: "str | int | float") -> Fraction:
+    """Parse a k8s quantity string into an exact Fraction of the base unit."""
+    if isinstance(value, bool):
+        raise ValueError(f"invalid quantity: {value!r}")
+    if isinstance(value, int):
+        return Fraction(value)
+    if isinstance(value, float):
+        return Fraction(value).limit_denominator(10**9)
+    m = _QUANTITY_RE.match(value.strip())
+    if not m:
+        raise ValueError(f"invalid quantity: {value!r}")
+    num = Fraction(m.group("num"))
+    if m.group("exp"):
+        num *= Fraction(10) ** int(m.group("exp"))
+    suffix = m.group("suffix")
+    if suffix:
+        num *= Fraction(_BINARY_SUFFIXES.get(suffix) or _DECIMAL_SUFFIXES[suffix])
+    if m.group("sign") == "-":
+        num = -num
+    return num
+
+
+def canonicalize(resource: str, value: "str | int | float", *, floor: bool = False) -> int:
+    """Canonicalise a quantity to the integer unit used on-device.
+
+    cpu -> millicores; everything else -> base units. Requests round up
+    (default) and capacities round down (``floor=True``) so that
+    ``capacity >= request`` comparisons never pass due to rounding.
+    """
+    q = parse_quantity(value)
+    if resource == "cpu":
+        q *= 1000
+    n = q.numerator // q.denominator
+    if not floor and n * q.denominator != q.numerator:
+        n += 1
+    return int(n)
+
+
+def parse_resource_list(
+    raw: "dict[str, str | int | float] | None", *, floor: bool = False
+) -> "dict[str, int]":
+    """Canonicalise a whole resource list (e.g. a container's requests)."""
+    if not raw:
+        return {}
+    return {name: canonicalize(name, v, floor=floor) for name, v in raw.items()}
+
+
+def format_quantity(resource: str, canonical: int) -> str:
+    """Human-readable rendering of a canonical integer quantity."""
+    if resource == "cpu":
+        if canonical % 1000 == 0:
+            return str(canonical // 1000)
+        return f"{canonical}m"
+    for suffix in ("Ei", "Pi", "Ti", "Gi", "Mi", "Ki"):
+        base = _BINARY_SUFFIXES[suffix]
+        if canonical and canonical % base == 0:
+            return f"{canonical // base}{suffix}"
+    return str(canonical)

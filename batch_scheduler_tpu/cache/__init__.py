@@ -1,0 +1,3 @@
+from .pg_cache import PGStatusCache, PodGroupMatchStatus, PodNodePair
+
+__all__ = ["PGStatusCache", "PodGroupMatchStatus", "PodNodePair"]

@@ -1,0 +1,18 @@
+from .operation import (
+    MAX_SCORE,
+    ClusterStateProvider,
+    PermitOutcome,
+    ScheduleOperation,
+)
+from .oracle_scorer import OracleScorer, demand_from_status
+from . import resources
+
+__all__ = [
+    "MAX_SCORE",
+    "ClusterStateProvider",
+    "PermitOutcome",
+    "ScheduleOperation",
+    "OracleScorer",
+    "demand_from_status",
+    "resources",
+]

@@ -1,0 +1,477 @@
+"""ScheduleOperation: all gang-scheduling semantics behind the framework's
+extension points.
+
+The behavioural equivalent of the reference's scheduling core
+(reference pkg/scheduler/core/core.go:49-434): prefilter feasibility, per-node
+fit, permit accounting, queue ordering, postbind status transitions,
+preemption policy and the deny/permit fast-path caches — with the hot loops
+swapped for the batched TPU oracle when ``scorer="oracle"`` (the
+``--scorer=tpu`` gate of the north star; ``scorer="serial"`` is the
+reference-parity host path).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Protocol, Tuple
+
+from ..api.types import Pod, PodGroup, PodGroupPhase
+from ..cache.pg_cache import PGStatusCache, PodGroupMatchStatus, PodNodePair
+from ..utils import errors as errs
+from ..utils.labels import get_wait_seconds, pod_group_name
+from ..utils.patch import create_merge_patch
+from ..utils.ttl_cache import TTLCache
+from . import resources as rmath
+from .oracle_scorer import OracleScorer
+
+__all__ = ["ScheduleOperation", "PermitOutcome", "ClusterStateProvider", "MAX_SCORE"]
+
+# Score stub ceiling (reference core.go:46).
+MAX_SCORE = 2**31 - 1
+
+# Deny/permit fast-path cache tuning (reference core.go:71-72,188,424).
+DENY_TTL = 20.0
+DENY_CACHE_DEFAULT_TTL = 30.0
+DENY_CACHE_JANITOR = 3.0
+PERMITTED_TTL = 2.0
+PERMITTED_CACHE_DEFAULT_TTL = 3.0
+
+
+class ClusterStateProvider(Protocol):
+    """The slice of cluster state the scorers need (the reference reads this
+    from the framework's SnapshotSharedLister, core.go:437,567)."""
+
+    def list_nodes(self) -> list: ...
+
+    def node_requested(self, node_name: str) -> Dict[str, int]: ...
+
+
+@dataclass
+class PermitOutcome:
+    """Result triple of Permit (reference core.go:268-309 returns
+    (ready, groupName, error))."""
+
+    ready: bool
+    pg_name: str
+    error: Optional[Exception] = None
+
+
+class ScheduleOperation:
+    def __init__(
+        self,
+        status_cache: PGStatusCache,
+        cluster: ClusterStateProvider,
+        pg_client=None,
+        max_schedule_seconds: Optional[float] = None,
+        pg_lister: Optional[Callable[[str, str], Optional[PodGroup]]] = None,
+        scorer: str = "oracle",
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if scorer not in ("oracle", "serial"):
+            raise ValueError(f"unknown scorer {scorer!r} (use 'oracle' or 'serial')")
+        self.status_cache = status_cache
+        self.cluster = cluster
+        self.pg_client = pg_client
+        self.max_schedule_seconds = max_schedule_seconds
+        self.pg_lister = pg_lister
+        self.scorer_kind = scorer
+        self.oracle = OracleScorer() if scorer == "oracle" else None
+        self.last_denied_pg = TTLCache(DENY_CACHE_DEFAULT_TTL, DENY_CACHE_JANITOR, clock=clock)
+        self.last_permitted_pod = TTLCache(PERMITTED_CACHE_DEFAULT_TTL, DENY_CACHE_JANITOR, clock=clock)
+        self._lock = threading.RLock()
+        # Cross-call max-progress group state used by the serial Filter path
+        # (reference core.go:58-59,118-127).
+        self.max_finished_pg: str = ""
+        self.max_pg_status: Optional[PodGroupMatchStatus] = None
+
+    # ------------------------------------------------------------------
+    # scorer lifecycle
+    # ------------------------------------------------------------------
+
+    def mark_dirty(self) -> None:
+        """Invalidate the oracle batch (cluster or gang state changed)."""
+        if self.oracle is not None:
+            self.oracle.mark_dirty()
+
+    def _oracle_fresh(self) -> OracleScorer:
+        self.oracle.ensure_fresh(self.cluster, self.status_cache)
+        return self.oracle
+
+    # ------------------------------------------------------------------
+    # PreFilter (reference core.go:88-167)
+    # ------------------------------------------------------------------
+
+    def pre_filter(self, pod: Pod) -> None:
+        """Raises a SchedulingError to reject the pod for this cycle."""
+        pg_name, ok = pod_group_name(pod)
+        if not ok:
+            return  # non-group pods pass straight through (core.go:89-92)
+        full_name = f"{pod.metadata.namespace}/{pg_name}"
+
+        if self.last_permitted_pod.contains(pod.metadata.uid):
+            return  # fast-pass: just permitted (core.go:95-98)
+
+        pgs = self.status_cache.get(full_name)
+        if pgs is None:
+            raise errs.PodGroupNotFoundError(f"pod group not found: {full_name}")
+
+        if self.last_denied_pg.contains(full_name):
+            raise errs.DeniedError(
+                f"pod group {full_name} denied recently, backing off"
+            )
+
+        self._fill_occupied(pgs, pod)
+
+        if self.scorer_kind == "oracle":
+            self._pre_filter_oracle(full_name, pgs)
+        else:
+            self._pre_filter_serial(full_name, pgs, pod)
+
+    def _pre_filter_oracle(self, full_name: str, pgs: PodGroupMatchStatus) -> None:
+        if pgs.scheduled:
+            return  # gang already released; let its members through
+        oracle = self._oracle_fresh()
+        self.max_finished_pg = oracle.max_group()
+        if oracle.placed(full_name):
+            return
+        self.add_to_deny_cache(full_name)
+        if oracle.gang_feasible(full_name):
+            # Feasible alone, but higher-priority gangs consume the space in
+            # this batch — the exact form of the reference's 0.7 reserve
+            # heuristic (core.go:157-165).
+            raise errs.ResourceNotEnoughError(
+                f"{full_name}: cluster capacity reserved for earlier gangs"
+            )
+        raise errs.ResourceNotEnoughError(
+            f"{full_name}: cluster cannot fit gang ({pgs.pod_group.spec.min_member} members)"
+        )
+
+    def _pre_filter_serial(
+        self, full_name: str, pgs: PodGroupMatchStatus, pod: Pod
+    ) -> None:
+        statuses = self.status_cache.snapshot()
+        max_name, max_status, _ = rmath.find_max_group_serial(statuses)
+        self.max_finished_pg = max_name
+        self.max_pg_status = max_status
+        if not max_name or max_status is None or max_status.pod_group is None:
+            return
+
+        nodes = self.cluster.list_nodes()
+        node_req = {
+            n.metadata.name: self.cluster.node_requested(n.metadata.name)
+            for n in nodes
+        }
+
+        matched = len(max_status.matched_pod_nodes.items())
+        if matched == 0:
+            # First gang in flight becomes the max group (core.go:136-147).
+            max_status = pgs
+            prealloc = rmath.pre_allocated_resource(max_status, matched)
+            if not rmath.cluster_satisfies(
+                nodes, node_req, max_status.pod, prealloc, (1, 1)
+            ):
+                self.add_to_deny_cache(full_name)
+                raise errs.ResourceNotEnoughError("cluster resource not enough")
+            return
+
+        if self.max_finished_pg == full_name:
+            return  # the max-progress gang itself always passes (core.go:150-155)
+
+        prealloc = rmath.pre_allocated_resource(max_status, matched)
+        prealloc = rmath.add_resources(prealloc, pod.resource_require())
+        if not rmath.cluster_satisfies(
+            nodes, node_req, max_status.pod, prealloc, (7, 10)
+        ):
+            self.add_to_deny_cache(full_name)
+            raise errs.ResourceNotEnoughError("cluster resource not enough")
+
+    # ------------------------------------------------------------------
+    # Filter (reference core.go:170-191,514-564)
+    # ------------------------------------------------------------------
+
+    def filter(self, pod: Pod, node_name: str) -> None:
+        pg_name, ok = pod_group_name(pod)
+        if not ok:
+            return
+        full_name = f"{pod.metadata.namespace}/{pg_name}"
+        pgs = self.status_cache.get(full_name)
+        if pgs is None:
+            raise errs.PodGroupNotFoundError(f"pod group not found: {full_name}")
+        try:
+            if self.scorer_kind == "oracle":
+                self._filter_oracle(full_name, pgs, pod, node_name)
+            else:
+                self._filter_serial(full_name, pgs, pod, node_name)
+        except errs.SchedulingError:
+            self.add_to_deny_cache(full_name)
+            raise
+        self.last_permitted_pod.set(pod.metadata.uid, "", PERMITTED_TTL)
+
+    def _filter_oracle(
+        self, full_name: str, pgs: PodGroupMatchStatus, pod: Pod, node_name: str
+    ) -> None:
+        oracle = self._oracle_fresh()
+        if oracle.node_capacity(full_name, node_name) > 0:
+            return
+        raise errs.ResourceNotEnoughError(
+            f"{full_name}: node {node_name} cannot fit a member"
+        )
+
+    def _filter_serial(
+        self, full_name: str, pgs: PodGroupMatchStatus, pod: Pod, node_name: str
+    ) -> None:
+        # case1: the max-progress group itself always passes (core.go:531-535)
+        if self.max_finished_pg == full_name:
+            return
+        max_status = self.max_pg_status
+        if max_status is None or not max_status.pod_group.spec.min_resources:
+            return  # nothing to reserve against (core.go:542-544)
+        max_single = dict(max_status.pod_group.spec.min_resources)
+
+        node = next(
+            (
+                n
+                for n in self.cluster.list_nodes()
+                if n.metadata.name == node_name
+            ),
+            None,
+        )
+        if node is None:
+            raise errs.SchedulingError("node snapshot not initialized")
+        left = rmath.single_node_left(
+            node, self.cluster.node_requested(node_name), None, (1, 1)
+        )
+
+        # case2: node fits this pod plus one member of the max group
+        combined = rmath.add_resources(pod.resource_require(), max_single)
+        if rmath.resource_satisfied(left, combined):
+            return
+        # case3: node can't host the max group's member anyway — don't hold
+        # this node hostage for it (core.go:557-561)
+        if not rmath.resource_satisfied(left, max_single):
+            return
+        raise errs.ResourceNotEnoughError(
+            f"node {node_name} reserved for max group {self.max_finished_pg}"
+        )
+
+    # ------------------------------------------------------------------
+    # Preemption (reference core.go:194-260)
+    # ------------------------------------------------------------------
+
+    def preempt_add_pod(self, pod_to_add: Pod, node_name: str) -> None:
+        return None
+
+    def preempt_remove_pod(self, pod_to_schedule: Pod, pod_to_remove: Pod) -> None:
+        """Raises SchedulingError when the preemption is forbidden.
+
+        Policy (reference core.go:198-260): online↔online free; offline may
+        never preempt online; nobody preempts members of Scheduled/Running
+        gangs; a gang never preempts itself. ("offline" = carries the group
+        label.)
+        """
+        remove_group, remove_offline = pod_group_name(pod_to_remove)
+        schedule_group, schedule_offline = pod_group_name(pod_to_schedule)
+
+        if not schedule_offline and not remove_offline:
+            return
+
+        if schedule_offline and not remove_offline:
+            raise errs.SchedulingError(
+                f"offline pod {pod_to_schedule.metadata.name} may not preempt "
+                f"online pod {pod_to_remove.metadata.name}"
+            )
+
+        def check_victim() -> Tuple[str, Optional[Exception]]:
+            full = f"{pod_to_remove.metadata.namespace}/{remove_group}"
+            pgs = self.status_cache.get(full)
+            if pgs is None:
+                return "", errs.PodGroupNotFoundError(f"pod group not found: {full}")
+            phase = pgs.pod_group.status.phase
+            if phase in (PodGroupPhase.SCHEDULED, PodGroupPhase.RUNNING):
+                return "", errs.SchedulingError(
+                    "members of Scheduled/Running pod groups may not be preempted"
+                )
+            return full, None
+
+        victim_full, err = check_victim()
+
+        if not schedule_offline and remove_offline:
+            if err is not None:
+                raise err
+            return
+
+        # offline preempts offline
+        schedule_full = f"{pod_to_schedule.metadata.namespace}/{schedule_group}"
+        if victim_full == schedule_full:
+            raise errs.SchedulingError(
+                "pod group may not preempt its own members"
+            )
+        if err is not None:
+            raise err
+
+    # ------------------------------------------------------------------
+    # Score (reference stub core.go:263-265 — real ranks in oracle mode)
+    # ------------------------------------------------------------------
+
+    def score(self, pod: Pod, node_name: str) -> int:
+        pg_name, ok = pod_group_name(pod)
+        if not ok or self.scorer_kind != "oracle":
+            return MAX_SCORE
+        full_name = f"{pod.metadata.namespace}/{pg_name}"
+        return self._oracle_fresh().node_score(full_name, node_name)
+
+    # ------------------------------------------------------------------
+    # Permit (reference core.go:268-309)
+    # ------------------------------------------------------------------
+
+    def permit(self, pod: Pod, node_name: str) -> PermitOutcome:
+        pg_name, ok = pod_group_name(pod)
+        if not ok:
+            return PermitOutcome(True, pg_name, errs.NotMatchedError())
+        full_name = f"{pod.metadata.namespace}/{pg_name}"
+        pgs = self.status_cache.get(full_name)
+        if pgs is None:
+            return PermitOutcome(
+                False, pg_name, errs.PodGroupNotFoundError(full_name)
+            )
+        pg = pgs.pod_group
+        if pg.status.phase == PodGroupPhase.PENDING:
+            pg.status.phase = PodGroupPhase.PRE_SCHEDULING
+
+        pod_key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        wait = get_wait_seconds(pg, self.max_schedule_seconds)
+        pgs.matched_pod_nodes.set(
+            pod.metadata.uid, PodNodePair(pod_key, node_name), wait
+        )
+        old_uid = pgs.pod_name_uids.get(pod_key)
+        if old_uid is not None and old_uid != pod.metadata.uid:
+            # the pod was re-created; drop the stale permit (core.go:293-296)
+            pgs.matched_pod_nodes.delete(old_uid)
+        pgs.pod_name_uids.set(pod_key, pod.metadata.uid, wait)
+        self.mark_dirty()
+
+        matched = len(pgs.matched_pod_nodes.items())
+        if matched >= pg.spec.min_member - pg.status.scheduled:
+            pgs.scheduled = True
+            return PermitOutcome(True, pg_name, None)
+        return PermitOutcome(False, pg_name, errs.WaitingError())
+
+    # ------------------------------------------------------------------
+    # PostBind (reference core.go:312-362)
+    # ------------------------------------------------------------------
+
+    def post_bind(self, pod: Pod, node_name: str) -> None:
+        pg_name, ok = pod_group_name(pod)
+        if not ok:
+            return
+        full_name = f"{pod.metadata.namespace}/{pg_name}"
+        with self._lock:
+            pgs = self.status_cache.get(full_name)
+            if pgs is None:
+                return
+            pg_copy = pgs.pod_group.deepcopy()
+            pg_copy.status.scheduled += 1
+            if pg_copy.status.scheduled >= pgs.pod_group.spec.min_member:
+                pg_copy.status.phase = PodGroupPhase.SCHEDULED
+            else:
+                pg_copy.status.phase = PodGroupPhase.SCHEDULING
+                if pg_copy.status.schedule_start_time == 0:
+                    pg_copy.status.schedule_start_time = time.time()
+
+            if (
+                pg_copy.status.phase != pgs.pod_group.status.phase
+                and self.pg_client is not None
+            ):
+                try:
+                    from ..api.types import to_dict
+
+                    live = self.pg_client.podgroups(pg_copy.metadata.namespace).get(
+                        pg_copy.metadata.name
+                    )
+                    patch = create_merge_patch(to_dict(live), to_dict(pg_copy))
+                    updated = self.pg_client.podgroups(
+                        pg_copy.metadata.namespace
+                    ).patch(pg_copy.metadata.name, patch)
+                    pgs.pod_group.status.phase = updated.status.phase
+                except Exception:
+                    return
+            else:
+                pgs.pod_group.status.phase = pg_copy.status.phase
+                pgs.pod_group.status.schedule_start_time = (
+                    pg_copy.status.schedule_start_time
+                )
+
+            pgs.pod_group.status.scheduled = pg_copy.status.scheduled
+        self.mark_dirty()
+
+    # ------------------------------------------------------------------
+    # Queue ordering (reference core.go:368-411)
+    # ------------------------------------------------------------------
+
+    def compare(self, pod1: Pod, ts1: float, pod2: Pod, ts2: float) -> bool:
+        """True iff pod1 should be scheduled before pod2: priority, then
+        PodGroup creation time, then (reverse) group name, then pod queue
+        timestamp — reference Compare semantics, including its
+        reverse-lexicographic name tiebreak (core.go:404)."""
+        prio1, prio2 = pod1.spec.priority, pod2.spec.priority
+        name1, _ = pod_group_name(pod1)
+        name2, _ = pod_group_name(pod2)
+
+        if prio1 > prio2:
+            return True
+        if prio1 == prio2:
+            if not name1 and not name2:
+                return ts1 < ts2
+            if not name1:
+                return True
+            if not name2:
+                return False
+        if self.pg_lister is None:
+            return False
+        pg1 = self.pg_lister(pod1.metadata.namespace, name1)
+        pg2 = self.pg_lister(pod2.metadata.namespace, name2)
+        if pg1 is None or pg2 is None:
+            return False
+        c1, c2 = pg1.metadata.creation_timestamp, pg2.metadata.creation_timestamp
+        if prio1 == prio2 and c1 < c2:
+            return True
+        if prio1 == prio2 and c1 == c2 and name1 > name2:
+            return True
+        return prio1 == prio2 and c1 == c2 and name1 == name2 and ts1 < ts2
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+
+    def add_to_deny_cache(self, full_name: str) -> None:
+        self.last_denied_pg.add(full_name, "", DENY_TTL)
+
+    def get_pod_node_pairs(self, full_name: str) -> Optional[TTLCache]:
+        pgs = self.status_cache.get(full_name)
+        return pgs.matched_pod_nodes if pgs is not None else None
+
+    def get_pod_name_uids(self, full_name: str) -> Optional[TTLCache]:
+        pgs = self.status_cache.get(full_name)
+        return pgs.pod_name_uids if pgs is not None else None
+
+    def _fill_occupied(self, pgs: PodGroupMatchStatus, pod: Pod) -> None:
+        """Owner-reference fencing: a PodGroup belongs to the first workload
+        whose pods claim it (reference fillOccupiedObj, core.go:477-512)."""
+        if pgs is None or pgs.pod_group is None:
+            raise errs.SchedulingError("pod group match status is nil")
+        refs = sorted(str(r) for r in pod.metadata.owner_references)
+        if pgs.pod is None:
+            pgs.pod = pod
+        if pgs.pod_group.spec.min_resources is None:
+            pgs.pod_group.spec.min_resources = pod.resource_require()
+        occupied = pgs.pod_group.status.occupied_by
+        if not occupied:
+            if refs:
+                pgs.pod_group.status.occupied_by = ",".join(refs)
+            return
+        if not refs or ",".join(refs) != occupied:
+            raise errs.OccupiedError(
+                f"pod group {pgs.pod_group.full_name()} occupied by {occupied}"
+            )

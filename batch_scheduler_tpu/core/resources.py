@@ -1,0 +1,168 @@
+"""Host-side exact resource math — the reference-parity serial path.
+
+This is the direct semantic equivalent of the reference's resource helpers
+(reference pkg/scheduler/core/core.go:436-475,566-699,741-793), kept as the
+``--scorer=serial`` fallback and as the measured baseline the TPU oracle must
+beat. Dict-based exact integer arithmetic; the reference's float32
+percent-truncation is replaced by exact ``floor(a·num/den)``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+from ..api.fit import selector_matches, tolerates_all
+from ..api.types import Node, Pod
+from ..cache.pg_cache import PodGroupMatchStatus
+
+__all__ = [
+    "add_resources",
+    "scale_resources",
+    "resource_satisfied",
+    "check_fit",
+    "single_node_left",
+    "cluster_left",
+    "cluster_satisfies",
+    "pre_allocated_resource",
+    "find_max_group_serial",
+]
+
+def add_resources(a: Dict[str, int], b: Dict[str, int]) -> Dict[str, int]:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = out.get(k, 0) + v
+    return out
+
+
+def scale_resources(r: Dict[str, int], num: int, den: int) -> Dict[str, int]:
+    """Exact floor(v·num/den) per lane (the reserve-percent scaling,
+    reference core.go:656-667)."""
+    if num == den:
+        return dict(r)
+    return {k: (v * num) // den for k, v in r.items()}
+
+
+def resource_satisfied(left: Dict[str, int], req: Dict[str, int]) -> bool:
+    """Element-wise left >= req; a nonzero requirement for a lane the left
+    side lacks fails (reference compareResourceAndRequire, core.go:672-699)."""
+    for k, v in req.items():
+        if v > left.get(k, 0):
+            return False
+    return True
+
+
+def check_fit(pod: Pod, node: Node) -> bool:
+    """Node selector + taint toleration placement fit
+    (reference checkFit, core.go:741-759)."""
+    return selector_matches(
+        pod.spec.node_selector, node.metadata.labels
+    ) and tolerates_all(pod.spec.tolerations, node.spec.taints)
+
+
+def single_node_left(
+    node: Node,
+    requested: Dict[str, int],
+    pod: Optional[Pod],
+    percent: Tuple[int, int] = (1, 1),
+) -> Dict[str, int]:
+    """Per-node leftover = floor(alloc·percent) − requested, zeroed when the
+    pod cannot be placed there at all (reference singleNodeResource,
+    core.go:634-670)."""
+    if pod is not None and not check_fit(pod, node):
+        return {}
+    scaled = scale_resources(node.status.allocatable, *percent)
+    left = dict(scaled)
+    for k, v in requested.items():
+        left[k] = left.get(k, 0) - v
+    return left
+
+
+def cluster_left(
+    nodes: Sequence[Node],
+    node_requested: Dict[str, Dict[str, int]],
+    pod: Optional[Pod],
+    percent: Tuple[int, int] = (1, 1),
+) -> Dict[str, int]:
+    """Sum of per-node leftovers over schedulable nodes
+    (reference computeClusterResource, core.go:566-593)."""
+    total: Dict[str, int] = {}
+    for node in nodes:
+        if node.spec.unschedulable:
+            continue
+        left = single_node_left(
+            node, node_requested.get(node.metadata.name, {}), pod, percent
+        )
+        total = add_resources(total, left)
+    return total
+
+
+def cluster_satisfies(
+    nodes: Sequence[Node],
+    node_requested: Dict[str, Dict[str, int]],
+    pod: Optional[Pod],
+    required: Dict[str, int],
+    percent: Tuple[int, int] = (1, 1),
+) -> bool:
+    """Running-sum cluster feasibility with early exit — the serial hot loop
+    the oracle replaces (reference compareClusterResourceAndRequire,
+    core.go:595-632)."""
+    running: Dict[str, int] = {}
+    for node in nodes:
+        if node.spec.unschedulable:
+            continue
+        left = single_node_left(
+            node, node_requested.get(node.metadata.name, {}), pod, percent
+        )
+        running = add_resources(running, left)
+        if resource_satisfied(running, required):
+            return True
+    return False
+
+
+def pre_allocated_resource(pgs: PodGroupMatchStatus, matched: int) -> Dict[str, int]:
+    """Resources to reserve for the max-progress group's unfinished members
+    (reference getPreAllocatedResource, core.go:774-793)."""
+    pg = pgs.pod_group
+    if matched != 0:
+        not_finished = pg.spec.min_member - matched
+    else:
+        not_finished = pg.spec.min_member - pg.status.scheduled
+    total: Dict[str, int] = {}
+    if pg.spec.min_resources:
+        for _ in range(max(not_finished, 0)):
+            total = add_resources(total, pg.spec.min_resources)
+    if total.get("pods", 0) == 0:
+        total["pods"] = pg.spec.min_member + 1
+    return total
+
+
+def find_max_group_serial(
+    statuses: Dict[str, PodGroupMatchStatus],
+) -> Tuple[str, Optional[PodGroupMatchStatus], int]:
+    """Serial max-progress group selection (reference findMaxPG,
+    core.go:701-739), with deterministic iteration (sorted by name) in place
+    of Go's randomised map order."""
+    max_name, max_status, max_finished = "", None, 0
+    for name in sorted(statuses):
+        pgs = statuses[name]
+        if pgs.scheduled or pgs.pod is None:
+            continue
+        pg = pgs.pod_group
+        if pg.spec.min_member - pg.status.scheduled <= 0:
+            finished = 0
+        else:
+            finished = (
+                (len(pgs.matched_pod_nodes.items()) + pg.status.scheduled)
+                * 1000
+                // max(pg.spec.min_member, 1)
+            )
+        if finished > max_finished:
+            max_finished, max_name, max_status = finished, name, pgs
+        elif finished == max_finished:
+            if max_status is None or (
+                max_status.pod_group.status.scheduled
+                >= max_status.pod_group.spec.min_member
+                and pg.status.scheduled == 0
+            ):
+                max_finished, max_name, max_status = finished, name, pgs
+    return max_name, max_status, max_finished

@@ -1,0 +1,31 @@
+from .bucketing import bucket_size, pad_rows, pad_to
+from .lanes import CORE_LANES, INT32_MAX, LaneSchema
+from .oracle import (
+    assign_gangs,
+    find_max_group,
+    gang_feasible,
+    group_capacity,
+    left_resources,
+    schedule_batch,
+    score_nodes,
+)
+from .snapshot import ClusterSnapshot, GroupDemand, node_requested_from_pods
+
+__all__ = [
+    "bucket_size",
+    "pad_rows",
+    "pad_to",
+    "CORE_LANES",
+    "INT32_MAX",
+    "LaneSchema",
+    "assign_gangs",
+    "find_max_group",
+    "gang_feasible",
+    "group_capacity",
+    "left_resources",
+    "schedule_batch",
+    "score_nodes",
+    "ClusterSnapshot",
+    "GroupDemand",
+    "node_requested_from_pods",
+]

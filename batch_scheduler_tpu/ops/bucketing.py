@@ -1,0 +1,41 @@
+"""Shape bucketing: pad dynamic pod/node/group counts to a small set of
+static shapes so churn re-scores hit the jit cache instead of recompiling.
+
+XLA traces once per distinct input shape; a cluster whose node count drifts
+between 4,997 and 5,003 must not trigger six compilations. Buckets are
+powers of two (with a smallest bucket of 8 and multiples of the TPU lane
+width where it matters), which bounds compilations at O(log max_size) per
+rank combination.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["bucket_size", "pad_to", "pad_rows"]
+
+_MIN_BUCKET = 8
+
+
+def bucket_size(n: int) -> int:
+    """Smallest power-of-two bucket >= n (>= 8)."""
+    b = _MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+def pad_to(arr: np.ndarray, size: int, axis: int = 0, fill=0) -> np.ndarray:
+    """Pad ``arr`` along ``axis`` to ``size`` with ``fill``."""
+    pad = size - arr.shape[axis]
+    if pad < 0:
+        raise ValueError(f"array dim {arr.shape[axis]} exceeds bucket {size}")
+    if pad == 0:
+        return arr
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, pad)
+    return np.pad(arr, widths, constant_values=fill)
+
+
+def pad_rows(arr: np.ndarray, size: int, fill=0) -> np.ndarray:
+    return pad_to(arr, size, axis=0, fill=fill)

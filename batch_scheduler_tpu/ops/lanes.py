@@ -1,0 +1,98 @@
+"""Resource lanes: the fixed vector layout resources are packed into on device.
+
+The reference models resources as a Go struct of int64 fields plus a scalar
+map (``nodeinfo.Resource`` handled at reference pkg/scheduler/core/core.go:
+656-668). The TPU-native equivalent is a dense ``int32[R]`` lane vector so a
+whole cluster becomes one ``int32[N, R]`` array the oracle can stream through
+the VPU.
+
+Lane units are chosen so exact integer comparison semantics survive int32:
+
+- ``cpu``                millicores   (max ~2.1M cores/node)
+- ``memory``             KiB          (max 2 TiB/node)
+- ``ephemeral-storage``  KiB          (max 2 TiB/node)
+- ``pods``               count
+- extended resources     raw integer counts
+
+Requests round **up** and capacities round **down** during unit conversion,
+so ``capacity >= request`` can never pass due to rounding. Gang feasibility
+on device is computed in *member counts* (small integers), never in raw byte
+sums, which is what keeps 5k-node clusters inside int32 (see ops.oracle).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["LaneSchema", "CORE_LANES", "INT32_MAX"]
+
+CORE_LANES: Tuple[str, ...] = ("cpu", "memory", "ephemeral-storage", "pods")
+# Lanes stored as KiB on device (canonical host unit is bytes).
+_KIB_LANES = frozenset({"memory", "ephemeral-storage"})
+
+INT32_MAX = np.int32(2**31 - 1)
+
+
+def _to_device_unit(name: str, value: int, *, capacity: bool) -> int:
+    if name in _KIB_LANES:
+        if capacity:
+            return value // 1024
+        return -((-value) // 1024)  # ceil
+    return value
+
+
+class LaneSchema:
+    """Maps resource names <-> lane indices for one cluster snapshot."""
+
+    def __init__(self, extended: Sequence[str] = ()):
+        self.names: Tuple[str, ...] = CORE_LANES + tuple(extended)
+        self.index: Dict[str, int] = {n: i for i, n in enumerate(self.names)}
+
+    @property
+    def num_lanes(self) -> int:
+        return len(self.names)
+
+    @classmethod
+    def collect(cls, resource_dicts: Iterable[Dict[str, int]]) -> "LaneSchema":
+        """Build a schema covering every resource name seen in the snapshot."""
+        extended = set()
+        for d in resource_dicts:
+            for name in d:
+                if name not in CORE_LANES:
+                    extended.add(name)
+        return cls(sorted(extended))
+
+    def pack(self, resources: Dict[str, int], *, capacity: bool = False) -> np.ndarray:
+        """Pack one canonical resource dict into an int32[R] lane vector.
+
+        Unknown resource names are an error: schemas are built with
+        ``collect`` over the full snapshot, so a miss is a caller bug — and
+        silently dropping a lane would break the reference's rule that a
+        request for a resource the node lacks must fail feasibility
+        (reference pkg/scheduler/core/core.go:686-696).
+        """
+        vec = np.zeros(self.num_lanes, dtype=np.int64)
+        for name, value in resources.items():
+            i = self.index.get(name)
+            if i is None:
+                raise KeyError(f"resource {name!r} not in lane schema {self.names}")
+            vec[i] = _to_device_unit(name, int(value), capacity=capacity)
+        if (vec > INT32_MAX).any() or (vec < -INT32_MAX - 1).any():
+            raise OverflowError(
+                f"resource vector exceeds int32 lanes: {dict(zip(self.names, vec))}"
+            )
+        return vec.astype(np.int32)
+
+    def pack_many(
+        self, dicts: Sequence[Dict[str, int]], *, capacity: bool = False
+    ) -> np.ndarray:
+        """Pack a sequence of resource dicts into int32[len, R]."""
+        if not dicts:
+            return np.zeros((0, self.num_lanes), dtype=np.int32)
+        return np.stack([self.pack(d, capacity=capacity) for d in dicts])
+
+    def unpack(self, vec: np.ndarray) -> Dict[str, int]:
+        """Inverse of pack (device units, for debugging/logging)."""
+        return {n: int(vec[i]) for n, i in self.index.items() if vec[i]}

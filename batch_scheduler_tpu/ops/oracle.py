@@ -1,0 +1,210 @@
+"""The bin-packing oracle: jitted JAX kernels scoring all PodGroups × all
+nodes in one batch.
+
+This replaces the reference's two serial hot loops — per-pod cluster
+feasibility (``findMaxPG`` + ``compareClusterResourceAndRequire``, reference
+pkg/scheduler/core/core.go:595-632,701-739) and per-node fit
+(``singleNodeResource`` + ``compareResourceAndRequire``, core.go:634-699) —
+with dense int32 tensor kernels:
+
+- ``left_resources``      per-node leftover = floor(alloc·percent) − requested
+- ``group_capacity``      members-per-node capacity matrix cap[G,N]
+- ``gang_feasible``       Σ_n cap[g,n] ≥ remaining[g]  (exact, in member
+                          counts, so 5k-node sums stay far inside int32 —
+                          and *stronger* than the reference's raw resource-sum
+                          check, which ignores per-node fragmentation)
+- ``find_max_group``      vectorized group-progress argmax (findMaxPG parity)
+- ``score_nodes``         per-(group,node) placement ranks for the Score
+                          extension point (a stub in the reference,
+                          core.go:263-265)
+- ``assign_gangs``        greedy whole-batch gang placement via ``lax.scan``
+                          over groups in priority order
+
+All kernels take statically-bucketed shapes (see ops.bucketing) and int32
+lanes (see ops.lanes); invalid rows are masked, never branched on, so there
+is no data-dependent Python control flow under jit.
+
+Determinism note: the reference's findMaxPG tie-break depends on Go map
+iteration order, which is randomised (core.go:701-739). ``find_max_group``
+resolves ties deterministically: prefer groups with nothing scheduled yet
+(same intent as core.go:725-735), then earlier creation rank.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "left_resources",
+    "group_capacity",
+    "gang_feasible",
+    "find_max_group",
+    "score_nodes",
+    "assign_gangs",
+    "schedule_batch",
+]
+
+_BIG = jnp.int32(2**30)
+
+
+@partial(jax.jit, static_argnames=("percent_num", "percent_den"))
+def left_resources(alloc, requested, percent_num: int = 1, percent_den: int = 1):
+    """Per-node leftover lanes: floor(alloc·percent) − requested.
+
+    ``percent`` is the reference's reserve fraction (1.0 for the max-progress
+    group, 0.7 otherwise — core.go:140,161,656-659), expressed as an exact
+    integer ratio. Computed as ``q·num + (r·num)//den`` with ``q,r =
+    divmod(alloc, den)`` so nothing overflows int32.
+    """
+    if percent_num == percent_den:
+        scaled = alloc
+    else:
+        q = alloc // percent_den
+        r = alloc - q * percent_den
+        scaled = q * percent_num + (r * percent_num) // percent_den
+    return scaled - requested
+
+
+@jax.jit
+def group_capacity(left, group_req, fit_mask):
+    """cap[G,N]: how many members of group g fit on node n's leftover.
+
+    cap = min over lanes with req>0 of left // req, clamped to >= 0, masked
+    by per-(group,node) placement feasibility (selector/taints/validity).
+    A node with any overcommitted lane naturally yields 0.
+    """
+    req = group_req[:, None, :]  # [G,1,R]
+    safe_req = jnp.maximum(req, 1)
+    per_lane = jnp.where(req > 0, left[None, :, :] // safe_req, _BIG)  # [G,N,R]
+    cap = jnp.min(per_lane, axis=-1)
+    return jnp.maximum(cap, 0).astype(jnp.int32) * fit_mask.astype(jnp.int32)
+
+
+@jax.jit
+def gang_feasible(cap, remaining, group_valid):
+    """ok[G]: total member capacity across the cluster covers the gang's
+    still-unbound members. Exact in int32: capacities are member counts."""
+    total = jnp.sum(cap, axis=1)
+    return (total >= remaining) & group_valid
+
+
+@jax.jit
+def find_max_group(min_member, scheduled, matched, ineligible, creation_rank):
+    """Vectorized findMaxPG (reference core.go:701-739).
+
+    progress = (matched + scheduled)·1000 // min_member for eligible groups
+    (not yet released, has a representative pod, still needs members), else 0
+    when fully satisfied. Returns (best_index, best_exists, progress[G]).
+
+    Tie-break (deterministic, unlike the Go map iteration): prefer groups
+    with scheduled == 0, then earlier creation rank.
+    """
+    g = min_member.shape[0]
+    needs = (min_member - scheduled) > 0
+    denom = jnp.maximum(min_member, 1)
+    progress = jnp.where(needs, (matched + scheduled) * 1000 // denom, 0)
+    progress = jnp.clip(progress, 0, 2047)
+    eligible = ~ineligible
+    key = (
+        progress.astype(jnp.int32) * (2 * g + 2)
+        + jnp.where(scheduled == 0, g + 1, 0)
+        + (g - creation_rank.astype(jnp.int32))
+    )
+    key = jnp.where(eligible, key, -1)
+    best = jnp.argmax(key)
+    return best.astype(jnp.int32), key[best] >= 0, progress
+
+
+@jax.jit
+def score_nodes(cap):
+    """score[G,N] for the Score extension point: best-fit ranking.
+
+    Higher is better. Nodes that fit at least one member are ranked by
+    *tightness* — fewer future members would fit, so gangs pack densely and
+    large holes stay available for wide pods. Infeasible nodes score
+    INT32_MIN-ish.
+    """
+    fits = cap > 0
+    return jnp.where(fits, _BIG - cap, -_BIG)
+
+
+@jax.jit
+def assign_gangs(left0, group_req, remaining, fit_mask, order):
+    """Greedy whole-batch gang placement.
+
+    Walks groups in ``order`` (priority-first, the queue-sort order) with a
+    ``lax.scan`` carrying the live leftover lanes; each step places all of a
+    gang's remaining members at once — best-fit packing onto the
+    tightest-fitting nodes — iff the whole gang fits (all-or-nothing at the
+    batch level, which *is* gang semantics). Returns:
+
+    - alloc[G,N]  members of group g placed on node n (rows in group index
+      space, not scan order)
+    - placed[G]   whether the gang was placed this batch
+    - left[N,R]   leftover lanes after all placements
+
+    One jitted call replaces the pod-at-a-time Permit accounting loop for
+    batch mode; the reference has no equivalent (it admits gangs pod by pod
+    against a TTL cache, core.go:268-309).
+    """
+    n = left0.shape[0]
+
+    def body(left, g):
+        req = jnp.take(group_req, g, axis=0)
+        mask = jnp.take(fit_mask, g, axis=0)
+        need = jnp.take(remaining, g)
+
+        safe_req = jnp.maximum(req, 1)
+        per_lane = jnp.where(req > 0, left // safe_req, _BIG)
+        cap = jnp.maximum(jnp.min(per_lane, axis=-1), 0) * mask
+
+        feasible = jnp.sum(cap) >= need
+        # Best-fit: tightest feasible nodes first (stable ties by index).
+        rank = jnp.where(cap > 0, cap, _BIG)
+        node_order = jnp.argsort(rank, stable=True)
+        cap_sorted = jnp.take(cap, node_order)
+        before = jnp.cumsum(cap_sorted) - cap_sorted
+        take_sorted = jnp.clip(need - before, 0, cap_sorted)
+        take = jnp.zeros((n,), jnp.int32).at[node_order].set(
+            take_sorted.astype(jnp.int32)
+        )
+        take = take * feasible.astype(jnp.int32)
+        left = left - take[:, None] * req[None, :]
+        return left, (take, feasible)
+
+    left, (takes, placed) = jax.lax.scan(body, left0, order)
+    g = group_req.shape[0]
+    alloc = jnp.zeros((g, n), jnp.int32).at[order].set(takes)
+    placed = jnp.zeros((g,), bool).at[order].set(placed)
+    return alloc, placed, left
+
+
+@jax.jit
+def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
+                   group_valid, order):
+    """Fused full-batch oracle: leftover -> capacity -> feasibility -> scores
+    -> greedy gang assignment, one XLA computation.
+
+    This is the ``fit()`` of SURVEY.md §7: everything the control plane needs
+    for one scheduling batch in a single device round-trip.
+    """
+    left = left_resources(alloc_lanes, requested)
+    cap = group_capacity(left, group_req, fit_mask)
+    feasible = gang_feasible(cap, remaining, group_valid)
+    scores = score_nodes(cap)
+    assignment, placed, left_after = assign_gangs(
+        left, group_req, remaining, fit_mask, order
+    )
+    placed = placed & group_valid
+    return {
+        "left": left,
+        "capacity": cap,
+        "gang_feasible": feasible,
+        "scores": scores,
+        "assignment": assignment,
+        "placed": placed,
+        "left_after": left_after,
+    }

@@ -1,0 +1,205 @@
+"""ClusterSnapshot: host-side packing of API objects into the oracle's
+padded int32 arrays.
+
+The reference walks a ``SnapshotSharedLister`` of NodeInfo objects per pod
+per cycle (reference pkg/scheduler/core/core.go:436-475,566-632). Here the
+snapshot is packed once per batch into dense arrays — node allocatable /
+requested lanes, per-group member requirements, and a (group × node)
+placement-feasibility mask — then every group is scored in one device call.
+
+Host-side string work (node selectors, taints — reference core.go:741-759)
+happens exactly once per (group, node) per snapshot, not per pod per cycle,
+with a fast path that skips the quadratic walk entirely when no selectors or
+taints exist (the overwhelmingly common case).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..api.fit import selector_matches, tolerates_all
+from ..api.types import Node, Pod, Toleration
+from .bucketing import bucket_size, pad_rows, pad_to
+from .lanes import LaneSchema
+
+__all__ = ["GroupDemand", "ClusterSnapshot", "node_requested_from_pods"]
+
+
+@dataclass
+class GroupDemand:
+    """One PodGroup's demand as seen by the oracle."""
+
+    full_name: str
+    min_member: int
+    scheduled: int = 0
+    matched: int = 0
+    priority: int = 0
+    creation_ts: float = 0.0
+    # Per-member canonical resource requirement (includes an implicit pod
+    # slot); from spec.min_resources or the representative pod
+    # (reference core.go:489-493).
+    member_request: Dict[str, int] = field(default_factory=dict)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: List[Toleration] = field(default_factory=list)
+    # Gang already released to bind (reference cache.go:66) — excluded from
+    # max-progress selection.
+    released: bool = False
+    # No representative pod observed yet (reference core.go:709-710).
+    has_pod: bool = True
+
+    @property
+    def remaining(self) -> int:
+        return max(self.min_member - self.scheduled, 0)
+
+
+def node_requested_from_pods(pods: Sequence[Pod]) -> Dict[str, int]:
+    """Aggregate the canonical requested resources of pods bound to a node,
+    including the implicit pod slot (reference core.go:650-654)."""
+    total: Dict[str, int] = {"pods": 0}
+    for p in pods:
+        total["pods"] += 1
+        for k, v in p.resource_require().items():
+            total[k] = total.get(k, 0) + v
+    return total
+
+
+class ClusterSnapshot:
+    """Padded, device-ready view of (nodes × groups) for one batch."""
+
+    def __init__(
+        self,
+        nodes: Sequence[Node],
+        node_requested: Dict[str, Dict[str, int]],
+        groups: Sequence[GroupDemand],
+    ):
+        self.node_names = [n.metadata.name for n in nodes]
+        self.group_names = [g.full_name for g in groups]
+        self.groups = list(groups)
+        self._node_index = {n: i for i, n in enumerate(self.node_names)}
+        self._group_index = {g: i for i, g in enumerate(self.group_names)}
+
+        self.schema = LaneSchema.collect(
+            [node_requested.get(n.metadata.name, {}) for n in nodes]
+            + [n.status.allocatable for n in nodes]
+            + [g.member_request for g in groups]
+        )
+
+        n_bucket = bucket_size(max(len(nodes), 1))
+        g_bucket = bucket_size(max(len(groups), 1))
+        self.num_nodes = len(nodes)
+        self.num_groups = len(groups)
+
+        alloc = self.schema.pack_many(
+            [n.status.allocatable for n in nodes], capacity=True
+        )
+        requested = self.schema.pack_many(
+            [node_requested.get(n.metadata.name, {}) for n in nodes]
+        )
+        node_valid = np.array(
+            [not n.spec.unschedulable for n in nodes], dtype=bool
+        )
+
+        member_reqs = []
+        for g in groups:
+            req = dict(g.member_request)
+            req["pods"] = max(req.get("pods", 0), 1)
+            member_reqs.append(req)
+        group_req = self.schema.pack_many(member_reqs)
+
+        fit = self._fit_mask(nodes, groups) & node_valid[None, :]
+
+        self.alloc = pad_rows(alloc, n_bucket)
+        self.requested = pad_rows(requested, n_bucket)
+        self.node_valid = pad_rows(node_valid, n_bucket, fill=False)
+        self.group_req = pad_rows(group_req, g_bucket)
+        self.remaining = pad_rows(
+            np.array([g.remaining for g in groups], dtype=np.int32), g_bucket
+        )
+        self.group_valid = pad_rows(
+            np.ones(len(groups), dtype=bool), g_bucket, fill=False
+        )
+        fit = pad_rows(fit, g_bucket, fill=False)
+        self.fit_mask = pad_to(fit, n_bucket, axis=1, fill=False)
+
+        self.min_member = pad_rows(
+            np.array([g.min_member for g in groups], dtype=np.int32), g_bucket
+        )
+        self.scheduled = pad_rows(
+            np.array([g.scheduled for g in groups], dtype=np.int32), g_bucket
+        )
+        self.matched = pad_rows(
+            np.array([g.matched for g in groups], dtype=np.int32), g_bucket
+        )
+        # Ineligible for max-progress selection: already released, no
+        # representative pod yet, or a padded row.
+        self.ineligible = pad_rows(
+            np.array([g.released or not g.has_pod for g in groups], dtype=bool),
+            g_bucket,
+            fill=True,
+        )
+
+        order_host = sorted(
+            range(len(groups)),
+            key=lambda i: (
+                -groups[i].priority,
+                groups[i].creation_ts,
+                groups[i].full_name,
+            ),
+        )
+        ranks = np.empty(len(groups), dtype=np.int32)
+        ranks[order_host] = np.arange(len(groups), dtype=np.int32)
+        self.creation_rank = pad_rows(ranks, g_bucket, fill=g_bucket - 1)
+        # Scan order over padded group rows: real groups by priority, then
+        # padded rows (remaining == 0, so they place nothing).
+        self.order = np.concatenate(
+            [
+                np.array(order_host, dtype=np.int32),
+                np.arange(len(groups), g_bucket, dtype=np.int32),
+            ]
+        )
+
+    def _fit_mask(
+        self, nodes: Sequence[Node], groups: Sequence[GroupDemand]
+    ) -> np.ndarray:
+        mask = np.ones((len(groups), len(nodes)), dtype=bool)
+        any_taints = any(n.spec.taints for n in nodes)
+        for gi, g in enumerate(groups):
+            if not g.node_selector and not any_taints:
+                continue
+            for ni, node in enumerate(nodes):
+                ok = selector_matches(g.node_selector, node.metadata.labels)
+                if ok and node.spec.taints:
+                    ok = tolerates_all(g.tolerations, node.spec.taints)
+                mask[gi, ni] = ok
+        return mask
+
+    # -- lookups -----------------------------------------------------------
+
+    def group_index(self, full_name: str) -> Optional[int]:
+        return self._group_index.get(full_name)
+
+    def node_index(self, name: str) -> Optional[int]:
+        return self._node_index.get(name)
+
+    def device_args(self) -> tuple:
+        """Argument tuple for ops.oracle.schedule_batch."""
+        return (
+            self.alloc,
+            self.requested,
+            self.group_req,
+            self.remaining,
+            self.fit_mask,
+            self.group_valid,
+            self.order,
+        )
+
+    @property
+    def shape(self) -> tuple:
+        return (
+            self.group_req.shape[0],
+            self.alloc.shape[0],
+            self.schema.num_lanes,
+        )

@@ -1,0 +1,39 @@
+from .errors import (
+    DeniedError,
+    NotMatchedError,
+    OccupiedError,
+    PodGroupNotFoundError,
+    ResourceNotEnoughError,
+    SchedulingError,
+    WaitingError,
+)
+from .labels import (
+    DEFAULT_WAIT_SECONDS,
+    POD_GROUP_ANN,
+    POD_GROUP_LABEL,
+    get_wait_seconds,
+    pod_group_full_name,
+    pod_group_name,
+)
+from .patch import apply_merge_patch, create_merge_patch
+from .ttl_cache import NO_EXPIRY, TTLCache
+
+__all__ = [
+    "DeniedError",
+    "NotMatchedError",
+    "OccupiedError",
+    "PodGroupNotFoundError",
+    "ResourceNotEnoughError",
+    "SchedulingError",
+    "WaitingError",
+    "DEFAULT_WAIT_SECONDS",
+    "POD_GROUP_ANN",
+    "POD_GROUP_LABEL",
+    "get_wait_seconds",
+    "pod_group_full_name",
+    "pod_group_name",
+    "apply_merge_patch",
+    "create_merge_patch",
+    "NO_EXPIRY",
+    "TTLCache",
+]

@@ -1,0 +1,30 @@
+"""Sentinel scheduling errors (reference pkg/util/types.go:28-35)."""
+
+
+class SchedulingError(Exception):
+    """Base class for scheduling-control errors."""
+
+
+class NotMatchedError(SchedulingError):
+    """Pod does not participate in batch scheduling (no group label)."""
+
+
+class WaitingError(SchedulingError):
+    """Gang not yet complete; pod must wait at the Permit gate."""
+
+
+class ResourceNotEnoughError(SchedulingError):
+    """Cluster (or node) resources cannot satisfy the request."""
+
+
+class PodGroupNotFoundError(SchedulingError):
+    """Pod references a PodGroup that is not in the status cache."""
+
+
+class OccupiedError(SchedulingError):
+    """PodGroup is fenced to a different owner workload
+    (reference pkg/scheduler/core/core.go:504-510)."""
+
+
+class DeniedError(SchedulingError):
+    """PodGroup is in the deny backoff cache (reference core.go:105-110)."""

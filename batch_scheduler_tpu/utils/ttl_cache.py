@@ -1,0 +1,196 @@
+"""Thread-safe TTL cache with eviction callbacks.
+
+Equivalent of the ``patrickmn/go-cache`` instances the reference leans on for
+all gang bookkeeping: permitted pod→node pairs and podName→UID maps with a
+TTL equal to the gang wait time, whose expiry *is* the gang-timeout abort
+signal (reference pkg/scheduler/controller/controller.go:314-335,
+pkg/scheduler/core/core.go:54-55,71-72).
+
+Semantics notes vs go-cache:
+
+- ``on_evicted`` fires for TTL expiry (janitor or lazy) only — NOT for
+  explicit ``delete``/``flush``. go-cache fires it on Delete too; the
+  reference only avoids spuriously aborting gangs after a successful start
+  because it deletes under a mismatched key
+  (reference pkg/scheduler/batch/batchscheduler.go:333 deletes PodNameUIDs by
+  uid while keys are pod names). We keep the intent, not the accident.
+- The clock is injectable and a manual ``purge_expired()`` exists so tests
+  and the simulator can drive time deterministically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, Optional, Tuple
+
+__all__ = ["TTLCache", "NO_EXPIRY"]
+
+NO_EXPIRY = 0.0
+
+_JANITOR_TICK = 0.5
+
+
+class _SharedJanitor:
+    """One daemon thread purging every registered TTLCache on its own
+    interval. A per-cache timer thread (the go-cache goroutine translated
+    literally) would cost two OS threads per PodGroup; this costs one per
+    process."""
+
+    _instance: "Optional[_SharedJanitor]" = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # cache -> next purge deadline (monotonic); weak so dropped caches
+        # unregister themselves.
+        self._due: "weakref.WeakKeyDictionary[TTLCache, float]" = (
+            weakref.WeakKeyDictionary()
+        )
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def instance(cls) -> "_SharedJanitor":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def register(self, cache: "TTLCache") -> None:
+        with self._lock:
+            self._due[cache] = time.monotonic() + cache._janitor_interval
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="ttl-cache-janitor", daemon=True
+                )
+                self._thread.start()
+
+    def unregister(self, cache: "TTLCache") -> None:
+        with self._lock:
+            self._due.pop(cache, None)
+
+    def _run(self) -> None:
+        while True:
+            time.sleep(_JANITOR_TICK)
+            now = time.monotonic()
+            with self._lock:
+                ready = [c for c, due in self._due.items() if due <= now]
+                for c in ready:
+                    self._due[c] = now + c._janitor_interval
+            for cache in ready:
+                try:
+                    cache.purge_expired()
+                except Exception:
+                    pass  # eviction callbacks must never kill the janitor
+
+
+class TTLCache:
+    def __init__(
+        self,
+        default_ttl: float = NO_EXPIRY,
+        janitor_interval: float = 0.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._default_ttl = default_ttl
+        self._clock = clock
+        self._lock = threading.RLock()
+        # key -> (value, expire_at); expire_at == NO_EXPIRY means never.
+        self._items: Dict[str, Tuple[Any, float]] = {}
+        self._on_evicted: Optional[Callable[[str, Any], None]] = None
+        self._janitor_interval = janitor_interval
+        if janitor_interval > 0:
+            _SharedJanitor.instance().register(self)
+
+    # -- configuration -----------------------------------------------------
+
+    def on_evicted(self, fn: Optional[Callable[[str, Any], None]]) -> None:
+        """Register the TTL-expiry callback (the gang-abort hook)."""
+        self._on_evicted = fn
+
+    # -- core operations ---------------------------------------------------
+
+    def _expire_at(self, ttl: Optional[float]) -> float:
+        if ttl is None:
+            ttl = self._default_ttl
+        if ttl <= 0:
+            return NO_EXPIRY
+        return self._clock() + ttl
+
+    def set(self, key: str, value: Any, ttl: Optional[float] = None) -> None:
+        with self._lock:
+            self._items[key] = (value, self._expire_at(ttl))
+
+    def add(self, key: str, value: Any, ttl: Optional[float] = None) -> bool:
+        """Set only if absent (or expired). Returns True if stored."""
+        with self._lock:
+            existing = self._get_locked(key)
+            if existing is not None:
+                return False
+            self._items[key] = (value, self._expire_at(ttl))
+            return True
+
+    def _get_locked(self, key: str):
+        entry = self._items.get(key)
+        if entry is None:
+            return None
+        value, expire_at = entry
+        if expire_at != NO_EXPIRY and self._clock() >= expire_at:
+            return None
+        return entry
+
+    def get(self, key: str) -> Optional[Any]:
+        with self._lock:
+            entry = self._get_locked(key)
+            return None if entry is None else entry[0]
+
+    def contains(self, key: str) -> bool:
+        return self.get(key) is not None
+
+    def delete(self, key: str) -> None:
+        """Remove without firing on_evicted (see module docstring)."""
+        with self._lock:
+            self._items.pop(key, None)
+
+    def items(self) -> Dict[str, Any]:
+        """Snapshot of live (non-expired) entries."""
+        with self._lock:
+            now = self._clock()
+            return {
+                k: v
+                for k, (v, exp) in self._items.items()
+                if exp == NO_EXPIRY or now < exp
+            }
+
+    def __len__(self) -> int:
+        return len(self.items())
+
+    def flush(self) -> None:
+        """Drop everything without firing on_evicted."""
+        with self._lock:
+            self._items.clear()
+
+    # -- expiry ------------------------------------------------------------
+
+    def purge_expired(self) -> int:
+        """Evict expired entries, firing on_evicted outside the lock.
+
+        Returns the number of evicted entries. Called by the janitor, and
+        callable directly by deterministic tests/simulations.
+        """
+        evicted = []
+        with self._lock:
+            now = self._clock()
+            for k in list(self._items):
+                v, exp = self._items[k]
+                if exp != NO_EXPIRY and now >= exp:
+                    del self._items[k]
+                    evicted.append((k, v))
+        if self._on_evicted is not None:
+            for k, v in evicted:
+                self._on_evicted(k, v)
+        return len(evicted)
+
+    def close(self) -> None:
+        if self._janitor_interval > 0:
+            _SharedJanitor.instance().unregister(self)

@@ -1,0 +1,127 @@
+"""Shared test fixtures: compact builders for pods, nodes and pod groups."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from batch_scheduler_tpu.api import (
+    Container,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodGroup,
+    PodGroupSpec,
+    PodSpec,
+    new_uid,
+    parse_resource_list,
+)
+from batch_scheduler_tpu.cache import PGStatusCache, PodGroupMatchStatus
+from batch_scheduler_tpu.utils.labels import POD_GROUP_LABEL
+
+
+def make_pod(
+    name: str,
+    group: str = "",
+    requests: Optional[Dict] = None,
+    limits: Optional[Dict] = None,
+    namespace: str = "default",
+    priority: int = 0,
+    node_selector: Optional[Dict] = None,
+    owner_refs: Optional[List[str]] = None,
+    creation_ts: float = 0.0,
+) -> Pod:
+    labels = {POD_GROUP_LABEL: group} if group else {}
+    return Pod(
+        metadata=ObjectMeta(
+            name=name,
+            namespace=namespace,
+            uid=new_uid("pod"),
+            labels=labels,
+            owner_references=owner_refs or [],
+            creation_timestamp=creation_ts,
+        ),
+        spec=PodSpec(
+            containers=[Container.from_raw(requests=requests, limits=limits)],
+            priority=priority,
+            node_selector=node_selector or {},
+        ),
+    )
+
+
+def make_node(
+    name: str,
+    allocatable: Optional[Dict] = None,
+    labels: Optional[Dict] = None,
+    unschedulable: bool = False,
+) -> Node:
+    alloc = parse_resource_list(allocatable or {"cpu": "8", "memory": "16Gi", "pods": 110}, floor=True)
+    return Node(
+        metadata=ObjectMeta(name=name, uid=new_uid("node"), labels=labels or {}),
+        spec=NodeSpec(unschedulable=unschedulable),
+        status=NodeStatus(allocatable=alloc, capacity=dict(alloc)),
+    )
+
+
+def make_group(
+    name: str,
+    min_member: int,
+    namespace: str = "default",
+    min_resources: Optional[Dict] = None,
+    max_schedule_time: Optional[float] = None,
+    creation_ts: float = 0.0,
+) -> PodGroup:
+    return PodGroup(
+        metadata=ObjectMeta(
+            name=name,
+            namespace=namespace,
+            uid=new_uid("pg"),
+            creation_timestamp=creation_ts,
+        ),
+        spec=PodGroupSpec(
+            min_member=min_member,
+            min_resources=parse_resource_list(min_resources) if min_resources else None,
+            max_schedule_time=max_schedule_time,
+        ),
+    )
+
+
+class FakeCluster:
+    """Minimal ClusterStateProvider over static nodes + bound-pod tracking."""
+
+    def __init__(self, nodes):
+        self.nodes = list(nodes)
+        self.bound: Dict[str, List] = {n.metadata.name: [] for n in self.nodes}
+
+    def list_nodes(self):
+        return list(self.nodes)
+
+    def node_requested(self, node_name: str) -> Dict[str, int]:
+        from batch_scheduler_tpu.ops.snapshot import node_requested_from_pods
+
+        return node_requested_from_pods(self.bound.get(node_name, []))
+
+    def bind(self, pod, node_name: str) -> None:
+        pod.spec.node_name = node_name
+        self.bound[node_name].append(pod)
+
+
+def status_for(
+    pg: PodGroup,
+    cache: PGStatusCache,
+    rep_pod: Optional[Pod] = None,
+    clock=None,
+) -> PodGroupMatchStatus:
+    from batch_scheduler_tpu.api import PodGroupPhase
+
+    pgs = PodGroupMatchStatus(pg, clock=clock)
+    if pg.status.phase == PodGroupPhase.EMPTY:
+        # the controller normalises ""->Pending on first sync
+        pg.status.phase = PodGroupPhase.PENDING
+    if rep_pod is not None:
+        pgs.pod = rep_pod
+        if pg.spec.min_resources is None:
+            pg.spec.min_resources = rep_pod.resource_require()
+    cache.set(pg.full_name(), pgs)
+    return pgs

@@ -1,0 +1,211 @@
+"""ScheduleOperation behavioural tests: the gang semantics of the reference's
+scheduling core (PreFilter/Permit/PostBind/Compare/preemption), run under both
+the oracle and serial scorers."""
+
+import pytest
+
+from batch_scheduler_tpu.api import PodGroupPhase
+from batch_scheduler_tpu.cache import PGStatusCache
+from batch_scheduler_tpu.core import ScheduleOperation
+from batch_scheduler_tpu.utils import errors as errs
+
+from helpers import FakeCluster, make_group, make_node, make_pod, status_for
+
+
+def build_race(scorer):
+    """README race scenario: one node with ~7.1 free cpus, two gangs of
+    minMember=5 x 1cpu pods."""
+    node = make_node("n1", {"cpu": "8", "memory": "32Gi", "pods": "110"})
+    cluster = FakeCluster([node])
+    # 0.9 cpu of system pods already bound
+    sys_pod = make_pod("sys", requests={"cpu": "900m"})
+    cluster.bind(sys_pod, "n1")
+
+    cache = PGStatusCache()
+    pods = {}
+    for gname, ts in (("race1", 1.0), ("race2", 2.0)):
+        pg = make_group(gname, 5, creation_ts=ts)
+        members = [
+            make_pod(f"{gname}-{i}", group=gname, requests={"cpu": "1"}, creation_ts=ts)
+            for i in range(5)
+        ]
+        status_for(pg, cache, rep_pod=members[0])
+        pods[gname] = members
+
+    op = ScheduleOperation(cache, cluster, scorer=scorer)
+    return op, cache, cluster, pods
+
+
+@pytest.mark.parametrize("scorer", ["oracle", "serial"])
+def test_race_scenario_one_group_wins(scorer):
+    op, cache, cluster, pods = build_race(scorer)
+
+    # Drive race1 through prefilter+permit to completion.
+    ready_seen = False
+    for pod in pods["race1"]:
+        op.pre_filter(pod)
+        out = op.permit(pod, "n1")
+        ready_seen = ready_seen or out.ready
+    assert ready_seen
+    assert cache.get("default/race1").scheduled
+
+    # Bind them (postbind updates counters; cluster tracks requested).
+    for pod in pods["race1"]:
+        cluster.bind(pod, "n1")
+        op.post_bind(pod, "n1")
+    pg1 = cache.get("default/race1").pod_group
+    assert pg1.status.scheduled == 5
+    assert pg1.status.phase == PodGroupPhase.SCHEDULED
+
+    # race2 must now be denied: only ~2.1 cpus remain for a 5-cpu gang.
+    with pytest.raises(errs.ResourceNotEnoughError):
+        op.pre_filter(pods["race2"][0])
+    # and the deny cache fast-fails the next attempt
+    with pytest.raises(errs.DeniedError):
+        op.pre_filter(pods["race2"][1])
+
+
+def test_oracle_prefilter_reserves_for_priority_group():
+    """With both gangs pending and capacity for only one, the oracle path
+    admits exactly the first-ordered gang up front (no 0.7 heuristic)."""
+    op, cache, cluster, pods = build_race("oracle")
+    op.pre_filter(pods["race1"][0])  # earlier creation_ts -> first in order
+    with pytest.raises(errs.ResourceNotEnoughError):
+        op.pre_filter(pods["race2"][0])
+
+
+@pytest.mark.parametrize("scorer", ["oracle", "serial"])
+def test_permit_gang_accounting(scorer):
+    op, cache, _, pods = build_race(scorer)
+    group = pods["race1"]
+    for i, pod in enumerate(group[:4]):
+        out = op.permit(pod, "n1")
+        assert not out.ready
+        assert isinstance(out.error, errs.WaitingError)
+        assert len(cache.get("default/race1").matched_pod_nodes.items()) == i + 1
+    out = op.permit(group[4], "n1")
+    assert out.ready and out.error is None
+    # phase advanced to PreScheduling on first permit
+    assert cache.get("default/race1").pod_group.status.phase == PodGroupPhase.PRE_SCHEDULING
+
+
+def test_permit_same_pod_name_new_uid_replaces_stale_entry():
+    op, cache, _, pods = build_race("oracle")
+    pod = pods["race1"][0]
+    op.permit(pod, "n1")
+    recreated = make_pod(pod.metadata.name, group="race1", requests={"cpu": "1"})
+    op.permit(recreated, "n1")
+    pgs = cache.get("default/race1")
+    matched = pgs.matched_pod_nodes.items()
+    assert recreated.metadata.uid in matched
+    assert pod.metadata.uid not in matched
+
+
+def test_permit_non_group_pod_not_matched():
+    op, _, _, _ = build_race("oracle")
+    out = op.permit(make_pod("lonely", requests={"cpu": "1"}), "n1")
+    assert out.ready and isinstance(out.error, errs.NotMatchedError)
+
+
+def test_prefilter_unknown_group_fails():
+    op, _, _, _ = build_race("oracle")
+    stray = make_pod("stray", group="nope", requests={"cpu": "1"})
+    with pytest.raises(errs.PodGroupNotFoundError):
+        op.pre_filter(stray)
+
+
+def test_prefilter_last_permitted_fast_path():
+    op, _, _, pods = build_race("oracle")
+    pod = pods["race1"][0]
+    op.last_permitted_pod.set(pod.metadata.uid, "")
+    op.pre_filter(pod)  # passes without consulting the oracle
+    assert op.oracle.batches_run == 0
+
+
+def test_occupied_by_fencing():
+    op, cache, _, _ = build_race("oracle")
+    owner_a = make_pod("a-0", group="race1", requests={"cpu": "1"}, owner_refs=["rs-a"])
+    op.pre_filter(owner_a)
+    assert cache.get("default/race1").pod_group.status.occupied_by == "rs-a"
+    owner_b = make_pod("b-0", group="race1", requests={"cpu": "1"}, owner_refs=["rs-b"])
+    with pytest.raises(errs.OccupiedError):
+        op.pre_filter(owner_b)
+    # same owner is fine
+    op.pre_filter(make_pod("a-1", group="race1", requests={"cpu": "1"}, owner_refs=["rs-a"]))
+
+
+def test_post_bind_phase_transitions():
+    op, cache, cluster, pods = build_race("oracle")
+    group = pods["race1"]
+    for pod in group[:4]:
+        op.post_bind(pod, "n1")
+    pg = cache.get("default/race1").pod_group
+    assert pg.status.phase == PodGroupPhase.SCHEDULING
+    assert pg.status.scheduled == 4
+    assert pg.status.schedule_start_time > 0
+    op.post_bind(group[4], "n1")
+    assert pg.status.phase == PodGroupPhase.SCHEDULED
+    assert pg.status.scheduled == 5
+
+
+def test_filter_oracle_rejects_full_node():
+    node_small = make_node("small", {"cpu": "1", "pods": "10"})
+    node_big = make_node("big", {"cpu": "8", "pods": "10"})
+    cluster = FakeCluster([node_small, node_big])
+    cache = PGStatusCache()
+    pg = make_group("g", 2)
+    members = [make_pod(f"g-{i}", group="g", requests={"cpu": "2"}) for i in range(2)]
+    status_for(pg, cache, rep_pod=members[0])
+    op = ScheduleOperation(cache, cluster, scorer="oracle")
+    op.filter(members[0], "big")
+    with pytest.raises(errs.ResourceNotEnoughError):
+        op.filter(members[1], "small")
+
+
+def test_preemption_policy():
+    op, cache, _, pods = build_race("oracle")
+    online = make_pod("web", requests={"cpu": "1"})
+    online2 = make_pod("web2", requests={"cpu": "1"})
+    offline1 = pods["race1"][0]
+    offline2 = pods["race2"][0]
+
+    # online preempts online: allowed
+    op.preempt_remove_pod(online, online2)
+    # offline preempts online: forbidden
+    with pytest.raises(errs.SchedulingError):
+        op.preempt_remove_pod(offline1, online)
+    # online preempts offline in a Pending gang: allowed
+    op.preempt_remove_pod(online, offline1)
+    # same gang: forbidden
+    with pytest.raises(errs.SchedulingError):
+        op.preempt_remove_pod(offline1, pods["race1"][1])
+    # offline preempts a different pending gang: allowed
+    op.preempt_remove_pod(offline1, offline2)
+    # victims of Scheduled/Running gangs are protected
+    cache.get("default/race2").pod_group.status.phase = PodGroupPhase.SCHEDULED
+    with pytest.raises(errs.SchedulingError):
+        op.preempt_remove_pod(offline1, offline2)
+
+
+def test_compare_queue_ordering():
+    cache = PGStatusCache()
+    cluster = FakeCluster([make_node("n", {"cpu": "8"})])
+    pg_old = make_group("alpha", 2, creation_ts=10.0)
+    pg_new = make_group("beta", 2, creation_ts=20.0)
+    lister = {("default", "alpha"): pg_old, ("default", "beta"): pg_new}
+    op = ScheduleOperation(
+        cache, cluster, scorer="serial",
+        pg_lister=lambda ns, name: lister.get((ns, name)),
+    )
+    pa = make_pod("pa", group="alpha", requests={"cpu": "1"})
+    pb = make_pod("pb", group="beta", requests={"cpu": "1"})
+    solo = make_pod("solo", requests={"cpu": "1"})
+    hi = make_pod("hi", requests={"cpu": "1"}, priority=100)
+
+    assert op.compare(hi, 5.0, pa, 1.0)          # priority wins
+    assert op.compare(solo, 2.0, pa, 1.0)        # non-group beats group at equal prio
+    assert not op.compare(pa, 1.0, solo, 2.0)
+    assert op.compare(pa, 9.0, pb, 1.0)          # earlier group creation wins
+    assert not op.compare(pb, 1.0, pa, 9.0)
+    pa2 = make_pod("pa2", group="alpha", requests={"cpu": "1"})
+    assert op.compare(pa, 1.0, pa2, 2.0)         # same group: queue timestamp

@@ -1,0 +1,185 @@
+"""Oracle kernel tests: exactness of the device math and parity between the
+batched TPU path and the serial reference-parity path."""
+
+import numpy as np
+
+from batch_scheduler_tpu.cache import PGStatusCache
+from batch_scheduler_tpu.core.resources import find_max_group_serial
+from batch_scheduler_tpu.ops import (
+    ClusterSnapshot,
+    GroupDemand,
+    LaneSchema,
+    assign_gangs,
+    bucket_size,
+    find_max_group,
+    gang_feasible,
+    group_capacity,
+    left_resources,
+    schedule_batch,
+)
+
+from helpers import make_group, make_node, make_pod, status_for
+
+
+def test_bucket_sizes():
+    assert bucket_size(1) == 8
+    assert bucket_size(8) == 8
+    assert bucket_size(9) == 16
+    assert bucket_size(5000) == 8192
+
+
+def test_lane_schema_packing():
+    schema = LaneSchema.collect([{"cpu": 1000, "nvidia.com/gpu": 2}])
+    assert schema.names == ("cpu", "memory", "ephemeral-storage", "pods", "nvidia.com/gpu")
+    vec = schema.pack({"cpu": 1500, "memory": 3 * 1024, "nvidia.com/gpu": 2})
+    assert vec.tolist() == [1500, 3, 0, 0, 2]  # memory ceil'd to KiB
+    cap = schema.pack({"memory": 1024 + 1}, capacity=True)
+    assert cap[1] == 1  # capacity floors
+
+
+def test_left_resources_percent_exact():
+    alloc = np.array([[8000, 1000000, 0, 100]], dtype=np.int32)
+    req = np.array([[900, 0, 0, 1]], dtype=np.int32)
+    out = np.asarray(left_resources(alloc, req, 7, 10))
+    # floor(0.7 * alloc) - requested, exactly
+    assert out.tolist() == [[4700, 700000, 0, 69]]
+
+
+def test_group_capacity_and_feasibility():
+    # one node with 7 cpu free, group members need 1 cpu + 1 pod slot
+    left = np.array([[7000, 10**6, 10**6, 50]], dtype=np.int32)
+    group_req = np.array([[1000, 0, 0, 1], [2000, 0, 0, 1]], dtype=np.int32)
+    fit = np.ones((2, 1), dtype=bool)
+    cap = np.asarray(group_capacity(left, group_req, fit))
+    assert cap.tolist() == [[7], [3]]
+    ok = np.asarray(
+        gang_feasible(cap, np.array([5, 4], np.int32), np.array([True, True]))
+    )
+    assert ok.tolist() == [True, False]
+
+
+def test_gang_race_exactly_one_group_wins():
+    """The README race scenario at the oracle level: ~7 free cpus, two
+    5-member gangs of 1cpu pods — exactly one gang places."""
+    left = np.array([[7100, 10**6, 10**6, 50]], dtype=np.int32)
+    group_req = np.array([[1000, 0, 0, 1], [1000, 0, 0, 1]], dtype=np.int32)
+    remaining = np.array([5, 5], dtype=np.int32)
+    fit = np.ones((2, 1), dtype=bool)
+    order = np.array([0, 1], dtype=np.int32)
+    alloc, placed, left_after = assign_gangs(left, group_req, remaining, fit, order)
+    assert np.asarray(placed).tolist() == [True, False]
+    assert np.asarray(alloc).sum() == 5
+    assert np.asarray(left_after)[0, 0] == 7100 - 5000
+
+
+def test_assign_gangs_best_fit_prefers_tight_nodes():
+    # two nodes: 2-cap and 10-cap; 2-member gang should pack the tight node
+    left = np.array([[2000, 0, 0, 10], [10000, 0, 0, 10]], dtype=np.int32)
+    group_req = np.array([[1000, 0, 0, 1]], dtype=np.int32)
+    alloc, placed, _ = assign_gangs(
+        left, group_req, np.array([2], np.int32),
+        np.ones((1, 2), bool), np.array([0], np.int32),
+    )
+    assert np.asarray(placed).tolist() == [True]
+    assert np.asarray(alloc).tolist() == [[2, 0]]
+
+
+def test_assign_gangs_spills_across_nodes():
+    left = np.array([[3000, 0, 0, 10], [3000, 0, 0, 10]], dtype=np.int32)
+    group_req = np.array([[1000, 0, 0, 1]], dtype=np.int32)
+    alloc, placed, _ = assign_gangs(
+        left, group_req, np.array([5], np.int32),
+        np.ones((1, 2), bool), np.array([0], np.int32),
+    )
+    assert np.asarray(placed).tolist() == [True]
+    assert sorted(np.asarray(alloc)[0].tolist()) == [2, 3]
+
+
+def test_priority_order_controls_reservation():
+    # capacity 5; group B first in order takes it even though A is feasible alone
+    left = np.array([[5000, 0, 0, 10]], dtype=np.int32)
+    group_req = np.array([[1000, 0, 0, 1], [1000, 0, 0, 1]], dtype=np.int32)
+    remaining = np.array([5, 5], dtype=np.int32)
+    fit = np.ones((2, 1), bool)
+    alloc, placed, _ = assign_gangs(
+        left, group_req, remaining, fit, np.array([1, 0], np.int32)
+    )
+    assert np.asarray(placed).tolist() == [False, True]
+
+
+def test_snapshot_padding_does_not_change_results():
+    nodes = [make_node(f"n{i}", {"cpu": "4", "memory": "8Gi", "pods": "10"}) for i in range(3)]
+    groups = [
+        GroupDemand("default/g1", 5, member_request={"cpu": 1000}),
+        GroupDemand("default/g2", 20, member_request={"cpu": 1000}),
+    ]
+    snap = ClusterSnapshot(nodes, {}, groups)
+    assert snap.alloc.shape[0] == 8 and snap.group_req.shape[0] == 8  # bucketed
+    out = schedule_batch(*snap.device_args())
+    feasible = np.asarray(out["gang_feasible"])
+    placed = np.asarray(out["placed"])
+    # 12 cpu total: g1 (5) fits, g2 (20) cannot
+    assert feasible[:2].tolist() == [True, False]
+    assert placed[:2].tolist() == [True, False]
+    # padded rows never report placement
+    assert not placed[2:].any()
+    assert not feasible[2:].any()
+
+
+def test_snapshot_fit_mask_selector():
+    nodes = [
+        make_node("a", {"cpu": "4", "pods": "10"}, labels={"zone": "east"}),
+        make_node("b", {"cpu": "4", "pods": "10"}, labels={"zone": "west"}),
+    ]
+    groups = [
+        GroupDemand(
+            "default/g", 2, member_request={"cpu": 1000},
+            node_selector={"zone": "east"},
+        )
+    ]
+    snap = ClusterSnapshot(nodes, {}, groups)
+    assert snap.fit_mask[0, :2].tolist() == [True, False]
+    out = schedule_batch(*snap.device_args())
+    alloc = np.asarray(out["assignment"])
+    assert alloc[0, 0] == 2 and alloc[0, 1] == 0
+
+
+def test_find_max_group_matches_serial():
+    cache = PGStatusCache()
+    specs = [("g1", 10, 2), ("g2", 10, 7), ("g3", 4, 1)]
+    for name, mm, scheduled in specs:
+        pg = make_group(name, mm)
+        pg.status.scheduled = scheduled
+        status_for(pg, cache, rep_pod=make_pod(f"{name}-p", group=name, requests={"cpu": "1"}))
+
+    serial_name, _, serial_progress = find_max_group_serial(cache.snapshot())
+    assert serial_name == "default/g2"  # 700/1000 progress
+
+    names = sorted(cache.snapshot())
+    statuses = [cache.get(n) for n in names]
+    min_member = np.array([s.pod_group.spec.min_member for s in statuses], np.int32)
+    scheduled = np.array([s.pod_group.status.scheduled for s in statuses], np.int32)
+    matched = np.zeros(len(names), np.int32)
+    ineligible = np.zeros(len(names), bool)
+    rank = np.arange(len(names), dtype=np.int32)
+    best, exists, progress = find_max_group(min_member, scheduled, matched, ineligible, rank)
+    assert bool(exists)
+    assert names[int(best)] == "default/g2"
+    assert int(np.asarray(progress)[int(best)]) == serial_progress
+
+
+def test_find_max_group_skips_released_and_podless():
+    min_member = np.array([4, 4], np.int32)
+    scheduled = np.array([2, 1], np.int32)
+    matched = np.zeros(2, np.int32)
+    ineligible = np.array([True, False])  # g0 released
+    best, exists, _ = find_max_group(
+        min_member, scheduled, matched, ineligible, np.arange(2, dtype=np.int32)
+    )
+    assert bool(exists) and int(best) == 1
+
+    none_eligible = np.array([True, True])
+    _, exists, _ = find_max_group(
+        min_member, scheduled, matched, none_eligible, np.arange(2, dtype=np.int32)
+    )
+    assert not bool(exists)

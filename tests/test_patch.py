@@ -1,0 +1,56 @@
+"""Merge-patch tests, modelled on the reference's table test
+(reference pkg/util/k8s_test.go:31-78)."""
+
+from batch_scheduler_tpu.utils.patch import apply_merge_patch, create_merge_patch
+
+
+def test_no_change_empty_patch():
+    doc = {"a": 1, "b": {"c": 2}}
+    assert create_merge_patch(doc, doc) == {}
+
+
+def test_scalar_change():
+    assert create_merge_patch({"phase": "Pending"}, {"phase": "Running"}) == {
+        "phase": "Running"
+    }
+
+
+def test_nested_status_change_only_diff():
+    original = {
+        "metadata": {"name": "g1"},
+        "status": {"phase": "Pending", "scheduled": 0},
+    }
+    modified = {
+        "metadata": {"name": "g1"},
+        "status": {"phase": "Scheduling", "scheduled": 3},
+    }
+    patch = create_merge_patch(original, modified)
+    assert patch == {"status": {"phase": "Scheduling", "scheduled": 3}}
+
+
+def test_removed_key_becomes_null():
+    patch = create_merge_patch({"a": 1, "b": 2}, {"a": 1})
+    assert patch == {"b": None}
+
+
+def test_added_key():
+    patch = create_merge_patch({"a": 1}, {"a": 1, "b": {"x": 5}})
+    assert patch == {"b": {"x": 5}}
+
+
+def test_lists_replaced_wholesale():
+    patch = create_merge_patch({"items": [1, 2]}, {"items": [1, 2, 3]})
+    assert patch == {"items": [1, 2, 3]}
+
+
+def test_apply_inverts_create():
+    original = {
+        "spec": {"minMember": 5},
+        "status": {"phase": "Pending", "scheduled": 0, "occupiedBy": "x"},
+    }
+    modified = {
+        "spec": {"minMember": 5},
+        "status": {"phase": "Scheduled", "scheduled": 5},
+    }
+    patch = create_merge_patch(original, modified)
+    assert apply_merge_patch(original, patch) == modified

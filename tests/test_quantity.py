@@ -1,0 +1,59 @@
+from fractions import Fraction
+
+import pytest
+
+from batch_scheduler_tpu.api.quantity import (
+    canonicalize,
+    format_quantity,
+    parse_quantity,
+    parse_resource_list,
+)
+
+
+def test_parse_plain_and_milli():
+    assert parse_quantity("1") == 1
+    assert parse_quantity("100m") == Fraction(1, 10)
+    assert parse_quantity("1.5") == Fraction(3, 2)
+
+
+def test_parse_binary_suffixes():
+    assert parse_quantity("1Ki") == 1024
+    assert parse_quantity("64Mi") == 64 * 1024**2
+    assert parse_quantity("2Gi") == 2 * 1024**3
+
+
+def test_parse_decimal_suffixes_and_exponent():
+    assert parse_quantity("2k") == 2000
+    assert parse_quantity("1M") == 10**6
+    assert parse_quantity("1e3") == 1000
+    assert parse_quantity("1.5G") == 1_500_000_000
+
+
+def test_parse_invalid():
+    for bad in ("", "abc", "1Q", "--3", "1..5"):
+        with pytest.raises(ValueError):
+            parse_quantity(bad)
+
+
+def test_canonicalize_cpu_millicores():
+    assert canonicalize("cpu", "1") == 1000
+    assert canonicalize("cpu", "250m") == 250
+    assert canonicalize("cpu", "1.5") == 1500
+
+
+def test_canonicalize_rounding_direction():
+    # requests round up, capacities round down
+    assert canonicalize("memory", "1.5", floor=False) == 2
+    assert canonicalize("memory", "1.5", floor=True) == 1
+    assert canonicalize("cpu", "1m") == 1
+
+
+def test_parse_resource_list():
+    rl = parse_resource_list({"cpu": "2", "memory": "1Gi", "nvidia.com/gpu": 4})
+    assert rl == {"cpu": 2000, "memory": 1024**3, "nvidia.com/gpu": 4}
+
+
+def test_format_roundtrip():
+    assert format_quantity("cpu", 1500) == "1500m"
+    assert format_quantity("cpu", 2000) == "2"
+    assert format_quantity("memory", 1024**3) == "1Gi"

