@@ -1,0 +1,205 @@
+"""In-memory API server: the durable-state store and watch hub.
+
+Plays the role the Kubernetes API server plays for the reference — the only
+durable state in the system (reference keeps all persistent state in CRD
+status patched over HTTPS; in-memory caches are rebuilt from informers,
+SURVEY.md §5 "Checkpoint/resume"). Objects are stored as plain dicts keyed
+by (kind, namespace, name); writers get JSON-merge-patch semantics; watchers
+get ordered ADDED/MODIFIED/DELETED events over thread-safe queues.
+
+The fake clientset for tests (reference pkg/generated/clientset/versioned/
+fake) is this same store with no external transport — see client.fake.
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api.serde import object_from_dict
+from ..api.types import to_dict
+from ..utils.patch import apply_merge_patch
+
+__all__ = ["APIServer", "WatchEvent", "NotFoundError", "ConflictError", "AlreadyExistsError"]
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class AlreadyExistsError(ValueError):
+    pass
+
+
+class ConflictError(ValueError):
+    pass
+
+
+class WatchEvent:
+    __slots__ = ("type", "kind", "obj")
+
+    ADDED = "ADDED"
+    MODIFIED = "MODIFIED"
+    DELETED = "DELETED"
+
+    def __init__(self, type_: str, kind: str, obj: dict):
+        self.type = type_
+        self.kind = kind
+        self.obj = obj
+
+    def object(self):
+        """Rehydrate the typed API object (deep copy; safe to mutate)."""
+        return object_from_dict(self.kind, copy.deepcopy(self.obj))
+
+
+class APIServer:
+    def __init__(self, clock: Callable[[], float] = time.time):
+        self._lock = threading.RLock()
+        self._clock = clock
+        # kind -> (namespace, name) -> dict
+        self._store: Dict[str, Dict[Tuple[str, str], dict]] = {}
+        self._rv = 0
+        self._watchers: Dict[str, List[queue.Queue]] = {}
+        self._crds: Dict[str, dict] = {}
+
+    # -- helpers -----------------------------------------------------------
+
+    def _kind_store(self, kind: str) -> Dict[Tuple[str, str], dict]:
+        return self._store.setdefault(kind, {})
+
+    def _notify(self, kind: str, event: WatchEvent) -> None:
+        for q in self._watchers.get(kind, []):
+            q.put(event)
+
+    @staticmethod
+    def _as_dict(obj) -> dict:
+        return obj if isinstance(obj, dict) else to_dict(obj)
+
+    # -- CRD registration (reference batchscheduler.go:416-436) -----------
+
+    def ensure_crd(self, name: str, spec: Optional[dict] = None) -> bool:
+        """Idempotent CRD create; returns True if newly created."""
+        with self._lock:
+            if name in self._crds:
+                return False
+            self._crds[name] = spec or {}
+            return True
+
+    def crds(self) -> List[str]:
+        with self._lock:
+            return list(self._crds)
+
+    # -- CRUD --------------------------------------------------------------
+
+    def create(self, kind: str, obj) -> dict:
+        d = copy.deepcopy(self._as_dict(obj))
+        meta = d.setdefault("metadata", {})
+        key = (meta.get("namespace", "default"), meta.get("name", ""))
+        with self._lock:
+            store = self._kind_store(kind)
+            if key in store:
+                raise AlreadyExistsError(f"{kind} {key[0]}/{key[1]} exists")
+            self._rv += 1
+            meta["resource_version"] = self._rv
+            if not meta.get("creation_timestamp"):
+                meta["creation_timestamp"] = self._clock()
+            store[key] = d
+            self._notify(kind, WatchEvent(WatchEvent.ADDED, kind, copy.deepcopy(d)))
+            return copy.deepcopy(d)
+
+    def get(self, kind: str, namespace: str, name: str) -> dict:
+        with self._lock:
+            obj = self._kind_store(kind).get((namespace, name))
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[dict]:
+        with self._lock:
+            out = []
+            for (ns, _), obj in self._kind_store(kind).items():
+                if namespace is not None and ns != namespace:
+                    continue
+                if label_selector:
+                    labels = (obj.get("metadata") or {}).get("labels") or {}
+                    if any(labels.get(k) != v for k, v in label_selector.items()):
+                        continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    def update(self, kind: str, obj) -> dict:
+        d = copy.deepcopy(self._as_dict(obj))
+        meta = d.setdefault("metadata", {})
+        key = (meta.get("namespace", "default"), meta.get("name", ""))
+        with self._lock:
+            store = self._kind_store(kind)
+            if key not in store:
+                raise NotFoundError(f"{kind} {key[0]}/{key[1]} not found")
+            self._rv += 1
+            meta["resource_version"] = self._rv
+            store[key] = d
+            self._notify(kind, WatchEvent(WatchEvent.MODIFIED, kind, copy.deepcopy(d)))
+            return copy.deepcopy(d)
+
+    def patch(self, kind: str, namespace: str, name: str, patch: dict) -> dict:
+        """RFC 7386 merge patch (the reference's only write verb for status,
+        e.g. core.go:351, controller.go:300)."""
+        with self._lock:
+            store = self._kind_store(kind)
+            key = (namespace, name)
+            if key not in store:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            merged = apply_merge_patch(store[key], patch)
+            self._rv += 1
+            merged.setdefault("metadata", {})["resource_version"] = self._rv
+            store[key] = merged
+            self._notify(
+                kind, WatchEvent(WatchEvent.MODIFIED, kind, copy.deepcopy(merged))
+            )
+            return copy.deepcopy(merged)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            store = self._kind_store(kind)
+            obj = store.pop((namespace, name), None)
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            self._notify(kind, WatchEvent(WatchEvent.DELETED, kind, obj))
+
+    def delete_collection(
+        self, kind: str, namespace: Optional[str] = None
+    ) -> int:
+        with self._lock:
+            store = self._kind_store(kind)
+            keys = [k for k in store if namespace is None or k[0] == namespace]
+            for k in keys:
+                obj = store.pop(k)
+                self._notify(kind, WatchEvent(WatchEvent.DELETED, kind, obj))
+            return len(keys)
+
+    # -- watch -------------------------------------------------------------
+
+    def watch(self, kind: str, *, replay: bool = True) -> "queue.Queue[WatchEvent]":
+        """Subscribe to a kind's event stream. With ``replay``, current
+        objects are delivered first as ADDED events (informer list+watch)."""
+        q: "queue.Queue[WatchEvent]" = queue.Queue()
+        with self._lock:
+            if replay:
+                for obj in self._kind_store(kind).values():
+                    q.put(WatchEvent(WatchEvent.ADDED, kind, copy.deepcopy(obj)))
+            self._watchers.setdefault(kind, []).append(q)
+        return q
+
+    def stop_watch(self, kind: str, q: queue.Queue) -> None:
+        with self._lock:
+            watchers = self._watchers.get(kind, [])
+            if q in watchers:
+                watchers.remove(q)

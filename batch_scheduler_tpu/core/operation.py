@@ -95,8 +95,8 @@ class ScheduleOperation:
         if self.oracle is not None:
             self.oracle.mark_dirty()
 
-    def _oracle_fresh(self) -> OracleScorer:
-        self.oracle.ensure_fresh(self.cluster, self.status_cache)
+    def _oracle_fresh(self, group: Optional[str] = None) -> OracleScorer:
+        self.oracle.ensure_fresh(self.cluster, self.status_cache, group)
         return self.oracle
 
     # ------------------------------------------------------------------
@@ -132,7 +132,7 @@ class ScheduleOperation:
     def _pre_filter_oracle(self, full_name: str, pgs: PodGroupMatchStatus) -> None:
         if pgs.scheduled:
             return  # gang already released; let its members through
-        oracle = self._oracle_fresh()
+        oracle = self._oracle_fresh(full_name)
         self.max_finished_pg = oracle.max_group()
         if oracle.placed(full_name):
             return
@@ -212,7 +212,7 @@ class ScheduleOperation:
     def _filter_oracle(
         self, full_name: str, pgs: PodGroupMatchStatus, pod: Pod, node_name: str
     ) -> None:
-        oracle = self._oracle_fresh()
+        oracle = self._oracle_fresh(full_name)
         if oracle.node_capacity(full_name, node_name) > 0:
             return
         raise errs.ResourceNotEnoughError(
@@ -464,8 +464,10 @@ class ScheduleOperation:
         refs = sorted(str(r) for r in pod.metadata.owner_references)
         if pgs.pod is None:
             pgs.pod = pod
+            self.mark_dirty()  # the group's demand row just became real
         if pgs.pod_group.spec.min_resources is None:
             pgs.pod_group.spec.min_resources = pod.resource_require()
+            self.mark_dirty()
         occupied = pgs.pod_group.status.occupied_by
         if not occupied:
             if refs:

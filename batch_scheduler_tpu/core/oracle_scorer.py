@@ -55,14 +55,40 @@ def demand_from_status(full_name: str, pgs: PodGroupMatchStatus) -> GroupDemand:
 
 class _BatchState:
     """One immutable (snapshot, results) pair, swapped in atomically so
-    concurrent readers never see a torn snapshot/result combination."""
+    concurrent readers never see a torn snapshot/result combination.
 
-    __slots__ = ("snapshot", "result", "max_group")
+    ``result`` holds only the O(G) host vectors; the big (G,N) tensors stay
+    on device in ``device_result`` and individual group rows are fetched
+    lazily (a row is KBs; the full tensor is ~100MB at 5k nodes and costs
+    ~10x the batch time to pull over the host link)."""
 
-    def __init__(self, snapshot: ClusterSnapshot, result: dict, max_group: str):
+    __slots__ = ("snapshot", "result", "max_group", "device_result", "_rows", "_rows_lock")
+
+    def __init__(
+        self,
+        snapshot: ClusterSnapshot,
+        result: dict,
+        max_group: str,
+        device_result: dict,
+    ):
         self.snapshot = snapshot
         self.result = result
         self.max_group = max_group
+        self.device_result = device_result
+        self._rows: Dict[tuple, np.ndarray] = {}
+        self._rows_lock = threading.Lock()
+
+    def row(self, kind: str, g: int) -> np.ndarray:
+        """Fetch (and cache) one group's row of a (G,N) device tensor."""
+        key = (kind, g)
+        with self._rows_lock:
+            cached = self._rows.get(key)
+        if cached is not None:
+            return cached
+        row = np.asarray(jax.device_get(self.device_result[kind][g]))
+        with self._rows_lock:
+            self._rows[key] = row
+        return row
 
 
 class OracleScorer:
@@ -72,6 +98,7 @@ class OracleScorer:
         self._dirty = True
         self._state: Optional[_BatchState] = None
         self._refresh_lock = threading.Lock()
+        self._cluster_version = None
         self.batches_run = 0
 
     def mark_dirty(self) -> None:
@@ -101,13 +128,14 @@ class OracleScorer:
             snap.ineligible,
             snap.creation_rank,
         )
+        # fetch only the O(G) vectors + compact assignment; (G,N) tensors
+        # stay on device for lazy row reads
         host = jax.device_get(
             {
                 "gang_feasible": out["gang_feasible"],
                 "placed": out["placed"],
-                "capacity": out["capacity"],
-                "scores": out["scores"],
-                "assignment": out["assignment"],
+                "assignment_nodes": out["assignment_nodes"],
+                "assignment_counts": out["assignment_counts"],
                 "best": best,
                 "best_exists": exists,
                 "progress": progress,
@@ -118,15 +146,36 @@ class OracleScorer:
             if bool(host["best_exists"]) and int(host["best"]) < len(snap.group_names)
             else ""
         )
-        self._state = _BatchState(snap, host, max_group)
+        device_result = {"capacity": out["capacity"], "scores": out["scores"]}
+        self._state = _BatchState(snap, host, max_group, device_result)
+        version_fn = getattr(cluster, "version", None)
+        self._cluster_version = version_fn() if callable(version_fn) else None
         self._dirty = False
         self.batches_run += 1
 
-    def ensure_fresh(self, cluster, status_cache: PGStatusCache) -> None:
-        if not self._dirty and self._state is not None:
-            return
+    def _stale(self, cluster) -> bool:
+        if self._dirty or self._state is None:
+            return True
+        version_fn = getattr(cluster, "version", None)
+        if callable(version_fn) and version_fn() != self._cluster_version:
+            return True
+        return False
+
+    def ensure_fresh(
+        self, cluster, status_cache: PGStatusCache, group: Optional[str] = None
+    ) -> None:
+        """Re-batch if dirty, the cluster changed, or ``group`` (a group the
+        caller is about to query) is missing from the cached snapshot —
+        newly created PodGroups must not be denied off a stale batch."""
+        if not self._stale(cluster):
+            state = self._state
+            if group is None or state.snapshot.group_index(group) is not None:
+                return
         with self._refresh_lock:
-            if self._dirty or self._state is None:
+            if self._stale(cluster) or (
+                group is not None
+                and self._state.snapshot.group_index(group) is None
+            ):
                 self.refresh(cluster, status_cache)
 
     # -- query API (host-side, post-batch) ---------------------------------
@@ -153,7 +202,7 @@ class OracleScorer:
         n = state.snapshot.node_index(node_name)
         if g is None or n is None:
             return 0
-        return int(state.result["capacity"][g, n])
+        return int(state.row("capacity", g)[n])
 
     def node_score(self, full_name: str, node_name: str) -> int:
         state = self._state
@@ -163,16 +212,22 @@ class OracleScorer:
         n = state.snapshot.node_index(node_name)
         if g is None or n is None:
             return -(2**30)
-        return int(state.result["scores"][g, n])
+        return int(state.row("scores", g)[n])
 
     def assignment(self, full_name: str) -> Dict[str, int]:
-        """node name -> member count placed there for this gang's batch plan."""
+        """node name -> member count placed there for this gang's batch plan
+        (from the compact top-K output; exact for gangs spanning <= K nodes)."""
         state = self._state
         g = state.snapshot.group_index(full_name) if state else None
         if g is None:
             return {}
-        row = state.result["assignment"][g]
         names = state.snapshot.node_names
-        return {
-            names[i]: int(row[i]) for i in np.nonzero(row[: len(names)])[0]
-        }
+        nodes_row = state.result["assignment_nodes"][g]
+        counts_row = state.result["assignment_counts"][g]
+        out: Dict[str, int] = {}
+        for idx, count in zip(nodes_row, counts_row):
+            if count <= 0:
+                continue
+            if idx < len(names):
+                out[names[int(idx)]] = int(count)
+        return out

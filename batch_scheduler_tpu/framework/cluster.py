@@ -1,0 +1,142 @@
+"""ClusterState: the scheduler's live view of nodes and pod placements.
+
+Plays the role of kube-scheduler's scheduler cache + snapshot shared lister
+(what the reference reads via frameworkHandler.SnapshotSharedLister(),
+core.go:437,567): nodes, per-node requested resources from bound pods, and
+*assumed* pods — pods the scheduler has decided to place but whose binds
+have not committed — so successive scheduling cycles see reserved capacity.
+
+Implements core.ClusterStateProvider, so both the serial scorer and the
+oracle snapshot pack straight from here.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..api.types import Node, Pod, PodPhase
+
+__all__ = ["ClusterState"]
+
+_TERMINAL = (PodPhase.SUCCEEDED, PodPhase.FAILED)
+
+
+class ClusterState:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._nodes: Dict[str, Node] = {}
+        # node -> pod uid -> canonical requested resources (incl. pod slot)
+        self._requested: Dict[str, Dict[str, Dict[str, int]]] = {}
+        # pod uid -> node, for pods assumed but not yet observed bound
+        self._assumed: Dict[str, str] = {}
+        self._pod_nodes: Dict[str, str] = {}
+        # bumped on every capacity-relevant change; the oracle scorer uses it
+        # to invalidate its batch without explicit mark_dirty plumbing
+        self._version = 0
+
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    # -- node lifecycle ----------------------------------------------------
+
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            self._nodes[node.metadata.name] = node
+            self._requested.setdefault(node.metadata.name, {})
+            self._version += 1
+
+    def update_node(self, node: Node) -> None:
+        self.add_node(node)
+
+    def remove_node(self, name: str) -> None:
+        with self._lock:
+            self._nodes.pop(name, None)
+            self._requested.pop(name, None)
+            self._version += 1
+
+    # -- pod lifecycle -----------------------------------------------------
+
+    @staticmethod
+    def _require(pod: Pod) -> Dict[str, int]:
+        req = dict(pod.resource_require())
+        req["pods"] = req.get("pods", 0) + 1
+        return req
+
+    def assume(self, pod: Pod, node_name: str) -> None:
+        """Reserve the pod's resources on the node before bind commits."""
+        with self._lock:
+            uid = pod.metadata.uid
+            # a re-assume after a failed cycle must release the old node
+            prev = self._pod_nodes.get(uid)
+            if prev is not None and prev != node_name:
+                self._requested.get(prev, {}).pop(uid, None)
+            self._requested.setdefault(node_name, {})[uid] = self._require(pod)
+            self._assumed[uid] = node_name
+            self._pod_nodes[uid] = node_name
+            self._version += 1
+
+    def forget(self, pod_uid: str) -> None:
+        """Drop an assumed pod whose permit/bind failed."""
+        with self._lock:
+            node = self._assumed.pop(pod_uid, None)
+            if node is None:
+                return
+            self._pod_nodes.pop(pod_uid, None)
+            self._requested.get(node, {}).pop(pod_uid, None)
+            self._version += 1
+
+    def finish_binding(self, pod_uid: str) -> None:
+        with self._lock:
+            self._assumed.pop(pod_uid, None)
+
+    def observe_pod(self, pod: Pod) -> None:
+        """Apply an informer event for a pod: bound pods charge their node,
+        terminal pods release it."""
+        if not pod.spec.node_name:
+            return
+        with self._lock:
+            uid = pod.metadata.uid
+            node = pod.spec.node_name
+            if pod.status.phase in _TERMINAL:
+                self._requested.get(node, {}).pop(uid, None)
+                self._pod_nodes.pop(uid, None)
+                self._assumed.pop(uid, None)
+                self._version += 1
+                return
+            self._requested.setdefault(node, {})[uid] = self._require(pod)
+            self._pod_nodes[uid] = node
+            self._assumed.pop(uid, None)
+            self._version += 1
+
+    def remove_pod(self, pod: Pod) -> None:
+        with self._lock:
+            uid = pod.metadata.uid
+            node = self._pod_nodes.pop(uid, None)
+            self._assumed.pop(uid, None)
+            if node is not None:
+                self._requested.get(node, {}).pop(uid, None)
+                self._version += 1
+
+    # -- ClusterStateProvider ---------------------------------------------
+
+    def list_nodes(self) -> List[Node]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def node_requested(self, node_name: str) -> Dict[str, int]:
+        with self._lock:
+            total: Dict[str, int] = {}
+            for req in self._requested.get(node_name, {}).values():
+                for k, v in req.items():
+                    total[k] = total.get(k, 0) + v
+            return total
+
+    def get_node(self, name: str) -> Optional[Node]:
+        with self._lock:
+            return self._nodes.get(name)
+
+    def pod_count(self, node_name: str) -> int:
+        with self._lock:
+            return len(self._requested.get(node_name, {}))

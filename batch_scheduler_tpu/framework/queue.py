@@ -1,0 +1,121 @@
+"""Priority scheduling queue with a pluggable queue-sort comparator.
+
+The active queue orders by the QueueSort plugin's Less (the reference's
+Compare chain: priority -> group creation time -> name -> pod timestamp,
+batchscheduler.go:214-216); unschedulable pods re-enter after per-pod
+exponential backoff, promoted by a flusher thread.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Callable, Optional
+
+from .types import PodInfo
+
+__all__ = ["SchedulingQueue"]
+
+LessFn = Callable[[PodInfo, PodInfo], bool]
+
+
+class _Entry:
+    __slots__ = ("info", "less")
+
+    def __init__(self, info: PodInfo, less: LessFn):
+        self.info = info
+        self.less = less
+
+    def __lt__(self, other: "_Entry") -> bool:
+        if self.less(self.info, other.info):
+            return True
+        if self.less(other.info, self.info):
+            return False
+        return self.info.seq < other.info.seq  # stable total order
+
+
+class SchedulingQueue:
+    def __init__(
+        self,
+        less_fn: Optional[LessFn] = None,
+        backoff_base: float = 1.0,
+        backoff_cap: float = 10.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._less = less_fn or (lambda a, b: a.timestamp < b.timestamp)
+        self._backoff_base = backoff_base
+        self._backoff_cap = backoff_cap
+        self._clock = clock
+        self._cond = threading.Condition()
+        self._active: list = []
+        self._backoff: list = []  # heap of (ready_at, seq, PodInfo)
+        self._closed = False
+        self._flusher = threading.Thread(
+            target=self._flush_loop, name="queue-backoff-flusher", daemon=True
+        )
+        self._flusher.start()
+
+    def push(self, info: PodInfo) -> None:
+        if not info.timestamp:
+            info.timestamp = self._clock()
+        with self._cond:
+            heapq.heappush(self._active, _Entry(info, self._less))
+            self._cond.notify()
+
+    def push_backoff(self, info: PodInfo) -> None:
+        """Re-queue an unschedulable pod after exponential backoff."""
+        info.attempts += 1
+        delay = min(
+            self._backoff_base * (2 ** (info.attempts - 1)), self._backoff_cap
+        )
+        with self._cond:
+            heapq.heappush(
+                self._backoff, (self._clock() + delay, info.seq, info)
+            )
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[PodInfo]:
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cond:
+            while not self._active:
+                if self._closed:
+                    return None
+                wait = None
+                if deadline is not None:
+                    wait = deadline - self._clock()
+                    if wait <= 0:
+                        return None
+                if self._backoff:
+                    due = self._backoff[0][0] - self._clock()
+                    wait = due if wait is None else min(wait, due)
+                if wait is not None and wait <= 0:
+                    self._promote_locked()
+                    continue
+                self._cond.wait(wait if wait is None else max(wait, 0.01))
+                self._promote_locked()
+            return heapq.heappop(self._active).info
+
+    def _promote_locked(self) -> None:
+        now = self._clock()
+        moved = False
+        while self._backoff and self._backoff[0][0] <= now:
+            _, _, info = heapq.heappop(self._backoff)
+            heapq.heappush(self._active, _Entry(info, self._less))
+            moved = True
+        if moved:
+            self._cond.notify_all()
+
+    def _flush_loop(self) -> None:
+        while not self._closed:
+            time.sleep(0.05)
+            with self._cond:
+                self._promote_locked()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._active) + len(self._backoff)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()

@@ -1,0 +1,243 @@
+"""The scheduling loop: pop -> prefilter -> feasible nodes -> score ->
+assume -> permit -> (wait) -> bind.
+
+This is the embedded mini-framework the plugin runs inside — the role
+upstream kube-scheduler plays for the reference (SURVEY.md §1 "control-flow
+relationship"). One scheduling cycle is single-threaded (the property the
+reference's cross-call maxPGStatus coupling relies on); permit waits are
+event-driven (no thread per waiting pod) and binds run on a small worker
+pool, mirroring the scheduling-cycle/binding-cycle split.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ..api.types import Pod, PodPhase
+from ..client.apiserver import NotFoundError
+from ..client.clientset import Clientset
+from ..core import resources as rmath
+from ..utils.errors import SchedulingError
+from .cluster import ClusterState
+from .queue import SchedulingQueue
+from .types import PodInfo, StatusCode
+from .waiting import ALLOW, WaitingPod, WaitingPods
+
+__all__ = ["Scheduler", "FrameworkHandle"]
+
+
+class FrameworkHandle:
+    """What plugins see of the framework (reference framework.FrameworkHandle):
+    waiting-pod access, the cluster snapshot provider, and the clientset."""
+
+    def __init__(
+        self, clientset: Clientset, cluster: ClusterState, waiting: WaitingPods
+    ):
+        self.clientset = clientset
+        self.cluster = cluster
+        self._waiting = waiting
+
+    def get_waiting_pod(self, uid: str) -> Optional[WaitingPod]:
+        return self._waiting.get(uid)
+
+    def iterate_over_waiting_pods(self, fn: Callable[[WaitingPod], None]) -> None:
+        self._waiting.iterate(fn)
+
+
+class Scheduler:
+    def __init__(
+        self,
+        clientset: Clientset,
+        cluster: ClusterState,
+        plugin=None,
+        plugin_factory=None,
+        bind_workers: int = 4,
+        backoff_base: float = 1.0,
+        backoff_cap: float = 10.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.clientset = clientset
+        self.cluster = cluster
+        self._clock = clock
+        self.waiting = WaitingPods(clock)
+        self.handle = FrameworkHandle(clientset, cluster, self.waiting)
+        # plugins need the handle at construction (reference New() receives
+        # the FrameworkHandle); plugin_factory resolves the cycle
+        self.plugin = plugin_factory(self.handle) if plugin_factory else plugin
+        less = self.plugin.less if self.plugin is not None else None
+        self.queue = SchedulingQueue(less, backoff_base, backoff_cap, clock)
+        self._bind_workers = bind_workers
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        # counters for observability (SURVEY.md §5 build note)
+        self.stats = {
+            "scheduled": 0,
+            "unschedulable": 0,
+            "permit_waits": 0,
+            "permit_rejects": 0,
+            "binds": 0,
+            "cycles": 0,
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._threads = [
+            threading.Thread(target=self._loop, name="sched-cycle", daemon=True)
+        ]
+        for i in range(self._bind_workers):
+            self._threads.append(
+                threading.Thread(
+                    target=self._bind_worker, name=f"bind-{i}", daemon=True
+                )
+            )
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.close()
+        self.waiting.close()
+
+    # -- enqueue (wired to pod informer events) ---------------------------
+
+    def enqueue(self, pod: Pod) -> None:
+        if pod.spec.node_name or pod.status.phase != PodPhase.PENDING:
+            return
+        self.queue.push(PodInfo(pod=pod, timestamp=self._clock()))
+
+    # -- main cycle --------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            info = self.queue.pop(timeout=0.2)
+            if info is None:
+                continue
+            try:
+                self._schedule_one(info)
+            except Exception:
+                # a broken cycle must not kill the loop; release any
+                # capacity assumed mid-cycle, then retry the pod
+                self.cluster.forget(info.pod.metadata.uid)
+                if self.plugin is not None:
+                    self.plugin.mark_dirty()
+                self.queue.push_backoff(info)
+
+    def _schedule_one(self, info: PodInfo) -> None:
+        self.stats["cycles"] += 1
+        # refresh from the API server: the queued copy may be stale/deleted
+        try:
+            pod = self.clientset.pods(info.pod.metadata.namespace).get(
+                info.pod.metadata.name
+            )
+        except NotFoundError:
+            return
+        if pod.spec.node_name or pod.metadata.uid != info.pod.metadata.uid:
+            return
+        info.pod = pod
+
+        if self.plugin is not None:
+            try:
+                self.plugin.pre_filter(pod)
+            except SchedulingError as e:
+                self._unschedulable(info, str(e))
+                return
+
+        node_name = self._select_node(pod)
+        if node_name is None:
+            self._unschedulable(info, "no feasible node")
+            return
+
+        self.cluster.assume(pod, node_name)
+        if self.plugin is not None:
+            self.plugin.mark_dirty()
+
+        if self.plugin is None:
+            self._bind(pod, node_name)
+            return
+
+        code, timeout = self.plugin.permit(pod, node_name)
+        if code == StatusCode.SUCCESS:
+            self._bind(pod, node_name)
+        elif code == StatusCode.WAIT:
+            self.stats["permit_waits"] += 1
+            wp = WaitingPod(pod, node_name, self._clock() + timeout)
+            wp._info = info  # carried for requeue on reject/timeout
+            self.waiting.park(wp)
+        else:
+            self.cluster.forget(pod.metadata.uid)
+            self.plugin.mark_dirty()
+            self._unschedulable(info, "permit denied")
+
+    def _select_node(self, pod: Pod) -> Optional[str]:
+        """Generic resource/selector/taint fit + plugin Filter, then highest
+        plugin Score wins (kube-scheduler's filter/score phases)."""
+        require = dict(pod.resource_require())
+        require["pods"] = require.get("pods", 0) + 1
+        best_name, best_score = None, None
+        for node in self.cluster.list_nodes():
+            if node.spec.unschedulable:
+                continue
+            if not rmath.check_fit(pod, node):
+                continue
+            left = rmath.single_node_left(
+                node, self.cluster.node_requested(node.metadata.name), None
+            )
+            if not rmath.resource_satisfied(left, require):
+                continue
+            if self.plugin is not None:
+                try:
+                    self.plugin.filter(pod, node.metadata.name)
+                except SchedulingError:
+                    continue
+            score = (
+                self.plugin.score(pod, node.metadata.name)
+                if self.plugin is not None
+                else 0
+            )
+            if best_score is None or score > best_score:
+                best_name, best_score = node.metadata.name, score
+        return best_name
+
+    def _unschedulable(self, info: PodInfo, reason: str) -> None:
+        self.stats["unschedulable"] += 1
+        self.queue.push_backoff(info)
+
+    # -- binding cycle -----------------------------------------------------
+
+    def _bind_worker(self) -> None:
+        import queue as _q
+
+        while not self._stop.is_set():
+            try:
+                wp, outcome, message = self.waiting.resolved.get(timeout=0.2)
+            except _q.Empty:
+                continue
+            pod = wp.pod
+            if outcome == ALLOW:
+                self._bind(pod, wp.node_name)
+            else:
+                self.stats["permit_rejects"] += 1
+                self.cluster.forget(pod.metadata.uid)
+                if self.plugin is not None:
+                    self.plugin.mark_dirty()
+                info = getattr(wp, "_info", None) or PodInfo(pod=pod)
+                self.queue.push_backoff(info)
+
+    def _bind(self, pod: Pod, node_name: str) -> None:
+        try:
+            self.clientset.pods(pod.metadata.namespace).bind(
+                pod.metadata.name, node_name
+            )
+        except NotFoundError:
+            self.cluster.forget(pod.metadata.uid)
+            return
+        self.cluster.finish_binding(pod.metadata.uid)
+        self.stats["binds"] += 1
+        self.stats["scheduled"] += 1
+        if self.plugin is not None:
+            pod.spec.node_name = node_name
+            self.plugin.post_bind(pod, node_name)
+            self.plugin.mark_dirty()

@@ -1,0 +1,43 @@
+"""Scheduling-framework data types: pod queue entries and cycle statuses.
+
+Shapes mirror the k8s scheduler framework v1alpha1 surface the reference
+plugs into (PodInfo with queue timestamp, Status codes Success/
+Unschedulable/Wait/Error) without depending on it.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+from dataclasses import dataclass, field
+
+from ..api.types import Pod
+
+__all__ = ["PodInfo", "StatusCode", "CycleStatus"]
+
+_seq = itertools.count(1)
+
+
+@dataclass
+class PodInfo:
+    pod: Pod
+    timestamp: float = 0.0
+    attempts: int = 0
+    # Monotonic tiebreak so heap ordering is total even when Less() says
+    # neither pod precedes the other.
+    seq: int = field(default_factory=lambda: next(_seq))
+
+
+class StatusCode(enum.Enum):
+    SUCCESS = "Success"
+    UNSCHEDULABLE = "Unschedulable"
+    WAIT = "Wait"
+    ERROR = "Error"
+
+
+@dataclass
+class CycleStatus:
+    code: StatusCode
+    message: str = ""
+    # for WAIT: permit timeout in seconds
+    timeout: float = 0.0

@@ -182,6 +182,12 @@ def assign_gangs(left0, group_req, remaining, fit_mask, order):
     return alloc, placed, left
 
 
+# Max distinct nodes one gang's compact assignment can report; a gang of M
+# members spans <= M nodes, so this only truncates gangs wider than 128
+# nodes (the dense `assignment` matrix remains authoritative on device).
+ASSIGNMENT_TOP_K = 128
+
+
 @jax.jit
 def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
                    group_valid, order):
@@ -190,6 +196,12 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
 
     This is the ``fit()`` of SURVEY.md §7: everything the control plane needs
     for one scheduling batch in a single device round-trip.
+
+    Output discipline: the (G,N) tensors (capacity/scores/assignment) are
+    BIG — fetching them over the host link costs more than computing them
+    (measured ~10x the batch time at 5k nodes). Hosts should fetch only the
+    O(G) vectors plus the compact top-K assignment, and pull individual
+    (G,·) rows on demand (see core.oracle_scorer).
     """
     left = left_resources(alloc_lanes, requested)
     cap = group_capacity(left, group_req, fit_mask)
@@ -199,12 +211,16 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
         left, group_req, remaining, fit_mask, order
     )
     placed = placed & group_valid
+    k = min(ASSIGNMENT_TOP_K, assignment.shape[1])
+    assign_counts, assign_nodes = jax.lax.top_k(assignment, k)
     return {
         "left": left,
         "capacity": cap,
         "gang_feasible": feasible,
         "scores": scores,
         "assignment": assignment,
+        "assignment_nodes": assign_nodes,
+        "assignment_counts": assign_counts,
         "placed": placed,
         "left_after": left_after,
     }

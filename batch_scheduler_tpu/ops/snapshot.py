@@ -52,7 +52,11 @@ class GroupDemand:
 
     @property
     def remaining(self) -> int:
-        return max(self.min_member - self.scheduled, 0)
+        """Members still needing placement. Matched (permitted-but-unbound)
+        pods are excluded: the framework has already *assumed* them onto
+        nodes, so their capacity is out of the leftover lanes — counting
+        them here too would double-charge the gang and starve its own tail."""
+        return max(self.min_member - self.scheduled - self.matched, 0)
 
 
 def node_requested_from_pods(pods: Sequence[Pod]) -> Dict[str, int]:

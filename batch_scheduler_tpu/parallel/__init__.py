@@ -1,0 +1,3 @@
+from .mesh import make_mesh, shard_snapshot_args, sharded_schedule_batch
+
+__all__ = ["make_mesh", "shard_snapshot_args", "sharded_schedule_batch"]

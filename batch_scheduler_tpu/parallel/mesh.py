@@ -1,0 +1,93 @@
+"""Device mesh + sharded oracle execution.
+
+Scaling model (SURVEY.md §2 "Parallelism strategies"): the scaling axis of
+this domain is cluster size, not sequence length — the (groups × nodes)
+feasibility/score tensors are sharded over a 2-D ``("groups", "nodes")``
+mesh, with XLA inserting the ICI collectives (psum for node-axis reductions,
+all-gathers for the assignment scan) under GSPMD. TP/PP/SP/EP/ring-attention
+are intentionally out of scope: no sequence dimension exists (SURVEY.md §5
+"Long-context").
+
+On one host this runs over the virtual CPU device mesh in tests and the
+single TPU chip in prod; on a v5e pod slice the same code spans chips over
+ICI — ``jax.sharding.Mesh`` is the only multi-chip abstraction used.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import oracle as okern
+
+__all__ = ["make_mesh", "shard_snapshot_args", "sharded_schedule_batch"]
+
+
+def _factor_devices(n: int) -> tuple:
+    """Split n devices into a (groups, nodes) grid, nodes-major — node-axis
+    parallelism carries the heavy lanes (N is the big dimension)."""
+    g = int(math.isqrt(n))
+    while g > 1 and n % g != 0:
+        g -= 1
+    return (g, n // g)
+
+
+def make_mesh(
+    n_devices: Optional[int] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    grid = _factor_devices(len(devs))
+    return Mesh(np.asarray(devs).reshape(grid), axis_names=("groups", "nodes"))
+
+
+def shard_snapshot_args(mesh: Mesh, args: tuple) -> tuple:
+    """Place ClusterSnapshot.device_args() onto the mesh.
+
+    Layout: node-major arrays split over "nodes"; group-major over "groups";
+    the (G, N) fit mask over both; the scan order replicated.
+    """
+    (alloc, requested, group_req, remaining, fit_mask, group_valid, order) = args
+    spec = {
+        "alloc": P("nodes", None),
+        "requested": P("nodes", None),
+        "group_req": P("groups", None),
+        "remaining": P("groups"),
+        "fit_mask": P("groups", "nodes"),
+        "group_valid": P("groups"),
+        "order": P(),
+    }
+    named = dict(
+        alloc=alloc,
+        requested=requested,
+        group_req=group_req,
+        remaining=remaining,
+        fit_mask=fit_mask,
+        group_valid=group_valid,
+        order=order,
+    )
+    placed = {
+        k: jax.device_put(v, NamedSharding(mesh, spec[k]))
+        for k, v in named.items()
+    }
+    return (
+        placed["alloc"],
+        placed["requested"],
+        placed["group_req"],
+        placed["remaining"],
+        placed["fit_mask"],
+        placed["group_valid"],
+        placed["order"],
+    )
+
+
+def sharded_schedule_batch(mesh: Mesh, args: tuple):
+    """One fused oracle batch with inputs sharded over the mesh; XLA/GSPMD
+    partitions the kernels and inserts the cross-chip collectives."""
+    sharded = shard_snapshot_args(mesh, args)
+    return okern.schedule_batch(*sharded)

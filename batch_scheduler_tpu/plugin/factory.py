@@ -1,0 +1,170 @@
+"""Plugin factory: assembles the whole control plane around a framework
+handle — the equivalent of the reference's ``New`` registration entry point
+(reference pkg/scheduler/batch/batchscheduler.go:377-448 and
+cmd/scheduler/main.go:28-36).
+
+Wiring order mirrors the reference: clientset -> informers -> status cache
+-> ScheduleOperation (with the ``scorer`` gate, the north star's
+``--scorer=tpu`` flag) -> CRD auto-create -> ReconcileStatus thread ->
+controller -> leader-gated controller runner.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api import register
+from ..cache.pg_cache import PGStatusCache
+from ..client.apiserver import APIServer
+from ..client.clientset import Clientset
+from ..client.informers import SharedInformerFactory
+from ..controller.controller import PodGroupController
+from ..core.operation import ScheduleOperation
+from .batch_plugin import BatchSchedulingPlugin
+from .leader import InMemoryLease, try_run_controller
+
+__all__ = ["PluginConfig", "PluginRuntime", "new_plugin_runtime"]
+
+
+@dataclass
+class PluginConfig:
+    """Plugin args (reference Configuration, batchscheduler.go:71-75).
+    ``max_schedule_minutes`` keeps the reference's minutes interpretation
+    (batchscheduler.go:406)."""
+
+    max_schedule_minutes: Optional[float] = None
+    # "oracle" = the TPU-batched scorer (the --scorer=tpu gate);
+    # "serial" = the reference-parity in-process path.
+    scorer: str = "oracle"
+    controller_workers: int = 10
+    leader_poll_seconds: float = 1.0
+    controller_resync_seconds: float = 0.5
+    identity: str = field(default_factory=socket.gethostname)
+
+    @property
+    def max_schedule_seconds(self) -> Optional[float]:
+        if self.max_schedule_minutes is None:
+            return None
+        return self.max_schedule_minutes * 60.0
+
+
+class PluginRuntime:
+    """Everything the factory assembled; owns background thread lifecycle."""
+
+    def __init__(self, plugin, controller, lease, config, informers, operation):
+        self.plugin = plugin
+        self.controller = controller
+        self.lease = lease
+        self.config = config
+        self.informers = informers
+        self.operation = operation
+        self._stop = threading.Event()
+        self._leader_thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self.informers.start()
+        self.plugin.start()
+        # leader-election heartbeat: keep trying to hold (or take over) the
+        # lease — the role upstream kube-scheduler's election loop plays for
+        # the reference
+        self._renew_thread = threading.Thread(
+            target=self._renew_loop, name="lease-renew", daemon=True
+        )
+        self._renew_thread.start()
+        self._leader_thread = threading.Thread(
+            target=try_run_controller,
+            args=(
+                self.lease,
+                self.config.identity,
+                self.controller,
+                self.config.controller_workers,
+                self._stop,
+                self.config.leader_poll_seconds,
+            ),
+            name="leader-gate",
+            daemon=True,
+        )
+        self._leader_thread.start()
+
+    def _renew_loop(self) -> None:
+        while not self._stop.wait(3.0):
+            try:
+                self.lease.acquire(self.config.identity)
+            except Exception:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.plugin.stop()
+        self.controller.stop()
+        self.informers.stop()
+
+
+def new_plugin_runtime(
+    api: APIServer,
+    handle,
+    config: Optional[PluginConfig] = None,
+    lease=None,
+    clock=None,
+) -> PluginRuntime:
+    """Build plugin + controller + leader gate over an API server and a
+    framework handle. ``handle.cluster`` is the snapshot provider."""
+    config = config or PluginConfig()
+    pg_client = Clientset(api)
+
+    informers = SharedInformerFactory(api)
+    pg_informer = informers.pod_groups()
+    lister = informers.pod_group_lister()
+
+    pg_cache = PGStatusCache()
+
+    kwargs = {} if clock is None else {"clock": clock}
+    operation = ScheduleOperation(
+        status_cache=pg_cache,
+        cluster=handle.cluster,
+        pg_client=pg_client,
+        max_schedule_seconds=config.max_schedule_seconds,
+        pg_lister=lambda ns, name: lister.pod_groups(ns).get(name),
+        scorer=config.scorer,
+        **kwargs,
+    )
+
+    plugin = BatchSchedulingPlugin(
+        handle=handle,
+        operation=operation,
+        pg_client=pg_client,
+        max_schedule_seconds=config.max_schedule_seconds,
+    )
+
+    # CRD auto-create, ignoring AlreadyExists (reference :416-436)
+    api.ensure_crd(
+        register.CRD_NAME,
+        {
+            "group": register.GROUP_NAME,
+            "version": register.VERSION,
+            "kind": register.KIND_POD_GROUP,
+            "plural": register.PLURAL_POD_GROUPS,
+            "short_names": list(register.SHORT_NAMES),
+            "scope": "Namespaced",
+        },
+    )
+
+    controller = PodGroupController(
+        client=pg_client,
+        pg_informer=pg_informer,
+        pg_cache=pg_cache,
+        reject_pod=plugin.reject_pod,
+        add_to_backoff=operation.add_to_deny_cache,
+        max_schedule_seconds=config.max_schedule_seconds,
+        resync_seconds=config.controller_resync_seconds,
+        **kwargs,
+    )
+
+    if lease is None:
+        lease = InMemoryLease()
+        lease.acquire(config.identity)  # single-replica default: we lead
+
+    return PluginRuntime(plugin, controller, lease, config, informers, operation)

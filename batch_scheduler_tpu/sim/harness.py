@@ -1,0 +1,173 @@
+"""SimCluster: the full framework wired over a simulated cluster.
+
+Composes API server + informers + ClusterState + Scheduler + plugin runtime
+(operation/controller/leader gate) + SimKubelet into one in-process system —
+the test/bench harness standing in for a real Kubernetes deployment, sized
+for anything from the README race demo to 10k pods / 5k nodes.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..api.types import Node, Pod, PodGroup, PodGroupPhase, PodPhase
+from ..client.apiserver import APIServer
+from ..client.clientset import Clientset
+from ..client.informers import SharedInformerFactory
+from ..framework.cluster import ClusterState
+from ..framework.scheduler import Scheduler
+from ..plugin.factory import PluginConfig, new_plugin_runtime
+from ..utils.labels import POD_GROUP_LABEL
+from .kubelet import SimKubelet
+
+__all__ = ["SimCluster"]
+
+
+class SimCluster:
+    def __init__(
+        self,
+        scorer: str = "oracle",
+        max_schedule_minutes: Optional[float] = None,
+        kubelet_start_delay: float = 0.02,
+        kubelet_run_duration: Optional[float] = None,
+        fail_pod: Optional[Callable[[str], bool]] = None,
+        bind_workers: int = 8,
+        backoff_base: float = 0.2,
+        backoff_cap: float = 2.0,
+        controller_resync_seconds: float = 0.1,
+    ):
+        self.api = APIServer()
+        self.clientset = Clientset(self.api)
+        self.cluster = ClusterState()
+
+        config = PluginConfig(
+            scorer=scorer,
+            max_schedule_minutes=max_schedule_minutes,
+            controller_resync_seconds=controller_resync_seconds,
+        )
+        self.runtime = None
+
+        def plugin_factory(handle):
+            self.runtime = new_plugin_runtime(self.api, handle, config)
+            return self.runtime.plugin
+
+        self.scheduler = Scheduler(
+            self.clientset,
+            self.cluster,
+            plugin_factory=plugin_factory,
+            bind_workers=bind_workers,
+            backoff_base=backoff_base,
+            backoff_cap=backoff_cap,
+        )
+        self.kubelet = SimKubelet(
+            self.api,
+            start_delay=kubelet_start_delay,
+            run_duration=kubelet_run_duration,
+            fail_pod=fail_pod,
+        )
+
+        # framework informers: nodes + pods feed ClusterState and the queue
+        self._fwk_informers = SharedInformerFactory(self.api)
+        self._fwk_informers.informer("Node").add_event_handler(
+            on_add=self.cluster.add_node,
+            on_update=lambda old, new: self.cluster.update_node(new),
+            on_delete=lambda n: self.cluster.remove_node(n.metadata.name),
+        )
+        self._fwk_informers.informer("Pod").add_event_handler(
+            on_add=self._pod_added,
+            on_update=lambda old, new: self.cluster.observe_pod(new),
+            on_delete=self.cluster.remove_pod,
+        )
+        self._started = False
+
+    def _pod_added(self, pod: Pod) -> None:
+        if pod.spec.node_name:
+            self.cluster.observe_pod(pod)
+        else:
+            self.scheduler.enqueue(pod)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        self._fwk_informers.start()
+        self.runtime.start()
+        self.kubelet.start()
+        self._fwk_informers.wait_for_cache_sync()
+        self.runtime.informers.wait_for_cache_sync()
+        self.scheduler.start()
+
+    def stop(self) -> None:
+        self.scheduler.stop()
+        self.kubelet.stop()
+        self.runtime.stop()
+        self._fwk_informers.stop()
+
+    # -- populate ----------------------------------------------------------
+
+    def add_nodes(self, nodes: List[Node]) -> None:
+        for node in nodes:
+            self.clientset.nodes().create(node)
+
+    def create_group(self, pg: PodGroup) -> PodGroup:
+        return self.clientset.podgroups(pg.metadata.namespace).create(pg)
+
+    def create_pods(self, pods: List[Pod]) -> None:
+        for pod in pods:
+            self.clientset.pods(pod.metadata.namespace).create(pod)
+
+    # -- observation -------------------------------------------------------
+
+    def group(self, name: str, namespace: str = "default") -> PodGroup:
+        return self.clientset.podgroups(namespace).get(name)
+
+    def group_phase(self, name: str, namespace: str = "default") -> PodGroupPhase:
+        return self.group(name, namespace).status.phase
+
+    def member_pods(self, group: str, namespace: str = "default") -> List[Pod]:
+        return self.clientset.pods(namespace).list(
+            label_selector={POD_GROUP_LABEL: group}
+        )
+
+    def member_phase_counts(self, group: str, namespace: str = "default") -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for pod in self.member_pods(group, namespace):
+            phase = pod.status.phase.value if pod.spec.node_name else "Unscheduled"
+            counts[phase] = counts.get(phase, 0) + 1
+        return counts
+
+    def wait_for(
+        self,
+        predicate: Callable[[], bool],
+        timeout: float = 15.0,
+        interval: float = 0.05,
+    ) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(interval)
+        return predicate()
+
+    def wait_for_group_phase(
+        self,
+        name: str,
+        phases,
+        timeout: float = 15.0,
+        namespace: str = "default",
+    ) -> bool:
+        if isinstance(phases, PodGroupPhase):
+            phases = (phases,)
+        return self.wait_for(
+            lambda: self.group_phase(name, namespace) in phases, timeout
+        )
+
+    def wait_for_bound(self, group: str, count: int, timeout: float = 15.0) -> bool:
+        return self.wait_for(
+            lambda: sum(1 for p in self.member_pods(group) if p.spec.node_name)
+            >= count,
+            timeout,
+        )

@@ -1,0 +1,114 @@
+"""SimKubelet: KWOK-style pod lifecycle simulation.
+
+The reference validates multi-node gang behaviour only manually against a
+real cluster (SURVEY.md §4); here a simulated kubelet drives bound pods
+through Pending -> Running (-> Succeeded/Failed) so the controller's phase
+machine and the gang timeout/abort paths run end-to-end in-process, at any
+cluster size — the KWOK harness the build plan calls for.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..api.types import PodPhase
+from ..client.apiserver import APIServer, NotFoundError, WatchEvent
+from ..client.clientset import Clientset
+
+__all__ = ["SimKubelet"]
+
+
+class SimKubelet:
+    def __init__(
+        self,
+        api: APIServer,
+        start_delay: float = 0.05,
+        run_duration: Optional[float] = None,
+        fail_pod: Optional[Callable[[str], bool]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        """``start_delay``: bind -> Running latency. ``run_duration``: if
+        set, Running -> Succeeded after this long. ``fail_pod``: fault
+        injection — pods whose "namespace/name" it accepts go to Failed
+        instead of Running."""
+        self.api = api
+        self.clientset = Clientset(api)
+        self.start_delay = start_delay
+        self.run_duration = run_duration
+        self.fail_pod = fail_pod
+        self._clock = clock
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._pending: list = []  # heap of (due, seq, ns, name, next_phase)
+        self._seq = 0
+        self._threads = []
+
+    def start(self) -> None:
+        self._events = self.api.watch("Pod", replay=True)
+        self._threads = [
+            threading.Thread(target=self._watch_loop, name="kubelet-watch", daemon=True),
+            threading.Thread(target=self._tick_loop, name="kubelet-tick", daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.api.stop_watch("Pod", self._events)
+
+    def _schedule_transition(self, ns: str, name: str, phase: PodPhase, delay: float) -> None:
+        with self._lock:
+            self._seq += 1
+            heapq.heappush(
+                self._pending, (self._clock() + delay, self._seq, ns, name, phase)
+            )
+
+    def _watch_loop(self) -> None:
+        import queue as _q
+
+        while not self._stop.is_set():
+            try:
+                event = self._events.get(timeout=0.1)
+            except _q.Empty:
+                continue
+            if event.type == WatchEvent.DELETED:
+                continue
+            obj = event.obj
+            spec = obj.get("spec") or {}
+            status = obj.get("status") or {}
+            if not spec.get("node_name"):
+                continue
+            if status.get("phase", "Pending") != "Pending":
+                continue
+            meta = obj.get("metadata") or {}
+            ns, name = meta.get("namespace", "default"), meta.get("name", "")
+            key = f"{ns}/{name}"
+            next_phase = (
+                PodPhase.FAILED
+                if self.fail_pod is not None and self.fail_pod(key)
+                else PodPhase.RUNNING
+            )
+            self._schedule_transition(ns, name, next_phase, self.start_delay)
+
+    def _tick_loop(self) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(0.02)
+            now = self._clock()
+            due = []
+            with self._lock:
+                while self._pending and self._pending[0][0] <= now:
+                    due.append(heapq.heappop(self._pending))
+            for _, _, ns, name, phase in due:
+                try:
+                    self.clientset.pods(ns).patch(
+                        name, {"status": {"phase": phase.value}}
+                    )
+                except NotFoundError:
+                    continue
+                if phase == PodPhase.RUNNING and self.run_duration is not None:
+                    self._schedule_transition(
+                        ns, name, PodPhase.SUCCEEDED, self.run_duration
+                    )

@@ -1,0 +1,161 @@
+"""Canonical simulated scenarios: the BASELINE.md measurement ladder.
+
+Scenario 1 reproduces the reference README's resource-race demo (two
+minMember=5 groups racing for ~7.1 free CPUs on one node: exactly one group
+schedules). The generators scale the same shape up to the 10k-pod / 5k-node
+north-star configs.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..api.types import (
+    Container,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodGroup,
+    PodGroupSpec,
+    PodSpec,
+    new_uid,
+)
+from ..api.quantity import parse_resource_list
+from ..utils.labels import POD_GROUP_LABEL
+
+__all__ = [
+    "make_sim_node",
+    "make_sim_group",
+    "make_member_pods",
+    "race_scenario",
+    "synthetic_cluster",
+]
+
+
+def make_sim_node(
+    name: str,
+    allocatable: Optional[Dict] = None,
+    labels: Optional[Dict] = None,
+) -> Node:
+    alloc = parse_resource_list(
+        allocatable or {"cpu": "32", "memory": "128Gi", "pods": 110}, floor=True
+    )
+    return Node(
+        metadata=ObjectMeta(name=name, uid=new_uid("node"), labels=labels or {}),
+        spec=NodeSpec(),
+        status=NodeStatus(allocatable=alloc, capacity=dict(alloc)),
+    )
+
+
+def make_sim_group(
+    name: str,
+    min_member: int,
+    namespace: str = "default",
+    max_schedule_time: Optional[float] = None,
+    creation_ts: float = 0.0,
+) -> PodGroup:
+    return PodGroup(
+        metadata=ObjectMeta(
+            name=name,
+            namespace=namespace,
+            uid=new_uid("pg"),
+            creation_timestamp=creation_ts,
+        ),
+        spec=PodGroupSpec(
+            min_member=min_member, max_schedule_time=max_schedule_time
+        ),
+    )
+
+
+def make_member_pods(
+    group: str,
+    count: int,
+    requests: Optional[Dict] = None,
+    namespace: str = "default",
+    priority: int = 0,
+) -> List[Pod]:
+    return [
+        Pod(
+            metadata=ObjectMeta(
+                name=f"{group}-{i}",
+                namespace=namespace,
+                uid=new_uid("pod"),
+                labels={POD_GROUP_LABEL: group},
+            ),
+            spec=PodSpec(
+                containers=[
+                    Container.from_raw(requests=requests or {"cpu": "1"})
+                ],
+                priority=priority,
+            ),
+        )
+        for i in range(count)
+    ]
+
+
+def race_scenario() -> Tuple[List[Node], List[PodGroup], Dict[str, List[Pod]]]:
+    """BASELINE config 1: one 8-cpu node with 0.9 cpu of system pods, two
+    minMember=5 gangs of 1-cpu pods (the reference README "Example")."""
+    node = make_sim_node("node-1", {"cpu": "8", "memory": "32Gi", "pods": "110"})
+    # wall-clock creation stamps (offset for deterministic ordering): the
+    # controller's 48h GC guard compares them against schedule_start_time
+    now = time.time()
+    groups = [
+        make_sim_group("web-group-race1", 5, creation_ts=now - 0.002),
+        make_sim_group("web-group-race2", 5, creation_ts=now - 0.001),
+    ]
+    pods = {
+        g.metadata.name: make_member_pods(g.metadata.name, 5, {"cpu": "1"})
+        for g in groups
+    }
+    return [node], groups, pods
+
+
+@dataclass
+class SyntheticSpec:
+    num_nodes: int
+    num_groups: int
+    members_per_group: int
+    node_shape: Dict = field(
+        default_factory=lambda: {"cpu": "64", "memory": "256Gi", "pods": "110"}
+    )
+    member_request: Dict = field(default_factory=lambda: {"cpu": "4", "memory": "8Gi"})
+    extended: Optional[Dict] = None  # e.g. {"nvidia.com/gpu": 8} per node
+    member_extended: Optional[Dict] = None  # e.g. {"nvidia.com/gpu": 1}
+    priority_classes: int = 1
+    seed: int = 0
+
+
+def synthetic_cluster(
+    spec: SyntheticSpec,
+) -> Tuple[List[Node], List[PodGroup], Dict[str, List[Pod]]]:
+    """Generator for BASELINE configs 2-5: N nodes, G gangs, mixed
+    priorities, optional extended resources."""
+    rng = random.Random(spec.seed)
+    node_shape = dict(spec.node_shape)
+    if spec.extended:
+        node_shape.update(spec.extended)
+    nodes = [
+        make_sim_node(f"node-{i:05d}", node_shape) for i in range(spec.num_nodes)
+    ]
+    member_request = dict(spec.member_request)
+    if spec.member_extended:
+        member_request.update(spec.member_extended)
+    groups, pods = [], {}
+    base_ts = time.time() - spec.num_groups * 1e-3
+    for g in range(spec.num_groups):
+        name = f"gang-{g:05d}"
+        prio = rng.randrange(spec.priority_classes) if spec.priority_classes > 1 else 0
+        pg = make_sim_group(
+            name, spec.members_per_group, creation_ts=base_ts + g * 1e-3
+        )
+        groups.append(pg)
+        pods[name] = make_member_pods(
+            name, spec.members_per_group, member_request, priority=prio
+        )
+    return nodes, groups, pods

@@ -1,0 +1,159 @@
+"""Benchmark: the BASELINE.md north-star config — gang-schedule a 10k-pod /
+5k-node simulated cluster in one oracle batch.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+value = end-to-end wall-clock of a full gang-admission batch (host pack +
+device scoring + greedy placement + fetch) on the default JAX platform (the
+real TPU chip under the driver). vs_baseline = speedup over the
+reference-equivalent serial PreFilter loop (findMaxPG + per-node cluster
+scan per pod, measured on a pod sample and scaled linearly — the
+reference's loop is O(pods) serial, reference
+pkg/scheduler/core/core.go:595-632,701-739).
+
+Run from the repo root (do NOT set PYTHONPATH: it breaks the axon TPU
+plugin; see .claude/skills/verify/SKILL.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+NUM_NODES = 5000
+NUM_GROUPS = 1000
+MEMBERS = 10  # 10k pods total
+SERIAL_SAMPLE_PODS = 10
+GPU = "nvidia.com/gpu"
+
+
+def build_inputs():
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot, GroupDemand
+    from batch_scheduler_tpu.sim.scenarios import make_sim_node
+
+    nodes = [
+        make_sim_node(
+            f"n{i:05d}",
+            {"cpu": "64", "memory": "256Gi", "pods": "110", GPU: "8"},
+        )
+        for i in range(NUM_NODES)
+    ]
+    groups = [
+        GroupDemand(
+            full_name=f"default/gang-{g:04d}",
+            min_member=MEMBERS,
+            member_request={
+                "cpu": 4000,
+                "memory": 8 * 1024**3,
+                GPU: 1,
+            },
+            creation_ts=float(g),
+        )
+        for g in range(NUM_GROUPS)
+    ]
+    return nodes, groups
+
+
+def bench_oracle(nodes, groups):
+    from batch_scheduler_tpu.ops.oracle import schedule_batch
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot
+
+    # warmup: compile for the bucketed shapes
+    warm = ClusterSnapshot(nodes, {}, groups)
+    out = schedule_batch(*warm.device_args())
+    jax.block_until_ready(out["placed"])
+
+    # timed: full end-to-end batch — host snapshot pack, device batch, fetch
+    t0 = time.perf_counter()
+    snap = ClusterSnapshot(nodes, {}, groups)
+    t_pack = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    out = schedule_batch(*snap.device_args())
+    # control-plane fetch: O(G) vectors + compact top-K assignment only;
+    # the (G,N) tensors stay on device for lazy row reads
+    host = jax.device_get(
+        {"placed": out["placed"], "gang_feasible": out["gang_feasible"],
+         "assignment_nodes": out["assignment_nodes"],
+         "assignment_counts": out["assignment_counts"]}
+    )
+    t_device = time.perf_counter() - t1
+    total = t_pack + t_device
+
+    placed = int(np.asarray(host["placed"]).sum())
+    # device-only re-run for steady-state batch latency (jit cache hot)
+    t2 = time.perf_counter()
+    out2 = schedule_batch(*snap.device_args())
+    jax.block_until_ready(out2["placed"])
+    t_steady = time.perf_counter() - t2
+    return {
+        "total_s": total,
+        "pack_s": t_pack,
+        "device_s": t_device,
+        "steady_batch_s": t_steady,
+        "gangs_placed": placed,
+    }
+
+
+def bench_serial(nodes, groups):
+    """Reference-equivalent serial PreFilter loop cost, per pod: findMaxPG
+    over all groups + running cluster-sum scan over all nodes."""
+    from batch_scheduler_tpu.core import resources as rmath
+
+    node_requested = {}
+    member_req = dict(groups[0].member_request)
+
+    def find_max_serial():
+        best, best_p = None, -1
+        for g in groups:
+            p = (g.matched + g.scheduled) * 1000 // max(g.min_member, 1)
+            if p > best_p:
+                best, best_p = g, p
+        return best
+
+    t0 = time.perf_counter()
+    for _ in range(SERIAL_SAMPLE_PODS):
+        find_max_serial()
+        prealloc = {k: v * MEMBERS for k, v in member_req.items()}
+        prealloc["pods"] = MEMBERS + 1
+        rmath.cluster_satisfies(nodes, node_requested, None, prealloc, (7, 10))
+    per_pod = (time.perf_counter() - t0) / SERIAL_SAMPLE_PODS
+    return {"per_pod_s": per_pod, "est_total_s": per_pod * NUM_GROUPS * MEMBERS}
+
+
+def main():
+    nodes, groups = build_inputs()
+    oracle = bench_oracle(nodes, groups)
+    serial = bench_serial(nodes, groups)
+
+    total_pods = NUM_GROUPS * MEMBERS
+    scored_per_sec = total_pods * NUM_NODES / max(oracle["device_s"], 1e-9)
+    vs_baseline = serial["est_total_s"] / max(oracle["total_s"], 1e-9)
+
+    print(
+        json.dumps(
+            {
+                "metric": "kwok_10k_pod_5k_node_gang_schedule_wall_clock",
+                "value": round(oracle["total_s"], 4),
+                "unit": "s",
+                "vs_baseline": round(vs_baseline, 1),
+                "detail": {
+                    "pods_x_nodes_scored_per_sec": round(scored_per_sec),
+                    "snapshot_pack_s": round(oracle["pack_s"], 4),
+                    "device_batch_s": round(oracle["device_s"], 4),
+                    "steady_batch_s": round(oracle["steady_batch_s"], 4),
+                    "gangs_placed": oracle["gangs_placed"],
+                    "serial_per_pod_s": round(serial["per_pod_s"], 6),
+                    "serial_est_total_s": round(serial["est_total_s"], 2),
+                    "platform": jax.devices()[0].platform,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

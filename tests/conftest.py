@@ -1,12 +1,23 @@
-"""Test configuration: force an 8-device virtual CPU platform BEFORE jax
-initialises, so multi-chip sharding paths are exercised without TPU hardware.
+"""Test configuration: force an 8-device virtual CPU platform so multi-chip
+sharding paths are exercised without TPU hardware.
+
+Note: this environment's sitecustomize registers the axon TPU plugin at
+interpreter start and overrides the jax_platforms *config* (env vars alone
+don't win); the config must be updated back to cpu before first device use.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.devices()[0].platform == "cpu", jax.devices()
+assert len(jax.devices()) == 8, jax.devices()

@@ -1,0 +1,140 @@
+"""API server / clientset / informer / fake clientset tests
+(the machinery of reference pkg/generated/, C3-C5 in SURVEY.md §2)."""
+
+import time
+
+import pytest
+
+from batch_scheduler_tpu.api import PodGroupPhase, PodPhase
+from batch_scheduler_tpu.client import (
+    AlreadyExistsError,
+    APIServer,
+    Clientset,
+    NotFoundError,
+    SharedInformerFactory,
+    new_simple_clientset,
+)
+
+from helpers import make_group, make_node, make_pod
+
+
+def test_podgroup_crud_roundtrip():
+    cs = Clientset(APIServer())
+    pg = make_group("g1", 5)
+    created = cs.podgroups().create(pg)
+    assert created.spec.min_member == 5
+    assert created.metadata.resource_version > 0
+
+    got = cs.podgroups().get("g1")
+    assert got.full_name() == "default/g1"
+
+    with pytest.raises(AlreadyExistsError):
+        cs.podgroups().create(pg)
+
+    got.status.phase = PodGroupPhase.PENDING
+    updated = cs.podgroups().update_status(got)
+    assert updated.status.phase == PodGroupPhase.PENDING
+    # update_status must not touch spec
+    assert updated.spec.min_member == 5
+
+    cs.podgroups().delete("g1")
+    with pytest.raises(NotFoundError):
+        cs.podgroups().get("g1")
+
+
+def test_patch_merges_status_only():
+    cs = Clientset(APIServer())
+    cs.podgroups().create(make_group("g", 3))
+    patched = cs.podgroups().patch(
+        "g", {"status": {"phase": "Scheduling", "scheduled": 2}}
+    )
+    assert patched.status.phase == PodGroupPhase.SCHEDULING
+    assert patched.status.scheduled == 2
+    assert patched.spec.min_member == 3
+
+
+def test_pod_list_by_label_selector():
+    cs = Clientset(APIServer())
+    for pod in (
+        make_pod("a-0", group="a"),
+        make_pod("a-1", group="a"),
+        make_pod("b-0", group="b"),
+        make_pod("solo"),
+    ):
+        cs.pods().create(pod)
+    from batch_scheduler_tpu.utils.labels import POD_GROUP_LABEL
+
+    a_pods = cs.pods().list(label_selector={POD_GROUP_LABEL: "a"})
+    assert sorted(p.metadata.name for p in a_pods) == ["a-0", "a-1"]
+    assert len(cs.pods().list()) == 4
+
+
+def test_pod_bind_subresource():
+    cs = Clientset(APIServer())
+    cs.pods().create(make_pod("p"))
+    bound = cs.pods().bind("p", "node-7")
+    assert bound.spec.node_name == "node-7"
+    assert bound.status.phase == PodPhase.PENDING
+
+
+def test_nodes_cluster_scoped():
+    cs = Clientset(APIServer())
+    cs.nodes().create(make_node("n1", {"cpu": "4"}))
+    assert cs.nodes().get("n1").status.allocatable["cpu"] == 4000
+
+
+def test_watch_stream_order_and_replay():
+    api = APIServer()
+    cs = Clientset(api)
+    cs.podgroups().create(make_group("early", 1))
+    q = api.watch("PodGroup", replay=True)
+    cs.podgroups().patch("early", {"status": {"phase": "Pending"}})
+    cs.podgroups().delete("early")
+    events = [q.get(timeout=1.0) for _ in range(3)]
+    assert [e.type for e in events] == ["ADDED", "MODIFIED", "DELETED"]
+    assert events[1].object().status.phase == PodGroupPhase.PENDING
+
+
+def test_informer_sync_handlers_and_lister():
+    api = APIServer()
+    cs = Clientset(api)
+    cs.podgroups().create(make_group("pre", 2))
+    factory = SharedInformerFactory(api)
+    informer = factory.pod_groups()
+    seen = {"add": [], "update": [], "delete": []}
+    informer.add_event_handler(
+        on_add=lambda pg: seen["add"].append(pg.metadata.name),
+        on_update=lambda old, new: seen["update"].append(new.metadata.name),
+        on_delete=lambda pg: seen["delete"].append(pg.metadata.name),
+    )
+    factory.start()
+    assert factory.wait_for_cache_sync(5.0)
+
+    cs.podgroups().create(make_group("post", 2))
+    cs.podgroups().patch("post", {"status": {"phase": "Pending"}})
+    cs.podgroups().delete("pre")
+
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline and (
+        "post" not in seen["add"]
+        or "post" not in seen["update"]
+        or "pre" not in seen["delete"]
+    ):
+        time.sleep(0.02)
+    assert "pre" in seen["add"] and "post" in seen["add"]
+    assert "post" in seen["update"]
+    assert seen["delete"] == ["pre"]
+
+    lister = factory.pod_group_lister()
+    assert lister.pod_groups("default").get("post").metadata.name == "post"
+    assert lister.pod_groups("default").get("pre") is None
+    factory.stop()
+
+
+def test_fake_clientset_seeding():
+    cs = new_simple_clientset(
+        make_group("g", 4), make_pod("p", group="g"), make_node("n", {"cpu": "2"})
+    )
+    assert cs.podgroups().get("g").spec.min_member == 4
+    assert cs.pods().get("p").metadata.name == "p"
+    assert cs.nodes().get("n").status.allocatable["cpu"] == 2000

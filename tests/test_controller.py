@@ -1,0 +1,142 @@
+"""PodGroup controller phase-machine tests, driven synchronously through
+_sync_handler with a fake clientset (the fake-clientset controller-test
+pattern the reference's generated fake enables but never uses —
+SURVEY.md §4)."""
+
+import pytest
+
+from batch_scheduler_tpu.api import PodGroupPhase, PodPhase
+from batch_scheduler_tpu.cache import PGStatusCache
+from batch_scheduler_tpu.client import APIServer, Clientset, SharedInformerFactory
+from batch_scheduler_tpu.controller import PodGroupController
+
+from helpers import make_group, make_pod
+
+
+class Harness:
+    def __init__(self, max_schedule_seconds=None):
+        self.api = APIServer()
+        self.client = Clientset(self.api)
+        self.cache = PGStatusCache()
+        self.rejected = []
+        self.backoffs = []
+        factory = SharedInformerFactory(self.api)
+        self.controller = PodGroupController(
+            client=self.client,
+            pg_informer=factory.pod_groups(),
+            pg_cache=self.cache,
+            reject_pod=self.rejected.append,
+            add_to_backoff=self.backoffs.append,
+            max_schedule_seconds=max_schedule_seconds,
+        )
+
+    def sync(self, name, namespace="default"):
+        pg = self.client.podgroups(namespace).get(name)
+        self.controller._sync_handler(pg, f"{namespace}/{name}")
+        return self.client.podgroups(namespace).get(name)
+
+
+def bind_and_phase(h, pod, node, phase):
+    h.client.pods().create(pod)
+    h.client.pods().bind(pod.metadata.name, node)
+    h.client.pods().patch(pod.metadata.name, {"status": {"phase": phase.value}})
+
+
+def test_empty_phase_normalized_to_pending():
+    h = Harness()
+    h.client.podgroups().create(make_group("g", 2))
+    pg = h.sync("g")
+    assert pg.status.phase == PodGroupPhase.PENDING
+    assert h.cache.get("default/g") is not None
+
+
+def test_scheduling_to_running_to_finished():
+    h = Harness()
+    h.client.podgroups().create(make_group("g", 2))
+    h.sync("g")
+    h.client.podgroups().patch(
+        "g", {"status": {"phase": "Scheduling", "scheduled": 2}}
+    )
+    for i in range(2):
+        bind_and_phase(h, make_pod(f"g-{i}", group="g"), "n1", PodPhase.RUNNING)
+    pg = h.sync("g")
+    assert pg.status.phase == PodGroupPhase.RUNNING
+    assert pg.status.running == 2
+
+    for i in range(2):
+        h.client.pods().patch(f"g-{i}", {"status": {"phase": "Succeeded"}})
+    pg = h.sync("g")
+    assert pg.status.phase == PodGroupPhase.FINISHED
+    assert pg.status.succeeded == 2
+    # terminal groups leave the cache (reference controller.go:304-306)
+    assert h.cache.get("default/g") is None
+
+
+def test_failure_detection():
+    h = Harness()
+    h.client.podgroups().create(make_group("g", 2))
+    h.sync("g")
+    h.client.podgroups().patch(
+        "g", {"status": {"phase": "Scheduling", "scheduled": 2}}
+    )
+    bind_and_phase(h, make_pod("g-0", group="g"), "n1", PodPhase.RUNNING)
+    bind_and_phase(h, make_pod("g-1", group="g"), "n1", PodPhase.FAILED)
+    pg = h.sync("g")
+    assert pg.status.phase == PodGroupPhase.FAILED
+    assert pg.status.failed == 1
+    assert h.cache.get("default/g") is None
+
+
+def test_crash_recovery_rederives_scheduled():
+    # phase Pending but schedule_start_time set: re-derive Scheduled from
+    # live member pods (reference controller.go:201-222)
+    h = Harness()
+    h.client.podgroups().create(make_group("g", 3))
+    h.client.podgroups().patch(
+        "g", {"status": {"phase": "Pending", "schedule_start_time": 123.0}}
+    )
+    for i in range(2):
+        bind_and_phase(h, make_pod(f"g-{i}", group="g"), "n1", PodPhase.RUNNING)
+    pg = h.sync("g")
+    assert pg.status.scheduled == 2
+
+
+def test_demotion_when_members_vanish():
+    # Scheduled group whose live notPending < minMember goes back to
+    # Scheduling (reference controller.go:276-279)
+    h = Harness()
+    h.client.podgroups().create(make_group("g", 3))
+    h.sync("g")
+    h.client.podgroups().patch(
+        "g", {"status": {"phase": "Scheduled", "scheduled": 3}}
+    )
+    bind_and_phase(h, make_pod("g-0", group="g"), "n1", PodPhase.RUNNING)
+    pg = h.sync("g")
+    assert pg.status.phase == PodGroupPhase.SCHEDULING
+    assert pg.status.scheduled == 1
+
+
+def test_local_schedule_progress_not_clobbered():
+    h = Harness()
+    h.client.podgroups().create(make_group("g", 3))
+    h.sync("g")
+    pgs = h.cache.get("default/g")
+    pgs.pod_group.status.phase = PodGroupPhase.PRE_SCHEDULING  # Permit advanced
+    pg = h.sync("g")
+    assert h.cache.get("default/g").pod_group.status.phase == PodGroupPhase.PRE_SCHEDULING
+
+
+def test_ttl_eviction_aborts_gang():
+    import time
+
+    h = Harness(max_schedule_seconds=60)
+    h.client.podgroups().create(make_group("g", 2))
+    h.sync("g")
+    pgs = h.cache.get("default/g")
+    pgs.matched_pod_nodes.set("uid-1", object(), ttl=60.0)
+    pgs.pod_name_uids.set("default/g-0", "uid-1", ttl=0.001)
+    time.sleep(0.01)
+    pgs.pod_name_uids.purge_expired()
+    assert h.rejected == ["uid-1"]
+    assert h.backoffs == ["default/g"]
+    assert pgs.matched_pod_nodes.items() == {}

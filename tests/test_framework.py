@@ -1,0 +1,106 @@
+"""Framework unit tests: queue ordering, waiting pods, cluster state."""
+
+import time
+
+from batch_scheduler_tpu.framework import (
+    ClusterState,
+    PodInfo,
+    SchedulingQueue,
+    WaitingPod,
+    WaitingPods,
+)
+from batch_scheduler_tpu.api import PodPhase
+
+from helpers import make_node, make_pod
+
+
+def test_queue_orders_by_less():
+    q = SchedulingQueue(
+        less_fn=lambda a, b: a.pod.spec.priority > b.pod.spec.priority
+    )
+    low = PodInfo(pod=make_pod("low", priority=1))
+    high = PodInfo(pod=make_pod("high", priority=9))
+    mid = PodInfo(pod=make_pod("mid", priority=5))
+    for info in (low, high, mid):
+        q.push(info)
+    assert q.pop(1).pod.metadata.name == "high"
+    assert q.pop(1).pod.metadata.name == "mid"
+    assert q.pop(1).pod.metadata.name == "low"
+    q.close()
+
+
+def test_queue_backoff_promotion():
+    q = SchedulingQueue(backoff_base=0.05, backoff_cap=0.2)
+    info = PodInfo(pod=make_pod("p"))
+    q.push_backoff(info)
+    assert q.pop(0.01) is None  # still backing off
+    got = q.pop(2.0)
+    assert got is not None and got.pod.metadata.name == "p"
+    assert got.attempts == 1
+    q.close()
+
+
+def test_waiting_pod_allow_reject_once():
+    pods = WaitingPods()
+    wp = WaitingPod(make_pod("w"), "n1", deadline=time.monotonic() + 60)
+    pods.park(wp)
+    assert pods.get(wp.pod.metadata.uid) is wp
+    assert wp.allow("batch-scheduler")
+    assert not wp.reject("too late")  # already resolved
+    resolved, outcome, _ = pods.resolved.get(timeout=1)
+    assert resolved is wp and outcome == "allow"
+    assert pods.get(wp.pod.metadata.uid) is None
+    pods.close()
+
+
+def test_waiting_pod_timeout_fires():
+    pods = WaitingPods()
+    wp = WaitingPod(make_pod("t"), "n1", deadline=time.monotonic() + 0.1)
+    pods.park(wp)
+    resolved, outcome, msg = pods.resolved.get(timeout=2)
+    assert resolved is wp and outcome == "timeout"
+    pods.close()
+
+
+def test_waiting_pods_iterate():
+    pods = WaitingPods()
+    for i in range(3):
+        pods.park(WaitingPod(make_pod(f"w{i}"), "n", time.monotonic() + 60))
+    names = []
+    pods.iterate(lambda wp: names.append(wp.get_pod().metadata.name))
+    assert sorted(names) == ["w0", "w1", "w2"]
+    pods.close()
+
+
+def test_cluster_state_assume_forget_observe():
+    cs = ClusterState()
+    cs.add_node(make_node("n1", {"cpu": "8", "pods": "10"}))
+    v0 = cs.version()
+
+    pod = make_pod("p", requests={"cpu": "2"})
+    cs.assume(pod, "n1")
+    assert cs.node_requested("n1") == {"cpu": 2000, "pods": 1}
+    assert cs.version() > v0
+
+    cs.forget(pod.metadata.uid)
+    assert cs.node_requested("n1") == {}
+
+    # observe a bound pod (informer path), then its terminal state frees it
+    bound = make_pod("b", requests={"cpu": "1"})
+    bound.spec.node_name = "n1"
+    cs.observe_pod(bound)
+    assert cs.node_requested("n1")["cpu"] == 1000
+    bound.status.phase = PodPhase.SUCCEEDED
+    cs.observe_pod(bound)
+    assert cs.node_requested("n1") == {}
+
+
+def test_cluster_state_assume_then_observe_no_double_count():
+    cs = ClusterState()
+    cs.add_node(make_node("n1", {"cpu": "8"}))
+    pod = make_pod("p", requests={"cpu": "2"})
+    cs.assume(pod, "n1")
+    cs.finish_binding(pod.metadata.uid)
+    pod.spec.node_name = "n1"
+    cs.observe_pod(pod)  # informer catches up with the bind
+    assert cs.node_requested("n1") == {"cpu": 2000, "pods": 1}

@@ -1,0 +1,158 @@
+"""End-to-end simulated-cluster tests: the full framework (API server,
+informers, scheduler, plugin, controller, leader gate, sim kubelet) running
+the BASELINE config-1 race scenario and the gang timeout/abort path."""
+
+import pytest
+
+from batch_scheduler_tpu.api import PodGroupPhase
+from batch_scheduler_tpu.sim import (
+    SimCluster,
+    make_member_pods,
+    make_sim_group,
+    make_sim_node,
+    race_scenario,
+)
+
+
+@pytest.fixture
+def sim(request):
+    clusters = []
+
+    def build(**kwargs):
+        c = SimCluster(**kwargs)
+        clusters.append(c)
+        return c
+
+    yield build
+    for c in clusters:
+        c.stop()
+
+
+@pytest.mark.parametrize("scorer", ["oracle", "serial"])
+def test_race_scenario_end_to_end(sim, scorer):
+    """README race demo: exactly one of two gangs schedules and runs; the
+    loser binds nothing."""
+    cluster = sim(scorer=scorer)
+    nodes, groups, pods = race_scenario()
+    cluster.add_nodes(nodes)
+    # ~0.9 cpu of system load, bound outside any group
+    sysload = make_member_pods("sysload", 1, {"cpu": "900m"})[0]
+    sysload.metadata.labels = {}
+    sysload.spec.node_name = "node-1"
+    cluster.clientset.pods().create(sysload)
+
+    for pg in groups:
+        cluster.create_group(pg)
+    cluster.start()
+    for group_pods in pods.values():
+        cluster.create_pods(group_pods)
+
+    assert cluster.wait_for_bound("web-group-race1", 5, timeout=30.0), (
+        cluster.member_phase_counts("web-group-race1"),
+        cluster.scheduler.stats,
+    )
+    assert cluster.wait_for_group_phase(
+        "web-group-race1",
+        (PodGroupPhase.SCHEDULED, PodGroupPhase.RUNNING),
+        timeout=30.0,
+    )
+    # winner reaches Running once the sim kubelet starts its pods
+    assert cluster.wait_for_group_phase(
+        "web-group-race1", PodGroupPhase.RUNNING, timeout=30.0
+    )
+
+    # the loser must have bound nothing
+    race2_bound = [
+        p for p in cluster.member_pods("web-group-race2") if p.spec.node_name
+    ]
+    assert race2_bound == []
+    assert cluster.group_phase("web-group-race2") in (
+        PodGroupPhase.PENDING,
+        PodGroupPhase.PRE_SCHEDULING,
+    )
+
+
+def test_multi_node_gang_spreads_and_runs(sim):
+    cluster = sim(scorer="oracle")
+    cluster.add_nodes(
+        [make_sim_node(f"n{i}", {"cpu": "4", "memory": "16Gi", "pods": "20"}) for i in range(4)]
+    )
+    cluster.create_group(make_sim_group("wide", 12))
+    cluster.start()
+    cluster.create_pods(make_member_pods("wide", 12, {"cpu": "1"}))
+
+    assert cluster.wait_for_bound("wide", 12, timeout=30.0), (
+        cluster.member_phase_counts("wide"),
+        cluster.scheduler.stats,
+    )
+    assert cluster.wait_for_group_phase("wide", PodGroupPhase.RUNNING, timeout=30.0)
+    # 12 x 1cpu over 4 x 4cpu nodes: best-fit packs into exactly 3 nodes,
+    # leaving one node entirely free for wide pods
+    nodes_used = {p.spec.node_name for p in cluster.member_pods("wide")}
+    assert len(nodes_used) == 3, nodes_used
+
+
+def _fragmented_gang_setup(cluster):
+    """Cluster-sum feasible but fragmentation-infeasible: 3 nodes x 2 cpu
+    (6 cpu total) vs a 4-member gang of 1.5-cpu pods (6 cpu total) — each
+    node fits only one member, so at most 3 of 4 can ever place."""
+    cluster.add_nodes(
+        [make_sim_node(f"n{i}", {"cpu": "2", "pods": "10"}) for i in range(3)]
+    )
+    cluster.create_group(make_sim_group("frag", 4, max_schedule_time=1.0))
+    cluster.start()
+    cluster.create_pods(make_member_pods("frag", 4, {"cpu": "1500m"}))
+
+
+def test_gang_timeout_aborts_partial_gang_serial(sim):
+    """The serial scorer's raw cluster-sum check admits a fragmentation-
+    infeasible gang (reference semantics); the TTL abort path must then
+    release its permitted pods and back the group off (reference §3.4)."""
+    cluster = sim(scorer="serial")
+    _fragmented_gang_setup(cluster)
+
+    op = cluster.runtime.operation
+    # some members get permitted and parked, but the gang can't complete
+    assert cluster.wait_for(
+        lambda: (pgs := op.status_cache.get("default/frag")) is not None
+        and len(pgs.matched_pod_nodes.items()) > 0,
+        timeout=15.0,
+    ), cluster.scheduler.stats
+    # after the 1s TTL: gang aborted -> deny backoff + all waits cleared
+    assert cluster.wait_for(
+        lambda: op.last_denied_pg.contains("default/frag"), timeout=15.0
+    )
+    assert cluster.wait_for(lambda: len(cluster.scheduler.waiting) == 0, timeout=15.0)
+    assert all(not p.spec.node_name for p in cluster.member_pods("frag"))
+
+
+def test_oracle_rejects_fragmented_gang_upfront(sim):
+    """The capacity-based oracle sees through fragmentation and denies the
+    gang before any pod is permitted — strictly better than the reference's
+    cluster-sum heuristic (SURVEY.md §7 hard parts)."""
+    cluster = sim(scorer="oracle")
+    _fragmented_gang_setup(cluster)
+
+    op = cluster.runtime.operation
+    assert cluster.wait_for(
+        lambda: op.last_denied_pg.contains("default/frag"), timeout=15.0
+    ), cluster.scheduler.stats
+    assert cluster.scheduler.stats["permit_waits"] == 0
+    assert all(not p.spec.node_name for p in cluster.member_pods("frag"))
+
+
+def test_non_group_pods_schedule_immediately(sim):
+    cluster = sim(scorer="oracle")
+    cluster.add_nodes([make_sim_node("n1", {"cpu": "4", "pods": "10"})])
+    cluster.start()
+    solo = make_member_pods("solo", 2, {"cpu": "1"})
+    for p in solo:
+        p.metadata.labels = {}
+    cluster.create_pods(solo)
+    assert cluster.wait_for(
+        lambda: all(
+            cluster.clientset.pods().get(p.metadata.name).spec.node_name
+            for p in solo
+        ),
+        timeout=15.0,
+    ), cluster.scheduler.stats

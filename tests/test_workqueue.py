@@ -1,0 +1,60 @@
+from batch_scheduler_tpu.utils.workqueue import RateLimitingQueue
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_add_get_done_dedup():
+    q = RateLimitingQueue(clock=FakeClock())
+    q.add("a")
+    q.add("a")  # deduped
+    q.add("b")
+    assert q.get(0) == "a"
+    assert q.get(0) == "b"
+    assert q.get(0) is None
+    q.done("a")
+    q.done("b")
+
+
+def test_readd_while_processing_defers():
+    q = RateLimitingQueue(clock=FakeClock())
+    q.add("k")
+    assert q.get(0) == "k"
+    q.add("k")  # while in-flight: marked dirty, not queued
+    assert q.get(0) is None
+    q.done("k")  # now the dirty key re-queues
+    assert q.get(0) == "k"
+
+
+def test_rate_limited_backoff_grows_and_forget_resets():
+    clk = FakeClock()
+    q = RateLimitingQueue(base_delay=1.0, max_delay=8.0, clock=clk)
+    q.add_rate_limited("k")  # 1s
+    assert q.get(0) is None
+    clk.now = 1.01
+    assert q.get(0) == "k"
+    q.done("k")
+    q.add_rate_limited("k")  # 2s
+    clk.now = 2.0
+    assert q.get(0) is None
+    clk.now = 3.1
+    assert q.get(0) == "k"
+    q.done("k")
+    q.forget("k")
+    q.add_rate_limited("k")  # back to 1s
+    clk.now = 4.2
+    assert q.get(0) == "k"
+    q.done("k")
+
+
+def test_shutdown_unblocks():
+    q = RateLimitingQueue(clock=FakeClock())
+    q.shut_down()
+    assert q.get(0) is None
+    q.add("x")  # ignored after shutdown
+    assert len(q) == 0
