@@ -66,18 +66,26 @@ class ScheduleOperation:
         pg_client=None,
         max_schedule_seconds: Optional[float] = None,
         pg_lister: Optional[Callable[[str, str], Optional[PodGroup]]] = None,
-        scorer: str = "oracle",
+        scorer: "str | OracleScorer" = "oracle",
         clock: Callable[[], float] = time.monotonic,
     ):
-        if scorer not in ("oracle", "serial"):
-            raise ValueError(f"unknown scorer {scorer!r} (use 'oracle' or 'serial')")
         self.status_cache = status_cache
         self.cluster = cluster
         self.pg_client = pg_client
         self.max_schedule_seconds = max_schedule_seconds
         self.pg_lister = pg_lister
-        self.scorer_kind = scorer
-        self.oracle = OracleScorer() if scorer == "oracle" else None
+        if isinstance(scorer, str):
+            if scorer not in ("oracle", "serial"):
+                raise ValueError(
+                    f"unknown scorer {scorer!r} (use 'oracle', 'serial', or an "
+                    "OracleScorer-like instance, e.g. service.RemoteScorer)"
+                )
+            self.scorer_kind = scorer
+            self.oracle = OracleScorer() if scorer == "oracle" else None
+        else:
+            # a scorer instance (e.g. RemoteScorer backed by the sidecar)
+            self.scorer_kind = "oracle"
+            self.oracle = scorer
         self.last_denied_pg = TTLCache(DENY_CACHE_DEFAULT_TTL, DENY_CACHE_JANITOR, clock=clock)
         self.last_permitted_pod = TTLCache(PERMITTED_CACHE_DEFAULT_TTL, DENY_CACHE_JANITOR, clock=clock)
         self._lock = threading.RLock()

@@ -26,7 +26,7 @@ import jax
 import numpy as np
 
 from ..cache.pg_cache import PGStatusCache, PodGroupMatchStatus
-from ..ops.oracle import find_max_group, schedule_batch
+from ..ops.oracle import execute_batch_host
 from ..ops.snapshot import ClusterSnapshot, GroupDemand
 
 __all__ = ["OracleScorer", "demand_from_status"]
@@ -58,34 +58,35 @@ class _BatchState:
     concurrent readers never see a torn snapshot/result combination.
 
     ``result`` holds only the O(G) host vectors; the big (G,N) tensors stay
-    on device in ``device_result`` and individual group rows are fetched
-    lazily (a row is KBs; the full tensor is ~100MB at 5k nodes and costs
-    ~10x the batch time to pull over the host link)."""
+    behind ``row_fetcher`` (on device locally, or on the sidecar remotely)
+    and individual group rows are fetched lazily (a row is KBs; the full
+    tensor is ~100MB at 5k nodes and costs ~10x the batch time to pull over
+    the host link)."""
 
-    __slots__ = ("snapshot", "result", "max_group", "device_result", "_rows", "_rows_lock")
+    __slots__ = ("snapshot", "result", "max_group", "row_fetcher", "_rows", "_rows_lock")
 
     def __init__(
         self,
         snapshot: ClusterSnapshot,
         result: dict,
         max_group: str,
-        device_result: dict,
+        row_fetcher,
     ):
         self.snapshot = snapshot
         self.result = result
         self.max_group = max_group
-        self.device_result = device_result
+        self.row_fetcher = row_fetcher
         self._rows: Dict[tuple, np.ndarray] = {}
         self._rows_lock = threading.Lock()
 
     def row(self, kind: str, g: int) -> np.ndarray:
-        """Fetch (and cache) one group's row of a (G,N) device tensor."""
+        """Fetch (and cache) one group's row of a (G,N) tensor."""
         key = (kind, g)
         with self._rows_lock:
             cached = self._rows.get(key)
         if cached is not None:
             return cached
-        row = np.asarray(jax.device_get(self.device_result[kind][g]))
+        row = np.asarray(self.row_fetcher(kind, g))
         with self._rows_lock:
             self._rows[key] = row
         return row
@@ -120,38 +121,37 @@ class OracleScorer:
             n.metadata.name: cluster.node_requested(n.metadata.name) for n in nodes
         }
         snap = ClusterSnapshot(nodes, node_req, demands)
-        out = schedule_batch(*snap.device_args())
-        best, exists, progress = find_max_group(
-            snap.min_member,
-            snap.scheduled,
-            snap.matched,
-            snap.ineligible,
-            snap.creation_rank,
-        )
-        # fetch only the O(G) vectors + compact assignment; (G,N) tensors
-        # stay on device for lazy row reads
-        host = jax.device_get(
-            {
-                "gang_feasible": out["gang_feasible"],
-                "placed": out["placed"],
-                "assignment_nodes": out["assignment_nodes"],
-                "assignment_counts": out["assignment_counts"],
-                "best": best,
-                "best_exists": exists,
-                "progress": progress,
-            }
-        )
+        host, row_fetcher = self._execute(snap)
         max_group = (
             snap.group_names[int(host["best"])]
             if bool(host["best_exists"]) and int(host["best"]) < len(snap.group_names)
             else ""
         )
-        device_result = {"capacity": out["capacity"], "scores": out["scores"]}
-        self._state = _BatchState(snap, host, max_group, device_result)
+        self._state = _BatchState(snap, host, max_group, row_fetcher)
         version_fn = getattr(cluster, "version", None)
         self._cluster_version = version_fn() if callable(version_fn) else None
         self._dirty = False
         self.batches_run += 1
+
+    def _execute(self, snap: ClusterSnapshot):
+        """Run one batch locally on the attached device. Returns the O(G)
+        host result dict and a lazy (G,N)-row fetcher. RemoteScorer swaps
+        this for the sidecar round-trip."""
+        host, device_result = execute_batch_host(
+            snap.device_args(),
+            (
+                snap.min_member,
+                snap.scheduled,
+                snap.matched,
+                snap.ineligible,
+                snap.creation_rank,
+            ),
+        )
+
+        def row_fetcher(kind: str, g: int) -> np.ndarray:
+            return np.asarray(jax.device_get(device_result[kind][g]))
+
+        return host, row_fetcher
 
     def _stale(self, cluster) -> bool:
         if self._dirty or self._state is None:
@@ -202,7 +202,12 @@ class OracleScorer:
         n = state.snapshot.node_index(node_name)
         if g is None or n is None:
             return 0
-        return int(state.row("capacity", g)[n])
+        try:
+            return int(state.row("capacity", g)[n])
+        except Exception:
+            # a stale remote batch (or transport hiccup) answers
+            # conservatively; the caller's next cycle refreshes
+            return 0
 
     def node_score(self, full_name: str, node_name: str) -> int:
         state = self._state
@@ -212,7 +217,10 @@ class OracleScorer:
         n = state.snapshot.node_index(node_name)
         if g is None or n is None:
             return -(2**30)
-        return int(state.row("scores", g)[n])
+        try:
+            return int(state.row("scores", g)[n])
+        except Exception:
+            return -(2**30)
 
     def assignment(self, full_name: str) -> Dict[str, int]:
         """node name -> member count placed there for this gang's batch plan

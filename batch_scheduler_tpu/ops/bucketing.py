@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["bucket_size", "pad_to", "pad_rows"]
+__all__ = ["bucket_size", "pad_to", "pad_rows", "pad_oracle_batch"]
 
 _MIN_BUCKET = 8
 
@@ -39,3 +39,62 @@ def pad_to(arr: np.ndarray, size: int, axis: int = 0, fill=0) -> np.ndarray:
 
 def pad_rows(arr: np.ndarray, size: int, fill=0) -> np.ndarray:
     return pad_to(arr, size, axis=0, fill=fill)
+
+
+def pad_oracle_batch(
+    alloc,
+    requested,
+    group_req,
+    remaining,
+    fit_mask,
+    group_valid,
+    order,
+    min_member,
+    scheduled,
+    matched,
+    ineligible,
+    creation_rank,
+):
+    """Bucket-pad one oracle batch with the canonical sentinel fills.
+
+    THE single source of truth for what padded rows look like — used by both
+    the in-process snapshot packer (ops.snapshot.ClusterSnapshot) and the
+    sidecar server (service.server), so the wire path can never drift from
+    the local path:
+
+    - padded groups: zero demand, invalid, ineligible for max-progress
+      selection, last in creation rank, appended at the tail of the scan
+      order (remaining == 0, so they place nothing);
+    - padded nodes: zero lanes (capacity 0), masked out of every fit row.
+
+    Returns ``(batch_args, progress_args)`` ready for
+    ``ops.oracle.schedule_batch`` / ``find_max_group``.
+    """
+    n = alloc.shape[0]
+    g = group_req.shape[0]
+    nb = bucket_size(max(n, 1))
+    gb = bucket_size(max(g, 1))
+    batch_args = (
+        pad_rows(np.asarray(alloc, dtype=np.int32), nb),
+        pad_rows(np.asarray(requested, dtype=np.int32), nb),
+        pad_rows(np.asarray(group_req, dtype=np.int32), gb),
+        pad_rows(np.asarray(remaining, dtype=np.int32), gb),
+        pad_to(
+            pad_rows(np.asarray(fit_mask, dtype=bool), gb, fill=False),
+            nb,
+            axis=1,
+            fill=False,
+        ),
+        pad_rows(np.asarray(group_valid, dtype=bool), gb, fill=False),
+        np.concatenate(
+            [np.asarray(order, dtype=np.int32), np.arange(g, gb, dtype=np.int32)]
+        ),
+    )
+    progress_args = (
+        pad_rows(np.asarray(min_member, dtype=np.int32), gb),
+        pad_rows(np.asarray(scheduled, dtype=np.int32), gb),
+        pad_rows(np.asarray(matched, dtype=np.int32), gb),
+        pad_rows(np.asarray(ineligible, dtype=bool), gb, fill=True),
+        pad_rows(np.asarray(creation_rank, dtype=np.int32), gb, fill=gb - 1),
+    )
+    return batch_args, progress_args

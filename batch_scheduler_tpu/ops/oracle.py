@@ -45,6 +45,7 @@ __all__ = [
     "score_nodes",
     "assign_gangs",
     "schedule_batch",
+    "execute_batch_host",
 ]
 
 _BIG = jnp.int32(2**30)
@@ -224,3 +225,26 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
         "placed": placed,
         "left_after": left_after,
     }
+
+
+def execute_batch_host(batch_args, progress_args):
+    """Run one fused batch + max-progress selection and fetch ONLY the O(G)
+    host vectors; the (G,N) tensors come back as device handles for lazy row
+    reads. The single batch-execution path shared by the in-process scorer
+    (core.oracle_scorer) and the sidecar server (service.server) — one place
+    to change when the oracle's outputs change."""
+    out = schedule_batch(*batch_args)
+    best, exists, progress = find_max_group(*progress_args)
+    host = jax.device_get(
+        {
+            "gang_feasible": out["gang_feasible"],
+            "placed": out["placed"],
+            "assignment_nodes": out["assignment_nodes"],
+            "assignment_counts": out["assignment_counts"],
+            "best": best,
+            "best_exists": exists,
+            "progress": progress,
+        }
+    )
+    device_result = {"capacity": out["capacity"], "scores": out["scores"]}
+    return host, device_result

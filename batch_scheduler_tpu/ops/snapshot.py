@@ -22,7 +22,7 @@ import numpy as np
 
 from ..api.fit import selector_matches, tolerates_all
 from ..api.types import Node, Pod, Toleration
-from .bucketing import bucket_size, pad_rows, pad_to
+from .bucketing import pad_oracle_batch, pad_rows
 from .lanes import LaneSchema
 
 __all__ = ["GroupDemand", "ClusterSnapshot", "node_requested_from_pods"]
@@ -91,8 +91,6 @@ class ClusterSnapshot:
             + [g.member_request for g in groups]
         )
 
-        n_bucket = bucket_size(max(len(nodes), 1))
-        g_bucket = bucket_size(max(len(groups), 1))
         self.num_nodes = len(nodes)
         self.num_groups = len(groups)
 
@@ -115,36 +113,7 @@ class ClusterSnapshot:
 
         fit = self._fit_mask(nodes, groups) & node_valid[None, :]
 
-        self.alloc = pad_rows(alloc, n_bucket)
-        self.requested = pad_rows(requested, n_bucket)
-        self.node_valid = pad_rows(node_valid, n_bucket, fill=False)
-        self.group_req = pad_rows(group_req, g_bucket)
-        self.remaining = pad_rows(
-            np.array([g.remaining for g in groups], dtype=np.int32), g_bucket
-        )
-        self.group_valid = pad_rows(
-            np.ones(len(groups), dtype=bool), g_bucket, fill=False
-        )
-        fit = pad_rows(fit, g_bucket, fill=False)
-        self.fit_mask = pad_to(fit, n_bucket, axis=1, fill=False)
-
-        self.min_member = pad_rows(
-            np.array([g.min_member for g in groups], dtype=np.int32), g_bucket
-        )
-        self.scheduled = pad_rows(
-            np.array([g.scheduled for g in groups], dtype=np.int32), g_bucket
-        )
-        self.matched = pad_rows(
-            np.array([g.matched for g in groups], dtype=np.int32), g_bucket
-        )
-        # Ineligible for max-progress selection: already released, no
-        # representative pod yet, or a padded row.
-        self.ineligible = pad_rows(
-            np.array([g.released or not g.has_pod for g in groups], dtype=bool),
-            g_bucket,
-            fill=True,
-        )
-
+        # queue order: priority desc, creation asc, name (Compare semantics)
         order_host = sorted(
             range(len(groups)),
             key=lambda i: (
@@ -155,14 +124,43 @@ class ClusterSnapshot:
         )
         ranks = np.empty(len(groups), dtype=np.int32)
         ranks[order_host] = np.arange(len(groups), dtype=np.int32)
-        self.creation_rank = pad_rows(ranks, g_bucket, fill=g_bucket - 1)
-        # Scan order over padded group rows: real groups by priority, then
-        # padded rows (remaining == 0, so they place nothing).
-        self.order = np.concatenate(
-            [
-                np.array(order_host, dtype=np.int32),
-                np.arange(len(groups), g_bucket, dtype=np.int32),
-            ]
+
+        batch_args, progress_args = pad_oracle_batch(
+            alloc=alloc,
+            requested=requested,
+            group_req=group_req,
+            remaining=np.array([g.remaining for g in groups], dtype=np.int32),
+            fit_mask=fit,
+            group_valid=np.ones(len(groups), dtype=bool),
+            order=np.array(order_host, dtype=np.int32),
+            min_member=np.array([g.min_member for g in groups], dtype=np.int32),
+            scheduled=np.array([g.scheduled for g in groups], dtype=np.int32),
+            matched=np.array([g.matched for g in groups], dtype=np.int32),
+            # Ineligible for max-progress selection: already released or no
+            # representative pod yet.
+            ineligible=np.array(
+                [g.released or not g.has_pod for g in groups], dtype=bool
+            ),
+            creation_rank=ranks,
+        )
+        (
+            self.alloc,
+            self.requested,
+            self.group_req,
+            self.remaining,
+            self.fit_mask,
+            self.group_valid,
+            self.order,
+        ) = batch_args
+        (
+            self.min_member,
+            self.scheduled,
+            self.matched,
+            self.ineligible,
+            self.creation_rank,
+        ) = progress_args
+        self.node_valid = pad_rows(
+            node_valid, self.alloc.shape[0], fill=False
         )
 
     def _fit_mask(

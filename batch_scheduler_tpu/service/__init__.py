@@ -1,0 +1,11 @@
+from . import protocol
+from .client import OracleClient, RemoteScorer
+from .server import OracleServer, serve_background
+
+__all__ = [
+    "protocol",
+    "OracleClient",
+    "RemoteScorer",
+    "OracleServer",
+    "serve_background",
+]

@@ -1,0 +1,107 @@
+"""Oracle sidecar clients.
+
+``OracleClient`` is the raw protocol client (one TCP connection, serialized
+round-trips). ``RemoteScorer`` plugs it into ScheduleOperation with the same
+interface as the in-process OracleScorer — the control plane is agnostic to
+whether the oracle lives in-process on the local chip or behind the sidecar
+(the deployment split of the north star: Go plugin <-> JAX sidecar).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Tuple
+
+import numpy as np
+
+from ..core.oracle_scorer import OracleScorer
+from ..ops.snapshot import ClusterSnapshot
+from . import protocol as proto
+
+__all__ = ["OracleClient", "RemoteScorer"]
+
+
+class OracleClient:
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _round_trip(self, msg_type: int, payload: bytes) -> Tuple[int, bytes]:
+        with self._lock:
+            proto.write_frame(self._sock, msg_type, payload)
+            resp_type, resp = proto.read_frame(self._sock)
+        if resp_type == proto.MsgType.ERROR:
+            raise RuntimeError(f"oracle server error: {resp.decode(errors='replace')}")
+        return resp_type, resp
+
+    def ping(self) -> bool:
+        resp_type, _ = self._round_trip(proto.MsgType.PING, b"")
+        return resp_type == proto.MsgType.PONG
+
+    def schedule(self, req: proto.ScheduleRequest) -> proto.ScheduleResponse:
+        resp_type, resp = self._round_trip(
+            proto.MsgType.SCHEDULE_REQ, proto.pack_schedule_request(req)
+        )
+        if resp_type != proto.MsgType.SCHEDULE_RESP:
+            raise RuntimeError(f"unexpected response type {resp_type}")
+        return proto.unpack_schedule_response(resp)
+
+    def row(self, kind: str, group_index: int, batch_seq: int = 0) -> np.ndarray:
+        resp_type, resp = self._round_trip(
+            proto.MsgType.ROW_REQ,
+            proto.pack_row_request(kind, group_index, batch_seq),
+        )
+        if resp_type != proto.MsgType.ROW_RESP:
+            raise RuntimeError(f"unexpected response type {resp_type}")
+        return np.frombuffer(resp, dtype="<i4")
+
+
+class RemoteScorer(OracleScorer):
+    """OracleScorer whose batch executes on the sidecar service."""
+
+    def __init__(self, client: OracleClient):
+        super().__init__()
+        self._client = client
+
+    def _execute(self, snap: ClusterSnapshot):
+        req = proto.ScheduleRequest(
+            alloc=snap.alloc,
+            requested=snap.requested,
+            group_req=snap.group_req,
+            remaining=snap.remaining,
+            fit_mask=snap.fit_mask,
+            group_valid=snap.group_valid,
+            order=snap.order,
+            min_member=snap.min_member,
+            scheduled=snap.scheduled,
+            matched=snap.matched,
+            ineligible=snap.ineligible,
+            creation_rank=snap.creation_rank,
+        )
+        resp = self._client.schedule(req)
+        host = {
+            "gang_feasible": resp.gang_feasible,
+            "placed": resp.placed,
+            "assignment_nodes": resp.assignment_nodes,
+            "assignment_counts": resp.assignment_counts,
+            "best": resp.best,
+            "best_exists": resp.best_exists,
+            "progress": resp.progress,
+        }
+        batch_seq = resp.batch_seq
+
+        def row_fetcher(kind: str, g: int) -> np.ndarray:
+            # the captured batch_seq pins this fetcher to ITS batch: if a
+            # newer batch has run on the connection, the server answers an
+            # in-band stale-batch error instead of another batch's row
+            return self._client.row(kind, g, batch_seq)
+
+        return host, row_fetcher

@@ -1,0 +1,243 @@
+"""Wire protocol for the oracle sidecar: framed packed arrays.
+
+The north star calls for a data plane carrying packed pod/node resource
+vectors from the control plane to a JAX sidecar (BASELINE.json north_star;
+SURVEY.md §7 notes "packed arrays, not protobuf-per-pod" is required for the
+<1s budget). The protocol is deliberately dumb and fast:
+
+    frame  := magic "BSO1" | u32 msg_type | u64 payload_len | payload
+    arrays := raw little-endian buffers in fixed order, counts up front
+
+No per-pod messages, no schema negotiation, no string tables in the hot
+path — names stay host-side in the caller's index maps. A C++ client
+(native/) speaks the same bytes.
+
+Message types:
+  SCHEDULE_REQ  : one full oracle batch (counts + 7 arrays)
+  SCHEDULE_RESP : O(G) vectors + compact top-K assignment
+  ROW_REQ       : fetch one (G,N) row ("capacity" or "scores") from the
+                  connection's last batch
+  ROW_RESP      : the row, int32[N]
+  PING/PONG     : liveness
+  ERROR         : UTF-8 message
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Tuple
+
+import numpy as np
+
+__all__ = [
+    "MAGIC",
+    "MsgType",
+    "ScheduleRequest",
+    "ScheduleResponse",
+    "write_frame",
+    "read_frame",
+    "pack_schedule_request",
+    "unpack_schedule_request",
+    "pack_schedule_response",
+    "unpack_schedule_response",
+    "pack_row_request",
+    "unpack_row_request",
+]
+
+MAGIC = b"BSO1"
+_HEADER = struct.Struct("<4sIQ")
+
+# A realistic max batch (8k-node/2k-group buckets) is tens of MB; anything
+# near this bound is a desynced or hostile peer, not a bigger cluster.
+MAX_FRAME_BYTES = 256 * 1024 * 1024
+
+
+class MsgType:
+    SCHEDULE_REQ = 1
+    SCHEDULE_RESP = 2
+    ROW_REQ = 3
+    ROW_RESP = 4
+    PING = 5
+    PONG = 6
+    ERROR = 7
+
+
+ROW_KINDS = ("capacity", "scores")
+
+
+@dataclass
+class ScheduleRequest:
+    alloc: np.ndarray  # i32 [N,R]
+    requested: np.ndarray  # i32 [N,R]
+    group_req: np.ndarray  # i32 [G,R]
+    remaining: np.ndarray  # i32 [G]
+    fit_mask: np.ndarray  # bool [G,N]
+    group_valid: np.ndarray  # bool [G]
+    order: np.ndarray  # i32 [G]
+    # max-progress selection inputs (reference findMaxPG semantics)
+    min_member: np.ndarray  # i32 [G]
+    scheduled: np.ndarray  # i32 [G]
+    matched: np.ndarray  # i32 [G]
+    ineligible: np.ndarray  # bool [G]
+    creation_rank: np.ndarray  # i32 [G]
+
+
+@dataclass
+class ScheduleResponse:
+    gang_feasible: np.ndarray  # bool [G]
+    placed: np.ndarray  # bool [G]
+    progress: np.ndarray  # i32 [G]
+    best: int
+    best_exists: bool
+    assignment_nodes: np.ndarray  # i32 [G,K]
+    assignment_counts: np.ndarray  # i32 [G,K]
+    # per-connection batch token; row requests must present it so a stale
+    # reader can never be served rows from a newer batch
+    batch_seq: int = 0
+
+
+def write_frame(sock, msg_type: int, payload: bytes) -> None:
+    sock.sendall(_HEADER.pack(MAGIC, msg_type, len(payload)) + payload)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def read_frame(sock) -> Tuple[int, bytes]:
+    header = _recv_exact(sock, _HEADER.size)
+    magic, msg_type, length = _HEADER.unpack(header)
+    if magic != MAGIC:
+        raise ValueError(f"bad frame magic: {magic!r}")
+    if length > MAX_FRAME_BYTES:
+        raise ValueError(f"oversized frame: {length}")
+    return msg_type, _recv_exact(sock, length)
+
+
+# -- schedule request ------------------------------------------------------
+
+_REQ_COUNTS = struct.Struct("<III")  # N, G, R
+
+
+def _i32(a) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(a), dtype="<i4")
+
+
+def _u8(a) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(a), dtype=np.uint8)
+
+
+def pack_schedule_request(req: ScheduleRequest) -> bytes:
+    n, r = req.alloc.shape
+    g = req.group_req.shape[0]
+    parts = [
+        _REQ_COUNTS.pack(n, g, r),
+        _i32(req.alloc).tobytes(),
+        _i32(req.requested).tobytes(),
+        _i32(req.group_req).tobytes(),
+        _i32(req.remaining).tobytes(),
+        _u8(req.fit_mask).tobytes(),
+        _u8(req.group_valid).tobytes(),
+        _i32(req.order).tobytes(),
+        _i32(req.min_member).tobytes(),
+        _i32(req.scheduled).tobytes(),
+        _i32(req.matched).tobytes(),
+        _u8(req.ineligible).tobytes(),
+        _i32(req.creation_rank).tobytes(),
+    ]
+    return b"".join(parts)
+
+
+def unpack_schedule_request(payload: bytes) -> ScheduleRequest:
+    n, g, r = _REQ_COUNTS.unpack_from(payload, 0)
+    off = _REQ_COUNTS.size
+
+    def take(count, dtype, shape):
+        nonlocal off
+        size = count * np.dtype(dtype).itemsize
+        arr = np.frombuffer(payload, dtype=dtype, count=count, offset=off)
+        off += size
+        return arr.reshape(shape)
+
+    alloc = take(n * r, "<i4", (n, r))
+    requested = take(n * r, "<i4", (n, r))
+    group_req = take(g * r, "<i4", (g, r))
+    remaining = take(g, "<i4", (g,))
+    fit_mask = take(g * n, np.uint8, (g, n)).astype(bool)
+    group_valid = take(g, np.uint8, (g,)).astype(bool)
+    order = take(g, "<i4", (g,))
+    min_member = take(g, "<i4", (g,))
+    scheduled = take(g, "<i4", (g,))
+    matched = take(g, "<i4", (g,))
+    ineligible = take(g, np.uint8, (g,)).astype(bool)
+    creation_rank = take(g, "<i4", (g,))
+    if off != len(payload):
+        raise ValueError(f"trailing bytes in schedule request: {len(payload) - off}")
+    return ScheduleRequest(
+        alloc, requested, group_req, remaining, fit_mask, group_valid, order,
+        min_member, scheduled, matched, ineligible, creation_rank,
+    )
+
+
+# -- schedule response -----------------------------------------------------
+
+_RESP_COUNTS = struct.Struct("<IIiBI")  # G, K, best, best_exists, batch_seq
+
+
+def pack_schedule_response(resp: ScheduleResponse) -> bytes:
+    g = resp.gang_feasible.shape[0]
+    k = resp.assignment_nodes.shape[1]
+    return b"".join(
+        [
+            _RESP_COUNTS.pack(g, k, resp.best, 1 if resp.best_exists else 0, resp.batch_seq),
+            _u8(resp.gang_feasible).tobytes(),
+            _u8(resp.placed).tobytes(),
+            _i32(resp.progress).tobytes(),
+            _i32(resp.assignment_nodes).tobytes(),
+            _i32(resp.assignment_counts).tobytes(),
+        ]
+    )
+
+
+def unpack_schedule_response(payload: bytes) -> ScheduleResponse:
+    g, k, best, best_exists, batch_seq = _RESP_COUNTS.unpack_from(payload, 0)
+    off = _RESP_COUNTS.size
+
+    def take(count, dtype, shape):
+        nonlocal off
+        arr = np.frombuffer(payload, dtype=dtype, count=count, offset=off)
+        off += count * np.dtype(dtype).itemsize
+        return arr.reshape(shape)
+
+    return ScheduleResponse(
+        gang_feasible=take(g, np.uint8, (g,)).astype(bool),
+        placed=take(g, np.uint8, (g,)).astype(bool),
+        progress=take(g, "<i4", (g,)),
+        best=best,
+        best_exists=bool(best_exists),
+        assignment_nodes=take(g * k, "<i4", (g, k)),
+        assignment_counts=take(g * k, "<i4", (g, k)),
+        batch_seq=batch_seq,
+    )
+
+
+# -- row request/response --------------------------------------------------
+
+_ROW_REQ = struct.Struct("<BII")  # kind index, group index, batch_seq
+
+
+def pack_row_request(kind: str, group_index: int, batch_seq: int = 0) -> bytes:
+    return _ROW_REQ.pack(ROW_KINDS.index(kind), group_index, batch_seq)
+
+
+def unpack_row_request(payload: bytes) -> Tuple[str, int, int]:
+    kind_idx, group_index, batch_seq = _ROW_REQ.unpack(payload)
+    return ROW_KINDS[kind_idx], group_index, batch_seq

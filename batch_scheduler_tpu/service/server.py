@@ -1,0 +1,134 @@
+"""The oracle sidecar service: a TCP server wrapping the jitted batch.
+
+This is the deployment shape of the north star: the (Go) control plane keeps
+its informers and gang choreography, and ships packed resource arrays to
+this sidecar, which owns the TPU and answers with O(G) verdicts + compact
+assignments. Stateless across batches (all durable state stays in the CRD
+status, SURVEY.md §5 checkpoint/resume) — per-connection, the last batch's
+(G,N) tensors are kept on device so row fetches don't resend the batch.
+"""
+
+from __future__ import annotations
+
+import socketserver
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..ops.bucketing import pad_oracle_batch
+from ..ops.oracle import execute_batch_host
+from . import protocol as proto
+
+__all__ = ["OracleServer", "serve_background"]
+
+
+def _pad_request(req: proto.ScheduleRequest):
+    """Bucket-pad an unpadded request via the SAME canonical padding as the
+    in-process snapshot packer (ops.bucketing.pad_oracle_batch) so the wire
+    path can never drift from the local path."""
+    n = req.alloc.shape[0]
+    g = req.group_req.shape[0]
+    batch_args, progress_args = pad_oracle_batch(
+        alloc=req.alloc,
+        requested=req.requested,
+        group_req=req.group_req,
+        remaining=req.remaining,
+        fit_mask=req.fit_mask,
+        group_valid=req.group_valid,
+        order=req.order,
+        min_member=req.min_member,
+        scheduled=req.scheduled,
+        matched=req.matched,
+        ineligible=req.ineligible,
+        creation_rank=req.creation_rank,
+    )
+    return batch_args, progress_args, (n, g)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        last_batch: Optional[dict] = None
+        last_counts = (0, 0)
+        batch_seq = 0
+        while True:
+            try:
+                msg_type, payload = proto.read_frame(self.request)
+            except (ConnectionError, OSError):
+                return
+            except ValueError:
+                return  # not speaking our protocol: drop the connection
+            try:
+                if msg_type == proto.MsgType.PING:
+                    proto.write_frame(self.request, proto.MsgType.PONG, b"")
+                elif msg_type == proto.MsgType.SCHEDULE_REQ:
+                    req = proto.unpack_schedule_request(payload)
+                    args, progress_args, (n, g) = _pad_request(req)
+                    host, last_batch = execute_batch_host(args, progress_args)
+                    last_counts = (n, g)
+                    batch_seq += 1
+                    resp = proto.ScheduleResponse(
+                        gang_feasible=np.asarray(host["gang_feasible"])[:g],
+                        placed=np.asarray(host["placed"])[:g],
+                        progress=np.asarray(host["progress"])[:g],
+                        best=int(host["best"]),
+                        best_exists=bool(host["best_exists"]),
+                        assignment_nodes=np.asarray(host["assignment_nodes"])[:g],
+                        assignment_counts=np.asarray(host["assignment_counts"])[:g],
+                        batch_seq=batch_seq,
+                    )
+                    proto.write_frame(
+                        self.request,
+                        proto.MsgType.SCHEDULE_RESP,
+                        proto.pack_schedule_response(resp),
+                    )
+                elif msg_type == proto.MsgType.ROW_REQ:
+                    kind, gidx, req_seq = proto.unpack_row_request(payload)
+                    if last_batch is None:
+                        raise ValueError("row request before any batch")
+                    if req_seq != batch_seq:
+                        raise ValueError(
+                            f"stale batch: row for seq {req_seq}, current {batch_seq}"
+                        )
+                    n, g = last_counts
+                    if not 0 <= gidx < g:
+                        raise ValueError(f"row index {gidx} out of range {g}")
+                    row = np.asarray(
+                        jax.device_get(last_batch[kind][gidx])
+                    ).astype("<i4")[:n]
+                    proto.write_frame(
+                        self.request, proto.MsgType.ROW_RESP, row.tobytes()
+                    )
+                else:
+                    raise ValueError(f"unknown message type {msg_type}")
+            except Exception as e:  # protocol errors answer in-band
+                try:
+                    proto.write_frame(
+                        self.request, proto.MsgType.ERROR, str(e).encode()
+                    )
+                except OSError:
+                    return
+
+
+class OracleServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        super().__init__((host, port), _Handler)
+
+    @property
+    def address(self):
+        return self.server_address
+
+
+def serve_background(host: str = "127.0.0.1", port: int = 0) -> OracleServer:
+    """Start an OracleServer on a daemon thread; returns it (``.address``
+    has the bound port, ``.shutdown()`` stops it)."""
+    server = OracleServer(host, port)
+    t = threading.Thread(
+        target=server.serve_forever, name="oracle-server", daemon=True
+    )
+    t.start()
+    return server

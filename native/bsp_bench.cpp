@@ -1,0 +1,117 @@
+// bsp_bench: round-trip benchmark CLI for the oracle sidecar data plane.
+//
+// Generates a synthetic (nodes x groups) batch, ships it over the packed
+// protocol, and reports per-batch latency from the native side — the number
+// a Go control plane would see.
+//
+// Usage: bsp_bench <host> <port> [nodes] [groups] [lanes] [iters]
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <chrono>
+#include <vector>
+
+extern "C" {
+struct BspClient;
+BspClient* bsp_connect(const char* host, int port);
+void bsp_close(BspClient*);
+int bsp_ping(BspClient*);
+const char* bsp_last_error(BspClient*);
+int bsp_schedule(BspClient*, int32_t n, int32_t g, int32_t r,
+                 const int32_t*, const int32_t*, const int32_t*,
+                 const int32_t*, const uint8_t*, const uint8_t*,
+                 const int32_t*, const int32_t*, const int32_t*,
+                 const int32_t*, const uint8_t*, const int32_t*, uint8_t*,
+                 uint8_t*, int32_t*, int32_t*, uint8_t*, int32_t*, int32_t*,
+                 int32_t*, int32_t, uint32_t*);
+}
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s <host> <port> [nodes] [groups] [lanes] [iters]\n",
+                 argv[0]);
+    return 2;
+  }
+  const char* host = argv[1];
+  int port = std::atoi(argv[2]);
+  int32_t n = argc > 3 ? std::atoi(argv[3]) : 1024;
+  int32_t g = argc > 4 ? std::atoi(argv[4]) : 256;
+  int32_t r = argc > 5 ? std::atoi(argv[5]) : 5;
+  int iters = argc > 6 ? std::atoi(argv[6]) : 10;
+
+  BspClient* client = bsp_connect(host, port);
+  if (!client) {
+    std::fprintf(stderr, "connect failed\n");
+    return 1;
+  }
+  if (bsp_ping(client) != 0) {
+    std::fprintf(stderr, "ping failed: %s\n", bsp_last_error(client));
+    return 1;
+  }
+
+  // synthetic cluster: uniform nodes, gangs of 4 x 1-cpu-ish members
+  std::vector<int32_t> alloc(static_cast<size_t>(n) * r);
+  std::vector<int32_t> requested(static_cast<size_t>(n) * r, 0);
+  for (int32_t i = 0; i < n; ++i) {
+    alloc[static_cast<size_t>(i) * r + 0] = 64000;   // cpu milli
+    alloc[static_cast<size_t>(i) * r + 1] = 1 << 28; // mem KiB
+    if (r > 3) alloc[static_cast<size_t>(i) * r + 3] = 110;  // pods
+  }
+  std::vector<int32_t> group_req(static_cast<size_t>(g) * r, 0);
+  std::vector<int32_t> remaining(g, 4);
+  for (int32_t j = 0; j < g; ++j) {
+    group_req[static_cast<size_t>(j) * r + 0] = 4000;
+    group_req[static_cast<size_t>(j) * r + 1] = 1 << 23;
+    if (r > 3) group_req[static_cast<size_t>(j) * r + 3] = 1;
+  }
+  std::vector<uint8_t> fit_mask(static_cast<size_t>(g) * n, 1);
+  std::vector<uint8_t> group_valid(g, 1);
+  std::vector<int32_t> order(g), min_member(g, 4), scheduled(g, 0),
+      matched(g, 0), creation_rank(g);
+  std::vector<uint8_t> ineligible(g, 0);
+  for (int32_t j = 0; j < g; ++j) order[j] = creation_rank[j] = j;
+
+  const int32_t k_capacity = 128;
+  std::vector<uint8_t> gang_feasible(g), placed(g);
+  std::vector<int32_t> progress(g);
+  std::vector<int32_t> assignment_nodes(static_cast<size_t>(g) * k_capacity);
+  std::vector<int32_t> assignment_counts(static_cast<size_t>(g) * k_capacity);
+  int32_t best = 0, k_out = 0;
+  uint8_t best_exists = 0;
+  uint32_t batch_seq = 0;
+
+  double total_ms = 0, best_ms = 1e18;
+  int placed_total = 0;
+  for (int it = 0; it < iters + 1; ++it) {
+    auto t0 = std::chrono::steady_clock::now();
+    int rc = bsp_schedule(client, n, g, r, alloc.data(), requested.data(),
+                          group_req.data(), remaining.data(), fit_mask.data(),
+                          group_valid.data(), order.data(), min_member.data(),
+                          scheduled.data(), matched.data(), ineligible.data(),
+                          creation_rank.data(), gang_feasible.data(),
+                          placed.data(), progress.data(), &best, &best_exists,
+                          assignment_nodes.data(), assignment_counts.data(),
+                          &k_out, k_capacity, &batch_seq);
+    auto t1 = std::chrono::steady_clock::now();
+    if (rc != 0) {
+      std::fprintf(stderr, "schedule failed: %s\n", bsp_last_error(client));
+      bsp_close(client);
+      return 1;
+    }
+    double ms = std::chrono::duration<double, std::milli>(t1 - t0).count();
+    if (it == 0) continue;  // first batch includes jit compile
+    total_ms += ms;
+    if (ms < best_ms) best_ms = ms;
+    placed_total = 0;
+    for (int32_t j = 0; j < g; ++j) placed_total += placed[j];
+  }
+
+  std::printf(
+      "{\"nodes\": %d, \"groups\": %d, \"lanes\": %d, \"iters\": %d, "
+      "\"avg_batch_ms\": %.2f, \"best_batch_ms\": %.2f, \"placed\": %d, "
+      "\"k\": %d}\n",
+      n, g, r, iters, total_ms / iters, best_ms, placed_total, k_out);
+  bsp_close(client);
+  return 0;
+}

@@ -1,0 +1,298 @@
+// bsp_client: native client for the batch-scheduler oracle sidecar.
+//
+// Speaks the framed packed-array protocol of
+// batch_scheduler_tpu/service/protocol.py:
+//
+//   frame := "BSO1" | u32 msg_type | u64 payload_len | payload  (LE)
+//
+// Exposed as a C API so it embeds anywhere the control plane lives: Go via
+// cgo, C++ directly, Python via ctypes (service/native.py). This is the
+// native half of the north star's data plane: the scheduler packs pod/node
+// resource lanes into flat int32 buffers and ships one batch per frame —
+// no per-pod marshalling anywhere on the hot path.
+//
+// Build: make -C native   (produces libbsp_client.so and bsp_bench)
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr char kMagic[4] = {'B', 'S', 'O', '1'};
+
+enum MsgType : uint32_t {
+  kScheduleReq = 1,
+  kScheduleResp = 2,
+  kRowReq = 3,
+  kRowResp = 4,
+  kPing = 5,
+  kPong = 6,
+  kError = 7,
+};
+
+struct Frame {
+  uint32_t msg_type = 0;
+  std::vector<uint8_t> payload;
+};
+
+class Conn {
+ public:
+  explicit Conn(int fd) : fd_(fd) {}
+  ~Conn() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool send_all(const void* data, size_t len) {
+    const uint8_t* p = static_cast<const uint8_t*>(data);
+    while (len) {
+      ssize_t n = ::send(fd_, p, len, 0);
+      if (n <= 0) {
+        if (n < 0 && errno == EINTR) continue;
+        return false;
+      }
+      p += n;
+      len -= static_cast<size_t>(n);
+    }
+    return true;
+  }
+
+  bool recv_all(void* data, size_t len) {
+    uint8_t* p = static_cast<uint8_t*>(data);
+    while (len) {
+      ssize_t n = ::recv(fd_, p, len, 0);
+      if (n <= 0) {
+        if (n < 0 && errno == EINTR) continue;
+        return false;
+      }
+      p += n;
+      len -= static_cast<size_t>(n);
+    }
+    return true;
+  }
+
+ private:
+  int fd_;
+};
+
+}  // namespace
+
+extern "C" {
+
+struct BspClient {
+  Conn* conn = nullptr;
+  std::string last_error;
+
+  bool write_frame(uint32_t msg_type, const std::vector<uint8_t>& payload) {
+    uint8_t header[16];
+    std::memcpy(header, kMagic, 4);
+    uint32_t type_le = msg_type;  // LE hosts only (TPU hosts are x86/ARM LE)
+    uint64_t len_le = payload.size();
+    std::memcpy(header + 4, &type_le, 4);
+    std::memcpy(header + 8, &len_le, 8);
+    if (!conn->send_all(header, sizeof(header))) {
+      last_error = "send failed";
+      return false;
+    }
+    if (!payload.empty() && !conn->send_all(payload.data(), payload.size())) {
+      last_error = "send failed";
+      return false;
+    }
+    return true;
+  }
+
+  bool read_frame(Frame* out) {
+    uint8_t header[16];
+    if (!conn->recv_all(header, sizeof(header))) {
+      last_error = "recv failed";
+      return false;
+    }
+    if (std::memcmp(header, kMagic, 4) != 0) {
+      last_error = "bad frame magic";
+      return false;
+    }
+    uint32_t msg_type;
+    uint64_t length;
+    std::memcpy(&msg_type, header + 4, 4);
+    std::memcpy(&length, header + 8, 8);
+    if (length > (256ull << 20)) {
+      last_error = "oversized frame";
+      return false;
+    }
+    out->msg_type = msg_type;
+    out->payload.resize(length);
+    if (length && !conn->recv_all(out->payload.data(), length)) {
+      last_error = "recv failed";
+      return false;
+    }
+    return true;
+  }
+
+  bool round_trip(uint32_t msg_type, const std::vector<uint8_t>& payload,
+                  Frame* resp) {
+    if (!write_frame(msg_type, payload) || !read_frame(resp)) return false;
+    if (resp->msg_type == kError) {
+      last_error.assign(resp->payload.begin(), resp->payload.end());
+      return false;
+    }
+    return true;
+  }
+};
+
+BspClient* bsp_connect(const char* host, int port) {
+  addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  std::string port_str = std::to_string(port);
+  if (getaddrinfo(host, port_str.c_str(), &hints, &res) != 0) return nullptr;
+  int fd = -1;
+  for (addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, 1 /*TCP_NODELAY*/, &one, sizeof(one));
+  auto* client = new BspClient();
+  client->conn = new Conn(fd);
+  return client;
+}
+
+void bsp_close(BspClient* c) {
+  if (!c) return;
+  delete c->conn;
+  delete c;
+}
+
+const char* bsp_last_error(BspClient* c) {
+  return c ? c->last_error.c_str() : "null client";
+}
+
+int bsp_ping(BspClient* c) {
+  Frame resp;
+  if (!c->round_trip(kPing, {}, &resp)) return -1;
+  return resp.msg_type == kPong ? 0 : -1;
+}
+
+static void append(std::vector<uint8_t>* buf, const void* data, size_t len) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  buf->insert(buf->end(), p, p + len);
+}
+
+// One oracle batch. All arrays row-major little-endian; outputs sized by the
+// caller: gang_feasible/placed/progress are [g]; assignment_* are
+// [g * k_capacity] with the actual K written to k_out (K <= k_capacity
+// required, server K is min(128, padded nodes)).
+int bsp_schedule(BspClient* c, int32_t n, int32_t g, int32_t r,
+                 const int32_t* alloc, const int32_t* requested,
+                 const int32_t* group_req, const int32_t* remaining,
+                 const uint8_t* fit_mask, const uint8_t* group_valid,
+                 const int32_t* order, const int32_t* min_member,
+                 const int32_t* scheduled, const int32_t* matched,
+                 const uint8_t* ineligible, const int32_t* creation_rank,
+                 uint8_t* gang_feasible, uint8_t* placed, int32_t* progress,
+                 int32_t* best, uint8_t* best_exists,
+                 int32_t* assignment_nodes, int32_t* assignment_counts,
+                 int32_t* k_out, int32_t k_capacity, uint32_t* batch_seq) {
+  std::vector<uint8_t> payload;
+  payload.reserve(12 + static_cast<size_t>(n) * r * 8 +
+                  static_cast<size_t>(g) * (r * 4 + n + 22));
+  uint32_t counts[3] = {static_cast<uint32_t>(n), static_cast<uint32_t>(g),
+                        static_cast<uint32_t>(r)};
+  append(&payload, counts, sizeof(counts));
+  append(&payload, alloc, static_cast<size_t>(n) * r * 4);
+  append(&payload, requested, static_cast<size_t>(n) * r * 4);
+  append(&payload, group_req, static_cast<size_t>(g) * r * 4);
+  append(&payload, remaining, static_cast<size_t>(g) * 4);
+  append(&payload, fit_mask, static_cast<size_t>(g) * n);
+  append(&payload, group_valid, static_cast<size_t>(g));
+  append(&payload, order, static_cast<size_t>(g) * 4);
+  append(&payload, min_member, static_cast<size_t>(g) * 4);
+  append(&payload, scheduled, static_cast<size_t>(g) * 4);
+  append(&payload, matched, static_cast<size_t>(g) * 4);
+  append(&payload, ineligible, static_cast<size_t>(g));
+  append(&payload, creation_rank, static_cast<size_t>(g) * 4);
+
+  Frame resp;
+  if (!c->round_trip(kScheduleReq, payload, &resp)) return -1;
+  if (resp.msg_type != kScheduleResp) {
+    c->last_error = "unexpected response type";
+    return -1;
+  }
+  const uint8_t* p = resp.payload.data();
+  size_t avail = resp.payload.size();
+  if (avail < 17) {
+    c->last_error = "short response";
+    return -1;
+  }
+  uint32_t resp_g, resp_k;
+  std::memcpy(&resp_g, p, 4);
+  std::memcpy(&resp_k, p + 4, 4);
+  std::memcpy(best, p + 8, 4);
+  *best_exists = p[12];
+  std::memcpy(batch_seq, p + 13, 4);
+  p += 17;
+  avail -= 17;
+  if (resp_g != static_cast<uint32_t>(g) ||
+      resp_k > static_cast<uint32_t>(k_capacity)) {
+    c->last_error = "response shape mismatch";
+    return -1;
+  }
+  size_t need = static_cast<size_t>(g) * 2 + static_cast<size_t>(g) * 4 +
+                static_cast<size_t>(g) * resp_k * 8;
+  if (avail != need) {
+    c->last_error = "response size mismatch";
+    return -1;
+  }
+  std::memcpy(gang_feasible, p, g);
+  p += g;
+  std::memcpy(placed, p, g);
+  p += g;
+  std::memcpy(progress, p, static_cast<size_t>(g) * 4);
+  p += static_cast<size_t>(g) * 4;
+  std::memcpy(assignment_nodes, p, static_cast<size_t>(g) * resp_k * 4);
+  p += static_cast<size_t>(g) * resp_k * 4;
+  std::memcpy(assignment_counts, p, static_cast<size_t>(g) * resp_k * 4);
+  *k_out = static_cast<int32_t>(resp_k);
+  return 0;
+}
+
+// Fetch one (group) row of "capacity" (kind=0) or "scores" (kind=1) from the
+// connection's last batch. Writes up to capacity int32s, count to n_out.
+int bsp_row(BspClient* c, int32_t kind, int32_t group_index,
+            uint32_t batch_seq, int32_t* out, int32_t capacity,
+            int32_t* n_out) {
+  std::vector<uint8_t> payload(9);
+  payload[0] = static_cast<uint8_t>(kind);
+  uint32_t g_le = static_cast<uint32_t>(group_index);
+  std::memcpy(payload.data() + 1, &g_le, 4);
+  std::memcpy(payload.data() + 5, &batch_seq, 4);
+  Frame resp;
+  if (!c->round_trip(kRowReq, payload, &resp)) return -1;
+  if (resp.msg_type != kRowResp) {
+    c->last_error = "unexpected response type";
+    return -1;
+  }
+  size_t count = resp.payload.size() / 4;
+  if (count > static_cast<size_t>(capacity)) {
+    c->last_error = "row larger than buffer";
+    return -1;
+  }
+  std::memcpy(out, resp.payload.data(), count * 4);
+  *n_out = static_cast<int32_t>(count);
+  return 0;
+}
+
+}  // extern "C"
