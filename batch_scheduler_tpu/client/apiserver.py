@@ -20,7 +20,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..api.serde import object_from_dict
-from ..api.types import to_dict
+from ..api.types import new_uid, to_dict
 from ..utils.patch import apply_merge_patch
 
 __all__ = ["APIServer", "WatchEvent", "NotFoundError", "ConflictError", "AlreadyExistsError"]
@@ -106,6 +106,10 @@ class APIServer:
             meta["resource_version"] = self._rv
             if not meta.get("creation_timestamp"):
                 meta["creation_timestamp"] = self._clock()
+            # the real API server always stamps a UID at admission; gang
+            # accounting (MatchedPodNodes/PodNameUIDs) is keyed by it
+            if not meta.get("uid"):
+                meta["uid"] = new_uid(kind.lower())
             store[key] = d
             self._notify(kind, WatchEvent(WatchEvent.ADDED, kind, copy.deepcopy(d)))
             return copy.deepcopy(d)

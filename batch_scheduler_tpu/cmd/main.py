@@ -1,0 +1,285 @@
+"""``python -m batch_scheduler_tpu`` — the framework's CLI entry point.
+
+The reference's entry point registers the plugin into upstream
+kube-scheduler's cobra command and defers all flags to it (reference
+cmd/scheduler/main.go:28-36, deploy/start.sh:1-3). This framework owns its
+whole stack, so the CLI exposes the workflows directly:
+
+  sim           run the full scheduler over a simulated cluster (scenario
+                generators or -f Kubernetes manifests), print the outcome
+  serve         run the TPU oracle sidecar service (packed-array protocol)
+  check-config  validate a scheduler configuration JSON
+  version       print the build stamp
+
+``--config`` takes the same JSON shape as the reference's
+``KubeSchedulerConfiguration`` (extension points + pluginConfig args).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional
+
+from .config import load_scheduler_config
+
+
+def _add_config_flag(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--config",
+        default=None,
+        help="scheduler configuration JSON (KubeSchedulerConfiguration shape)",
+    )
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="batch-scheduler-tpu",
+        description="TPU-native gang/batch scheduling framework",
+    )
+    parser.add_argument("--v", type=int, default=0, help="log verbosity (klog-style)")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    sim = sub.add_parser("sim", help="run the scheduler over a simulated cluster")
+    _add_config_flag(sim)
+    sim.add_argument(
+        "-f",
+        "--filename",
+        action="append",
+        default=[],
+        help="Kubernetes manifest(s) to apply (PodGroup/Pod/Node/workloads)",
+    )
+    sim.add_argument("--scenario", choices=["race", "synthetic"], default=None)
+    sim.add_argument("--scorer", choices=["oracle", "serial"], default=None,
+                     help="override the scorer gate (--scorer=tpu north star)")
+    sim.add_argument("--oracle-addr", default=None, metavar="HOST:PORT",
+                     help="score via a remote oracle sidecar (see `serve`) "
+                          "instead of the in-process oracle")
+    sim.add_argument("--nodes", type=int, default=0,
+                     help="synthetic nodes to add (in addition to manifests)")
+    sim.add_argument("--node-cpu", default="32")
+    sim.add_argument("--node-memory", default="128Gi")
+    sim.add_argument("--groups", type=int, default=10, help="synthetic scenario groups")
+    sim.add_argument("--members", type=int, default=5, help="pods per synthetic group")
+    sim.add_argument("--timeout", type=float, default=60.0)
+    sim.add_argument("--settle", type=float, default=3.0,
+                     help="finish early once group phases and bound counts "
+                          "have been stable this many seconds (a denied gang "
+                          "never reaches a terminal phase)")
+
+    serve = sub.add_parser("serve", help="run the TPU oracle sidecar service")
+    serve.add_argument("--host", default="127.0.0.1")
+    serve.add_argument("--port", type=int, default=9090)
+    serve.add_argument(
+        "--warmup",
+        action="store_true",
+        help="jit-compile the smallest bucket shape before accepting traffic "
+             "(first TPU compile is ~20-40s; warmed shapes answer instantly)",
+    )
+
+    chk = sub.add_parser("check-config", help="validate a scheduler config JSON")
+    _add_config_flag(chk)
+
+    sub.add_parser("version", help="print the build stamp")
+    return parser
+
+
+def cmd_version(_args) -> int:
+    from ..version import version_string
+
+    print(version_string())
+    return 0
+
+
+def cmd_check_config(args) -> int:
+    cfg = load_scheduler_config(args.config)
+    print(
+        json.dumps(
+            {
+                "valid": True,
+                "scorer": cfg.plugin_config.scorer,
+                "max_schedule_minutes": cfg.plugin_config.max_schedule_minutes,
+                "enabled_points": sorted(cfg.enabled_points),
+                "controller_workers": cfg.plugin_config.controller_workers,
+            }
+        )
+    )
+    return 0
+
+
+def cmd_serve(args) -> int:
+    from ..service.server import OracleServer
+
+    if args.warmup:
+        import jax
+
+        from ..ops.oracle import schedule_batch
+        from ..ops.snapshot import ClusterSnapshot, GroupDemand
+        from ..sim.scenarios import make_sim_node
+
+        t0 = time.perf_counter()
+        snap = ClusterSnapshot(
+            [make_sim_node("warm", {"cpu": "8", "memory": "32Gi", "pods": "110"})],
+            {},
+            [GroupDemand("default/warm", 1, member_request={"cpu": 1000})],
+        )
+        jax.block_until_ready(schedule_batch(*snap.device_args())["placed"])
+        print(f"warmup compile done in {time.perf_counter() - t0:.1f}s", flush=True)
+
+    server = OracleServer(host=args.host, port=args.port)
+    host, port = server.address
+    print(f"oracle sidecar listening on {host}:{port}", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        server.server_close()
+    return 0
+
+
+def _terminal(phase) -> bool:
+    from ..api import PodGroupPhase
+
+    return phase in (
+        PodGroupPhase.RUNNING,
+        PodGroupPhase.FINISHED,
+        PodGroupPhase.FAILED,
+    )
+
+
+def cmd_sim(args) -> int:
+    from ..api.manifest import load_manifest_file
+    from ..api.types import Node, Pod, PodGroup
+    from ..sim import SimCluster, make_member_pods, make_sim_group, make_sim_node
+    from ..sim.scenarios import race_scenario
+
+    cfg = load_scheduler_config(args.config)
+    if args.scorer:
+        cfg.plugin_config.scorer = args.scorer
+
+    scorer = cfg.plugin_config.scorer
+    oracle_client = None
+    if args.oracle_addr:
+        from ..service.client import OracleClient, RemoteScorer
+
+        host, _, port = args.oracle_addr.rpartition(":")
+        oracle_client = OracleClient(host or "127.0.0.1", int(port))
+        scorer = RemoteScorer(oracle_client)
+
+    cluster = SimCluster(
+        scorer=scorer,
+        max_schedule_minutes=cfg.plugin_config.max_schedule_minutes,
+        enabled_points=cfg.enabled_points,
+    )
+
+    nodes: List[Node] = []
+    groups: List[PodGroup] = []
+    pods: List[Pod] = []
+
+    for path in args.filename:
+        for obj in load_manifest_file(path):
+            if isinstance(obj, Node):
+                nodes.append(obj)
+            elif isinstance(obj, PodGroup):
+                groups.append(obj)
+            elif isinstance(obj, Pod):
+                pods.append(obj)
+
+    if args.scenario == "race":
+        rnodes, rgroups, rpods = race_scenario()
+        nodes += rnodes
+        groups += rgroups
+        for plist in rpods.values():
+            pods += plist
+    elif args.scenario == "synthetic":
+        for g in range(args.groups):
+            name = f"group-{g:03d}"
+            groups.append(make_sim_group(name, args.members))
+            pods += make_member_pods(name, args.members, {"cpu": "1"})
+
+    for i in range(args.nodes):
+        nodes.append(
+            make_sim_node(
+                f"sim-node-{i:04d}",
+                {"cpu": args.node_cpu, "memory": args.node_memory, "pods": "110"},
+            )
+        )
+
+    if not nodes:
+        print("error: no nodes (use -f with Node manifests or --nodes N)", file=sys.stderr)
+        return 2
+    if not groups:
+        print("error: no PodGroups (use -f or --scenario)", file=sys.stderr)
+        return 2
+
+    cluster.add_nodes(nodes)
+    for pg in groups:
+        cluster.create_group(pg)
+    cluster.start()
+    try:
+        cluster.create_pods(pods)
+
+        deadline = time.monotonic() + args.timeout
+        names = [(pg.metadata.namespace, pg.metadata.name) for pg in groups]
+        last_state, stable_since = None, time.monotonic()
+        while time.monotonic() < deadline:
+            state = tuple(
+                (
+                    cluster.group_phase(n, ns),
+                    sum(1 for p in cluster.member_pods(n, ns) if p.spec.node_name),
+                )
+                for ns, n in names
+            )
+            if all(_terminal(p) for p, _ in state):
+                break
+            now = time.monotonic()
+            if state != last_state:
+                last_state, stable_since = state, now
+            elif now - stable_since >= args.settle:
+                # nothing has moved for a while: denied gangs never reach a
+                # terminal phase, so this is the settled outcome
+                break
+            time.sleep(0.2)
+
+        print(f"{'GROUP':<28} {'PHASE':<14} {'MINMEMBER':>9} {'BOUND':>6} MEMBERS")
+        for ns, name in names:
+            pg = cluster.group(name, ns)
+            members = cluster.member_phase_counts(name, ns)
+            bound = sum(
+                1 for p in cluster.member_pods(name, ns) if p.spec.node_name
+            )
+            print(
+                f"{ns + '/' + name:<28} {pg.status.phase.value or 'Pending':<14} "
+                f"{pg.spec.min_member:>9} {bound:>6} {members}"
+            )
+        stats = cluster.scheduler.stats
+        print(f"scheduler stats: {dict(stats)}")
+    finally:
+        cluster.stop()
+        if oracle_client is not None:
+            oracle_client.close()
+    return 0
+
+
+COMMANDS = {
+    "version": cmd_version,
+    "check-config": cmd_check_config,
+    "serve": cmd_serve,
+    "sim": cmd_sim,
+}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    # klog-style: --v 0 warnings only, 1-2 info, >=3 debug
+    import logging
+
+    level = (
+        logging.WARNING if args.v <= 0 else logging.INFO if args.v <= 2 else logging.DEBUG
+    )
+    logging.basicConfig(level=level)
+    return COMMANDS[args.command](args)

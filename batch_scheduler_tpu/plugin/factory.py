@@ -24,6 +24,7 @@ from ..client.informers import SharedInformerFactory
 from ..controller.controller import PodGroupController
 from ..core.operation import ScheduleOperation
 from .batch_plugin import BatchSchedulingPlugin
+from .gate import ALL_EXTENSION_POINTS, ExtensionPointGate
 from .leader import InMemoryLease, try_run_controller
 
 __all__ = ["PluginConfig", "PluginRuntime", "new_plugin_runtime"]
@@ -41,6 +42,11 @@ class PluginConfig:
     scorer: str = "oracle"
     controller_workers: int = 10
     leader_poll_seconds: float = 1.0
+    # Extension points the plugin is enabled at (config-file surface,
+    # reference batch_scheduler_config.json:7-36). Default: all — a superset
+    # of the reference's shipped four (it omits filter/score; we keep score
+    # on so node selection reads oracle ranks).
+    enabled_points: frozenset = ALL_EXTENSION_POINTS
     controller_resync_seconds: float = 0.5
     identity: str = field(default_factory=socket.gethostname)
 
@@ -138,6 +144,8 @@ def new_plugin_runtime(
         pg_client=pg_client,
         max_schedule_seconds=config.max_schedule_seconds,
     )
+    if frozenset(config.enabled_points) != ALL_EXTENSION_POINTS:
+        plugin = ExtensionPointGate(plugin, config.enabled_points)
 
     # CRD auto-create, ignoring AlreadyExists (reference :416-436)
     api.ensure_crd(

@@ -23,7 +23,9 @@ __all__ = ["OracleClient", "RemoteScorer"]
 
 
 class OracleClient:
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
+    # default generous enough to sit through a first TPU jit compile of a
+    # new bucket shape (~20-40s) plus the batch itself
+    def __init__(self, host: str, port: int, timeout: float = 120.0):
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()

@@ -36,15 +36,18 @@ class SimCluster:
         backoff_base: float = 0.2,
         backoff_cap: float = 2.0,
         controller_resync_seconds: float = 0.1,
+        enabled_points=None,
     ):
         self.api = APIServer()
         self.clientset = Clientset(self.api)
         self.cluster = ClusterState()
 
+        kwargs = {} if enabled_points is None else {"enabled_points": frozenset(enabled_points)}
         config = PluginConfig(
             scorer=scorer,
             max_schedule_minutes=max_schedule_minutes,
             controller_resync_seconds=controller_resync_seconds,
+            **kwargs,
         )
         self.runtime = None
 

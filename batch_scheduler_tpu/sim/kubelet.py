@@ -45,6 +45,7 @@ class SimKubelet:
         self._pending: list = []  # heap of (due, seq, ns, name, next_phase)
         self._seq = 0
         self._threads = []
+        self._events = None
 
     def start(self) -> None:
         self._events = self.api.watch("Pod", replay=True)
@@ -57,7 +58,8 @@ class SimKubelet:
 
     def stop(self) -> None:
         self._stop.set()
-        self.api.stop_watch("Pod", self._events)
+        if self._events is not None:
+            self.api.stop_watch("Pod", self._events)
 
     def _schedule_transition(self, ns: str, name: str, phase: PodPhase, delay: float) -> None:
         with self._lock:

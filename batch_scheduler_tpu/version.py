@@ -1,0 +1,49 @@
+"""Build/version stamping — the analog of the reference's ldflags injection
+of gitVersion/gitCommit/buildDate into the binary (reference version.sh:3-38,
+Makefile:23-26). Python has no link step, so the stamp is resolved lazily
+from git with static fallbacks.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import subprocess
+from typing import Dict
+
+VERSION = "0.1.0"
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _git(*args: str) -> str:
+    try:
+        out = subprocess.run(
+            ["git", "-C", _REPO_ROOT, *args],
+            capture_output=True,
+            text=True,
+            timeout=5,
+        )
+        return out.stdout.strip() if out.returncode == 0 else ""
+    except Exception:
+        return ""
+
+
+def version_info() -> Dict[str, str]:
+    commit = _git("rev-parse", "HEAD")
+    dirty = bool(_git("status", "--porcelain"))
+    return {
+        "version": VERSION,
+        "gitCommit": commit or "unknown",
+        "gitTreeState": "dirty" if dirty else "clean",
+        "buildDate": datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%SZ"
+        ),
+    }
+
+
+def version_string() -> str:
+    info = version_info()
+    return (
+        f"batch-scheduler-tpu v{info['version']} "
+        f"({info['gitCommit'][:14]}, {info['gitTreeState']}) {info['buildDate']}"
+    )

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Launcher — analog of the reference's deploy/start.sh:1-3 (CRD apply +
+# nohup'd scheduler with --v=5 --config). Here the "cluster" is the sim
+# harness and the TPU oracle runs as a sidecar service.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# sidecar: the TPU oracle service (packed-array protocol, port 9090),
+# warmed so the first scheduling round isn't waiting on a jit compile
+nohup python -m batch_scheduler_tpu serve --port 9090 --warmup > oracle.log 2>&1 &
+ORACLE_PID=$!
+trap 'kill "$ORACLE_PID" 2>/dev/null || true' EXIT
+echo "oracle sidecar pid $ORACLE_PID"
+for _ in $(seq 120); do
+  grep -q "listening on" oracle.log 2>/dev/null && break
+  sleep 1
+done
+
+# scheduler over the example gang workload, scoring via the sidecar
+python -m batch_scheduler_tpu --v 5 sim \
+  --config deploy/scheduler/config/batch_scheduler_config.json \
+  --oracle-addr 127.0.0.1:9090 \
+  -f examples/example1.yaml --nodes 4 --node-cpu 4 --settle 15

@@ -1,0 +1,298 @@
+"""CLI layer tests: manifest loading, scheduler-config parsing, the
+extension-point gate, and the `sim` command end-to-end on the examples/
+manifests (the reference's gang demo and README race demo)."""
+
+import json
+import os
+
+import pytest
+
+from batch_scheduler_tpu.api.manifest import (
+    expand_workload,
+    load_manifest_file,
+    load_manifests,
+)
+from batch_scheduler_tpu.api.types import Node, Pod, PodGroup
+from batch_scheduler_tpu.cmd.config import SchedulerConfiguration, load_scheduler_config
+from batch_scheduler_tpu.cmd.main import main
+from batch_scheduler_tpu.plugin.gate import (
+    ALL_EXTENSION_POINTS,
+    DEFAULT_ENABLED,
+    ExtensionPointGate,
+)
+from batch_scheduler_tpu.framework.types import StatusCode
+from batch_scheduler_tpu.utils.labels import POD_GROUP_LABEL
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- manifest loader ---------------------------------------------------------
+
+
+def test_example1_manifest_expands_statefulset():
+    objs = load_manifest_file(os.path.join(REPO, "examples", "example1.yaml"))
+    groups = [o for o in objs if isinstance(o, PodGroup)]
+    pods = [o for o in objs if isinstance(o, Pod)]
+    assert len(groups) == 1 and groups[0].spec.min_member == 9
+    assert len(pods) == 9
+    names = {p.metadata.name for p in pods}
+    assert "web-group-valid1-0" in names and "web-group-valid1-8" in names
+    for p in pods:
+        assert p.metadata.labels[POD_GROUP_LABEL] == "group1"
+        # "1" cpu limit+request -> canonical 1000 milli
+        assert p.resource_require() == {"cpu": 1000}
+
+
+def test_race_manifest_node_quantities():
+    objs = load_manifest_file(os.path.join(REPO, "examples", "race.yaml"))
+    nodes = [o for o in objs if isinstance(o, Node)]
+    assert len(nodes) == 1
+    assert nodes[0].status.allocatable["cpu"] == 7100
+    assert nodes[0].status.allocatable["memory"] == 32 * 1024**3
+    assert nodes[0].status.allocatable["pods"] == 110
+
+
+def test_duration_parsing():
+    from batch_scheduler_tpu.api.manifest import _duration_seconds
+
+    assert _duration_seconds(None) is None
+    assert _duration_seconds(90) == 90.0
+    assert _duration_seconds("30s") == 30.0
+    assert _duration_seconds("1m30s") == 90.0
+    assert _duration_seconds("500ms") == 0.5
+    assert _duration_seconds("1h2m3s") == 3723.0
+    assert _duration_seconds("2.5m") == 150.0
+    with pytest.raises(ValueError, match="maxScheduleTime"):
+        _duration_seconds("tomorrow")
+
+
+def test_manifest_skips_unknown_kinds_and_parses_durations():
+    text = """
+apiVersion: v1
+kind: Service
+metadata: {name: svc}
+---
+apiVersion: batch.scheduler.tpu/v1
+kind: PodGroup
+metadata: {name: g}
+spec:
+  minMember: 3
+  maxScheduleTime: 5m
+  minResources: {cpu: "2", memory: 1Gi}
+"""
+    objs = load_manifests(text)
+    assert len(objs) == 1
+    pg = objs[0]
+    assert pg.spec.max_schedule_time == 300.0
+    assert pg.spec.min_resources == {"cpu": 2000, "memory": 1024**3}
+
+
+def test_expand_job_uses_parallelism():
+    pods = expand_workload(
+        {
+            "kind": "Job",
+            "metadata": {"name": "j", "namespace": "ns1"},
+            "spec": {
+                "parallelism": 3,
+                "template": {
+                    "metadata": {"labels": {POD_GROUP_LABEL: "g"}},
+                    "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "500m"}}}]},
+                },
+            },
+        }
+    )
+    assert [p.metadata.name for p in pods] == ["j-0", "j-1", "j-2"]
+    assert pods[0].metadata.namespace == "ns1"
+    assert pods[0].resource_require() == {"cpu": 500}
+
+
+# -- scheduler configuration -------------------------------------------------
+
+
+def test_load_shipped_config():
+    cfg = load_scheduler_config(
+        os.path.join(REPO, "deploy", "scheduler", "config", "batch_scheduler_config.json")
+    )
+    assert cfg.plugin_config.scorer == "oracle"
+    assert cfg.plugin_config.max_schedule_minutes == 10
+    assert cfg.enabled_points == ALL_EXTENSION_POINTS
+
+
+def test_load_reference_parity_config():
+    """The reference's shipped KubeSchedulerConfiguration shape parses, with
+    its four extension points and no filter/score (reference
+    deploy/scheduler/config/batch_scheduler_config.json:7-36)."""
+    cfg = load_scheduler_config(
+        os.path.join(REPO, "deploy", "scheduler", "config", "reference_parity_config.json")
+    )
+    assert cfg.enabled_points == DEFAULT_ENABLED
+    assert cfg.plugin_config.scorer == "serial"
+    assert cfg.kubeconfig  # clientConnection surfaced
+
+
+def test_default_config_and_bad_kind():
+    assert load_scheduler_config(None).enabled_points == DEFAULT_ENABLED
+    with pytest.raises(ValueError):
+        SchedulerConfiguration.from_dict({"kind": "Deployment"})
+    with pytest.raises(ValueError):
+        SchedulerConfiguration.from_dict(
+            {"plugins": {"bogusPoint": {"enabled": [{"name": "batch-scheduler"}]}}}
+        )
+
+
+# -- extension-point gate ----------------------------------------------------
+
+
+class _RecordingPlugin:
+    def __init__(self):
+        self.calls = []
+
+    def less(self, a, b):
+        self.calls.append("less")
+        return True
+
+    def pre_filter(self, pod):
+        self.calls.append("pre_filter")
+
+    def filter(self, pod, node):
+        self.calls.append("filter")
+
+    def score(self, pod, node):
+        self.calls.append("score")
+        return 7
+
+    def permit(self, pod, node):
+        self.calls.append("permit")
+        return (StatusCode.WAIT, 1.0)
+
+    def post_bind(self, pod, node):
+        self.calls.append("post_bind")
+
+    def reject_pod(self, uid):
+        self.calls.append("reject_pod")
+
+
+def test_gate_reference_default_disables_filter_and_score():
+    base = _RecordingPlugin()
+    gate = ExtensionPointGate(base, DEFAULT_ENABLED)
+    gate.filter(None, "n")  # disabled -> no-op, no exception
+    assert gate.score(None, "n") == 0
+    gate.pre_filter(None)
+    assert gate.permit(None, "n") == (StatusCode.WAIT, 1.0)
+    gate.post_bind(None, "n")
+    gate.reject_pod("u")  # non-extension-point methods always pass through
+    assert base.calls == ["pre_filter", "permit", "post_bind", "reject_pod"]
+
+
+def test_gate_disabled_queue_sort_falls_back_to_fifo():
+    class Info:
+        def __init__(self, ts):
+            self.timestamp = ts
+
+    gate = ExtensionPointGate(_RecordingPlugin(), frozenset())
+    assert gate.less(Info(1.0), Info(2.0)) is True
+    assert gate.less(Info(2.0), Info(1.0)) is False
+    assert gate.permit(None, "n") == (StatusCode.SUCCESS, 0.0)
+
+
+def test_gate_rejects_unknown_point():
+    with pytest.raises(ValueError):
+        ExtensionPointGate(_RecordingPlugin(), {"preFilter", "bogus"})
+
+
+# -- sim command end-to-end --------------------------------------------------
+
+
+def test_cli_check_config(capsys):
+    rc = main(
+        [
+            "check-config",
+            "--config",
+            os.path.join(REPO, "deploy", "scheduler", "config", "batch_scheduler_config.json"),
+        ]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["valid"] and out["scorer"] == "oracle"
+
+
+def test_cli_version(capsys):
+    assert main(["version"]) == 0
+    assert "batch-scheduler-tpu v" in capsys.readouterr().out
+
+
+@pytest.mark.parametrize("scorer", ["oracle", "serial"])
+def test_cli_sim_race_manifest(scorer, capsys):
+    """README race demo through the real CLI: exactly one gang wins."""
+    rc = main(
+        [
+            "sim",
+            "-f",
+            os.path.join(REPO, "examples", "race.yaml"),
+            "--scorer",
+            scorer,
+            "--timeout",
+            "30",
+            "--settle",
+            "2",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    lines = {l.split()[0]: l.split() for l in out.splitlines() if l.startswith("default/")}
+    winner = lines["default/web-group-race1"]
+    loser = lines["default/web-group-race2"]
+    assert winner[1] == "Running" and winner[3] == "5"
+    assert loser[3] == "0"
+
+
+def test_cli_sim_requires_nodes_and_groups(capsys):
+    assert main(["sim", "--timeout", "1"]) == 2
+
+
+def test_cli_sim_remote_scorer(capsys):
+    """sim --oracle-addr scores through the sidecar service (the start.sh
+    deployment shape: scheduler process + oracle sidecar)."""
+    from batch_scheduler_tpu.service.server import serve_background
+
+    server = serve_background()
+    host, port = server.address
+    try:
+        rc = main(
+            [
+                "sim",
+                "-f",
+                os.path.join(REPO, "examples", "example1.yaml"),
+                "--nodes",
+                "4",
+                "--node-cpu",
+                "4",
+                "--oracle-addr",
+                f"{host}:{port}",
+                "--timeout",
+                "30",
+                "--settle",
+                "2",
+            ]
+        )
+    finally:
+        server.shutdown()
+        server.server_close()
+    assert rc == 0
+    out = capsys.readouterr().out
+    row = next(l.split() for l in out.splitlines() if l.startswith("default/group1"))
+    assert row[1] == "Running" and row[3] == "9"
+
+
+def test_sim_cluster_enabled_points_passthrough():
+    """cfg.plugins gating reaches the runtime: with permit disabled the
+    plugin never parks pods, binds go straight through."""
+    from batch_scheduler_tpu.plugin.gate import ExtensionPointGate
+    from batch_scheduler_tpu.sim import SimCluster
+
+    cluster = SimCluster(enabled_points={"queueSort", "preFilter", "postBind"})
+    try:
+        assert isinstance(cluster.runtime.plugin, ExtensionPointGate)
+        assert "permit" not in cluster.runtime.plugin.enabled
+    finally:
+        cluster.stop()
