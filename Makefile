@@ -1,0 +1,52 @@
+# Build/test/bench entry points — the analog of the reference's Makefile
+# (vet + static build + image targets, reference Makefile:23-45). Python has
+# no link step; "build" here means byte-compile + native client build, and
+# "vet" is a strict syntax/import sweep.
+
+PY ?= python
+
+.PHONY: all build vet test test-cpu bench native ladder dryrun clean version
+
+all: vet native test
+
+build: vet native
+
+# go-vet analog: byte-compile every module, fail on syntax errors
+vet:
+	$(PY) -m compileall -q batch_scheduler_tpu tests bench.py __graft_entry__.py
+
+# the native C++ sidecar client + bench harness
+native:
+	$(MAKE) -C native
+
+# full suite (CPU-mesh conftest handles multi-device paths)
+test:
+	$(PY) -m pytest tests/ -q
+
+test-cpu:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q
+
+# headline benchmark on the default platform (one JSON line)
+bench:
+	$(PY) bench.py
+
+# BASELINE.json measurement ladder, configs 1-5
+ladder:
+	$(PY) benchmarks/ladder.py
+
+# driver-style entry checks: single-chip jit + 8-device sharded dry run.
+# NB: this environment's sitecustomize registers the TPU plugin and overrides
+# the jax_platforms config — env vars alone don't switch to CPU; the config
+# update below is what makes the virtual 8-device CPU mesh take effect.
+dryrun:
+	$(PY) -c "import __graft_entry__ as g; fn, args = g.entry(); fn(*args); print('entry OK')"
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) -c "import jax; jax.config.update('jax_platforms', 'cpu'); \
+		import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+version:
+	$(PY) -m batch_scheduler_tpu version
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} +
