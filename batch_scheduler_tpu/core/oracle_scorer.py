@@ -138,14 +138,7 @@ class OracleScorer:
         host result dict and a lazy (G,N)-row fetcher. RemoteScorer swaps
         this for the sidecar round-trip."""
         host, device_result = execute_batch_host(
-            snap.device_args(),
-            (
-                snap.min_member,
-                snap.scheduled,
-                snap.matched,
-                snap.ineligible,
-                snap.creation_rank,
-            ),
+            snap.device_args(), snap.progress_args()
         )
 
         def row_fetcher(kind: str, g: int) -> np.ndarray:

@@ -54,6 +54,7 @@ def pad_oracle_batch(
     matched,
     ineligible,
     creation_rank,
+    min_buckets=(0, 0),
 ):
     """Bucket-pad one oracle batch with the canonical sentinel fills.
 
@@ -67,13 +68,17 @@ def pad_oracle_batch(
       order (remaining == 0, so they place nothing);
     - padded nodes: zero lanes (capacity 0), masked out of every fit row.
 
+    ``min_buckets=(G, N)`` sets floor bucket sizes — churn re-scoring pins
+    them to the largest shape seen so a shrinking cluster never triggers a
+    fresh compile (ops.rescore sticky buckets).
+
     Returns ``(batch_args, progress_args)`` ready for
     ``ops.oracle.schedule_batch`` / ``find_max_group``.
     """
     n = alloc.shape[0]
     g = group_req.shape[0]
-    nb = bucket_size(max(n, 1))
-    gb = bucket_size(max(g, 1))
+    nb = max(bucket_size(max(n, 1)), min_buckets[1])
+    gb = max(bucket_size(max(g, 1)), min_buckets[0])
     batch_args = (
         pad_rows(np.asarray(alloc, dtype=np.int32), nb),
         pad_rows(np.asarray(requested, dtype=np.int32), nb),

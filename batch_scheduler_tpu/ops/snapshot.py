@@ -78,6 +78,10 @@ class ClusterSnapshot:
         nodes: Sequence[Node],
         node_requested: Dict[str, Dict[str, int]],
         groups: Sequence[GroupDemand],
+        schema: Optional[LaneSchema] = None,
+        requested_lanes: Optional[np.ndarray] = None,
+        alloc_lanes: Optional[np.ndarray] = None,
+        min_buckets: tuple = (0, 0),
     ):
         self.node_names = [n.metadata.name for n in nodes]
         self.group_names = [g.full_name for g in groups]
@@ -85,7 +89,10 @@ class ClusterSnapshot:
         self._node_index = {n: i for i, n in enumerate(self.node_names)}
         self._group_index = {g: i for i, g in enumerate(self.group_names)}
 
-        self.schema = LaneSchema.collect(
+        # a caller-pinned schema keeps the lane dimension stable across
+        # successive snapshots (churn re-scoring must hit the jit cache;
+        # a resource name appearing/vanishing would otherwise change R)
+        self.schema = schema or LaneSchema.collect(
             [node_requested.get(n.metadata.name, {}) for n in nodes]
             + [n.status.allocatable for n in nodes]
             + [g.member_request for g in groups]
@@ -94,12 +101,32 @@ class ClusterSnapshot:
         self.num_nodes = len(nodes)
         self.num_groups = len(groups)
 
-        alloc = self.schema.pack_many(
-            [n.status.allocatable for n in nodes], capacity=True
-        )
-        requested = self.schema.pack_many(
-            [node_requested.get(n.metadata.name, {}) for n in nodes]
-        )
+        if alloc_lanes is not None:
+            alloc = np.asarray(alloc_lanes, dtype=np.int32)
+            if alloc.shape != (len(nodes), self.schema.num_lanes):
+                raise ValueError(
+                    f"alloc_lanes shape {alloc.shape} != "
+                    f"({len(nodes)}, {self.schema.num_lanes})"
+                )
+        else:
+            alloc = self.schema.pack_many(
+                [n.status.allocatable for n in nodes], capacity=True
+            )
+        if requested_lanes is not None:
+            # dense fast path for churn re-scoring: the caller maintains the
+            # (N, R) requested array in device units and skips dict packing.
+            # Copied: the caller keeps mutating its array (admit/release) and
+            # the snapshot must stay what was actually scored.
+            requested = np.array(requested_lanes, dtype=np.int32)
+            if requested.shape != (len(nodes), self.schema.num_lanes):
+                raise ValueError(
+                    f"requested_lanes shape {requested.shape} != "
+                    f"({len(nodes)}, {self.schema.num_lanes})"
+                )
+        else:
+            requested = self.schema.pack_many(
+                [node_requested.get(n.metadata.name, {}) for n in nodes]
+            )
         node_valid = np.array(
             [not n.spec.unschedulable for n in nodes], dtype=bool
         )
@@ -126,6 +153,7 @@ class ClusterSnapshot:
         ranks[order_host] = np.arange(len(groups), dtype=np.int32)
 
         batch_args, progress_args = pad_oracle_batch(
+            min_buckets=min_buckets,
             alloc=alloc,
             requested=requested,
             group_req=group_req,
@@ -196,6 +224,16 @@ class ClusterSnapshot:
             self.fit_mask,
             self.group_valid,
             self.order,
+        )
+
+    def progress_args(self) -> tuple:
+        """Argument tuple for ops.oracle.find_max_group."""
+        return (
+            self.min_member,
+            self.scheduled,
+            self.matched,
+            self.ineligible,
+            self.creation_rank,
         )
 
     @property

@@ -1,0 +1,293 @@
+"""BASELINE.json measurement ladder, configs 1-5.
+
+Each config prints one JSON line; the headline (config 4) matches bench.py.
+Run from the repo root: ``python benchmarks/ladder.py [--configs 1,2,5]``.
+
+  1  README race: 2 PodGroups x 5 pods, 1 node — full framework end-to-end
+     (API server, scheduler, plugin, controller, sim kubelet), in-process
+     serial scorer: reference-parity functional baseline.
+  2  100 PG x 10 pods, 50 nodes, cpu+mem — scoring through the sidecar
+     service (packed-array protocol), the Go-plugin deployment shape.
+  3  1k PG, 500 nodes, mixed priorities — queue-order (Compare semantics)
+     batched into the oracle's assignment scan on one chip.
+  4  10k pods / 5k nodes, extended-resources (nvidia.com/gpu) bin-packing —
+     the bench.py headline batch.
+  5  config 4 under churn: every 100ms tick, ~2% of running gangs finish
+     (freeing capacity) and new gangs arrive; sustained re-score latency
+     must hold the tick budget with zero steady-state recompiles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# runnable as `python benchmarks/ladder.py` from the repo root (PYTHONPATH
+# must stay unset in this environment — it breaks the TPU plugin)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GPU = "nvidia.com/gpu"
+
+
+def _emit(config: int, metric: str, value: float, unit: str, **detail):
+    print(
+        json.dumps(
+            {
+                "config": config,
+                "metric": metric,
+                "value": round(value, 5),
+                "unit": unit,
+                "detail": detail,
+            }
+        ),
+        flush=True,
+    )
+
+
+def config1_race_e2e():
+    """Full-framework race demo wall-clock to settled outcome."""
+    from batch_scheduler_tpu.api import PodGroupPhase
+    from batch_scheduler_tpu.sim import SimCluster
+    from batch_scheduler_tpu.sim.scenarios import race_scenario
+
+    cluster = SimCluster(scorer="serial")
+    nodes, groups, pods = race_scenario()
+    cluster.add_nodes(nodes)
+    for pg in groups:
+        cluster.create_group(pg)
+    cluster.start()
+    t0 = time.perf_counter()
+    try:
+        for plist in pods.values():
+            cluster.create_pods(plist)
+        ok = cluster.wait_for_bound("web-group-race1", 5, timeout=30.0)
+        elapsed = time.perf_counter() - t0
+        loser_bound = sum(
+            1 for p in cluster.member_pods("web-group-race2") if p.spec.node_name
+        )
+    finally:
+        cluster.stop()
+    _emit(
+        1,
+        "race_2x5_e2e_wall_clock",
+        elapsed,
+        "s",
+        winner_bound_5=ok,
+        loser_bound=loser_bound,
+        gang_exclusive=ok and loser_bound == 0,
+    )
+
+
+def _synthetic_demands(num_groups, members, cpu=2000, mem=4 * 1024**3, extra=None):
+    from batch_scheduler_tpu.ops.snapshot import GroupDemand
+
+    out = []
+    for g in range(num_groups):
+        req = {"cpu": cpu, "memory": mem}
+        if extra:
+            req.update(extra)
+        out.append(
+            GroupDemand(
+                full_name=f"default/gang-{g:05d}",
+                min_member=members,
+                member_request=req,
+                creation_ts=float(g),
+                priority=(g % 3) - 1,  # mixed priorities for config 3
+                has_pod=True,
+            )
+        )
+    return out
+
+
+def _sim_nodes(n, spec):
+    from batch_scheduler_tpu.sim.scenarios import make_sim_node
+
+    return [make_sim_node(f"n{i:05d}", spec) for i in range(n)]
+
+
+def config2_sidecar():
+    """100 PG x 10 pods over 50 nodes, scored via the sidecar service."""
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot
+    from batch_scheduler_tpu.service import protocol as proto
+    from batch_scheduler_tpu.service.client import OracleClient
+    from batch_scheduler_tpu.service.server import serve_background
+
+    nodes = _sim_nodes(50, {"cpu": "64", "memory": "256Gi", "pods": "110"})
+    groups = _synthetic_demands(100, 10)
+    server = serve_background()
+    host, port = server.address
+    client = OracleClient(host, port)
+    try:
+        snap = ClusterSnapshot(nodes, {}, groups)
+
+        def round_trip():
+            req = proto.ScheduleRequest(
+                alloc=snap.alloc, requested=snap.requested,
+                group_req=snap.group_req, remaining=snap.remaining,
+                fit_mask=snap.fit_mask, group_valid=snap.group_valid,
+                order=snap.order, min_member=snap.min_member,
+                scheduled=snap.scheduled, matched=snap.matched,
+                ineligible=snap.ineligible, creation_rank=snap.creation_rank,
+            )
+            return client.schedule(req)
+
+        resp = round_trip()  # warmup (compile)
+        t0 = time.perf_counter()
+        resp = round_trip()
+        elapsed = time.perf_counter() - t0
+        placed = int(np.asarray(resp.placed).sum())
+    finally:
+        client.close()
+        server.shutdown()
+        server.server_close()
+    _emit(
+        2,
+        "sidecar_100pg_50node_round_trip",
+        elapsed,
+        "s",
+        gangs_placed=placed,
+        pods=1000,
+    )
+
+
+def config3_priorities():
+    """1k PG / 500 nodes, mixed priorities: batched Compare ordering + oracle
+    scoring in one device call."""
+    import jax
+
+    from batch_scheduler_tpu.ops.oracle import schedule_batch
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot
+
+    nodes = _sim_nodes(500, {"cpu": "64", "memory": "256Gi", "pods": "110"})
+    groups = _synthetic_demands(1000, 10)
+    snap = ClusterSnapshot(nodes, {}, groups)
+    out = schedule_batch(*snap.device_args())
+    jax.block_until_ready(out["placed"])  # warmup
+    t0 = time.perf_counter()
+    snap = ClusterSnapshot(nodes, {}, groups)
+    out = schedule_batch(*snap.device_args())
+    placed_arr = jax.device_get(out["placed"])
+    elapsed = time.perf_counter() - t0
+
+    # priority inversion check: every placed gang must outrank, or not
+    # conflict with, denied higher-priority gangs (orderings are exact, so
+    # just report counts per priority tier)
+    placed_by_prio = {}
+    for g, p in zip(groups, np.asarray(placed_arr)[: len(groups)]):
+        placed_by_prio.setdefault(g.priority, [0, 0])
+        placed_by_prio[g.priority][0] += int(bool(p))
+        placed_by_prio[g.priority][1] += 1
+    _emit(
+        3,
+        "priority_1kpg_500node_batch",
+        elapsed,
+        "s",
+        placed_by_priority={str(k): f"{v[0]}/{v[1]}" for k, v in sorted(placed_by_prio.items(), reverse=True)},
+        platform=jax.devices()[0].platform,
+    )
+
+
+def config4_headline():
+    """10k pods / 5k nodes GPU bin-packing: delegate to bench.py's path."""
+    import bench
+
+    nodes, groups = bench.build_inputs()
+    oracle = bench.bench_oracle(nodes, groups)
+    _emit(
+        4,
+        "gpu_10kpod_5knode_batch",
+        oracle["total_s"],
+        "s",
+        steady_batch_s=round(oracle["steady_batch_s"], 4),
+        gangs_placed=oracle["gangs_placed"],
+    )
+
+
+def config5_churn(ticks: int = 30, interval: float = 0.1):
+    """Sustained 100ms churn re-score at the 10k-pod/5k-node scale."""
+    import jax
+
+    from batch_scheduler_tpu.ops.rescore import ChurnRescorer
+
+    rng = np.random.default_rng(0)
+    nodes = _sim_nodes(5000, {"cpu": "64", "memory": "256Gi", "pods": "110", GPU: "8"})
+    all_gangs = _synthetic_demands(10000, 10, cpu=4000, mem=8 * 1024**3, extra={GPU: 1})
+    pending = all_gangs[:600]
+    arrivals = iter(all_gangs[600:])
+
+    r = ChurnRescorer(nodes, extra_resources=[GPU])
+    # precompile every bucket the loop can visit: the initial 600-gang burst
+    # plus the steady-state pending sizes
+    r.warm([8, 16, 32, 64, 1024])
+    warmed = r.recompiles
+
+    deadline_misses = 0
+    for _ in range(ticks):
+        t0 = time.perf_counter()
+        out = r.tick(None, pending)
+
+        # admit: committed gangs charge their assignments (dense bookkeeping)
+        placed = set(out.placed_groups())
+        for g in pending:
+            if g.full_name in placed:
+                r.admit(out, g.full_name)
+        pending = [g for g in pending if g.full_name not in placed]
+
+        # churn: ~2% of running gangs finish, their capacity frees
+        running = r.running
+        for _ in range(max(1, len(running) // 50) if running else 0):
+            r.release(running.pop(int(rng.integers(len(running)))))
+        # arrivals: a few new gangs join the pending set
+        for _ in range(2):
+            g = next(arrivals, None)
+            if g is not None:
+                pending.append(g)
+
+        elapsed = time.perf_counter() - t0
+        if elapsed > interval:
+            deadline_misses += 1
+        else:
+            time.sleep(interval - elapsed)
+
+    s = r.summary()
+    _emit(
+        5,
+        "churn_rescore_100ms_10kpod_5knode",
+        s["p95_s"],
+        "s_p95_tick",
+        p50_s=s["p50_s"],
+        max_s=s["max_s"],
+        p50_pack_s=s["p50_pack_s"],
+        p50_device_s=s["p50_device_s"],
+        ticks=s["ticks"],
+        steady_state_recompiles=s["recompiles"] - warmed,
+        deadline_misses_incl_admission=deadline_misses,
+        running_gangs_final=len(r.running),
+        platform=jax.devices()[0].platform,
+    )
+
+
+CONFIGS = {
+    1: config1_race_e2e,
+    2: config2_sidecar,
+    3: config3_priorities,
+    4: config4_headline,
+    5: config5_churn,
+}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--configs", default="1,2,3,4,5")
+    args = ap.parse_args()
+    for c in [int(x) for x in args.configs.split(",")]:
+        CONFIGS[c]()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""Churn re-scoring tests (BASELINE config 5 semantics): bucketed jit-cache
+stability across ticks, pinned lane schema, and backfill — freed capacity is
+re-offered to previously infeasible gangs on the next tick."""
+
+import numpy as np
+import pytest
+
+from batch_scheduler_tpu.ops.rescore import ChurnRescorer
+from batch_scheduler_tpu.ops.snapshot import GroupDemand
+from batch_scheduler_tpu.sim.scenarios import make_sim_node
+
+
+def _nodes(n, cpu="8"):
+    return [
+        make_sim_node(f"n{i:03d}", {"cpu": cpu, "memory": "32Gi", "pods": "110"})
+        for i in range(n)
+    ]
+
+
+def _gang(name, members, cpu_milli=1000, ts=0.0):
+    return GroupDemand(
+        full_name=f"default/{name}",
+        min_member=members,
+        member_request={"cpu": cpu_milli},
+        creation_ts=ts,
+        has_pod=True,
+    )
+
+
+def test_steady_churn_hits_one_bucket_shape():
+    """Group counts varying inside one bucket never change padded shapes, so
+    only the first tick can compile."""
+    r = ChurnRescorer(_nodes(12))  # 12 nodes -> node bucket 16
+    for tick_no, g in enumerate([3, 5, 8, 6, 4, 7, 2, 8]):  # all <= bucket 8
+        groups = [_gang(f"g{tick_no}-{i}", 2, ts=float(i)) for i in range(g)]
+        r.tick({}, groups)
+    assert r.recompiles == 1, r.summary()
+    assert len(r._shapes_seen) == 1
+
+
+def test_bucket_boundary_crossing_is_counted():
+    r = ChurnRescorer(_nodes(4))
+    r.tick({}, [_gang("a", 2)])  # 1 group -> bucket 8
+    r.tick({}, [_gang(f"g{i}", 2, ts=float(i)) for i in range(9)])  # -> bucket 16
+    assert r.recompiles == 2
+
+
+def test_pinned_schema_keeps_shape_when_resource_appears():
+    """An extended resource declared up front doesn't change R when it shows
+    up mid-loop; an undeclared one fails loudly instead of silently
+    reshaping."""
+    gpu = "nvidia.com/gpu"
+    r = ChurnRescorer(_nodes(4), extra_resources=[gpu])
+    t1 = r.tick({}, [_gang("plain", 2)])
+    g = _gang("gpu-gang", 2)
+    g.member_request[gpu] = 1
+    t2 = r.tick({}, [g])
+    assert t1.bucket_shape == t2.bucket_shape
+    assert r.recompiles == 1
+
+    bad = _gang("bad", 2)
+    bad.member_request["vendor.example/fpga"] = 1
+    with pytest.raises(KeyError):
+        r.tick({}, [bad])
+
+
+def test_backfill_after_capacity_freed():
+    """Config-5 churn semantics: a gang denied for capacity gets placed on a
+    later tick once a running gang completes and frees its nodes."""
+    nodes = _nodes(4, cpu="4")  # 16 cpus total
+    r = ChurnRescorer(nodes)
+
+    running = [_gang("running", 12, ts=0.0)]  # 12 cpus committed
+    requested = {n.metadata.name: {"cpu": 3000, "pods": 3} for n in nodes}
+
+    # while `running` occupies the cluster, a 10-cpu gang cannot place
+    waiting = _gang("waiting", 10, ts=1.0)
+    out = r.tick(requested, [waiting])
+    assert "default/waiting" not in out.placed_groups()
+
+    # the running gang finishes -> its requested capacity is freed
+    out2 = r.tick({}, [waiting])
+    assert out2.placed_groups() == ["default/waiting"]
+    # same bucket both ticks: the backfill came from data, not a recompile
+    assert r.recompiles == 1
+
+
+def test_dense_state_guards():
+    """admit/release bookkeeping: snapshots don't alias the mutable
+    occupancy array, and a nodes override can't silently drop it."""
+    nodes = _nodes(8, cpu="4")  # power-of-two node count: no pad copy
+    r = ChurnRescorer(nodes)
+    gang = _gang("g", 4)
+    out = r.tick(None, [gang])
+    before = out.snapshot.requested.copy()
+    r.admit(out, "default/g")
+    assert (out.snapshot.requested == before).all()  # not corrupted by admit
+    assert r.requested_lanes.sum() > 0
+    r.release("default/g")
+    assert r.requested_lanes.sum() == 0
+
+    with pytest.raises(ValueError, match="node_requested"):
+        r.tick(None, [gang], nodes=_nodes(6))
+
+
+def test_latency_summary_shape():
+    r = ChurnRescorer(_nodes(4))
+    for i in range(5):
+        r.tick({}, [_gang(f"g{i}", 2)])
+    s = r.summary()
+    assert s["ticks"] == 5
+    assert s["p50_s"] > 0 and s["p95_s"] >= s["p50_s"]
+    assert s["recompiles"] == 1
