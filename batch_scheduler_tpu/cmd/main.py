@@ -21,9 +21,10 @@ import argparse
 import json
 import sys
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from .config import load_scheduler_config
+from ..utils.labels import POD_GROUP_LABEL
 
 
 def _add_config_flag(p: argparse.ArgumentParser) -> None:
@@ -109,24 +110,62 @@ def cmd_check_config(args) -> int:
     return 0
 
 
+def warm_oracle(nodes=None, groups=None, pods=None, remote_scorer=None) -> float:
+    """Compile the oracle for the bucket shapes the given cluster will
+    actually hit (falling back to the smallest bucket), so the first real
+    batch doesn't pay the jit inside a scheduling callback. Shapes are what
+    matter: node/group counts round to the same power-of-two buckets
+    (ops.bucketing) and the lane schema must cover the same resource names.
+    With ``remote_scorer`` the warm batch is sent through the sidecar wire
+    path instead — warming the *server's* jit cache, the only one a remote
+    run exercises. Returns elapsed seconds."""
+    from ..ops.oracle import execute_batch_host
+    from ..ops.snapshot import ClusterSnapshot, GroupDemand
+    from ..sim.scenarios import make_sim_node
+
+    t0 = time.perf_counter()
+    warm_nodes = list(nodes) if nodes else [
+        make_sim_node("warm", {"cpu": "8", "memory": "32Gi", "pods": "110"})
+    ]
+    rep_pods: Dict[str, object] = {}
+    for pod in pods or []:
+        label = pod.metadata.labels.get(POD_GROUP_LABEL)
+        if label and label not in rep_pods:
+            rep_pods[label] = pod
+    warm_groups = []
+    for pg in groups or []:
+        rep = rep_pods.get(pg.metadata.name)
+        warm_groups.append(
+            GroupDemand(
+                f"{pg.metadata.namespace}/{pg.metadata.name}",
+                pg.spec.min_member,
+                member_request=dict(
+                    pg.spec.min_resources
+                    or (rep.resource_require() if rep else None)
+                    or {"cpu": 1000}
+                ),
+                # selectors/tolerations decide the fit-mask jit signature
+                # ([1,N] broadcast vs full [G,N]) — warm what traffic will hit
+                node_selector=dict(rep.spec.node_selector) if rep else {},
+                tolerations=list(rep.spec.tolerations) if rep else [],
+            )
+        )
+    warm_groups = warm_groups or [
+        GroupDemand("default/warm", 1, member_request={"cpu": 1000})
+    ]
+    snap = ClusterSnapshot(warm_nodes, {}, warm_groups)
+    if remote_scorer is not None:
+        remote_scorer._execute(snap)
+    else:
+        execute_batch_host(snap.device_args(), snap.progress_args())
+    return time.perf_counter() - t0
+
+
 def cmd_serve(args) -> int:
     from ..service.server import OracleServer
 
     if args.warmup:
-        import jax
-
-        from ..ops.oracle import schedule_batch
-        from ..ops.snapshot import ClusterSnapshot, GroupDemand
-        from ..sim.scenarios import make_sim_node
-
-        t0 = time.perf_counter()
-        snap = ClusterSnapshot(
-            [make_sim_node("warm", {"cpu": "8", "memory": "32Gi", "pods": "110"})],
-            {},
-            [GroupDemand("default/warm", 1, member_request={"cpu": 1000})],
-        )
-        jax.block_until_ready(schedule_batch(*snap.device_args())["placed"])
-        print(f"warmup compile done in {time.perf_counter() - t0:.1f}s", flush=True)
+        print(f"warmup compile done in {warm_oracle():.1f}s", flush=True)
 
     server = OracleServer(host=args.host, port=args.port)
     host, port = server.address
@@ -215,6 +254,19 @@ def cmd_sim(args) -> int:
     if not groups:
         print("error: no PodGroups (use -f or --scenario)", file=sys.stderr)
         return 2
+
+    if scorer == "oracle" or oracle_client is not None:
+        # Compile this cluster's bucket shapes before admitting traffic: the
+        # first jit otherwise lands inside the first scheduling callback, and
+        # on a short --settle the run can conclude "nothing is moving" while
+        # XLA is still compiling. For --oracle-addr the warm batch goes over
+        # the wire so the *sidecar's* jit cache (the one real traffic hits)
+        # is what warms.
+        elapsed = warm_oracle(
+            nodes=nodes, groups=groups, pods=pods,
+            remote_scorer=scorer if oracle_client is not None else None,
+        )
+        print(f"oracle warmup compile: {elapsed:.1f}s", flush=True)
 
     cluster.add_nodes(nodes)
     for pg in groups:

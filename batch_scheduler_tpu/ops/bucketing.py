@@ -68,6 +68,11 @@ def pad_oracle_batch(
       order (remaining == 0, so they place nothing);
     - padded nodes: zero lanes (capacity 0), masked out of every fit row.
 
+    A broadcast ``[1,N]`` fit mask (uniform-feasibility fast path, see
+    ops.snapshot._fit_mask) keeps its single row: padded groups are already
+    neutralised by zero demand + group_valid=False, and padded nodes by the
+    axis-1 False fill.
+
     ``min_buckets=(G, N)`` sets floor bucket sizes — churn re-scoring pins
     them to the largest shape seen so a shrinking cluster never triggers a
     fresh compile (ops.rescore sticky buckets).
@@ -79,13 +84,39 @@ def pad_oracle_batch(
     g = group_req.shape[0]
     nb = max(bucket_size(max(n, 1)), min_buckets[1])
     gb = max(bucket_size(max(g, 1)), min_buckets[0])
+    # Enforce the exact-division domain (ops.lanes.LANE_MAX) at the batch
+    # boundary: LaneSchema.pack already guards the dict-packing path, but
+    # raw-lane snapshots (churn fast path) and the sidecar wire path feed
+    # arrays straight through here — out-of-domain lanes would make
+    # ops.oracle._exact_floordiv silently wrong, not just imprecise.
+    from .lanes import LANE_MAX
+    from .oracle import GANG_MAX
+
+    for name, arr in (("alloc", alloc), ("requested", requested),
+                      ("group_req", group_req)):
+        a = np.asarray(arr)
+        if a.size and (np.abs(a.astype(np.int64)) > int(LANE_MAX)).any():
+            raise OverflowError(
+                f"{name} lanes exceed LANE_MAX (2**30): max abs "
+                f"{int(np.abs(a.astype(np.int64)).max())}"
+            )
+    for name, arr in (("remaining", remaining), ("min_member", min_member),
+                      ("scheduled", scheduled), ("matched", matched)):
+        a = np.asarray(arr)
+        if a.size and (np.abs(a.astype(np.int64)) > GANG_MAX).any():
+            raise OverflowError(
+                f"{name} exceeds GANG_MAX (2**18) members: max abs "
+                f"{int(np.abs(a.astype(np.int64)).max())}"
+            )
     batch_args = (
         pad_rows(np.asarray(alloc, dtype=np.int32), nb),
         pad_rows(np.asarray(requested, dtype=np.int32), nb),
         pad_rows(np.asarray(group_req, dtype=np.int32), gb),
         pad_rows(np.asarray(remaining, dtype=np.int32), gb),
         pad_to(
-            pad_rows(np.asarray(fit_mask, dtype=bool), gb, fill=False),
+            np.asarray(fit_mask, dtype=bool)
+            if np.asarray(fit_mask).shape[0] == 1
+            else pad_rows(np.asarray(fit_mask, dtype=bool), gb, fill=False),
             nb,
             axis=1,
             fill=False,

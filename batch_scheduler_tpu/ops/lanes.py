@@ -6,11 +6,14 @@ map (``nodeinfo.Resource`` handled at reference pkg/scheduler/core/core.go:
 whole cluster becomes one ``int32[N, R]`` array the oracle can stream through
 the VPU.
 
-Lane units are chosen so exact integer comparison semantics survive int32:
+Lane units are chosen so exact integer comparison semantics survive int32.
+Every lane value is bounded by ``LANE_MAX = 2**30`` — the domain on which the
+oracle's float32 reciprocal division (ops.oracle._exact_floordiv) is provably
+exact and its int32 residuals provably overflow-free:
 
-- ``cpu``                millicores   (max ~2.1M cores/node)
-- ``memory``             KiB          (max 2 TiB/node)
-- ``ephemeral-storage``  KiB          (max 2 TiB/node)
+- ``cpu``                millicores   (max ~1.07M cores/node)
+- ``memory``             KiB          (max 1 TiB/node)
+- ``ephemeral-storage``  KiB          (max 1 TiB/node)
 - ``pods``               count
 - extended resources     raw integer counts
 
@@ -26,13 +29,15 @@ from typing import Dict, Iterable, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["LaneSchema", "CORE_LANES", "INT32_MAX"]
+__all__ = ["LaneSchema", "CORE_LANES", "INT32_MAX", "LANE_MAX"]
 
 CORE_LANES: Tuple[str, ...] = ("cpu", "memory", "ephemeral-storage", "pods")
 # Lanes stored as KiB on device (canonical host unit is bytes).
 _KIB_LANES = frozenset({"memory", "ephemeral-storage"})
 
 INT32_MAX = np.int32(2**31 - 1)
+# Hard per-value bound: the exact-float-division domain (see module doc).
+LANE_MAX = np.int32(2**30)
 
 
 def _to_device_unit(name: str, value: int, *, capacity: bool) -> int:
@@ -79,19 +84,34 @@ class LaneSchema:
             if i is None:
                 raise KeyError(f"resource {name!r} not in lane schema {self.names}")
             vec[i] = _to_device_unit(name, int(value), capacity=capacity)
-        if (vec > INT32_MAX).any() or (vec < -INT32_MAX - 1).any():
+        if (vec > LANE_MAX).any() or (vec < -LANE_MAX).any():
             raise OverflowError(
-                f"resource vector exceeds int32 lanes: {dict(zip(self.names, vec))}"
+                f"resource vector exceeds LANE_MAX (2**30) lanes: "
+                f"{dict(zip(self.names, vec))}; for >1TiB-per-lane nodes use "
+                f"a coarser unit schema"
             )
         return vec.astype(np.int32)
 
     def pack_many(
         self, dicts: Sequence[Dict[str, int]], *, capacity: bool = False
     ) -> np.ndarray:
-        """Pack a sequence of resource dicts into int32[len, R]."""
+        """Pack a sequence of resource dicts into int32[len, R].
+
+        Identical dicts (the overwhelmingly common case: homogeneous node
+        pools, uniform gang members) are packed once and memoized — this is
+        the 5k-node snapshot hot loop on the host."""
         if not dicts:
             return np.zeros((0, self.num_lanes), dtype=np.int32)
-        return np.stack([self.pack(d, capacity=capacity) for d in dicts])
+        out = np.empty((len(dicts), self.num_lanes), dtype=np.int32)
+        memo = {}
+        for i, d in enumerate(dicts):
+            key = tuple(sorted(d.items()))
+            row = memo.get(key)
+            if row is None:
+                row = self.pack(d, capacity=capacity)
+                memo[key] = row
+            out[i] = row
+        return out
 
     def unpack(self, vec: np.ndarray) -> Dict[str, int]:
         """Inverse of pack (device units, for debugging/logging)."""

@@ -50,6 +50,54 @@ __all__ = [
 
 _BIG = jnp.int32(2**30)
 
+# Largest admissible gang: keeps every need-clipped capacity cumsum in the
+# assignment scan exact in int32 (bound proven in assign_gangs' docstring).
+# Enforced at the batch boundary (ops.bucketing.pad_oracle_batch).
+GANG_MAX = 2**18
+
+# Best-fit ranking buckets for the gang-placement scan. Nodes are ranked
+# tightest-first by min(cap, _BINS-1); all nodes that could hold >= _BINS-1
+# members of a gang are equally "loose" and tie-break by node index. 128
+# covers every realistic per-node member count (the pods lane alone caps a
+# node at ~110 members) while keeping the per-step histogram tiny.
+_BINS = 128
+
+
+@jax.jit
+def _exact_floordiv(num, den):
+    """Exact ``num // den`` for int32 ``0 <= num <= 2**30, 1 <= den <= 2**30``.
+
+    XLA lowers int32 division on TPU to a long scalar expansion; over the
+    oracle's (G,N,R) tensor that one op dominates the whole batch. Instead:
+    two float32 reciprocal-multiply Newton steps, then an integer fixup.
+    Error analysis: the first quotient is within ``0.5 + q*2**-22`` of exact,
+    so the int32 residual ``num - q*den`` never overflows given the 2**30
+    operand bound (enforced at pack time, ops.lanes.LANE_MAX); the second
+    step lands within 1, and the fixups make it exact.
+    """
+    inv = 1.0 / den.astype(jnp.float32)
+    q = jnp.round(num.astype(jnp.float32) * inv).astype(jnp.int32)
+    r = num - q * den
+    q = q + jnp.round(r.astype(jnp.float32) * inv).astype(jnp.int32)
+    r = num - q * den
+    q = jnp.where(r < 0, q - 1, q)
+    q = jnp.where(num - q * den >= den, q + 1, q)
+    return q
+
+
+def _member_capacity(left, req):
+    """min over resource lanes of floor(left/req), for req-positive lanes —
+    how many members of a demand row fit in a leftover row. Broadcasts:
+    callers shape ``left``/``req`` to a common [..., R]. Inputs are clamped
+    into the ``_exact_floordiv`` domain; the ``_BIG`` ceiling only saturates
+    values already rejected at the batch boundary (ops.bucketing LANE_MAX /
+    GANG_MAX checks) — THE single definition of per-node capacity shared by
+    the batch kernel and the assignment scan."""
+    safe_req = jnp.clip(req, 1, _BIG)
+    lpos = jnp.clip(left, 0, _BIG)
+    per_lane = jnp.where(req > 0, _exact_floordiv(lpos, safe_req), _BIG)
+    return jnp.min(per_lane, axis=-1)
+
 
 @partial(jax.jit, static_argnames=("percent_num", "percent_den"))
 def left_resources(alloc, requested, percent_num: int = 1, percent_den: int = 1):
@@ -77,18 +125,18 @@ def group_capacity(left, group_req, fit_mask):
     by per-(group,node) placement feasibility (selector/taints/validity).
     A node with any overcommitted lane naturally yields 0.
     """
-    req = group_req[:, None, :]  # [G,1,R]
-    safe_req = jnp.maximum(req, 1)
-    per_lane = jnp.where(req > 0, left[None, :, :] // safe_req, _BIG)  # [G,N,R]
-    cap = jnp.min(per_lane, axis=-1)
-    return jnp.maximum(cap, 0).astype(jnp.int32) * fit_mask.astype(jnp.int32)
+    cap = _member_capacity(left[None, :, :], group_req[:, None, :])  # [G,N]
+    return cap.astype(jnp.int32) * fit_mask.astype(jnp.int32)
 
 
 @jax.jit
 def gang_feasible(cap, remaining, group_valid):
     """ok[G]: total member capacity across the cluster covers the gang's
-    still-unbound members. Exact in int32: capacities are member counts."""
-    total = jnp.sum(cap, axis=1)
+    still-unbound members. Per-node capacity is clipped at the gang's own
+    remaining count before summing — equivalent (one node covering the whole
+    gang already saturates the test) and it keeps the N-node sum exact in
+    int32 even when sparse requests make single-node capacities huge."""
+    total = jnp.sum(jnp.minimum(cap, remaining[:, None]), axis=1)
     return (total >= remaining) & group_valid
 
 
@@ -150,33 +198,59 @@ def assign_gangs(left0, group_req, remaining, fit_mask, order):
     One jitted call replaces the pod-at-a-time Permit accounting loop for
     batch mode; the reference has no equivalent (it admits gangs pod by pod
     against a TTL cache, core.go:268-309).
+
+    Each scan step selects tightest-first WITHOUT a sort: nodes are bucketed
+    by clamped capacity (``_BINS`` histogram). Buckets strictly below the
+    threshold bucket (the one where cumulative capacity crosses ``need``)
+    contribute every member they can hold; buckets above contribute none; so
+    only the threshold bucket needs within-bucket (node-index) ordering —
+    one O(N) cumsum. A sort-based selection costs O(N log^2 N) bitonic
+    stages on TPU per group; this matches the sorted greedy exactly for
+    per-node capacities < _BINS-1 (above that, equally-loose nodes tie-break
+    by index instead of by capacity). Exactness bound: cumulative sums use
+    capacities clipped at ``need``, so they stay inside int32 for any gang
+    with min_member <= 2**18 — far above any real gang.
+
+    ``fit_mask`` may be ``[G,N]`` or a broadcast ``[1,N]`` row (the
+    no-selectors/no-taints common case — see ops.snapshot; an 8 MB host
+    transfer becomes 8 KB).
     """
     n = left0.shape[0]
+    bins = jnp.arange(_BINS, dtype=jnp.int32)
+    mask_rows = fit_mask.shape[0]
 
     def body(left, g):
         req = jnp.take(group_req, g, axis=0)
-        mask = jnp.take(fit_mask, g, axis=0)
+        mask = jnp.take(fit_mask, jnp.minimum(g, mask_rows - 1), axis=0)
         need = jnp.take(remaining, g)
 
-        safe_req = jnp.maximum(req, 1)
-        per_lane = jnp.where(req > 0, left // safe_req, _BIG)
-        cap = jnp.maximum(jnp.min(per_lane, axis=-1), 0) * mask
+        cap = _member_capacity(left, req[None, :]) * mask  # [N] >= 0
 
-        feasible = jnp.sum(cap) >= need
-        # Best-fit: tightest feasible nodes first (stable ties by index).
-        rank = jnp.where(cap > 0, cap, _BIG)
-        node_order = jnp.argsort(rank, stable=True)
-        cap_sorted = jnp.take(cap, node_order)
-        before = jnp.cumsum(cap_sorted) - cap_sorted
-        take_sorted = jnp.clip(need - before, 0, cap_sorted)
-        take = jnp.zeros((n,), jnp.int32).at[node_order].set(
-            take_sorted.astype(jnp.int32)
+        capc = jnp.minimum(cap, need)  # overflow-safe effective capacity
+        feasible = jnp.sum(capc) >= need
+
+        key = jnp.minimum(cap, _BINS - 1)  # tightness bucket (0 = no fit)
+        bin_totals = jnp.sum(
+            jnp.where(key[:, None] == bins[None, :], capc[:, None], 0), axis=0
+        )  # [_BINS]
+        cum_bins = jnp.cumsum(bin_totals)
+        # threshold bucket: first where cumulative capacity covers the gang
+        thresh = jnp.sum((cum_bins < need).astype(jnp.int32))
+        thresh = jnp.minimum(thresh, _BINS - 1)
+        before_thresh = jnp.take(cum_bins, thresh) - jnp.take(bin_totals, thresh)
+        rem_t = need - before_thresh
+        in_t = key == thresh
+        prefix_t = jnp.cumsum(jnp.where(in_t, capc, 0)) - jnp.where(in_t, capc, 0)
+        take = jnp.where(
+            key < thresh,
+            capc,
+            jnp.where(in_t, jnp.clip(rem_t - prefix_t, 0, capc), 0),
         )
         take = take * feasible.astype(jnp.int32)
         left = left - take[:, None] * req[None, :]
         return left, (take, feasible)
 
-    left, (takes, placed) = jax.lax.scan(body, left0, order)
+    left, (takes, placed) = jax.lax.scan(body, left0, order, unroll=4)
     g = group_req.shape[0]
     alloc = jnp.zeros((g, n), jnp.int32).at[order].set(takes)
     placed = jnp.zeros((g,), bool).at[order].set(placed)

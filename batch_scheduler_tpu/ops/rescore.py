@@ -41,7 +41,7 @@ class TickResult:
     snapshot: ClusterSnapshot
     pack_seconds: float
     device_seconds: float
-    bucket_shape: tuple  # (G_bucket, N_bucket, R)
+    bucket_shape: tuple  # (G_bucket, N_bucket, R, fit_mask_rows)
 
     @property
     def total_seconds(self) -> float:
@@ -150,7 +150,14 @@ class ChurnRescorer:
         host, _device = execute_batch_host(snap.device_args(), snap.progress_args())
         t_device = time.perf_counter() - t1
 
-        bucket_shape = (snap.fit_mask.shape[0], snap.fit_mask.shape[1], snap.alloc.shape[1])
+        bucket_shape = (
+            snap.group_req.shape[0],
+            snap.alloc.shape[0],
+            snap.alloc.shape[1],
+            # mask row rank: 1 (uniform broadcast) vs G (selectors/taints
+            # present) is a distinct jit signature — count it as a recompile
+            snap.fit_mask.shape[0],
+        )
         if bucket_shape not in self._shapes_seen:
             self._shapes_seen.add(bucket_shape)
             self.recompiles += 1
@@ -171,21 +178,32 @@ class ChurnRescorer:
         self.device_times.append(t_device)
         return result
 
-    def warm(self, group_buckets: Sequence[int]) -> None:
+    def warm(self, group_buckets: Sequence[int], with_selectors: bool = False) -> None:
         """Precompile the oracle for the given gang-count buckets so no tick
         inside the churn loop ever pays a first-compile (~seconds on TPU).
-        Timing stats are reset afterwards."""
+
+        A uniform cluster compiles the broadcast ``[1,N]``-mask jit signature
+        (ops.snapshot._fit_mask fast path); groups with node selectors (or
+        tainted nodes) produce the full ``[G,N]`` signature — a distinct
+        compile. Pass ``with_selectors=True`` if churn traffic can carry
+        selectors, so both signatures are warm. Timing stats are reset
+        afterwards."""
         for gb in group_buckets:
-            dummies = [
-                GroupDemand(
-                    full_name=f"__warm__/{i}",
-                    min_member=1,
-                    member_request={"cpu": 1},
-                    has_pod=True,
-                )
-                for i in range(gb)
-            ]
-            self.tick(None, dummies)
+            variants = [{}]
+            if with_selectors:
+                variants.append({"node_selector": {"__warm__": "never"}})
+            for extra in variants:
+                dummies = [
+                    GroupDemand(
+                        full_name=f"__warm__/{i}",
+                        min_member=1,
+                        member_request={"cpu": 1},
+                        has_pod=True,
+                        **extra,
+                    )
+                    for i in range(gb)
+                ]
+                self.tick(None, dummies)
         self.latencies.clear()
         self.pack_times.clear()
         self.device_times.clear()

@@ -194,8 +194,18 @@ class ClusterSnapshot:
     def _fit_mask(
         self, nodes: Sequence[Node], groups: Sequence[GroupDemand]
     ) -> np.ndarray:
-        mask = np.ones((len(groups), len(nodes)), dtype=bool)
+        """Per-(group,node) placement feasibility.
+
+        Fast path: with no node selectors and no taints anywhere (the
+        overwhelmingly common case) the mask is uniform — return a single
+        broadcast ``[1,N]`` row. At 1k groups x 5k nodes the full mask is
+        ~8 MB of host->device transfer per batch; the broadcast row is 8 KB.
+        The oracle kernels accept either shape (ops.oracle.assign_gangs).
+        """
         any_taints = any(n.spec.taints for n in nodes)
+        if not any_taints and not any(g.node_selector for g in groups):
+            return np.ones((1, len(nodes)), dtype=bool)
+        mask = np.ones((len(groups), len(nodes)), dtype=bool)
         for gi, g in enumerate(groups):
             if not g.node_selector and not any_taints:
                 continue

@@ -53,12 +53,17 @@ def shard_snapshot_args(mesh: Mesh, args: tuple) -> tuple:
     the (G, N) fit mask over both; the scan order replicated.
     """
     (alloc, requested, group_req, remaining, fit_mask, group_valid, order) = args
+    # A broadcast [1,N] fit mask (uniform-feasibility fast path) has no
+    # group extent to split — shard its node axis only.
+    mask_spec = (
+        P(None, "nodes") if fit_mask.shape[0] == 1 else P("groups", "nodes")
+    )
     spec = {
         "alloc": P("nodes", None),
         "requested": P("nodes", None),
         "group_req": P("groups", None),
         "remaining": P("groups"),
-        "fit_mask": P("groups", "nodes"),
+        "fit_mask": mask_spec,
         "group_valid": P("groups"),
         "order": P(),
     }

@@ -101,7 +101,27 @@ class SimCluster:
         self.kubelet.start()
         self._fwk_informers.wait_for_cache_sync()
         self.runtime.informers.wait_for_cache_sync()
+        self._wait_for_status_cache()
         self.scheduler.start()
+
+    def _wait_for_status_cache(self, timeout: float = 10.0) -> None:
+        """Block until the leader-gated controller has synced every
+        already-created PodGroup into the gang status cache — the analog of
+        kube-scheduler's WaitForCacheSync barrier. Without it the first
+        scheduling cycles race the controller's lease acquisition (~1s poll)
+        and burn pod backoff attempts on PodGroupNotFound."""
+        want = {
+            f"{pg['metadata']['namespace']}/{pg['metadata']['name']}"
+            for pg in self.api.list("PodGroup")
+        }
+        if not want:
+            return
+        cache = self.runtime.operation.status_cache
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(cache.get(name) is not None for name in want):
+                return
+            time.sleep(0.01)
 
     def stop(self) -> None:
         self.scheduler.stop()

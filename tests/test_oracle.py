@@ -183,3 +183,81 @@ def test_find_max_group_skips_released_and_podless():
         min_member, scheduled, matched, none_eligible, np.arange(2, dtype=np.int32)
     )
     assert not bool(exists)
+
+
+def test_exact_floordiv_adversarial():
+    """The float32 reciprocal division must be bit-exact across the full
+    LANE_MAX domain, including the values float32 cannot represent."""
+    from batch_scheduler_tpu.ops.oracle import _exact_floordiv
+
+    rng = np.random.default_rng(0)
+    hard = [1, 2, 3, 5, 7, 127, 2**24 - 1, 2**24, 2**24 + 1, 2**30 - 1, 2**30]
+    num = np.array(
+        hard + list(rng.integers(0, 2**30 + 1, size=4096)), dtype=np.int64
+    )
+    den = np.array(
+        hard + list(rng.integers(1, 2**30 + 1, size=4096)), dtype=np.int64
+    )
+    # all pairs on a coarse grid + elementwise on the random draw
+    for d in hard:
+        got = np.asarray(_exact_floordiv(num.astype(np.int32), np.full_like(num, d, dtype=np.int32)))
+        assert (got == num // d).all(), f"den={d}"
+    got = np.asarray(_exact_floordiv(num.astype(np.int32), den.astype(np.int32)))
+    assert (got == num // den).all()
+
+
+def test_gang_feasible_huge_caps_no_overflow():
+    # sparse request: only cpu lane -> per-node capacity is huge; the
+    # cluster sum must not wrap int32
+    n = 4096
+    left = np.tile(np.array([[10**6, 0, 0, 0]], np.int32), (n, 1))
+    group_req = np.array([[1, 0, 0, 0]], np.int32)  # cap = 1e6 per node
+    fit = np.ones((1, n), bool)
+    cap = np.asarray(group_capacity(left, group_req, fit))
+    assert cap[0, 0] == 10**6
+    ok = np.asarray(gang_feasible(cap, np.array([5], np.int32), np.array([True])))
+    assert ok.tolist() == [True]
+
+
+def test_assign_gangs_huge_caps_and_wide_spill():
+    # capacities above the ranking-bucket clamp still place correctly
+    left = np.tile(np.array([[10**6, 0, 0, 0]], np.int32), (8, 1))
+    group_req = np.array([[1, 0, 0, 0]], np.int32)
+    alloc, placed, left_after = assign_gangs(
+        left, group_req, np.array([300], np.int32),
+        np.ones((1, 8), bool), np.array([0], np.int32),
+    )
+    assert np.asarray(placed).tolist() == [True]
+    a = np.asarray(alloc)[0]
+    assert a.sum() == 300 and (a >= 0).all()
+    assert np.asarray(left_after)[:, 0].sum() == 8 * 10**6 - 300
+
+
+def test_raw_lane_paths_reject_out_of_domain_values():
+    """LaneSchema.pack guards dict packing; the raw-array batch boundary
+    (churn fast path, sidecar wire path) must also reject lanes outside the
+    exact-division domain rather than compute silently wrong capacities."""
+    import pytest
+
+    from batch_scheduler_tpu.ops.bucketing import pad_oracle_batch
+
+    g, n, r = 1, 2, 4
+    good = dict(
+        alloc=np.zeros((n, r), np.int32),
+        requested=np.zeros((n, r), np.int32),
+        group_req=np.zeros((g, r), np.int32),
+        remaining=np.zeros(g, np.int32),
+        fit_mask=np.ones((g, n), bool),
+        group_valid=np.ones(g, bool),
+        order=np.arange(g, dtype=np.int32),
+        min_member=np.ones(g, np.int32),
+        scheduled=np.zeros(g, np.int32),
+        matched=np.zeros(g, np.int32),
+        ineligible=np.zeros(g, bool),
+        creation_rank=np.arange(g, dtype=np.int32),
+    )
+    pad_oracle_batch(**good)  # in-domain passes
+    bad = dict(good)
+    bad["alloc"] = np.full((n, r), 2**30 + 1, np.int32)
+    with pytest.raises(OverflowError):
+        pad_oracle_batch(**bad)
