@@ -32,6 +32,7 @@ resolves ties deterministically: prefer groups with nothing scheduled yet
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -48,7 +49,9 @@ __all__ = [
     "execute_batch_host",
 ]
 
-_BIG = jnp.int32(2**30)
+# Plain int (not a device array) so pallas kernels can share these helpers
+# without capturing traced constants.
+_BIG = 2**30
 
 # Largest admissible gang: keeps every need-clipped capacity cumsum in the
 # assignment scan exact in int32 (bound proven in assign_gangs' docstring).
@@ -61,6 +64,10 @@ GANG_MAX = 2**18
 # covers every realistic per-node member count (the pods lane alone caps a
 # node at ~110 members) while keeping the per-step histogram tiny.
 _BINS = 128
+
+# Process-wide gate for the fused pallas assignment kernel; flipped off on
+# the first hardware failure (see execute_batch_host) or via env var.
+_pallas_enabled = os.environ.get("BST_DISABLE_PALLAS", "") != "1"
 
 
 @jax.jit
@@ -85,14 +92,42 @@ def _exact_floordiv(num, den):
     return q
 
 
+def _select_best_fit(cap, capc, need):
+    """Tightest-first take vector for one gang: the histogram threshold
+    selection documented in assign_gangs. Shapes are [1, N] (2-D so the iota
+    lowers on TPU inside pallas kernels too); returns (take[1,N], feasible).
+    THE single definition of the selection — shared by the lax.scan path and
+    the fused pallas kernel (ops.pallas_assign)."""
+    feasible = jnp.sum(capc) >= need
+    key = jnp.minimum(cap, _BINS - 1)  # tightness bucket (0 = no fit)
+    bins = jax.lax.broadcasted_iota(jnp.int32, (_BINS, 1), 0)
+    bin_totals = jnp.sum(
+        jnp.where(key == bins, capc, 0), axis=1, keepdims=True
+    )  # [_BINS, 1]
+    cum_bins = jnp.cumsum(bin_totals, axis=0)
+    # threshold bucket: first where cumulative capacity covers the gang
+    thresh = jnp.minimum(jnp.sum((cum_bins < need).astype(jnp.int32)), _BINS - 1)
+    cum_at = jnp.sum(jnp.where(bins == thresh, cum_bins, 0))
+    tot_at = jnp.sum(jnp.where(bins == thresh, bin_totals, 0))
+    rem_t = need - (cum_at - tot_at)
+    in_t = key == thresh
+    capc_t = jnp.where(in_t, capc, 0)
+    prefix_t = jnp.cumsum(capc_t, axis=1) - capc_t
+    take = jnp.where(
+        key < thresh, capc, jnp.where(in_t, jnp.clip(rem_t - prefix_t, 0, capc), 0)
+    )
+    return take * feasible.astype(jnp.int32), feasible
+
+
 def _member_capacity(left, req):
     """min over resource lanes of floor(left/req), for req-positive lanes —
     how many members of a demand row fit in a leftover row. Broadcasts:
     callers shape ``left``/``req`` to a common [..., R]. Inputs are clamped
     into the ``_exact_floordiv`` domain; the ``_BIG`` ceiling only saturates
     values already rejected at the batch boundary (ops.bucketing LANE_MAX /
-    GANG_MAX checks) — THE single definition of per-node capacity shared by
-    the batch kernel and the assignment scan."""
+    GANG_MAX checks). Shared by the batch kernel and the assignment scan;
+    the pallas kernel (ops.pallas_assign) carries the same computation in
+    its transposed [R, N] layout — change both together."""
     safe_req = jnp.clip(req, 1, _BIG)
     lpos = jnp.clip(left, 0, _BIG)
     per_lane = jnp.where(req > 0, _exact_floordiv(lpos, safe_req), _BIG)
@@ -216,7 +251,6 @@ def assign_gangs(left0, group_req, remaining, fit_mask, order):
     transfer becomes 8 KB).
     """
     n = left0.shape[0]
-    bins = jnp.arange(_BINS, dtype=jnp.int32)
     mask_rows = fit_mask.shape[0]
 
     def body(left, g):
@@ -225,28 +259,9 @@ def assign_gangs(left0, group_req, remaining, fit_mask, order):
         need = jnp.take(remaining, g)
 
         cap = _member_capacity(left, req[None, :]) * mask  # [N] >= 0
-
         capc = jnp.minimum(cap, need)  # overflow-safe effective capacity
-        feasible = jnp.sum(capc) >= need
-
-        key = jnp.minimum(cap, _BINS - 1)  # tightness bucket (0 = no fit)
-        bin_totals = jnp.sum(
-            jnp.where(key[:, None] == bins[None, :], capc[:, None], 0), axis=0
-        )  # [_BINS]
-        cum_bins = jnp.cumsum(bin_totals)
-        # threshold bucket: first where cumulative capacity covers the gang
-        thresh = jnp.sum((cum_bins < need).astype(jnp.int32))
-        thresh = jnp.minimum(thresh, _BINS - 1)
-        before_thresh = jnp.take(cum_bins, thresh) - jnp.take(bin_totals, thresh)
-        rem_t = need - before_thresh
-        in_t = key == thresh
-        prefix_t = jnp.cumsum(jnp.where(in_t, capc, 0)) - jnp.where(in_t, capc, 0)
-        take = jnp.where(
-            key < thresh,
-            capc,
-            jnp.where(in_t, jnp.clip(rem_t - prefix_t, 0, capc), 0),
-        )
-        take = take * feasible.astype(jnp.int32)
+        take2d, feasible = _select_best_fit(cap[None, :], capc[None, :], need)
+        take = take2d[0]
         left = left - take[:, None] * req[None, :]
         return left, (take, feasible)
 
@@ -263,11 +278,15 @@ def assign_gangs(left0, group_req, remaining, fit_mask, order):
 ASSIGNMENT_TOP_K = 128
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("use_pallas",))
 def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
-                   group_valid, order):
+                   group_valid, order, use_pallas: bool = False):
     """Fused full-batch oracle: leftover -> capacity -> feasibility -> scores
     -> greedy gang assignment, one XLA computation.
+
+    ``use_pallas=True`` (single TPU device, broadcast [1,N] mask only) swaps
+    the assignment scan for the fused VMEM-resident Pallas kernel
+    (ops.pallas_assign); the GSPMD-sharded path keeps the lax.scan form.
 
     This is the ``fit()`` of SURVEY.md §7: everything the control plane needs
     for one scheduling batch in a single device round-trip.
@@ -282,9 +301,16 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
     cap = group_capacity(left, group_req, fit_mask)
     feasible = gang_feasible(cap, remaining, group_valid)
     scores = score_nodes(cap)
-    assignment, placed, left_after = assign_gangs(
-        left, group_req, remaining, fit_mask, order
-    )
+    if use_pallas and fit_mask.shape[0] == 1:
+        from .pallas_assign import assign_gangs_pallas
+
+        assignment, placed, left_after = assign_gangs_pallas(
+            left, group_req, remaining, fit_mask, order
+        )
+    else:
+        assignment, placed, left_after = assign_gangs(
+            left, group_req, remaining, fit_mask, order
+        )
     placed = placed & group_valid
     k = min(ASSIGNMENT_TOP_K, assignment.shape[1])
     assign_counts, assign_nodes = jax.lax.top_k(assignment, k)
@@ -307,7 +333,30 @@ def execute_batch_host(batch_args, progress_args):
     reads. The single batch-execution path shared by the in-process scorer
     (core.oracle_scorer) and the sidecar server (service.server) — one place
     to change when the oracle's outputs change."""
-    out = schedule_batch(*batch_args)
+    # The fused Pallas scan is single-device TPU + broadcast-mask only, and
+    # Mosaic lowering is hardware-path-only (tests exercise interpret mode):
+    # if it fails to compile/run on this chip, fall back to the lax.scan
+    # form permanently for the process rather than failing every batch.
+    global _pallas_enabled
+    use_pallas = (
+        _pallas_enabled
+        and jax.default_backend() == "tpu"
+        and batch_args[4].shape[0] == 1
+    )
+    if use_pallas:
+        try:
+            out = schedule_batch(*batch_args, use_pallas=True)
+        except Exception as e:  # noqa: BLE001 — any lowering/runtime failure
+            _pallas_enabled = False
+            import warnings
+
+            warnings.warn(
+                f"pallas assignment kernel disabled after failure: {e!r}; "
+                "falling back to the lax.scan path"
+            )
+            out = schedule_batch(*batch_args, use_pallas=False)
+    else:
+        out = schedule_batch(*batch_args, use_pallas=False)
     best, exists, progress = find_max_group(*progress_args)
     host = jax.device_get(
         {

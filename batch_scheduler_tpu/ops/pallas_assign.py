@@ -1,0 +1,117 @@
+"""Pallas TPU kernel for the gang-placement scan.
+
+``ops.oracle.assign_gangs`` expresses the greedy whole-batch placement as a
+``lax.scan`` over groups: ~G sequential XLA steps, each re-reading the live
+leftover lanes from HBM and writing them back. This kernel fuses the whole
+scan into ONE ``pallas_call``:
+
+- the leftover lanes live in a VMEM scratch buffer for the entire sweep
+  (transposed to ``[R, N]`` so the big node axis sits on the 128-wide lane
+  dimension — ``[N, R]`` would use 5 of 128 lanes);
+- the scan order and per-group remaining counts are scalar-prefetched to
+  SMEM, and drive the *index maps*: step ``s`` DMAs exactly group
+  ``order[s]``'s request row in and its take row out;
+- per-step selection is the same sortless histogram threshold as the scan
+  path (see assign_gangs' docstring) — the two implementations are asserted
+  equivalent in tests/test_pallas.py.
+
+Used for the single-device batch when the fit mask is the broadcast ``[1,N]``
+fast path (no selectors/taints — the common case and the bench shape); the
+``lax.scan`` path remains the general fallback and the GSPMD-sharded path
+(a pallas_call is a black box to the partitioner).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .oracle import _BIG, _exact_floordiv, _select_best_fit
+
+__all__ = ["assign_gangs_pallas"]
+
+
+def _kernel(order_ref, remaining_ref, left0_ref, group_req_ref, mask_ref,
+            takes_ref, placed_ref, left_after_ref, left_scratch):
+    s = pl.program_id(0)
+    num_steps = pl.num_programs(0)
+
+    @pl.when(s == 0)
+    def _():
+        left_scratch[:] = left0_ref[:]
+
+    g = order_ref[s]
+    need = remaining_ref[g]
+
+    left = left_scratch[:]  # [R, N]
+    req = group_req_ref[:]  # [1, R] (this step's group row via index map)
+    req_col = req.reshape(-1, 1)  # [R, 1]
+
+    # ops.oracle._member_capacity in the kernel's transposed [R, N] layout
+    # (lanes on axis 0 so the node axis rides the 128-wide lane dimension)
+    safe_req = jnp.clip(req_col, 1, _BIG)
+    lpos = jnp.clip(left, 0, _BIG)
+    per_lane = jnp.where(req_col > 0, _exact_floordiv(lpos, safe_req), _BIG)
+    cap = jnp.min(per_lane, axis=0, keepdims=True)  # [1, N]
+    cap = cap * mask_ref[:].astype(jnp.int32)
+
+    capc = jnp.minimum(cap, need)
+    take, _feasible = _select_best_fit(cap, capc, need)
+    feasible = _feasible.astype(jnp.int32)
+
+    left_scratch[:] = left - take * req_col
+    takes_ref[:] = take
+    placed_ref[0, 0] = feasible
+
+    @pl.when(s == num_steps - 1)
+    def _():
+        left_after_ref[:] = left_scratch[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def assign_gangs_pallas(left0, group_req, remaining, fit_mask, order,
+                        *, interpret: bool = False):
+    """Drop-in for ``ops.oracle.assign_gangs`` (same signature/returns) with
+    the restriction fit_mask.shape[0] == 1 (broadcast fast path).
+
+    Returns (alloc[G,N] i32, placed[G] bool, left_after[N,R] i32).
+    """
+    if fit_mask.shape[0] != 1:
+        raise ValueError(
+            "assign_gangs_pallas requires the broadcast [1,N] fit mask; "
+            "use ops.oracle.assign_gangs for per-group masks"
+        )
+    n, r = left0.shape
+    g = group_req.shape[0]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # order, remaining
+        grid=(g,),
+        in_specs=[
+            pl.BlockSpec((r, n), lambda s, order, rem: (0, 0)),  # left0^T
+            # step s sees exactly group order[s]'s request row
+            pl.BlockSpec((1, r), lambda s, order, rem: (order[s], 0)),
+            pl.BlockSpec((1, n), lambda s, order, rem: (0, 0)),  # mask
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n), lambda s, order, rem: (order[s], 0)),  # takes
+            pl.BlockSpec((1, 1), lambda s, order, rem: (order[s], 0)),  # placed
+            pl.BlockSpec((r, n), lambda s, order, rem: (0, 0)),  # left_after^T
+        ],
+        scratch_shapes=[pltpu.VMEM((r, n), jnp.int32)],
+    )
+    takes, placed, left_after_t = pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((g, n), jnp.int32),
+            jax.ShapeDtypeStruct((g, 1), jnp.int32),
+            jax.ShapeDtypeStruct((r, n), jnp.int32),
+        ],
+        interpret=interpret,
+    )(order, remaining, left0.T, group_req, fit_mask.astype(jnp.int32))
+    return takes, placed[:, 0].astype(bool), left_after_t.T

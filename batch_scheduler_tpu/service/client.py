@@ -74,17 +74,14 @@ class RemoteScorer(OracleScorer):
         self._client = client
 
     def _execute(self, snap: ClusterSnapshot):
-        # The wire format (and the native C++ client) carries a full [G,N]
-        # mask; expand the in-process [1,N] broadcast fast path for the wire.
-        mask = snap.fit_mask
-        if mask.shape[0] == 1:
-            mask = np.broadcast_to(mask, (snap.group_req.shape[0], mask.shape[1]))
+        # fit_mask may be the [1,N] broadcast fast path; the wire encoder
+        # (protocol.pack_schedule_request) expands it to the [G,N] format.
         req = proto.ScheduleRequest(
             alloc=snap.alloc,
             requested=snap.requested,
             group_req=snap.group_req,
             remaining=snap.remaining,
-            fit_mask=mask,
+            fit_mask=snap.fit_mask,
             group_valid=snap.group_valid,
             order=snap.order,
             min_member=snap.min_member,

@@ -138,13 +138,19 @@ def _u8(a) -> np.ndarray:
 def pack_schedule_request(req: ScheduleRequest) -> bytes:
     n, r = req.alloc.shape
     g = req.group_req.shape[0]
+    # The wire format (shared with the native C++ client) always carries a
+    # full [G,N] mask; expand the in-process [1,N] broadcast fast path here,
+    # the single encode point, so every caller stays wire-correct.
+    mask = np.asarray(req.fit_mask)
+    if mask.shape[0] == 1 and g != 1:
+        mask = np.broadcast_to(mask, (g, mask.shape[1]))
     parts = [
         _REQ_COUNTS.pack(n, g, r),
         _i32(req.alloc).tobytes(),
         _i32(req.requested).tobytes(),
         _i32(req.group_req).tobytes(),
         _i32(req.remaining).tobytes(),
-        _u8(req.fit_mask).tobytes(),
+        _u8(mask).tobytes(),
         _u8(req.group_valid).tobytes(),
         _i32(req.order).tobytes(),
         _i32(req.min_member).tobytes(),

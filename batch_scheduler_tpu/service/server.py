@@ -27,15 +27,23 @@ __all__ = ["OracleServer", "serve_background"]
 def _pad_request(req: proto.ScheduleRequest):
     """Bucket-pad an unpadded request via the SAME canonical padding as the
     in-process snapshot packer (ops.bucketing.pad_oracle_batch) so the wire
-    path can never drift from the local path."""
+    path can never drift from the local path.
+
+    The wire format always carries a full [G,N] mask (native C++ client
+    compatibility); re-collapse a uniform one to the broadcast [1,N] row so
+    sidecar batches reach the same fast paths as in-process batches (smaller
+    transfer + the fused pallas assignment kernel)."""
     n = req.alloc.shape[0]
     g = req.group_req.shape[0]
+    mask = req.fit_mask
+    if mask.shape[0] > 1 and bool((mask == mask[0:1]).all()):
+        mask = mask[0:1]
     batch_args, progress_args = pad_oracle_batch(
         alloc=req.alloc,
         requested=req.requested,
         group_req=req.group_req,
         remaining=req.remaining,
-        fit_mask=req.fit_mask,
+        fit_mask=mask,
         group_valid=req.group_valid,
         order=req.order,
         min_member=req.min_member,

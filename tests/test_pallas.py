@@ -1,0 +1,54 @@
+"""Equivalence of the fused Pallas gang-placement kernel against the
+lax.scan reference implementation (ops.oracle.assign_gangs)."""
+
+import numpy as np
+import pytest
+
+from batch_scheduler_tpu.ops.oracle import assign_gangs
+from batch_scheduler_tpu.ops.pallas_assign import assign_gangs_pallas
+
+
+def _run_both(left, group_req, remaining, mask, order):
+    a_ref, p_ref, l_ref = assign_gangs(left, group_req, remaining, mask, order)
+    a_pal, p_pal, l_pal = assign_gangs_pallas(
+        left, group_req, remaining, mask, order, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(a_ref), np.asarray(a_pal))
+    np.testing.assert_array_equal(np.asarray(p_ref), np.asarray(p_pal))
+    np.testing.assert_array_equal(np.asarray(l_ref), np.asarray(l_pal))
+    return np.asarray(a_pal), np.asarray(p_pal), np.asarray(l_pal)
+
+
+def test_pallas_matches_scan_race():
+    left = np.array([[7100, 10**6, 10**6, 50]], dtype=np.int32)
+    group_req = np.array([[1000, 0, 0, 1], [1000, 0, 0, 1]], dtype=np.int32)
+    alloc, placed, _ = _run_both(
+        left, group_req, np.array([5, 5], np.int32),
+        np.ones((1, 1), bool), np.array([0, 1], np.int32),
+    )
+    assert placed.tolist() == [True, False]
+    assert alloc.sum() == 5
+
+
+def test_pallas_matches_scan_fuzz():
+    rng = np.random.default_rng(7)
+    for trial in range(8):
+        n = int(rng.integers(1, 24))
+        g = int(rng.integers(1, 12))
+        r = int(rng.integers(1, 5))
+        left = rng.integers(0, 40, size=(n, r)).astype(np.int32)
+        group_req = rng.integers(0, 6, size=(g, r)).astype(np.int32)
+        remaining = rng.integers(0, 10, size=g).astype(np.int32)
+        order = rng.permutation(g).astype(np.int32)
+        mask = np.ones((1, n), bool)
+        mask[0, rng.integers(0, n)] = bool(rng.integers(0, 2))
+        _run_both(left, group_req, remaining, mask, order)
+
+
+def test_pallas_rejects_full_mask():
+    left = np.zeros((2, 2), np.int32)
+    with pytest.raises(ValueError):
+        assign_gangs_pallas(
+            left, np.zeros((3, 2), np.int32), np.zeros(3, np.int32),
+            np.ones((3, 2), bool), np.arange(3, dtype=np.int32),
+        )

@@ -158,3 +158,37 @@ def test_native_client_wire_compat(server):
     np.testing.assert_array_equal(row_native, row_py)
     native.close()
     py_client.close()
+
+def test_native_client_protocol_constants_in_sync():
+    """Drift check between the Python wire protocol and the native C++
+    client — the analog of the reference's codegen drift gate
+    (reference hack/verify-codegen.sh:36-45): the generated/duplicated
+    artifact must match the source of truth or CI fails."""
+    import os
+    import re
+
+    from batch_scheduler_tpu.service import protocol as proto
+
+    src = open(
+        os.path.join(os.path.dirname(__file__), "..", "native", "bsp_client.cpp")
+    ).read()
+
+    magic = re.search(
+        r"kMagic\[4\]\s*=\s*\{'(.)',\s*'(.)',\s*'(.)',\s*'(.)'\}", src
+    )
+    assert magic, "kMagic not found in bsp_client.cpp"
+    assert "".join(magic.groups()).encode() == proto.MAGIC
+
+    want = {
+        "kScheduleReq": proto.MsgType.SCHEDULE_REQ,
+        "kScheduleResp": proto.MsgType.SCHEDULE_RESP,
+        "kRowReq": proto.MsgType.ROW_REQ,
+        "kRowResp": proto.MsgType.ROW_RESP,
+        "kPing": proto.MsgType.PING,
+        "kPong": proto.MsgType.PONG,
+        "kError": proto.MsgType.ERROR,
+    }
+    for name, value in want.items():
+        m = re.search(rf"{name}\s*=\s*(\d+)", src)
+        assert m, f"{name} not found in bsp_client.cpp"
+        assert int(m.group(1)) == value, f"{name} drifted: C++ {m.group(1)} != py {value}"
