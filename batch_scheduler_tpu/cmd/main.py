@@ -162,7 +162,18 @@ def warm_oracle(nodes=None, groups=None, pods=None, remote_scorer=None) -> float
 
 
 def cmd_serve(args) -> int:
+    from ..parallel.distributed import init_distributed
     from ..service.server import OracleServer
+
+    # multi-host slice bootstrap (no-op unless BST_COORDINATOR is set)
+    if init_distributed():
+        import jax
+
+        print(
+            f"jax.distributed initialized: process {jax.process_index()}/"
+            f"{jax.process_count()}, {len(jax.devices())} global devices",
+            flush=True,
+        )
 
     if args.warmup:
         print(f"warmup compile done in {warm_oracle():.1f}s", flush=True)
@@ -310,6 +321,9 @@ def cmd_sim(args) -> int:
             )
         stats = cluster.scheduler.stats
         print(f"scheduler stats: {dict(stats)}")
+        oracle = getattr(cluster.runtime.operation, "oracle", None)
+        if oracle is not None and getattr(oracle, "batches_run", 0):
+            print(f"oracle stats: {oracle.stats()}")
     finally:
         cluster.stop()
         if oracle_client is not None:

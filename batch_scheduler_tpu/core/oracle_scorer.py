@@ -20,6 +20,7 @@ core.go:514-632,701-739) with exact, stronger batch answers:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -101,6 +102,12 @@ class OracleScorer:
         self._refresh_lock = threading.Lock()
         self._cluster_version = None
         self.batches_run = 0
+        # oracle-batch latency telemetry (SURVEY.md §5: schedule-cycle
+        # latency is the headline metric; the reference has no equivalent
+        # instrumentation, only klog verbosity)
+        self.pack_seconds: list = []
+        self.batch_seconds: list = []
+        self._stats_lock = threading.Lock()
 
     def mark_dirty(self) -> None:
         self._dirty = True
@@ -112,6 +119,7 @@ class OracleScorer:
 
     def refresh(self, cluster, status_cache: PGStatusCache) -> None:
         """Rebuild the snapshot and run one fused oracle batch."""
+        t0 = time.perf_counter()
         statuses = status_cache.snapshot()
         demands: List[GroupDemand] = [
             demand_from_status(name, pgs) for name, pgs in sorted(statuses.items())
@@ -121,7 +129,9 @@ class OracleScorer:
             n.metadata.name: cluster.node_requested(n.metadata.name) for n in nodes
         }
         snap = ClusterSnapshot(nodes, node_req, demands)
+        t_pack = time.perf_counter()
         host, row_fetcher = self._execute(snap)
+        t_batch = time.perf_counter()
         max_group = (
             snap.group_names[int(host["best"])]
             if bool(host["best_exists"]) and int(host["best"]) < len(snap.group_names)
@@ -132,6 +142,10 @@ class OracleScorer:
         self._cluster_version = version_fn() if callable(version_fn) else None
         self._dirty = False
         self.batches_run += 1
+        with self._stats_lock:
+            self.pack_seconds.append(t_pack - t0)
+            self.batch_seconds.append(t_batch - t_pack)
+            del self.pack_seconds[:-1000], self.batch_seconds[:-1000]
 
     def _execute(self, snap: ClusterSnapshot):
         """Run one batch locally on the attached device. Returns the O(G)
@@ -172,6 +186,20 @@ class OracleScorer:
                 self.refresh(cluster, status_cache)
 
     # -- query API (host-side, post-batch) ---------------------------------
+
+    def stats(self) -> dict:
+        """Batch-latency summary for the observability surface (the sim CLI
+        prints it; the reference's only observability is CRD phase
+        transitions + klog)."""
+        with self._stats_lock:
+            batches = list(self.batch_seconds)
+            packs = list(self.pack_seconds)
+        out = {"batches": self.batches_run}
+        if batches:
+            out["batch_p50_ms"] = round(float(np.median(batches)) * 1000, 2)
+            out["batch_max_ms"] = round(float(max(batches)) * 1000, 2)
+            out["pack_p50_ms"] = round(float(np.median(packs)) * 1000, 2)
+        return out
 
     def max_group(self) -> str:
         state = self._state

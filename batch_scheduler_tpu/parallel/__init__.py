@@ -1,3 +1,10 @@
+from .distributed import global_mesh, init_distributed
 from .mesh import make_mesh, shard_snapshot_args, sharded_schedule_batch
 
-__all__ = ["make_mesh", "shard_snapshot_args", "sharded_schedule_batch"]
+__all__ = [
+    "global_mesh",
+    "init_distributed",
+    "make_mesh",
+    "shard_snapshot_args",
+    "sharded_schedule_batch",
+]

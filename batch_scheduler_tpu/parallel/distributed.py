@@ -1,0 +1,70 @@
+"""Multi-host bootstrap for the oracle's device mesh.
+
+The reference's only distributed machinery is control-plane: an
+Endpoints-lease leader poll plus API-server watches (reference
+pkg/scheduler/batch/batchscheduler.go:452-502; SURVEY.md §5 "Distributed
+communication backend"). The TPU build's data plane scales differently: the
+same fused batch runs ``pjit``-sharded over a ``jax.sharding.Mesh``, and on
+a multi-host slice the mesh simply spans all hosts' devices — XLA's
+collectives over ICI/DCN are the communication backend; there is no NCCL/MPI
+analog to port.
+
+``init_distributed`` wires ``jax.distributed`` from standard environment
+variables so the same service binary works single-host (no-op) and
+multi-host (each host runs one process; the coordinator address is the only
+required config). ``global_mesh`` then builds the (groups × nodes) mesh over
+every device in the job.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from .mesh import make_mesh
+
+__all__ = ["init_distributed", "global_mesh"]
+
+_initialized = False
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize ``jax.distributed`` for a multi-host oracle service.
+
+    Reads ``BST_COORDINATOR`` / ``BST_NUM_PROCESSES`` / ``BST_PROCESS_ID``
+    when arguments are omitted (matching the one-process-per-host model of
+    ``jax.distributed.initialize``). Returns True if a multi-host runtime
+    was initialized; False for the single-process no-op (no coordinator
+    configured — the common case, and the only one exercised in CI).
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = coordinator_address or os.environ.get("BST_COORDINATOR")
+    if not coordinator_address:
+        return False
+    num_processes = num_processes or int(os.environ.get("BST_NUM_PROCESSES", "1"))
+    process_id = (
+        process_id
+        if process_id is not None
+        else int(os.environ.get("BST_PROCESS_ID", "0"))
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True
+
+
+def global_mesh():
+    """The (groups × nodes) mesh over every device in the job — all local
+    devices single-host, or the full slice after ``init_distributed``."""
+    return make_mesh(devices=jax.devices())

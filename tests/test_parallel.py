@@ -51,3 +51,15 @@ def test_sharded_batch_on_subset_mesh():
     mesh = make_mesh(4)
     out = jax.device_get(sharded_schedule_batch(mesh, snap.device_args()))
     assert np.asarray(out["placed"])[:8].all()
+
+
+def test_init_distributed_noop_without_coordinator(monkeypatch):
+    """Single-process is a no-op: no coordinator configured -> False, and
+    global_mesh still builds over the local (virtual) devices."""
+    from batch_scheduler_tpu.parallel import global_mesh, init_distributed
+
+    monkeypatch.delenv("BST_COORDINATOR", raising=False)
+    assert init_distributed() is False
+    mesh = global_mesh()
+    assert mesh.size == len(jax.devices())
+    assert set(mesh.axis_names) == {"groups", "nodes"}
