@@ -100,13 +100,23 @@ def pad_oracle_batch(
                 f"{name} lanes exceed LANE_MAX (2**30): max abs "
                 f"{int(np.abs(a.astype(np.int64)).max())}"
             )
-    for name, arr in (("remaining", remaining), ("min_member", min_member),
-                      ("scheduled", scheduled), ("matched", matched)):
+    # The assignment scan and gang_feasible accumulate need-clipped
+    # capacities over the node bucket in int32; sum <= need * nb, so the
+    # admissible gang size shrinks with the node bucket: need * nb must stay
+    # strictly below 2**31. GANG_MAX alone (2**18) is exactly the boundary
+    # at an 8192-node bucket and past it for larger buckets.
+    gang_bound = min(GANG_MAX, (2**31 - 1) // nb)
+    for name, arr, bound in (
+        ("remaining", remaining, gang_bound),
+        ("min_member", min_member, GANG_MAX),
+        ("scheduled", scheduled, GANG_MAX),
+        ("matched", matched, GANG_MAX),
+    ):
         a = np.asarray(arr)
-        if a.size and (np.abs(a.astype(np.int64)) > GANG_MAX).any():
+        if a.size and (np.abs(a.astype(np.int64)) > bound).any():
             raise OverflowError(
-                f"{name} exceeds GANG_MAX (2**18) members: max abs "
-                f"{int(np.abs(a.astype(np.int64)).max())}"
+                f"{name} exceeds the gang bound ({bound} members at node "
+                f"bucket {nb}): max abs {int(np.abs(a.astype(np.int64)).max())}"
             )
     batch_args = (
         pad_rows(np.asarray(alloc, dtype=np.int32), nb),

@@ -12,20 +12,38 @@ oracle's float32 reciprocal division (ops.oracle._exact_floordiv) is provably
 exact and its int32 residuals provably overflow-free:
 
 - ``cpu``                millicores   (max ~1.07M cores/node)
-- ``memory``             KiB          (max 1 TiB/node)
-- ``ephemeral-storage``  KiB          (max 1 TiB/node)
+- ``memory``             KiB          (max 1 TiB/node at shift 0)
+- ``ephemeral-storage``  KiB          (max 1 TiB/node at shift 0)
 - ``pods``               count
 - extended resources     raw integer counts
 
-Requests round **up** and capacities round **down** during unit conversion,
-so ``capacity >= request`` can never pass due to rounding. Gang feasibility
-on device is computed in *member counts* (small integers), never in raw byte
-sums, which is what keeps 5k-node clusters inside int32 (see ops.oracle).
+Values larger than the base unit allows (the reference carries int64
+quantities with no cap) do NOT abort packing. Two mechanisms keep big
+clusters schedulable:
+
+1. **Per-lane auto-scaling**: ``LaneSchema.collect`` inspects every value in
+   the snapshot and gives each lane a power-of-two ``shift`` so the largest
+   observed value fits below ``LANE_MAX``. A 2 TiB-memory node simply packs
+   in 2 KiB units for that snapshot. Capacities round **down** and requests
+   round **up** in the shifted unit, so ``capacity >= request`` can never
+   pass due to rounding.
+2. **Safe saturation**: with a caller-pinned schema (churn re-scoring pins
+   the schema so shapes stay jit-stable), a later value may still exceed the
+   shifted domain. ``pack`` then clamps instead of raising: capacities clamp
+   to ``LANE_MAX - 1`` (a conservative *underestimate* — the node still
+   schedules, it just looks no larger than the domain bound) and requests
+   clamp to ``LANE_MAX`` (strictly above any clamped capacity, so an
+   unrepresentable request can never be falsely admitted).
+
+Gang feasibility on device is computed in *member counts* (small integers),
+never in raw byte sums, which is what keeps 5k-node clusters inside int32
+(see ops.oracle).
 """
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, Sequence, Tuple
+import warnings
+from typing import Dict, Iterable, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -48,12 +66,30 @@ def _to_device_unit(name: str, value: int, *, capacity: bool) -> int:
     return value
 
 
-class LaneSchema:
-    """Maps resource names <-> lane indices for one cluster snapshot."""
+def _apply_shift(value: int, shift: int, *, capacity: bool) -> int:
+    if shift == 0:
+        return value
+    if capacity:
+        return value >> shift  # floor (arithmetic shift: floor for negatives too)
+    return -((-value) >> shift)  # ceil
 
-    def __init__(self, extended: Sequence[str] = ()):
+
+class LaneSchema:
+    """Maps resource names <-> lane indices (+ per-lane unit shifts) for one
+    cluster snapshot."""
+
+    def __init__(
+        self,
+        extended: Sequence[str] = (),
+        shifts: Optional[Dict[str, int]] = None,
+    ):
         self.names: Tuple[str, ...] = CORE_LANES + tuple(extended)
         self.index: Dict[str, int] = {n: i for i, n in enumerate(self.names)}
+        # Per-lane power-of-two unit coarsening (see module doc, mechanism 1).
+        self.shifts: Tuple[int, ...] = tuple(
+            int((shifts or {}).get(n, 0)) for n in self.names
+        )
+        self._warned_clamp = False
 
     @property
     def num_lanes(self) -> int:
@@ -61,13 +97,27 @@ class LaneSchema:
 
     @classmethod
     def collect(cls, resource_dicts: Iterable[Dict[str, int]]) -> "LaneSchema":
-        """Build a schema covering every resource name seen in the snapshot."""
+        """Build a schema covering every resource name seen in the snapshot,
+        with per-lane shifts sized so every observed value packs exactly."""
         extended = set()
+        max_seen: Dict[str, int] = {}
         for d in resource_dicts:
-            for name in d:
+            for name, value in d.items():
                 if name not in CORE_LANES:
                     extended.add(name)
-        return cls(sorted(extended))
+                # Conservative bound: the ceil-rounded request conversion is
+                # the larger of the two unit conversions by at most 1.
+                dev = abs(_to_device_unit(name, int(value), capacity=False))
+                if dev > max_seen.get(name, 0):
+                    max_seen[name] = dev
+        shifts = {}
+        for name, peak in max_seen.items():
+            shift = 0
+            while (peak >> shift) >= int(LANE_MAX):
+                shift += 1
+            if shift:
+                shifts[name] = shift
+        return cls(sorted(extended), shifts=shifts)
 
     def pack(self, resources: Dict[str, int], *, capacity: bool = False) -> np.ndarray:
         """Pack one canonical resource dict into an int32[R] lane vector.
@@ -77,19 +127,28 @@ class LaneSchema:
         silently dropping a lane would break the reference's rule that a
         request for a resource the node lacks must fail feasibility
         (reference pkg/scheduler/core/core.go:686-696).
+
+        Values outside the shifted domain saturate safely instead of
+        raising (see module doc, mechanism 2).
         """
         vec = np.zeros(self.num_lanes, dtype=np.int64)
         for name, value in resources.items():
             i = self.index.get(name)
             if i is None:
                 raise KeyError(f"resource {name!r} not in lane schema {self.names}")
-            vec[i] = _to_device_unit(name, int(value), capacity=capacity)
-        if (vec > LANE_MAX).any() or (vec < -LANE_MAX).any():
-            raise OverflowError(
-                f"resource vector exceeds LANE_MAX (2**30) lanes: "
-                f"{dict(zip(self.names, vec))}; for >1TiB-per-lane nodes use "
-                f"a coarser unit schema"
-            )
+            dev = _to_device_unit(name, int(value), capacity=capacity)
+            vec[i] = _apply_shift(dev, self.shifts[i], capacity=capacity)
+        cap_bound = int(LANE_MAX) - 1 if capacity else int(LANE_MAX)
+        if (vec > cap_bound).any() or (vec < -cap_bound).any():
+            if not self._warned_clamp:
+                self._warned_clamp = True
+                warnings.warn(
+                    f"resource vector exceeds the shifted lane domain and was "
+                    f"clamped ({'capacity floor' if capacity else 'request'} "
+                    f"bound {cap_bound}): {dict(zip(self.names, vec))}; "
+                    "re-collect the schema to restore exact packing"
+                )
+            np.clip(vec, -cap_bound, cap_bound, out=vec)
         return vec.astype(np.int32)
 
     def pack_many(
@@ -114,5 +173,9 @@ class LaneSchema:
         return out
 
     def unpack(self, vec: np.ndarray) -> Dict[str, int]:
-        """Inverse of pack (device units, for debugging/logging)."""
-        return {n: int(vec[i]) for n, i in self.index.items() if vec[i]}
+        """Inverse of pack (device units x 2**shift, for debugging/logging)."""
+        return {
+            n: int(vec[i]) << self.shifts[i]
+            for n, i in self.index.items()
+            if vec[i]
+        }

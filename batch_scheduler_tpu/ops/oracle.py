@@ -37,6 +37,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = [
     "left_resources",
@@ -355,6 +356,10 @@ def execute_batch_host(batch_args, progress_args):
     if use_pallas:
         try:
             out = schedule_batch(*batch_args, use_pallas=True)
+            # Async dispatch: a device-side kernel failure would otherwise
+            # surface at the later fetch, outside this try — block on one
+            # cheap output so the fallback actually engages.
+            jax.block_until_ready(out["placed"])
         except Exception as e:  # noqa: BLE001 — any lowering/runtime failure
             _pallas_enabled = False
             import warnings
@@ -374,8 +379,13 @@ def execute_batch_host(batch_args, progress_args):
         "best_exists": exists,
         "progress": progress,
     }
+    # The packed form saturates per-node counts at 65535; a take can reach
+    # the gang's full remaining count on one node, so gate the compact fetch
+    # on the host-side remaining bound (batch_args[3]) and fall back to the
+    # exact two-array fetch for wider gangs.
     packed = out.get("assignment_packed")
-    if packed is not None:
+    remaining_host = np.asarray(batch_args[3])
+    if packed is not None and int(remaining_host.max(initial=0)) <= 2**16 - 1:
         fetch["assignment_packed"] = packed
     else:
         fetch["assignment_nodes"] = out["assignment_nodes"]

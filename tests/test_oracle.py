@@ -37,6 +37,71 @@ def test_lane_schema_packing():
     assert cap[1] == 1  # capacity floors
 
 
+def test_lane_schema_autoshift_big_nodes():
+    """A >1 TiB-memory node must pack (shifted unit), not abort the batch
+    (the reference carries int64 quantities with no cap)."""
+    big = {"cpu": 64000, "memory": 2 * 1024**4}  # 2 TiB
+    req = {"cpu": 1000, "memory": 8 * 1024**3}
+    schema = LaneSchema.collect([big, req])
+    mem = schema.index["memory"]
+    assert schema.shifts[mem] == 2  # 2 TiB in KiB = 2**31 -> 4-KiB units
+    cap = schema.pack(big, capacity=True)
+    want = schema.pack(req)
+    assert cap[mem] == 2 * 1024**3 // 4  # exact in 4-KiB units
+    # capacity floors, request ceils in the shifted unit: fit math stays exact
+    assert cap[mem] // want[mem] == (2 * 1024**4) // (8 * 1024**3)
+
+
+def test_lane_schema_pinned_schema_clamps_safely():
+    """With a pinned (stale) schema, an out-of-domain value saturates instead
+    of raising — and a clamped request can never fit a clamped capacity."""
+    import warnings as _w
+
+    schema = LaneSchema.collect([{"cpu": 1000}])
+    huge = {"memory": 4 * 1024**4}  # 4 TiB, beyond the unshifted KiB domain
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        cap = schema.pack(huge, capacity=True)
+        req = schema.pack(huge)
+    mem = schema.index["memory"]
+    assert cap[mem] == 2**30 - 1  # conservative capacity underestimate
+    assert req[mem] == 2**30  # strictly above any clamped capacity
+    assert req[mem] > cap[mem]
+
+
+def test_gang_bound_shrinks_with_node_bucket():
+    """need * node_bucket must stay < 2**31 for the int32 cumsums; a gang at
+    GANG_MAX with a 16k-node bucket must be rejected at the batch boundary."""
+    import pytest
+
+    from batch_scheduler_tpu.ops.bucketing import pad_oracle_batch
+    from batch_scheduler_tpu.ops.oracle import GANG_MAX
+
+    g, n, r = 1, 2**14, 4
+    args = dict(
+        alloc=np.zeros((n, r), np.int32),
+        requested=np.zeros((n, r), np.int32),
+        group_req=np.zeros((g, r), np.int32),
+        remaining=np.full(g, GANG_MAX, np.int32),
+        fit_mask=np.ones((1, n), bool),
+        group_valid=np.ones(g, bool),
+        order=np.arange(g, dtype=np.int32),
+        min_member=np.full(g, GANG_MAX, np.int32),
+        scheduled=np.zeros(g, np.int32),
+        matched=np.zeros(g, np.int32),
+        ineligible=np.zeros(g, bool),
+        creation_rank=np.arange(g, dtype=np.int32),
+    )
+    with pytest.raises(OverflowError):
+        pad_oracle_batch(**args)
+    # the same gang on a small node bucket is fine
+    args_small = dict(args)
+    for k in ("alloc", "requested"):
+        args_small[k] = np.zeros((8, r), np.int32)
+    args_small["fit_mask"] = np.ones((1, 8), bool)
+    pad_oracle_batch(**args_small)
+
+
 def test_left_resources_percent_exact():
     alloc = np.array([[8000, 1000000, 0, 100]], dtype=np.int32)
     req = np.array([[900, 0, 0, 1]], dtype=np.int32)
