@@ -59,6 +59,17 @@ class PodGroupMatchStatus:
         self.pod: Optional[Pod] = None
         # True once the gang has been released to bind at least once.
         self.scheduled = False
+        # Gang-granular admission plan (no reference equivalent — it admits
+        # gangs pod by pod against a TTL cache, core.go:268-309): the oracle
+        # batch that places this gang stamps its node->member-count plan
+        # here, and member pods ride pre_filter/permit/select off the plan
+        # without re-running the batch per pod. ``plan_base_matched`` is the
+        # matched-per-node counter at stamp time: slots consumed on a node =
+        # current matched there minus the base, so evicted/rejected permits
+        # automatically re-open their slots.
+        self.placement_plan: Optional[Dict[str, int]] = None
+        self.plan_base_matched: Dict[str, int] = {}
+        self.plan_batch_seq: int = -1
 
     def close(self) -> None:
         self.matched_pod_nodes.close()

@@ -68,6 +68,7 @@ class ScheduleOperation:
         pg_lister: Optional[Callable[[str, str], Optional[PodGroup]]] = None,
         scorer: "str | OracleScorer" = "oracle",
         clock: Callable[[], float] = time.monotonic,
+        min_batch_interval: float = 0.0,
     ):
         self.status_cache = status_cache
         self.cluster = cluster
@@ -81,7 +82,11 @@ class ScheduleOperation:
                     "OracleScorer-like instance, e.g. service.RemoteScorer)"
                 )
             self.scorer_kind = scorer
-            self.oracle = OracleScorer() if scorer == "oracle" else None
+            self.oracle = (
+                OracleScorer(min_batch_interval=min_batch_interval)
+                if scorer == "oracle"
+                else None
+            )
         else:
             # a scorer instance (e.g. RemoteScorer backed by the sidecar)
             self.scorer_kind = "oracle"
@@ -143,6 +148,7 @@ class ScheduleOperation:
         oracle = self._oracle_fresh(full_name)
         self.max_finished_pg = oracle.max_group()
         if oracle.placed(full_name):
+            self._stamp_plan(full_name, pgs, oracle)
             return
         self.add_to_deny_cache(full_name)
         if oracle.gang_feasible(full_name):
@@ -194,6 +200,69 @@ class ScheduleOperation:
         ):
             self.add_to_deny_cache(full_name)
             raise errs.ResourceNotEnoughError("cluster resource not enough")
+
+    # ------------------------------------------------------------------
+    # Gang-granular admission (no reference equivalent: the reference
+    # re-runs its serial accounting per pod, core.go:268-309; here the
+    # batch's whole-gang placement becomes a per-gang plan that member
+    # pods ride without re-batching)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _matched_per_node(pgs: PodGroupMatchStatus) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for pair in pgs.matched_pod_nodes.items().values():
+            counts[pair.node] = counts.get(pair.node, 0) + 1
+        return counts
+
+    def _stamp_plan(
+        self, full_name: str, pgs: PodGroupMatchStatus, oracle: OracleScorer
+    ) -> None:
+        """Stamp (or refresh) the gang's placement plan from the current
+        batch. Idempotent per batch: the plan covers the members that were
+        *remaining* when the batch ran, and the matched-per-node base lets
+        slot consumption be derived from live matched counts."""
+        seq = oracle.batches_run
+        if pgs.plan_batch_seq == seq:
+            return
+        pgs.plan_base_matched = self._matched_per_node(pgs)
+        pgs.placement_plan = oracle.assignment(full_name)
+        pgs.plan_batch_seq = seq
+
+    def suggested_node(self, pod: Pod) -> Optional[str]:
+        """The plan's next open slot for this pod's gang, or None (caller
+        falls back to the full node scan). Served entirely host-side from
+        the stamped plan — no oracle query, no re-batch."""
+        if self.scorer_kind != "oracle":
+            return None
+        pg_name, ok = pod_group_name(pod)
+        if not ok:
+            return None
+        pgs = self.status_cache.get(f"{pod.metadata.namespace}/{pg_name}")
+        if pgs is None or not pgs.placement_plan:
+            return None
+        current = self._matched_per_node(pgs)
+        base = pgs.plan_base_matched
+        for node, planned in pgs.placement_plan.items():
+            if planned > current.get(node, 0) - base.get(node, 0):
+                return node
+        return None
+
+    def on_assume(self, pod: Pod, node_name: str) -> None:
+        """Called after the framework assumes a pod onto a node. A plan-
+        covered gang member's capacity charge is exactly what the batch
+        already planned — credit the version bump instead of invalidating.
+        Everything else (non-gang pods, planless gangs) dirties the batch."""
+        if self.scorer_kind == "oracle" and self.oracle is not None:
+            pg_name, ok = pod_group_name(pod)
+            if ok:
+                pgs = self.status_cache.get(
+                    f"{pod.metadata.namespace}/{pg_name}"
+                )
+                if pgs is not None and pgs.placement_plan is not None:
+                    self.oracle.credit_expected_change(1)
+                    return
+        self.mark_dirty()
 
     # ------------------------------------------------------------------
     # Filter (reference core.go:170-191,514-564)
@@ -358,7 +427,12 @@ class ScheduleOperation:
             # the pod was re-created; drop the stale permit (core.go:293-296)
             pgs.matched_pod_nodes.delete(old_uid)
         pgs.pod_name_uids.set(pod_key, pod.metadata.uid, wait)
-        self.mark_dirty()
+        if self.scorer_kind != "oracle" or pgs.placement_plan is None:
+            # Plan-covered gangs skip the per-pod invalidation: the batch's
+            # assignment already placed every remaining member, so a member
+            # matching only *reduces* future demand (conservative to serve
+            # from the existing batch).
+            self.mark_dirty()
 
         matched = len(pgs.matched_pod_nodes.items())
         if matched >= pg.spec.min_member - pg.status.scheduled:
@@ -412,7 +486,17 @@ class ScheduleOperation:
                 )
 
             pgs.pod_group.status.scheduled = pg_copy.status.scheduled
-        self.mark_dirty()
+            completed = (
+                pg_copy.status.scheduled >= pgs.pod_group.spec.min_member
+            )
+        # Plan-covered member binds are pre-accounted; re-batch once per
+        # gang completion (progress/max-group freshness), not per pod.
+        if (
+            completed
+            or self.scorer_kind != "oracle"
+            or pgs.placement_plan is None
+        ):
+            self.mark_dirty()
 
     # ------------------------------------------------------------------
     # Queue ordering (reference core.go:368-411)
@@ -472,7 +556,18 @@ class ScheduleOperation:
         refs = sorted(str(r) for r in pod.metadata.owner_references)
         if pgs.pod is None:
             pgs.pod = pod
-            self.mark_dirty()  # the group's demand row just became real
+            # The demand row only *changes* if the pod carries placement
+            # constraints the spec didn't (priority/selector/tolerations) or
+            # fixes the member shape below; a plain first pod of a
+            # min_resources gang leaves the row identical (has_pod only
+            # gates max-progress eligibility) — don't burn a re-batch on it.
+            if (
+                pod.spec.priority
+                or pod.spec.node_selector
+                or pod.spec.tolerations
+                or pgs.pod_group.spec.min_resources is None
+            ):
+                self.mark_dirty()
         if pgs.pod_group.spec.min_resources is None:
             pgs.pod_group.spec.min_resources = pod.resource_require()
             self.mark_dirty()

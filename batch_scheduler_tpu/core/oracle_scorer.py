@@ -96,12 +96,25 @@ class _BatchState:
 class OracleScorer:
     """Caches one batch of oracle results; invalidated by ``mark_dirty``."""
 
-    def __init__(self):
+    def __init__(self, min_batch_interval: float = 0.0):
         self._dirty = True
         self._state: Optional[_BatchState] = None
         self._refresh_lock = threading.Lock()
         self._cluster_version = None
         self.batches_run = 0
+        # Gang-granular admission support: plan-covered cluster changes
+        # (member assumes/binds the current batch already charged via its
+        # gang placement) are *credited* rather than invalidating the batch,
+        # so batches scale with gangs and cluster churn — not with pods.
+        self._version_credits = 0
+        self._credits_lock = threading.Lock()
+        # Optional re-batch coalescing: when > 0, a dirty batch whose answers
+        # can still be served (all queried groups known) is refreshed at most
+        # once per interval. Denials are already 20s-sticky via the deny
+        # cache (reference core.go:188), so bounded staleness here is well
+        # inside existing semantics.
+        self.min_batch_interval = min_batch_interval
+        self._last_batch_t = 0.0
         # oracle-batch latency telemetry (SURVEY.md §5: schedule-cycle
         # latency is the headline metric; the reference has no equivalent
         # instrumentation, only klog verbosity)
@@ -112,6 +125,14 @@ class OracleScorer:
     def mark_dirty(self) -> None:
         self._dirty = True
 
+    def credit_expected_change(self, n: int = 1) -> None:
+        """Record n cluster-version bumps as pre-accounted by the current
+        batch (a planned gang member being assumed/bound): the batch stays
+        fresh. Over- or under-crediting is safe — any mismatch makes
+        ``_stale`` true, which only costs an extra re-batch."""
+        with self._credits_lock:
+            self._version_credits += n
+
     @property
     def snapshot(self) -> Optional[ClusterSnapshot]:
         state = self._state
@@ -120,6 +141,13 @@ class OracleScorer:
     def refresh(self, cluster, status_cache: PGStatusCache) -> None:
         """Rebuild the snapshot and run one fused oracle batch."""
         t0 = time.perf_counter()
+        # Credits and the version base are taken BEFORE reading state: any
+        # change landing mid-refresh leaves version() ahead of the base and
+        # re-batches conservatively.
+        with self._credits_lock:
+            self._version_credits = 0
+        version_fn = getattr(cluster, "version", None)
+        version_base = version_fn() if callable(version_fn) else None
         statuses = status_cache.snapshot()
         demands: List[GroupDemand] = [
             demand_from_status(name, pgs) for name, pgs in sorted(statuses.items())
@@ -138,10 +166,10 @@ class OracleScorer:
             else ""
         )
         self._state = _BatchState(snap, host, max_group, row_fetcher)
-        version_fn = getattr(cluster, "version", None)
-        self._cluster_version = version_fn() if callable(version_fn) else None
+        self._cluster_version = version_base
         self._dirty = False
         self.batches_run += 1
+        self._last_batch_t = time.monotonic()
         with self._stats_lock:
             self.pack_seconds.append(t_pack - t0)
             self.batch_seconds.append(t_batch - t_pack)
@@ -164,25 +192,44 @@ class OracleScorer:
         if self._dirty or self._state is None:
             return True
         version_fn = getattr(cluster, "version", None)
-        if callable(version_fn) and version_fn() != self._cluster_version:
-            return True
+        if callable(version_fn):
+            with self._credits_lock:
+                credits = self._version_credits
+            if version_fn() - credits != self._cluster_version:
+                return True
         return False
+
+    def _group_missing(self, group: Optional[str]) -> bool:
+        return (
+            group is not None
+            and (
+                self._state is None
+                or self._state.snapshot.group_index(group) is None
+            )
+        )
 
     def ensure_fresh(
         self, cluster, status_cache: PGStatusCache, group: Optional[str] = None
     ) -> None:
         """Re-batch if dirty, the cluster changed, or ``group`` (a group the
         caller is about to query) is missing from the cached snapshot —
-        newly created PodGroups must not be denied off a stale batch."""
+        newly created PodGroups must not be denied off a stale batch.
+
+        With ``min_batch_interval`` > 0, a merely-stale batch (the queried
+        group is known) is served as-is until the interval elapses, bounding
+        re-batch rate under churn."""
         if not self._stale(cluster):
-            state = self._state
-            if group is None or state.snapshot.group_index(group) is not None:
+            if not self._group_missing(group):
                 return
+        elif (
+            not self._group_missing(group)
+            and self._state is not None
+            and self.min_batch_interval > 0
+            and time.monotonic() - self._last_batch_t < self.min_batch_interval
+        ):
+            return
         with self._refresh_lock:
-            if self._stale(cluster) or (
-                group is not None
-                and self._state.snapshot.group_index(group) is None
-            ):
+            if self._stale(cluster) or self._group_missing(group):
                 self.refresh(cluster, status_cache)
 
     # -- query API (host-side, post-batch) ---------------------------------

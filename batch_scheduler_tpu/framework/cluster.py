@@ -93,22 +93,37 @@ class ClusterState:
 
     def observe_pod(self, pod: Pod) -> None:
         """Apply an informer event for a pod: bound pods charge their node,
-        terminal pods release it."""
+        terminal pods release it.
+
+        The version only bumps when the *capacity view* actually changes:
+        the assumed→bound transition of a pod already charged to the same
+        node with the same request is a no-op here, so a gang member's bind
+        commit does not invalidate the oracle batch that planned it."""
         if not pod.spec.node_name:
             return
         with self._lock:
             uid = pod.metadata.uid
             node = pod.spec.node_name
             if pod.status.phase in _TERMINAL:
-                self._requested.get(node, {}).pop(uid, None)
-                self._pod_nodes.pop(uid, None)
+                charged = self._requested.get(node, {}).pop(uid, None)
+                known = self._pod_nodes.pop(uid, None)
                 self._assumed.pop(uid, None)
-                self._version += 1
+                if charged is not None or known is not None:
+                    self._version += 1
                 return
-            self._requested.setdefault(node, {})[uid] = self._require(pod)
+            req = self._require(pod)
+            unchanged = (
+                self._pod_nodes.get(uid) == node
+                and self._requested.get(node, {}).get(uid) == req
+            )
+            prev = self._pod_nodes.get(uid)
+            if prev is not None and prev != node:
+                self._requested.get(prev, {}).pop(uid, None)
+            self._requested.setdefault(node, {})[uid] = req
             self._pod_nodes[uid] = node
             self._assumed.pop(uid, None)
-            self._version += 1
+            if not unchanged:
+                self._version += 1
 
     def remove_pod(self, pod: Pod) -> None:
         with self._lock:

@@ -152,7 +152,13 @@ class Scheduler:
 
         self.cluster.assume(pod, node_name)
         if self.plugin is not None:
-            self.plugin.mark_dirty()
+            # Let the plugin decide whether this assume invalidates its
+            # batch (plan-covered gang members are pre-accounted).
+            on_assume = getattr(self.plugin, "on_assume", None)
+            if on_assume is not None:
+                on_assume(pod, node_name)
+            else:
+                self.plugin.mark_dirty()
 
         if self.plugin is None:
             self._bind(pod, node_name)
@@ -173,9 +179,31 @@ class Scheduler:
 
     def _select_node(self, pod: Pod) -> Optional[str]:
         """Generic resource/selector/taint fit + plugin Filter, then highest
-        plugin Score wins (kube-scheduler's filter/score phases)."""
+        plugin Score wins (kube-scheduler's filter/score phases).
+
+        Fast path: a plugin-suggested node (the gang's batch placement plan)
+        is verified against that single node and taken — O(1) per pod
+        instead of the O(nodes) scan."""
         require = dict(pod.resource_require())
         require["pods"] = require.get("pods", 0) + 1
+
+        if self.plugin is not None:
+            suggest = getattr(self.plugin, "suggested_node", None)
+            hint = suggest(pod) if suggest is not None else None
+            if hint is not None:
+                node = self.cluster.get_node(hint)
+                if (
+                    node is not None
+                    and not node.spec.unschedulable
+                    and rmath.check_fit(pod, node)
+                ):
+                    left = rmath.single_node_left(
+                        node, self.cluster.node_requested(hint), None
+                    )
+                    if rmath.resource_satisfied(left, require):
+                        return hint
+                # plan slot unusable (node gone/full): fall through to the
+                # scan, which sees the live cluster
         best_name, best_score = None, None
         for node in self.cluster.list_nodes():
             if node.spec.unschedulable:
@@ -239,5 +267,6 @@ class Scheduler:
         self.stats["scheduled"] += 1
         if self.plugin is not None:
             pod.spec.node_name = node_name
+            # post_bind owns batch invalidation (per gang completion, not
+            # per pod — plan-covered member binds are pre-accounted)
             self.plugin.post_bind(pod, node_name)
-            self.plugin.mark_dirty()

@@ -104,6 +104,14 @@ class BatchSchedulingPlugin:
     def mark_dirty(self) -> None:
         self.operation.mark_dirty()
 
+    def suggested_node(self, pod: Pod) -> Optional[str]:
+        """Gang-granular admission: the batch plan's next open slot for this
+        pod, letting the framework skip the full node scan."""
+        return self.operation.suggested_node(pod)
+
+    def on_assume(self, pod: Pod, node_name: str) -> None:
+        self.operation.on_assume(pod, node_name)
+
     # ------------------------------------------------------------------
     # gang release choreography (the batchScheduler interface,
     # reference batchscheduler.go:53-58)

@@ -40,6 +40,10 @@ class PluginConfig:
     # "oracle" = the TPU-batched scorer (the --scorer=tpu gate);
     # "serial" = the reference-parity in-process path.
     scorer: str = "oracle"
+    # Re-batch coalescing window for the oracle scorer (0 = re-batch on
+    # every invalidation; >0 bounds batch rate under churn — denials are
+    # already 20s-sticky so bounded staleness is inside existing semantics).
+    min_batch_interval_seconds: float = 0.0
     controller_workers: int = 10
     leader_poll_seconds: float = 1.0
     # Extension points the plugin is enabled at (config-file surface,
@@ -135,6 +139,7 @@ def new_plugin_runtime(
         max_schedule_seconds=config.max_schedule_seconds,
         pg_lister=lambda ns, name: lister.pod_groups(ns).get(name),
         scorer=config.scorer,
+        min_batch_interval=config.min_batch_interval_seconds,
         **kwargs,
     )
 

@@ -37,6 +37,7 @@ class SimCluster:
         backoff_cap: float = 2.0,
         controller_resync_seconds: float = 0.1,
         enabled_points=None,
+        min_batch_interval: float = 0.0,
     ):
         self.api = APIServer()
         self.clientset = Clientset(self.api)
@@ -47,6 +48,7 @@ class SimCluster:
             scorer=scorer,
             max_schedule_minutes=max_schedule_minutes,
             controller_resync_seconds=controller_resync_seconds,
+            min_batch_interval_seconds=min_batch_interval,
             **kwargs,
         )
         self.runtime = None
