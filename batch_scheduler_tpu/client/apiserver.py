@@ -13,15 +13,14 @@ fake) is this same store with no external transport — see client.fake.
 
 from __future__ import annotations
 
-import copy
 import queue
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..api.serde import object_from_dict
 from ..api.types import new_uid, to_dict
-from ..utils.patch import apply_merge_patch
+from ..utils.patch import apply_merge_patch, json_deepcopy
 
 __all__ = ["APIServer", "WatchEvent", "NotFoundError", "ConflictError", "AlreadyExistsError"]
 
@@ -52,7 +51,7 @@ class WatchEvent:
 
     def object(self):
         """Rehydrate the typed API object (deep copy; safe to mutate)."""
-        return object_from_dict(self.kind, copy.deepcopy(self.obj))
+        return object_from_dict(self.kind, json_deepcopy(self.obj))
 
 
 class APIServer:
@@ -64,11 +63,31 @@ class APIServer:
         self._rv = 0
         self._watchers: Dict[str, List[queue.Queue]] = {}
         self._crds: Dict[str, dict] = {}
+        # label index: kind -> (label_key, label_value) -> object keys —
+        # keeps selector lists (the controller's per-group member listing,
+        # reference controller.go:235-241) O(matches), not O(all objects)
+        self._label_idx: Dict[str, Dict[Tuple[str, str], Set[Tuple[str, str]]]] = {}
 
     # -- helpers -----------------------------------------------------------
 
     def _kind_store(self, kind: str) -> Dict[Tuple[str, str], dict]:
         return self._store.setdefault(kind, {})
+
+    @staticmethod
+    def _labels_of(obj: dict) -> dict:
+        return (obj.get("metadata") or {}).get("labels") or {}
+
+    def _index_add(self, kind: str, key: Tuple[str, str], obj: dict) -> None:
+        idx = self._label_idx.setdefault(kind, {})
+        for kv in self._labels_of(obj).items():
+            idx.setdefault(kv, set()).add(key)
+
+    def _index_remove(self, kind: str, key: Tuple[str, str], obj: dict) -> None:
+        idx = self._label_idx.get(kind, {})
+        for kv in self._labels_of(obj).items():
+            bucket = idx.get(kv)
+            if bucket is not None:
+                bucket.discard(key)
 
     def _notify(self, kind: str, event: WatchEvent) -> None:
         for q in self._watchers.get(kind, []):
@@ -95,7 +114,7 @@ class APIServer:
     # -- CRUD --------------------------------------------------------------
 
     def create(self, kind: str, obj) -> dict:
-        d = copy.deepcopy(self._as_dict(obj))
+        d = json_deepcopy(self._as_dict(obj))
         meta = d.setdefault("metadata", {})
         key = (meta.get("namespace", "default"), meta.get("name", ""))
         with self._lock:
@@ -111,15 +130,16 @@ class APIServer:
             if not meta.get("uid"):
                 meta["uid"] = new_uid(kind.lower())
             store[key] = d
-            self._notify(kind, WatchEvent(WatchEvent.ADDED, kind, copy.deepcopy(d)))
-            return copy.deepcopy(d)
+            self._index_add(kind, key, d)
+            self._notify(kind, WatchEvent(WatchEvent.ADDED, kind, json_deepcopy(d)))
+            return json_deepcopy(d)
 
     def get(self, kind: str, namespace: str, name: str) -> dict:
         with self._lock:
             obj = self._kind_store(kind).get((namespace, name))
             if obj is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
-            return copy.deepcopy(obj)
+            return json_deepcopy(obj)
 
     def list(
         self,
@@ -128,19 +148,33 @@ class APIServer:
         label_selector: Optional[Dict[str, str]] = None,
     ) -> List[dict]:
         with self._lock:
-            out = []
-            for (ns, _), obj in self._kind_store(kind).items():
-                if namespace is not None and ns != namespace:
-                    continue
-                if label_selector:
-                    labels = (obj.get("metadata") or {}).get("labels") or {}
-                    if any(labels.get(k) != v for k, v in label_selector.items()):
+            store = self._kind_store(kind)
+            if label_selector:
+                # candidate set from the index on the first selector term,
+                # verified against the rest — O(matches), not O(objects)
+                idx = self._label_idx.get(kind, {})
+                first, *rest = label_selector.items()
+                keys = idx.get(first, set())
+                out = []
+                for key in keys:
+                    obj = store.get(key)
+                    if obj is None:
                         continue
-                out.append(copy.deepcopy(obj))
-            return out
+                    if namespace is not None and key[0] != namespace:
+                        continue
+                    labels = self._labels_of(obj)
+                    if any(labels.get(k) != v for k, v in rest):
+                        continue
+                    out.append(json_deepcopy(obj))
+                return out
+            return [
+                json_deepcopy(obj)
+                for (ns, _), obj in store.items()
+                if namespace is None or ns == namespace
+            ]
 
     def update(self, kind: str, obj) -> dict:
-        d = copy.deepcopy(self._as_dict(obj))
+        d = json_deepcopy(self._as_dict(obj))
         meta = d.setdefault("metadata", {})
         key = (meta.get("namespace", "default"), meta.get("name", ""))
         with self._lock:
@@ -149,9 +183,11 @@ class APIServer:
                 raise NotFoundError(f"{kind} {key[0]}/{key[1]} not found")
             self._rv += 1
             meta["resource_version"] = self._rv
+            self._index_remove(kind, key, store[key])
             store[key] = d
-            self._notify(kind, WatchEvent(WatchEvent.MODIFIED, kind, copy.deepcopy(d)))
-            return copy.deepcopy(d)
+            self._index_add(kind, key, d)
+            self._notify(kind, WatchEvent(WatchEvent.MODIFIED, kind, json_deepcopy(d)))
+            return json_deepcopy(d)
 
     def patch(self, kind: str, namespace: str, name: str, patch: dict) -> dict:
         """RFC 7386 merge patch (the reference's only write verb for status,
@@ -161,14 +197,17 @@ class APIServer:
             key = (namespace, name)
             if key not in store:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
-            merged = apply_merge_patch(store[key], patch)
+            old = store[key]
+            merged = apply_merge_patch(old, patch)
             self._rv += 1
             merged.setdefault("metadata", {})["resource_version"] = self._rv
+            self._index_remove(kind, key, old)
             store[key] = merged
+            self._index_add(kind, key, merged)
             self._notify(
-                kind, WatchEvent(WatchEvent.MODIFIED, kind, copy.deepcopy(merged))
+                kind, WatchEvent(WatchEvent.MODIFIED, kind, json_deepcopy(merged))
             )
-            return copy.deepcopy(merged)
+            return json_deepcopy(merged)
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         with self._lock:
@@ -176,6 +215,7 @@ class APIServer:
             obj = store.pop((namespace, name), None)
             if obj is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            self._index_remove(kind, (namespace, name), obj)
             self._notify(kind, WatchEvent(WatchEvent.DELETED, kind, obj))
 
     def delete_collection(
@@ -186,6 +226,7 @@ class APIServer:
             keys = [k for k in store if namespace is None or k[0] == namespace]
             for k in keys:
                 obj = store.pop(k)
+                self._index_remove(kind, k, obj)
                 self._notify(kind, WatchEvent(WatchEvent.DELETED, kind, obj))
             return len(keys)
 
@@ -198,7 +239,7 @@ class APIServer:
         with self._lock:
             if replay:
                 for obj in self._kind_store(kind).values():
-                    q.put(WatchEvent(WatchEvent.ADDED, kind, copy.deepcopy(obj)))
+                    q.put(WatchEvent(WatchEvent.ADDED, kind, json_deepcopy(obj)))
             self._watchers.setdefault(kind, []).append(q)
         return q
 

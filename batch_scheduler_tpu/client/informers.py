@@ -33,6 +33,10 @@ class SharedInformer:
         self._synced = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # lazily-built typed views for read-only hot paths (queue compare
+        # runs two lister reads per heap comparison); keyed by store-dict
+        # identity so any update invalidates
+        self._typed_cache: Dict[Tuple[str, str], tuple] = {}
 
     # -- registration ------------------------------------------------------
 
@@ -106,6 +110,23 @@ class SharedInformer:
         with self._lock:
             d = self._store.get((namespace, name))
             return object_from_dict(self.kind, d) if d else None
+
+    def get_typed(self, namespace: str, name: str):
+        """READ-ONLY cached typed view: one construction per store update,
+        shared across callers — never mutate the result (use ``get`` for a
+        private copy)."""
+        key = (namespace, name)
+        with self._lock:
+            d = self._store.get(key)
+            if d is None:
+                self._typed_cache.pop(key, None)
+                return None
+            cached = self._typed_cache.get(key)
+            if cached is not None and cached[0] is d:
+                return cached[1]
+            obj = object_from_dict(self.kind, d)
+            self._typed_cache[key] = (d, obj)
+            return obj
 
     def list(self, namespace: Optional[str] = None) -> list:
         with self._lock:

@@ -137,7 +137,9 @@ def new_plugin_runtime(
         cluster=handle.cluster,
         pg_client=pg_client,
         max_schedule_seconds=config.max_schedule_seconds,
-        pg_lister=lambda ns, name: lister.pod_groups(ns).get(name),
+        # compare runs per heap comparison — use the informer's cached
+        # typed view (read-only) instead of rebuilding objects per call
+        pg_lister=pg_informer.get_typed,
         scorer=config.scorer,
         min_batch_interval=config.min_batch_interval_seconds,
         **kwargs,

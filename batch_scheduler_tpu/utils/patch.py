@@ -11,7 +11,21 @@ from __future__ import annotations
 
 from typing import Any
 
-__all__ = ["create_merge_patch", "apply_merge_patch"]
+__all__ = ["create_merge_patch", "apply_merge_patch", "json_deepcopy"]
+
+
+def json_deepcopy(o: Any) -> Any:
+    """Deep-copy a JSON tree (dict/list/scalars) ~10x faster than
+    ``copy.deepcopy``: no memo table, no reduce protocol — the API server's
+    stores only ever hold ``to_dict`` output, so exact-type dispatch is
+    sound. Tuples (possible in hand-built test fixtures) normalise to lists,
+    matching what a JSON round-trip would do."""
+    t = type(o)
+    if t is dict:
+        return {k: json_deepcopy(v) for k, v in o.items()}
+    if t is list or t is tuple:
+        return [json_deepcopy(v) for v in o]
+    return o
 
 
 def create_merge_patch(original: Any, modified: Any) -> dict:
