@@ -1,0 +1,200 @@
+"""HTTPAPIServer: point the control plane at a REAL (remote) API server.
+
+Implements the same interface as ``client.apiserver.APIServer`` — create /
+get / list / update / patch / delete / delete_collection / watch /
+stop_watch / ensure_crd — over Kubernetes-shaped HTTP (the dialect served by
+``client.http_gateway``, which is the k8s resource-path + watch-stream
+protocol shape a KWOK-simulated cluster speaks). ``Clientset`` and
+``SharedInformerFactory`` take it unchanged, so the whole scheduler stack
+can run against an external endpoint — the capability the reference gets
+from client-go (reference pkg/generated/clientset/versioned/
+clientset.go:58-97, informers list+watch factory.go:79-180). The in-memory
+path is untouched.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import queue
+import threading
+from typing import Dict, List, Optional
+from urllib.parse import quote
+
+from .apiserver import (
+    AlreadyExistsError,
+    NotFoundError,
+    WatchEvent,
+)
+from .http_gateway import CRD_PATH, KIND_ROUTES
+from ..api.types import to_dict
+
+__all__ = ["HTTPAPIServer"]
+
+
+class HTTPAPIServer:
+    """APIServer-interface client over HTTP (one connection per request;
+    watches hold a streaming connection + reader thread per subscription)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._watches: Dict[int, tuple] = {}  # id(queue) -> (conn, resp, thread, stop)
+        self._lock = threading.Lock()
+
+    # -- request plumbing --------------------------------------------------
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=self.timeout)
+        try:
+            payload = None if body is None else json.dumps(body)
+            headers = {"Content-Type": "application/json"} if payload else {}
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            data = json.loads(resp.read() or b"{}")
+            if resp.status == 404:
+                raise NotFoundError(data.get("message", path))
+            if resp.status == 409:
+                raise AlreadyExistsError(data.get("message", path))
+            if resp.status >= 400:
+                raise RuntimeError(f"{method} {path}: {resp.status} {data}")
+            return data
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _collection_path(kind: str, namespace: Optional[str]) -> str:
+        prefix, plural, namespaced = KIND_ROUTES[kind]
+        if namespaced and namespace:
+            return f"{prefix}/namespaces/{quote(namespace)}/{plural}"
+        return f"{prefix}/{plural}"
+
+    def _object_path(self, kind: str, namespace: str, name: str) -> str:
+        return f"{self._collection_path(kind, namespace)}/{quote(name)}"
+
+    @staticmethod
+    def _as_dict(obj) -> dict:
+        return obj if isinstance(obj, dict) else to_dict(obj)
+
+    # -- APIServer interface ----------------------------------------------
+
+    def ensure_crd(self, name: str, spec: Optional[dict] = None) -> bool:
+        try:
+            self._request(
+                "POST", CRD_PATH, {"metadata": {"name": name}, "spec": spec or {}}
+            )
+            return True
+        except AlreadyExistsError:
+            return False
+
+    def crds(self) -> List[str]:
+        return self._request("GET", CRD_PATH)["items"]
+
+    def create(self, kind: str, obj) -> dict:
+        d = self._as_dict(obj)
+        ns = (d.get("metadata") or {}).get("namespace", "default")
+        return self._request("POST", self._collection_path(kind, ns), d)
+
+    def get(self, kind: str, namespace: str, name: str) -> dict:
+        return self._request("GET", self._object_path(kind, namespace, name))
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[dict]:
+        path = self._collection_path(kind, namespace)
+        if label_selector:
+            sel = ",".join(f"{k}={v}" for k, v in label_selector.items())
+            path += f"?labelSelector={quote(sel)}"
+        return self._request("GET", path)["items"]
+
+    def update(self, kind: str, obj) -> dict:
+        d = self._as_dict(obj)
+        meta = d.get("metadata") or {}
+        path = self._object_path(
+            kind, meta.get("namespace", "default"), meta.get("name", "")
+        )
+        return self._request("PUT", path, d)
+
+    def patch(self, kind: str, namespace: str, name: str, patch: dict) -> dict:
+        return self._request(
+            "PATCH", self._object_path(kind, namespace, name), patch
+        )
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._request("DELETE", self._object_path(kind, namespace, name))
+
+    def delete_collection(self, kind: str, namespace: Optional[str] = None) -> int:
+        return self._request(
+            "DELETE", self._collection_path(kind, namespace)
+        ).get("deleted", 0)
+
+    # -- watch -------------------------------------------------------------
+
+    def watch(self, kind: str, *, replay: bool = True) -> "queue.Queue[WatchEvent]":
+        """Open a streaming watch; events arrive on the returned queue
+        (same contract as APIServer.watch)."""
+        q: "queue.Queue[WatchEvent]" = queue.Queue()
+        conn = http.client.HTTPConnection(self.host, self.port)
+        path = (
+            self._collection_path(kind, None)
+            + f"?watch=1&replay={'1' if replay else '0'}"
+        )
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        stop = threading.Event()
+
+        def reader() -> None:
+            try:
+                while not stop.is_set():
+                    line = resp.fp.readline()
+                    if not line or stop.is_set():
+                        return  # stream closed or unsubscribed
+                    line = line.strip()
+                    if not line:
+                        continue  # heartbeat
+                    ev = json.loads(line)
+                    q.put(WatchEvent(ev["type"], kind, ev["object"]))
+            except (OSError, ValueError):
+                pass  # connection torn down by stop_watch or server exit
+
+        t = threading.Thread(
+            target=reader, name=f"http-watch-{kind}", daemon=True
+        )
+        t.start()
+        with self._lock:
+            self._watches[id(q)] = (conn, resp, t, stop)
+        return q
+
+    def stop_watch(self, kind: str, q: queue.Queue) -> None:
+        with self._lock:
+            entry = self._watches.pop(id(q), None)
+        if entry is None:
+            return
+        conn, resp, _, stop = entry
+        stop.set()
+        # resp holds its own buffered socket file — closing the connection
+        # alone leaves the reader consuming buffered events
+        try:
+            resp.close()
+        except OSError:
+            pass
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        with self._lock:
+            entries = list(self._watches.values())
+            self._watches.clear()
+        for conn, resp, _, stop in entries:
+            stop.set()
+            for c in (resp, conn):
+                try:
+                    c.close()
+                except OSError:
+                    pass

@@ -1,0 +1,230 @@
+"""HTTP gateway: expose an APIServer over Kubernetes-shaped REST.
+
+The reference talks to a real API server over HTTPS via client-go
+(reference pkg/generated/clientset/versioned/clientset.go:58-97); this
+module is the transport-parity piece for the owned control plane: any
+APIServer can be served on a socket with k8s-style resource paths and the
+k8s watch protocol (streamed ``{"type": ..., "object": ...}`` JSON lines),
+and ``client.http_apiserver.HTTPAPIServer`` connects Clientset/informers to
+such an endpoint — ours, or any server speaking the same dialect (KWOK-style
+simulated clusters serve exactly these paths).
+
+Routes:
+  /api/v1/namespaces/{ns}/pods[/{name}]
+  /api/v1/nodes[/{name}]
+  /apis/batch.scheduler.tpu/v1/namespaces/{ns}/podgroups[/{name}]
+  /apis/apiextensions.k8s.io/v1/customresourcedefinitions
+  collection GET with ?watch=1[&replay=1] streams watch events
+  collection GET with ?labelSelector=k%3Dv,... filters server-side
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import parse_qs, unquote, urlparse
+
+from .apiserver import AlreadyExistsError, APIServer, NotFoundError
+
+__all__ = ["KIND_ROUTES", "CRD_PATH", "serve_gateway", "GatewayServer"]
+
+# kind -> (api prefix, plural, namespaced)
+KIND_ROUTES = {
+    "Pod": ("/api/v1", "pods", True),
+    "Node": ("/api/v1", "nodes", False),
+    "PodGroup": ("/apis/batch.scheduler.tpu/v1", "podgroups", True),
+}
+_PLURALS = {v[1]: k for k, v in KIND_ROUTES.items()}
+CRD_PATH = "/apis/apiextensions.k8s.io/v1/customresourcedefinitions"
+
+
+def _parse_resource(path: str) -> Optional[Tuple[str, Optional[str], Optional[str]]]:
+    """path -> (kind, namespace or None, name or None), or None."""
+    parts = [p for p in path.split("/") if p]
+    # {prefix...}/namespaces/{ns}/{plural}[/{name}]
+    if "namespaces" in parts:
+        i = parts.index("namespaces")
+        if len(parts) < i + 3:
+            return None
+        ns, plural = parts[i + 1], parts[i + 2]
+        kind = _PLURALS.get(plural)
+        if kind is None:
+            return None
+        name = parts[i + 3] if len(parts) > i + 3 else None
+        return kind, ns, name
+    # cluster-scoped or all-namespaces: {prefix...}/{plural}[/{name}]
+    for j, part in enumerate(parts):
+        kind = _PLURALS.get(part)
+        if kind is not None:
+            name = parts[j + 1] if len(parts) > j + 1 else None
+            return kind, None, name
+    return None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # Streaming watch needs per-request flushing, not buffered responses.
+    protocol_version = "HTTP/1.0"
+    api: APIServer = None  # set by serve_gateway subclass
+
+    def log_message(self, *args) -> None:  # quiet
+        pass
+
+    # -- helpers -----------------------------------------------------------
+
+    def _send_json(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_error_json(self, code: int, message: str) -> None:
+        self._send_json(code, {"kind": "Status", "code": code, "message": message})
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        return json.loads(self.rfile.read(length) or b"{}")
+
+    def _selector(self, qs) -> Optional[dict]:
+        raw = qs.get("labelSelector", [None])[0]
+        if not raw:
+            return None
+        out = {}
+        for term in unquote(raw).split(","):
+            if "=" in term:
+                k, v = term.split("=", 1)
+                out[k] = v
+        return out or None
+
+    # -- verbs -------------------------------------------------------------
+
+    def do_GET(self) -> None:
+        url = urlparse(self.path)
+        parsed = _parse_resource(url.path)
+        if parsed is None:
+            if url.path == CRD_PATH:
+                self._send_json(200, {"items": self.api.crds()})
+                return
+            self._send_error_json(404, f"unknown path {url.path}")
+            return
+        kind, ns, name = parsed
+        qs = parse_qs(url.query)
+        try:
+            if name is not None:
+                self._send_json(200, self.api.get(kind, ns or "", name))
+            elif qs.get("watch", ["0"])[0] in ("1", "true"):
+                self._stream_watch(kind, qs)
+            else:
+                items = self.api.list(kind, ns, self._selector(qs))
+                self._send_json(200, {"items": items})
+        except NotFoundError as e:
+            self._send_error_json(404, str(e))
+
+    def _stream_watch(self, kind: str, qs) -> None:
+        replay = qs.get("replay", ["1"])[0] in ("1", "true")
+        events = self.api.watch(kind, replay=replay)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "identity")
+        self.end_headers()
+        try:
+            while True:
+                try:
+                    ev = events.get(timeout=0.2)
+                except queue.Empty:
+                    # heartbeat keeps half-open disconnects detectable
+                    self.wfile.write(b"\n")
+                    self.wfile.flush()
+                    continue
+                line = json.dumps({"type": ev.type, "object": ev.obj}) + "\n"
+                self.wfile.write(line.encode())
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self.api.stop_watch(kind, events)
+
+    def do_POST(self) -> None:
+        url = urlparse(self.path)
+        if url.path == CRD_PATH:
+            body = self._read_body()
+            created = self.api.ensure_crd(
+                body.get("metadata", {}).get("name", ""), body.get("spec")
+            )
+            self._send_json(201 if created else 409, body)
+            return
+        parsed = _parse_resource(url.path)
+        if parsed is None:
+            self._send_error_json(404, f"unknown path {url.path}")
+            return
+        kind, ns, _ = parsed
+        obj = self._read_body()
+        if ns is not None:
+            obj.setdefault("metadata", {}).setdefault("namespace", ns)
+        try:
+            self._send_json(201, self.api.create(kind, obj))
+        except AlreadyExistsError as e:
+            self._send_error_json(409, str(e))
+
+    def do_PUT(self) -> None:
+        parsed = _parse_resource(urlparse(self.path).path)
+        if parsed is None:
+            self._send_error_json(404, "unknown path")
+            return
+        kind, _, _ = parsed
+        try:
+            self._send_json(200, self.api.update(kind, self._read_body()))
+        except NotFoundError as e:
+            self._send_error_json(404, str(e))
+
+    def do_PATCH(self) -> None:
+        parsed = _parse_resource(urlparse(self.path).path)
+        if parsed is None or parsed[2] is None:
+            self._send_error_json(404, "unknown path")
+            return
+        kind, ns, name = parsed
+        try:
+            self._send_json(
+                200, self.api.patch(kind, ns or "", name, self._read_body())
+            )
+        except NotFoundError as e:
+            self._send_error_json(404, str(e))
+
+    def do_DELETE(self) -> None:
+        parsed = _parse_resource(urlparse(self.path).path)
+        if parsed is None:
+            self._send_error_json(404, "unknown path")
+            return
+        kind, ns, name = parsed
+        try:
+            if name is not None:
+                self.api.delete(kind, ns or "", name)
+                self._send_json(200, {"kind": "Status", "status": "Success"})
+            else:
+                n = self.api.delete_collection(kind, ns)
+                self._send_json(200, {"kind": "Status", "deleted": n})
+        except NotFoundError as e:
+            self._send_error_json(404, str(e))
+
+
+class GatewayServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def serve_gateway(
+    api: APIServer, host: str = "127.0.0.1", port: int = 0
+) -> GatewayServer:
+    """Serve ``api`` on (host, port) in a background thread; returns the
+    server (``server.server_address`` has the bound port; ``shutdown()`` +
+    ``server_close()`` stops it)."""
+    handler = type("BoundHandler", (_Handler,), {"api": api})
+    server = GatewayServer((host, port), handler)
+    threading.Thread(
+        target=server.serve_forever, name="apiserver-gateway", daemon=True
+    ).start()
+    return server
