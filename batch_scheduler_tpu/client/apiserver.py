@@ -174,6 +174,11 @@ class APIServer:
             ]
 
     def update(self, kind: str, obj) -> dict:
+        """Replace an object. When the incoming object carries a nonzero
+        ``metadata.resource_version``, it is an optimistic-concurrency
+        precondition (Kubernetes update semantics): a mismatch with the
+        stored version raises ConflictError — the compare-and-swap that
+        makes API-server-backed leases race-free."""
         d = json_deepcopy(self._as_dict(obj))
         meta = d.setdefault("metadata", {})
         key = (meta.get("namespace", "default"), meta.get("name", ""))
@@ -181,6 +186,13 @@ class APIServer:
             store = self._kind_store(kind)
             if key not in store:
                 raise NotFoundError(f"{kind} {key[0]}/{key[1]} not found")
+            expect = meta.get("resource_version")
+            have = (store[key].get("metadata") or {}).get("resource_version")
+            if expect and have and expect != have:
+                raise ConflictError(
+                    f"{kind} {key[0]}/{key[1]}: resource_version {expect} "
+                    f"is stale (have {have})"
+                )
             self._rv += 1
             meta["resource_version"] = self._rv
             self._index_remove(kind, key, store[key])

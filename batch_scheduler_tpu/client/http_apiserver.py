@@ -23,6 +23,7 @@ from urllib.parse import quote
 
 from .apiserver import (
     AlreadyExistsError,
+    ConflictError,
     NotFoundError,
     WatchEvent,
 )
@@ -56,6 +57,8 @@ class HTTPAPIServer:
             if resp.status == 404:
                 raise NotFoundError(data.get("message", path))
             if resp.status == 409:
+                if data.get("reason") == "Conflict":
+                    raise ConflictError(data.get("message", path))
                 raise AlreadyExistsError(data.get("message", path))
             if resp.status >= 400:
                 raise RuntimeError(f"{method} {path}: {resp.status} {data}")

@@ -27,7 +27,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 from urllib.parse import parse_qs, unquote, urlparse
 
-from .apiserver import AlreadyExistsError, APIServer, NotFoundError
+from .apiserver import AlreadyExistsError, APIServer, ConflictError, NotFoundError
 
 __all__ = ["KIND_ROUTES", "CRD_PATH", "serve_gateway", "GatewayServer"]
 
@@ -36,6 +36,7 @@ KIND_ROUTES = {
     "Pod": ("/api/v1", "pods", True),
     "Node": ("/api/v1", "nodes", False),
     "PodGroup": ("/apis/batch.scheduler.tpu/v1", "podgroups", True),
+    "Lease": ("/apis/coordination.k8s.io/v1", "leases", True),
 }
 _PLURALS = {v[1]: k for k, v in KIND_ROUTES.items()}
 CRD_PATH = "/apis/apiextensions.k8s.io/v1/customresourcedefinitions"
@@ -82,8 +83,13 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _send_error_json(self, code: int, message: str) -> None:
-        self._send_json(code, {"kind": "Status", "code": code, "message": message})
+    def _send_error_json(
+        self, code: int, message: str, reason: str = ""
+    ) -> None:
+        self._send_json(
+            code,
+            {"kind": "Status", "code": code, "message": message, "reason": reason},
+        )
 
     def _read_body(self) -> dict:
         length = int(self.headers.get("Content-Length") or 0)
@@ -168,7 +174,7 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             self._send_json(201, self.api.create(kind, obj))
         except AlreadyExistsError as e:
-            self._send_error_json(409, str(e))
+            self._send_error_json(409, str(e), reason="AlreadyExists")
 
     def do_PUT(self) -> None:
         parsed = _parse_resource(urlparse(self.path).path)
@@ -178,6 +184,8 @@ class _Handler(BaseHTTPRequestHandler):
         kind, _, _ = parsed
         try:
             self._send_json(200, self.api.update(kind, self._read_body()))
+        except ConflictError as e:
+            self._send_error_json(409, str(e), reason="Conflict")
         except NotFoundError as e:
             self._send_error_json(404, str(e))
 

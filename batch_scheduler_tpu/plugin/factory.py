@@ -46,6 +46,7 @@ class PluginConfig:
     min_batch_interval_seconds: float = 0.0
     controller_workers: int = 10
     leader_poll_seconds: float = 1.0
+    lease_renew_seconds: float = 3.0
     # Extension points the plugin is enabled at (config-file surface,
     # reference batch_scheduler_config.json:7-36). Default: all — a superset
     # of the reference's shipped four (it omits filter/score; we keep score
@@ -100,7 +101,7 @@ class PluginRuntime:
         self._leader_thread.start()
 
     def _renew_loop(self) -> None:
-        while not self._stop.wait(3.0):
+        while not self._stop.wait(self.config.lease_renew_seconds):
             try:
                 self.lease.acquire(self.config.identity)
             except Exception:

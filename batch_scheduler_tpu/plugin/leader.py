@@ -7,8 +7,12 @@ starts when the lease is observed held by us and fresh, and stops on loss.
 
 The lease itself is an abstraction: ``InMemoryLease`` for single-process /
 simulated deployments, ``FileLease`` for multi-process single-host
-deployments (atomic O_EXCL claim files). A real multi-host deployment would
-back this with its coordination service; the gate logic is identical.
+deployments (atomic O_EXCL claim files), and ``APILease`` — the deployment-
+grade one — a Lease object living *in the API server* (the analog of the
+reference's EndpointsLock in kube-system, batchscheduler.go:458-464), so any
+number of scheduler replicas against one API server coordinate through the
+same durable object, with optimistic-concurrency updates making claims
+race-free.
 """
 
 from __future__ import annotations
@@ -20,7 +24,13 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
-__all__ = ["LeaseRecord", "InMemoryLease", "FileLease", "try_run_controller"]
+__all__ = [
+    "LeaseRecord",
+    "InMemoryLease",
+    "FileLease",
+    "APILease",
+    "try_run_controller",
+]
 
 
 @dataclass
@@ -138,6 +148,121 @@ class FileLease:
                     os.unlink(self._path)
                 except OSError:
                     pass
+
+
+class APILease:
+    """Lease object stored in the API server (namespace ``kube-system``,
+    like the reference's EndpointsLock, batchscheduler.go:458-464).
+
+    Claims are compare-and-swap: the update carries the read
+    ``resource_version``, so two replicas racing an expired lease cannot
+    both win — the loser's update raises ConflictError and its ``acquire``
+    returns False. Works over the in-memory APIServer and the HTTP adapter
+    alike (both speak the same interface)."""
+
+    KIND = "Lease"
+
+    def __init__(
+        self,
+        api,
+        name: str = "batch-scheduler",
+        namespace: str = "kube-system",
+        default_duration: float = 15.0,
+        clock: Callable[[], float] = time.time,
+    ):
+        self._api = api
+        self._name = name
+        self._ns = namespace
+        self._default_duration = default_duration
+        self._clock = clock
+
+    @staticmethod
+    def _record(d: dict) -> LeaseRecord:
+        spec = d.get("spec") or {}
+        return LeaseRecord(
+            spec.get("holder_identity", ""),
+            spec.get("renew_time", 0.0),
+            spec.get("lease_duration_seconds", 15.0),
+        )
+
+    def get(self) -> Optional[LeaseRecord]:
+        from ..client.apiserver import NotFoundError
+
+        try:
+            return self._record(self._api.get(self.KIND, self._ns, self._name))
+        except NotFoundError:
+            return None
+
+    def _spec(self, identity: str, duration: float) -> dict:
+        return {
+            "holder_identity": identity,
+            "renew_time": self._clock(),
+            "lease_duration_seconds": duration,
+        }
+
+    def acquire(self, identity: str, duration: Optional[float] = None) -> bool:
+        from ..client.apiserver import (
+            AlreadyExistsError,
+            ConflictError,
+            NotFoundError,
+        )
+
+        duration = self._default_duration if duration is None else duration
+        try:
+            d = self._api.get(self.KIND, self._ns, self._name)
+        except NotFoundError:
+            try:
+                self._api.create(
+                    self.KIND,
+                    {
+                        "metadata": {"namespace": self._ns, "name": self._name},
+                        "spec": self._spec(identity, duration),
+                    },
+                )
+                return True
+            except AlreadyExistsError:
+                return False  # raced another replica's create; retry next poll
+        rec = self._record(d)
+        now = self._clock()
+        expired = now - rec.renew_time > rec.lease_duration_seconds
+        if rec.holder_identity not in ("", identity) and not expired:
+            return False
+        d["spec"] = self._spec(identity, duration)
+        try:
+            self._api.update(self.KIND, d)  # CAS on resource_version
+            return True
+        except (ConflictError, NotFoundError):
+            return False
+
+    def renew(self, identity: str) -> bool:
+        from ..client.apiserver import ConflictError, NotFoundError
+
+        try:
+            d = self._api.get(self.KIND, self._ns, self._name)
+        except NotFoundError:
+            return False
+        rec = self._record(d)
+        if rec.holder_identity != identity:
+            return False
+        d["spec"]["renew_time"] = self._clock()
+        try:
+            self._api.update(self.KIND, d)
+            return True
+        except (ConflictError, NotFoundError):
+            return False
+
+    def release(self, identity: str) -> None:
+        from ..client.apiserver import NotFoundError
+
+        try:
+            d = self._api.get(self.KIND, self._ns, self._name)
+        except NotFoundError:
+            return
+        if self._record(d).holder_identity == identity:
+            try:
+                self._api.delete(self.KIND, self._ns, self._name)
+            except NotFoundError:
+                pass
 
 
 def try_run_controller(
