@@ -76,9 +76,21 @@ def shard_snapshot_args(mesh: Mesh, args: tuple) -> tuple:
         group_valid=group_valid,
         order=order,
     )
+    multiprocess = jax.process_count() > 1
+
+    def _place(v, sharding):
+        v = np.asarray(v)
+        if multiprocess:
+            # every host holds the full array; each process contributes its
+            # addressable shards (jax.device_put cannot target devices on
+            # other hosts)
+            return jax.make_array_from_callback(
+                v.shape, sharding, lambda idx: v[idx]
+            )
+        return jax.device_put(v, sharding)
+
     placed = {
-        k: jax.device_put(v, NamedSharding(mesh, spec[k]))
-        for k, v in named.items()
+        k: _place(v, NamedSharding(mesh, spec[k])) for k, v in named.items()
     }
     return (
         placed["alloc"],

@@ -1,0 +1,85 @@
+"""Worker process for the two-process jax.distributed test (run by
+tests/test_distributed.py, one instance per process). Exercises the REAL
+multi-host bootstrap path: jax.distributed.initialize from env, a global
+(groups x nodes) mesh spanning both processes' devices, and one sharded
+oracle batch with cross-process collectives."""
+
+import os
+import sys
+
+# run as a script: the repo root (not tests/) must be importable; PYTHONPATH
+# must stay unset in this environment (it breaks the axon TPU plugin)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# virtual multi-device CPU platform, forced the conftest way (sitecustomize
+# registers the TPU plugin; the config update below is what wins)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from batch_scheduler_tpu.parallel.distributed import (  # noqa: E402
+    global_mesh,
+    init_distributed,
+)
+from batch_scheduler_tpu.parallel.mesh import sharded_schedule_batch  # noqa: E402
+
+
+def build_snapshot():
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot, GroupDemand
+    from batch_scheduler_tpu.sim.scenarios import make_sim_node
+
+    nodes = [
+        make_sim_node(f"n{i:03d}", {"cpu": "32", "memory": "128Gi", "pods": "110"})
+        for i in range(16)
+    ]
+    groups = [
+        GroupDemand(
+            full_name=f"default/g{g}",
+            min_member=4,
+            member_request={"cpu": 2000, "memory": 4 * 1024**3},
+            creation_ts=float(g),
+        )
+        for g in range(8)
+    ]
+    return ClusterSnapshot(nodes, {}, groups)
+
+
+def main() -> None:
+    assert init_distributed(), "BST_COORDINATOR env not picked up"
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, jax.devices()
+    assert len(jax.local_devices()) == 4
+
+    mesh = global_mesh()
+    assert mesh.devices.size == 8
+
+    snap = build_snapshot()
+    out = sharded_schedule_batch(mesh, snap.device_args())
+
+    from jax.experimental import multihost_utils
+
+    placed = np.asarray(multihost_utils.process_allgather(out["placed"], tiled=True))
+    feasible = np.asarray(
+        multihost_utils.process_allgather(out["gang_feasible"], tiled=True)
+    )
+    assert placed[:8].all(), placed
+    assert feasible[:8].all(), feasible
+    if jax.process_index() == 0:
+        print(
+            f"DIST-OK processes={jax.process_count()} mesh={dict(mesh.shape)} "
+            f"placed={int(placed.sum())}/8"
+        )
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

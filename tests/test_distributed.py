@@ -1,0 +1,61 @@
+"""Two-process jax.distributed bootstrap test (VERDICT r1 item 7): the
+multi-host path of parallel.distributed actually executes — coordinator
+handshake, global mesh over both processes' devices, one sharded oracle
+batch with cross-process collectives — on the CPU backend, localhost."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_batch():
+    port = _free_port()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo_root, "tests", "distributed_worker.py")
+
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)  # worker sets its own platform
+        # 4 virtual devices per process -> 8-device global mesh (override
+        # whatever the test session's conftest exported)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env.update(
+            BST_COORDINATOR=f"127.0.0.1:{port}",
+            BST_NUM_PROCESSES="2",
+            BST_PROCESS_ID=str(pid),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, worker],
+                cwd=repo_root,
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=150)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed workers hung")
+        outs.append((p.returncode, out, err))
+
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\nstdout:{out}\nstderr:{err[-2000:]}"
+    # process 0 prints the summary line
+    assert any("DIST-OK processes=2" in out for _, out, _ in outs), outs
