@@ -31,6 +31,8 @@ class ClusterState:
         # pod uid -> node, for pods assumed but not yet observed bound
         self._assumed: Dict[str, str] = {}
         self._pod_nodes: Dict[str, str] = {}
+        # pod uid -> Pod object, for victim search in the preemption cycle
+        self._pod_objs: Dict[str, Pod] = {}
         # bumped on every capacity-relevant change; the oracle scorer uses it
         # to invalidate its batch without explicit mark_dirty plumbing
         self._version = 0
@@ -75,6 +77,7 @@ class ClusterState:
             self._requested.setdefault(node_name, {})[uid] = self._require(pod)
             self._assumed[uid] = node_name
             self._pod_nodes[uid] = node_name
+            self._pod_objs[uid] = pod
             self._version += 1
 
     def forget(self, pod_uid: str) -> None:
@@ -84,6 +87,7 @@ class ClusterState:
             if node is None:
                 return
             self._pod_nodes.pop(pod_uid, None)
+            self._pod_objs.pop(pod_uid, None)
             self._requested.get(node, {}).pop(pod_uid, None)
             self._version += 1
 
@@ -108,6 +112,7 @@ class ClusterState:
                 charged = self._requested.get(node, {}).pop(uid, None)
                 known = self._pod_nodes.pop(uid, None)
                 self._assumed.pop(uid, None)
+                self._pod_objs.pop(uid, None)
                 if charged is not None or known is not None:
                     self._version += 1
                 return
@@ -121,6 +126,7 @@ class ClusterState:
                 self._requested.get(prev, {}).pop(uid, None)
             self._requested.setdefault(node, {})[uid] = req
             self._pod_nodes[uid] = node
+            self._pod_objs[uid] = pod
             self._assumed.pop(uid, None)
             if not unchanged:
                 self._version += 1
@@ -130,6 +136,7 @@ class ClusterState:
             uid = pod.metadata.uid
             node = self._pod_nodes.pop(uid, None)
             self._assumed.pop(uid, None)
+            self._pod_objs.pop(uid, None)
             if node is not None:
                 self._requested.get(node, {}).pop(uid, None)
                 self._version += 1
@@ -155,3 +162,17 @@ class ClusterState:
     def pod_count(self, node_name: str) -> int:
         with self._lock:
             return len(self._requested.get(node_name, {}))
+
+    def pods_on(self, node_name: str) -> List[Pod]:
+        """Pods currently charged to a node (bound or assumed) — the victim
+        candidate set for the preemption cycle."""
+        with self._lock:
+            return [
+                self._pod_objs[uid]
+                for uid in self._requested.get(node_name, {})
+                if uid in self._pod_objs
+            ]
+
+    def is_assumed(self, pod_uid: str) -> bool:
+        with self._lock:
+            return pod_uid in self._assumed

@@ -79,6 +79,7 @@ class Scheduler:
             "permit_rejects": 0,
             "binds": 0,
             "cycles": 0,
+            "preemptions": 0,
         }
 
     # -- lifecycle ---------------------------------------------------------
@@ -147,6 +148,13 @@ class Scheduler:
 
         node_name = self._select_node(pod)
         if node_name is None:
+            # preemption cycle (the role upstream kube-scheduler's
+            # PostFilter plays for the reference, whose policy hooks are
+            # PreFilterExtensions — batchscheduler.go:116-144): dry-run a
+            # victim search; evicted capacity frees asynchronously and the
+            # pod retries from backoff
+            if self._try_preempt(pod):
+                self.stats["preemptions"] += 1
             self._unschedulable(info, "no feasible node")
             return
 
@@ -228,6 +236,73 @@ class Scheduler:
             if best_score is None or score > best_score:
                 best_name, best_score = node.metadata.name, score
         return best_name
+
+    def _try_preempt(self, pod: Pod) -> bool:
+        """Victim search + eviction for an unschedulable pod.
+
+        Per node: dry-run removing strictly-lower-priority pods (tightest
+        legality via the plugin's preempt_remove_pod policy — online/offline
+        rules, Scheduled/Running gangs protected, no self-preemption,
+        reference core.go:203-260) until the pod would fit. On the first
+        node where that works, evict the chosen victims: waiting (assumed)
+        pods are rejected back to the queue, bound pods are deleted. Returns
+        True if victims were evicted."""
+        if self.plugin is None:
+            return False
+        require = dict(pod.resource_require())
+        require["pods"] = require.get("pods", 0) + 1
+
+        for node in self.cluster.list_nodes():
+            if node.spec.unschedulable or not rmath.check_fit(pod, node):
+                continue
+            try:
+                self.plugin.preempt_add_pod(pod, node.metadata.name)
+            except SchedulingError:
+                continue
+            left = rmath.single_node_left(
+                node, self.cluster.node_requested(node.metadata.name), None
+            )
+            victims: List[Pod] = []
+            freed: dict = {}
+            candidates = sorted(
+                self.cluster.pods_on(node.metadata.name),
+                key=lambda p: p.spec.priority,
+            )
+            for victim in candidates:
+                if victim.spec.priority >= pod.spec.priority:
+                    break  # sorted ascending: no lower-priority victims left
+                try:
+                    self.plugin.preempt_remove_pod(pod, victim)
+                except SchedulingError:
+                    continue  # policy forbids this victim
+                victims.append(victim)
+                vreq = dict(victim.resource_require())
+                vreq["pods"] = vreq.get("pods", 0) + 1
+                freed = rmath.add_resources(freed, vreq)
+                if rmath.resource_satisfied(
+                    rmath.add_resources(left, freed), require
+                ):
+                    self._evict(victims)
+                    return True
+        return False
+
+    def _evict(self, victims: List[Pod]) -> None:
+        for victim in victims:
+            uid = victim.metadata.uid
+            wp = self.waiting.get(uid)
+            if wp is not None:
+                # permitted-but-unbound gang member: fail its Permit wait
+                # first so the bind worker releases its assumed capacity
+                wp.reject("Preempted")
+            # eviction is deletion (k8s semantics): without it a rejected
+            # member instantly requeues and races the preemptor for the
+            # capacity it just freed
+            try:
+                self.clientset.pods(victim.metadata.namespace).delete(
+                    victim.metadata.name
+                )
+            except NotFoundError:
+                self.cluster.forget(uid)
 
     def _unschedulable(self, info: PodInfo, reason: str) -> None:
         self.stats["unschedulable"] += 1

@@ -101,6 +101,14 @@ class BatchSchedulingPlugin:
     def post_bind(self, pod: Pod, node_name: str) -> None:
         self.operation.post_bind(pod, node_name)
 
+    # PreFilterExtensions (reference batchscheduler.go:116-144): the
+    # preemption dry-run's add/remove hooks
+    def preempt_add_pod(self, pod_to_add: Pod, node_name: str) -> None:
+        self.operation.preempt_add_pod(pod_to_add, node_name)
+
+    def preempt_remove_pod(self, pod_to_schedule: Pod, pod_to_remove: Pod) -> None:
+        self.operation.preempt_remove_pod(pod_to_schedule, pod_to_remove)
+
     def mark_dirty(self) -> None:
         self.operation.mark_dirty()
 

@@ -194,3 +194,61 @@ def test_gang_granular_admission_batches_scale_with_gangs(sim):
     for g in range(n_gangs):
         pgs = cluster.runtime.operation.status_cache.get(f"default/gang{g}")
         assert pgs is not None and pgs.placement_plan is not None, g
+
+
+def test_preemption_evicts_pending_gang_member_only(sim):
+    """VERDICT r1 item 9 e2e: an online (non-group) pod preempts a pending
+    gang's permitted member, but never touches a Running gang
+    (reference policy core.go:203-260, hooks batchscheduler.go:116-144)."""
+    cluster = sim(scorer="oracle")
+    cluster.add_nodes([make_sim_node("n1", {"cpu": "4", "pods": "10"})])
+    # incomplete gang: 3 of 4 members exist, so they park in Permit wait
+    cluster.create_group(make_sim_group("lowgang", 4))
+    cluster.start()
+    cluster.create_pods(make_member_pods("lowgang", 3, {"cpu": "1"}))
+
+    op = cluster.runtime.operation
+    assert cluster.wait_for(
+        lambda: (pgs := op.status_cache.get("default/lowgang")) is not None
+        and len(pgs.matched_pod_nodes.items()) == 3,
+        timeout=15.0,
+    ), cluster.scheduler.stats
+
+    # online pod needs 2 cpu; only 1 is free -> must evict one member
+    online = make_member_pods("online", 1, {"cpu": "2"}, priority=10)
+    for p in online:
+        p.metadata.labels = {}
+    cluster.create_pods(online)
+
+    assert cluster.wait_for(
+        lambda: cluster.clientset.pods().get("online-0").spec.node_name,
+        timeout=20.0,
+    ), cluster.scheduler.stats
+    assert cluster.scheduler.stats["preemptions"] >= 1
+    # exactly one member was evicted (deleted), the others still pending
+    remaining = cluster.member_pods("lowgang")
+    assert len(remaining) == 2, [p.metadata.name for p in remaining]
+
+
+def test_preemption_never_touches_running_gang(sim):
+    cluster = sim(scorer="oracle")
+    cluster.add_nodes([make_sim_node("n1", {"cpu": "4", "pods": "10"})])
+    cluster.create_group(make_sim_group("rungang", 3))
+    cluster.start()
+    cluster.create_pods(make_member_pods("rungang", 3, {"cpu": "1"}))
+    assert cluster.wait_for_group_phase(
+        "rungang", PodGroupPhase.RUNNING, timeout=30.0
+    ), cluster.member_phase_counts("rungang")
+
+    online = make_member_pods("online", 1, {"cpu": "2"}, priority=10)
+    for p in online:
+        p.metadata.labels = {}
+    cluster.create_pods(online)
+
+    # the online pod must stay unbound: Running gang members are protected
+    import time as _time
+
+    _time.sleep(2.0)
+    assert not cluster.clientset.pods().get("online-0").spec.node_name
+    assert cluster.scheduler.stats["preemptions"] == 0
+    assert len([p for p in cluster.member_pods("rungang") if p.spec.node_name]) == 3
