@@ -30,9 +30,13 @@ test-cpu:
 bench:
 	$(PY) bench.py
 
-# BASELINE.json measurement ladder, configs 1-5
+# BASELINE.json measurement ladder, configs 1-6 (asserts regressions)
 ladder:
 	$(PY) benchmarks/ladder.py
+
+# pallas-kernel-on-hardware proof (skips with rc=1 off-TPU)
+smoke-tpu:
+	$(PY) benchmarks/tpu_smoke.py
 
 # driver-style entry checks: single-chip jit + 8-device sharded dry run.
 # NB: this environment's sitecustomize registers the TPU plugin and overrides

@@ -29,6 +29,7 @@ import numpy as np
 from ..cache.pg_cache import PGStatusCache, PodGroupMatchStatus
 from ..ops.oracle import execute_batch_host
 from ..ops.snapshot import ClusterSnapshot, GroupDemand
+from ..utils.errors import StaleBatchError
 
 __all__ = ["OracleScorer", "demand_from_status"]
 
@@ -272,9 +273,11 @@ class OracleScorer:
             return 0
         try:
             return int(state.row("capacity", g)[n])
-        except Exception:
-            # a stale remote batch (or transport hiccup) answers
-            # conservatively; the caller's next cycle refreshes
+        except StaleBatchError:
+            # the row raced a newer batch — answer conservatively, the
+            # caller's next cycle refreshes. ONLY this error class is
+            # swallowed: a dead transport turning into an invisible
+            # all-deny is exactly the failure mode to avoid.
             return 0
 
     def node_score(self, full_name: str, node_name: str) -> int:
@@ -287,7 +290,7 @@ class OracleScorer:
             return -(2**30)
         try:
             return int(state.row("scores", g)[n])
-        except Exception:
+        except StaleBatchError:
             return -(2**30)
 
     def assignment(self, full_name: str) -> Dict[str, int]:

@@ -41,7 +41,12 @@ class OracleClient:
             proto.write_frame(self._sock, msg_type, payload)
             resp_type, resp = proto.read_frame(self._sock)
         if resp_type == proto.MsgType.ERROR:
-            raise RuntimeError(f"oracle server error: {resp.decode(errors='replace')}")
+            message = resp.decode(errors="replace")
+            if "stale batch" in message:
+                from ..utils.errors import StaleBatchError
+
+                raise StaleBatchError(message)
+            raise RuntimeError(f"oracle server error: {message}")
         return resp_type, resp
 
     def ping(self) -> bool:

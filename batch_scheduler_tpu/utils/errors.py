@@ -28,3 +28,10 @@ class OccupiedError(SchedulingError):
 
 class DeniedError(SchedulingError):
     """PodGroup is in the deny backoff cache (reference core.go:105-110)."""
+
+
+class StaleBatchError(RuntimeError):
+    """A lazy (G,N)-row fetch raced a newer oracle batch: the answer for the
+    old batch no longer exists. Callers answer conservatively and let the
+    next cycle refresh — the ONLY error class the scorer's row reads may
+    swallow (anything else, e.g. a dead sidecar transport, must surface)."""

