@@ -175,6 +175,17 @@ class OracleScorer:
             self.pack_seconds.append(t_pack - t0)
             self.batch_seconds.append(t_batch - t_pack)
             del self.pack_seconds[:-1000], self.batch_seconds[:-1000]
+        from ..utils.metrics import DEFAULT_REGISTRY
+
+        DEFAULT_REGISTRY.counter(
+            "bst_oracle_batches_total", "Fused oracle batches executed"
+        ).inc()
+        DEFAULT_REGISTRY.histogram(
+            "bst_oracle_batch_seconds", "Device time per fused oracle batch"
+        ).observe(t_batch - t_pack)
+        DEFAULT_REGISTRY.histogram(
+            "bst_oracle_pack_seconds", "Host snapshot-pack time per batch"
+        ).observe(t_pack - t0)
 
     def _execute(self, snap: ClusterSnapshot):
         """Run one batch locally on the attached device. Returns the O(G)

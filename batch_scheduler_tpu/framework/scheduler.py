@@ -20,6 +20,7 @@ from ..client.apiserver import NotFoundError
 from ..client.clientset import Clientset
 from ..core import resources as rmath
 from ..utils.errors import SchedulingError
+from ..utils.metrics import DEFAULT_REGISTRY
 from .cluster import ClusterState
 from .queue import SchedulingQueue
 from .types import PodInfo, StatusCode
@@ -81,6 +82,14 @@ class Scheduler:
             "cycles": 0,
             "preemptions": 0,
         }
+        # schedule-cycle latency: THE headline metric (SURVEY.md §5)
+        self._cycle_seconds = DEFAULT_REGISTRY.histogram(
+            "bst_schedule_cycle_seconds",
+            "Wall-clock seconds per scheduling cycle (pop to permit/park)",
+        )
+        self._binds_total = DEFAULT_REGISTRY.counter(
+            "bst_pods_bound_total", "Pods successfully bound"
+        )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -117,7 +126,8 @@ class Scheduler:
             if info is None:
                 continue
             try:
-                self._schedule_one(info)
+                with self._cycle_seconds.time():
+                    self._schedule_one(info)
             except Exception:
                 # a broken cycle must not kill the loop; release any
                 # capacity assumed mid-cycle, then retry the pod
@@ -340,6 +350,7 @@ class Scheduler:
         self.cluster.finish_binding(pod.metadata.uid)
         self.stats["binds"] += 1
         self.stats["scheduled"] += 1
+        self._binds_total.inc()
         if self.plugin is not None:
             pod.spec.node_name = node_name
             # post_bind owns batch invalidation (per gang completion, not

@@ -22,6 +22,7 @@ from ..core.operation import ScheduleOperation
 from ..framework.types import StatusCode
 from ..utils import errors as errs
 from ..utils.labels import DEFAULT_WAIT_SECONDS, get_wait_seconds, pod_group_name
+from ..utils.metrics import DEFAULT_REGISTRY, Registry
 from ..utils.patch import create_merge_patch
 
 __all__ = ["BatchSchedulingPlugin", "PLUGIN_NAME"]
@@ -43,6 +44,7 @@ class BatchSchedulingPlugin:
         operation: ScheduleOperation,
         pg_client,
         max_schedule_seconds: Optional[float] = None,
+        registry: Optional[Registry] = None,
     ):
         self.handle = handle
         self.operation = operation
@@ -51,6 +53,16 @@ class BatchSchedulingPlugin:
         self.start_chan: "queue.Queue[str]" = queue.Queue()
         self._stop = threading.Event()
         self._reconcile_thread: Optional[threading.Thread] = None
+        # per-extension-point latency (SURVEY.md §5 build note: the
+        # reference has no instrumentation of its own)
+        registry = registry or DEFAULT_REGISTRY
+        self._ext_seconds = registry.histogram(
+            "bst_extension_point_seconds",
+            "Wall-clock seconds spent in each plugin extension point",
+        )
+        self._gang_releases = registry.counter(
+            "bst_gang_releases_total", "Gangs released to bind"
+        )
 
     # ------------------------------------------------------------------
     # framework extension points
@@ -62,20 +74,24 @@ class BatchSchedulingPlugin:
         )
 
     def pre_filter(self, pod: Pod) -> None:
-        self.operation.pre_filter(pod)
+        with self._ext_seconds.time(point="preFilter"):
+            self.operation.pre_filter(pod)
 
     def filter(self, pod: Pod, node_name: str) -> None:
-        self.operation.filter(pod, node_name)
+        with self._ext_seconds.time(point="filter"):
+            self.operation.filter(pod, node_name)
 
     def score(self, pod: Pod, node_name: str) -> int:
-        return self.operation.score(pod, node_name)
+        with self._ext_seconds.time(point="score"):
+            return self.operation.score(pod, node_name)
 
     def permit(self, pod: Pod, node_name: str) -> Tuple[StatusCode, float]:
         """Returns (status, wait timeout). Gang pods always Wait; the wait
         timeout is the gang TTL + 1s so cache eviction (gang abort) fires
         before the framework's own timeout (reference batchscheduler.go:
         165-202, the +1s at :180-182)."""
-        outcome = self.operation.permit(pod, node_name)
+        with self._ext_seconds.time(point="permit"):
+            outcome = self.operation.permit(pod, node_name)
         wait = DEFAULT_WAIT_SECONDS
         if outcome.pg_name:
             full_name = f"{pod.metadata.namespace}/{outcome.pg_name}"
@@ -92,6 +108,7 @@ class BatchSchedulingPlugin:
             return StatusCode.UNSCHEDULABLE, DEFAULT_WAIT_SECONDS
 
         if outcome.ready:
+            self._gang_releases.inc()
             # non-blocking put on an unbounded queue; no thread needed
             self.send_start_schedule_signal(
                 f"{pod.metadata.namespace}/{outcome.pg_name}"
@@ -99,7 +116,8 @@ class BatchSchedulingPlugin:
         return StatusCode.WAIT, wait
 
     def post_bind(self, pod: Pod, node_name: str) -> None:
-        self.operation.post_bind(pod, node_name)
+        with self._ext_seconds.time(point="postBind"):
+            self.operation.post_bind(pod, node_name)
 
     # PreFilterExtensions (reference batchscheduler.go:116-144): the
     # preemption dry-run's add/remove hooks

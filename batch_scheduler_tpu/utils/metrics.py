@@ -1,0 +1,226 @@
+"""Metrics registry + Prometheus-text exposition.
+
+The reference adds no instrumentation of its own — its observability is CRD
+phase transitions plus klog verbosity, and the /metrics endpoint belongs to
+the embedded kube-scheduler (SURVEY.md §5 "Tracing/profiling": the TPU build
+should add real timing; schedule-cycle latency is the headline metric). This
+module is that surface: thread-safe counters/gauges/histograms, rendered in
+Prometheus text format over a tiny HTTP endpoint.
+
+Usage: components take a ``Registry`` (default: the process-wide
+``DEFAULT_REGISTRY``); ``serve_metrics(registry)`` exposes ``/metrics`` and
+``/healthz``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "DEFAULT_REGISTRY",
+    "serve_metrics",
+]
+
+# schedule-cycle / extension-point latencies live in the ms..s range
+_DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str):
+        self.name, self.help = name, help_
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def inc(self, n: float = 1.0, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            items = sorted(self._values.items()) or [((), 0.0)]
+        for key, v in items:
+            lines.append(f"{self.name}{_fmt_labels(dict(key))} {v:g}")
+        return "\n".join(lines)
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str):
+        self.name, self.help = name, help_
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def set(self, v: float, **labels: str) -> None:
+        with self._lock:
+            self._values[tuple(sorted(labels.items()))] = float(v)
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._lock:
+            items = sorted(self._values.items()) or [((), 0.0)]
+        for key, v in items:
+            lines.append(f"{self.name}{_fmt_labels(dict(key))} {v:g}")
+        return "\n".join(lines)
+
+
+class Histogram:
+    def __init__(
+        self, name: str, help_: str, buckets: Sequence[float] = _DEFAULT_BUCKETS
+    ):
+        self.name, self.help = name, help_
+        self.buckets = tuple(sorted(buckets))
+        self._lock = threading.Lock()
+        # label key -> (bucket counts, sum, count)
+        self._series: Dict[Tuple[Tuple[str, str], ...], list] = {}
+
+    def observe(self, v: float, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = [[0] * len(self.buckets), 0.0, 0]
+                self._series[key] = s
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    s[0][i] += 1
+            s[1] += v
+            s[2] += 1
+
+    def time(self, **labels: str):
+        """Context manager observing elapsed wall-clock seconds."""
+        hist = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                hist.observe(time.perf_counter() - self.t0, **labels)
+                return False
+
+        return _Timer()
+
+    def count(self, **labels: str) -> int:
+        with self._lock:
+            s = self._series.get(tuple(sorted(labels.items())))
+            return s[2] if s else 0
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            items = sorted(self._series.items())
+        for key, (counts, total, n) in items:
+            base = dict(key)
+            for b, c in zip(self.buckets, counts):
+                lines.append(
+                    f"{self.name}_bucket{_fmt_labels({**base, 'le': f'{b:g}'})} {c}"
+                )
+            lines.append(
+                f"{self.name}_bucket{_fmt_labels({**base, 'le': '+Inf'})} {n}"
+            )
+            lines.append(f"{self.name}_sum{_fmt_labels(base)} {total:g}")
+            lines.append(f"{self.name}_count{_fmt_labels(base)} {n}")
+        return "\n".join(lines)
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def _get_or_make(self, cls, name: str, help_: str, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name} already registered as {type(m)}")
+            return m
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get_or_make(Counter, name, help_)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get_or_make(Gauge, name, help_)
+
+    def histogram(
+        self, name: str, help_: str = "", buckets: Sequence[float] = _DEFAULT_BUCKETS
+    ) -> Histogram:
+        return self._get_or_make(Histogram, name, help_, buckets=buckets)
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = [self._metrics[k] for k in sorted(self._metrics)]
+        return "\n".join(m.render() for m in metrics) + "\n"
+
+
+DEFAULT_REGISTRY = Registry()
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    registry: Registry = None
+
+    def log_message(self, *args) -> None:
+        pass
+
+    def do_GET(self) -> None:
+        if self.path.split("?")[0] == "/metrics":
+            body = self.registry.render().encode()
+            ctype = "text/plain; version=0.0.4"
+        elif self.path.split("?")[0] == "/healthz":
+            body, ctype = b"ok\n", "text/plain"
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def serve_metrics(
+    registry: Optional[Registry] = None, host: str = "127.0.0.1", port: int = 0
+) -> ThreadingHTTPServer:
+    """Expose /metrics + /healthz in a background thread; returns the server
+    (``server.server_address`` has the bound port)."""
+    handler = type(
+        "BoundMetricsHandler",
+        (_MetricsHandler,),
+        {"registry": registry or DEFAULT_REGISTRY},
+    )
+    server = ThreadingHTTPServer((host, port), handler)
+    server.daemon_threads = True
+    threading.Thread(
+        target=server.serve_forever, name="metrics-endpoint", daemon=True
+    ).start()
+    return server
