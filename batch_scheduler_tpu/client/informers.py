@@ -90,6 +90,9 @@ class SharedInformer:
             old = self._store.get(key)
             if event.type == WatchEvent.DELETED:
                 self._store.pop(key, None)
+                # drop the typed view too, or deleted-and-never-requeried
+                # keys leak one (dict, typed) pair each (ADVICE r2)
+                self._typed_cache.pop(key, None)
             else:
                 self._store[key] = event.obj
         old_typed = object_from_dict(self.kind, old) if old else None
@@ -110,6 +113,14 @@ class SharedInformer:
         with self._lock:
             d = self._store.get((namespace, name))
             return object_from_dict(self.kind, d) if d else None
+
+    def peek_raw(self, namespace: str, name: str) -> Optional[dict]:
+        """The stored raw dict — NOT a copy, read-only. The scheduler's
+        per-cycle liveness check (uid/node_name) reads this instead of a
+        deep-copying API-server GET (reference reads its queued copy; the
+        GET was our addition and cost ~100µs/cycle at 10k-pod scale)."""
+        with self._lock:
+            return self._store.get((namespace, name))
 
     def get_typed(self, namespace: str, name: str):
         """READ-ONLY cached typed view: one construction per store update,

@@ -58,10 +58,14 @@ class Scheduler:
         backoff_base: float = 1.0,
         backoff_cap: float = 10.0,
         clock: Callable[[], float] = time.monotonic,
+        pod_informer=None,
     ):
         self.clientset = clientset
         self.cluster = cluster
         self._clock = clock
+        # optional SharedInformer("Pod"): liveness checks read its raw store
+        # instead of issuing a deep-copying API GET per cycle
+        self._pod_informer = pod_informer
         self.waiting = WaitingPods(clock)
         self.handle = FrameworkHandle(clientset, cluster, self.waiting)
         # plugins need the handle at construction (reference New() receives
@@ -138,16 +142,34 @@ class Scheduler:
 
     def _schedule_one(self, info: PodInfo) -> None:
         self.stats["cycles"] += 1
-        # refresh from the API server: the queued copy may be stale/deleted
-        try:
-            pod = self.clientset.pods(info.pod.metadata.namespace).get(
-                info.pod.metadata.name
+        # liveness check: the queued copy may be stale (deleted, replaced,
+        # already bound). Prefer the informer's raw store — same signal as
+        # an API GET without the deep copy + rehydration.
+        if self._pod_informer is not None:
+            d = self._pod_informer.peek_raw(
+                info.pod.metadata.namespace, info.pod.metadata.name
             )
-        except NotFoundError:
-            return
-        if pod.spec.node_name or pod.metadata.uid != info.pod.metadata.uid:
-            return
-        info.pod = pod
+            if d is None:
+                return
+            meta = d.get("metadata") or {}
+            if meta.get("uid") != info.pod.metadata.uid or (
+                (d.get("spec") or {}).get("node_name")
+            ):
+                return
+            pod = info.pod
+        else:
+            try:
+                pod = self.clientset.pods(info.pod.metadata.namespace).get(
+                    info.pod.metadata.name
+                )
+            except NotFoundError:
+                return
+            if (
+                pod.spec.node_name
+                or pod.metadata.uid != info.pod.metadata.uid
+            ):
+                return
+            info.pod = pod
 
         if self.plugin is not None:
             try:

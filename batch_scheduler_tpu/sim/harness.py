@@ -57,6 +57,8 @@ class SimCluster:
             self.runtime = new_plugin_runtime(self.api, handle, config)
             return self.runtime.plugin
 
+        # framework informers: nodes + pods feed ClusterState and the queue
+        self._fwk_informers = SharedInformerFactory(self.api)
         self.scheduler = Scheduler(
             self.clientset,
             self.cluster,
@@ -64,6 +66,7 @@ class SimCluster:
             bind_workers=bind_workers,
             backoff_base=backoff_base,
             backoff_cap=backoff_cap,
+            pod_informer=self._fwk_informers.informer("Pod"),
         )
         self.kubelet = SimKubelet(
             self.api,
@@ -72,8 +75,6 @@ class SimCluster:
             fail_pod=fail_pod,
         )
 
-        # framework informers: nodes + pods feed ClusterState and the queue
-        self._fwk_informers = SharedInformerFactory(self.api)
         self._fwk_informers.informer("Node").add_event_handler(
             on_add=self.cluster.add_node,
             on_update=lambda old, new: self.cluster.update_node(new),

@@ -1,0 +1,141 @@
+"""Instrumented scaled-down config-6 run: accumulates wall time per
+scheduler sub-step to locate control-plane overhead (VERDICT r2 weak #2).
+
+Usage: python benchmarks/profile_e2e.py [nodes groups members]
+"""
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, ".")
+
+# sitecustomize registers the axon TPU plugin and overrides jax_platforms
+# config; env vars alone don't win (see tests/conftest.py)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+ACC = defaultdict(float)
+CNT = defaultdict(int)
+
+
+def wrap(obj, name, label):
+    orig = getattr(obj, name)
+
+    def timed(*a, **kw):
+        t0 = time.perf_counter()
+        try:
+            return orig(*a, **kw)
+        finally:
+            ACC[label] += time.perf_counter() - t0
+            CNT[label] += 1
+
+    setattr(obj, name, timed)
+    return orig
+
+
+def main():
+    nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    groups = int(sys.argv[2]) if len(sys.argv) > 2 else 200
+    members = int(sys.argv[3]) if len(sys.argv) > 3 else 10
+
+    from batch_scheduler_tpu.framework.scheduler import Scheduler
+    from batch_scheduler_tpu.sim import SimCluster
+    from batch_scheduler_tpu.sim.scenarios import (
+        make_member_pods,
+        make_sim_group,
+        make_sim_node,
+    )
+
+    GPU = "nvidia.com/gpu"
+    wrap(Scheduler, "_select_node", "select_node")
+    wrap(Scheduler, "_schedule_one", "schedule_one_total")
+    wrap(Scheduler, "_bind", "bind")
+
+    cluster = SimCluster(
+        scorer="oracle",
+        bind_workers=16,
+        kubelet_start_delay=0.01,
+        backoff_base=0.5,
+        backoff_cap=5.0,
+        controller_resync_seconds=2.0,
+        min_batch_interval=1.0,
+    )
+    # instrument instance-level collaborators after construction
+    wrap(cluster.scheduler.plugin, "pre_filter", "pre_filter")
+    wrap(cluster.scheduler.plugin, "permit", "permit")
+    wrap(cluster.scheduler.plugin, "on_assume", "on_assume")
+    wrap(cluster.scheduler.plugin, "post_bind", "post_bind")
+    wrap(cluster.cluster, "assume", "cluster_assume")
+    wrap(cluster.cluster, "node_requested", "node_requested")
+    sched = cluster.scheduler
+
+    orig_get_cls = type(cluster.clientset.pods("default"))
+    wrap(orig_get_cls, "get", "api_get")
+
+    cluster.add_nodes(
+        [
+            make_sim_node(
+                f"n{i:05d}",
+                {"cpu": "64", "memory": "256Gi", "pods": "110", GPU: "8"},
+            )
+            for i in range(nodes)
+        ]
+    )
+    member_req = {"cpu": 4000, "memory": 8 * 1024**3, GPU: 1}
+    for g in range(groups):
+        pg = make_sim_group(f"gang-{g:04d}", members, creation_ts=float(g))
+        pg.spec.min_resources = dict(member_req)
+        cluster.create_group(pg)
+    cluster.start()
+
+    pods = []
+    for g in range(groups):
+        pods.extend(
+            make_member_pods(
+                f"gang-{g:04d}", members, {"cpu": "4", "memory": "8Gi", GPU: "1"}
+            )
+        )
+    total = groups * members
+    t0 = time.perf_counter()
+    cluster.create_pods(pods)
+
+    import threading
+
+    def watchdog():
+        while not done.is_set():
+            done.wait(5.0)
+            print(
+                f"[{time.perf_counter()-t0:6.1f}s] binds={sched.stats['binds']}"
+                f"/{total} cycles={sched.stats['cycles']} "
+                f"unsched={sched.stats['unschedulable']} "
+                f"batches={cluster.runtime.operation.oracle.batches_run}",
+                flush=True,
+            )
+
+    done = threading.Event()
+    threading.Thread(target=watchdog, daemon=True).start()
+    ok = cluster.wait_for(
+        lambda: sched.stats["binds"] >= total, timeout=600.0, interval=0.25
+    )
+    done.set()
+    elapsed = time.perf_counter() - t0
+    stats = dict(sched.stats)
+    ostats = cluster.runtime.operation.oracle.stats()
+    cluster.stop()
+
+    print(f"\nok={ok} elapsed={elapsed:.2f}s binds={stats['binds']}/{total} "
+          f"pods/s={total/elapsed:.0f}")
+    print(f"cycles={stats['cycles']} unsched={stats['unschedulable']} "
+          f"oracle_batches={cluster.runtime.operation.oracle.batches_run}")
+    print(f"oracle стats: {ostats}")
+    print(f"\n{'label':24s} {'total_s':>9s} {'calls':>8s} {'per_call_us':>12s}")
+    for label in sorted(ACC, key=lambda k: -ACC[k]):
+        per = ACC[label] / max(CNT[label], 1) * 1e6
+        print(f"{label:24s} {ACC[label]:9.3f} {CNT[label]:8d} {per:12.1f}")
+
+
+if __name__ == "__main__":
+    main()
