@@ -93,6 +93,26 @@ def _exact_floordiv(num, den):
     return q
 
 
+def _cumsum(x, axis):
+    """Inclusive cumsum via Hillis-Steele doubling (log2(n) shift-adds).
+
+    ``jnp.cumsum`` has no Pallas TPU (Mosaic) lowering; static pad/slice/add
+    do. Used by ``_select_best_fit`` on BOTH the lax.scan and pallas paths so
+    the two stay bit-identical (int32 addition is associative, so the
+    doubling order changes nothing).
+    """
+    n = x.shape[axis]
+    shift = 1
+    while shift < n:
+        zeros = jnp.zeros_like(jax.lax.slice_in_dim(x, 0, shift, axis=axis))
+        shifted = jax.lax.concatenate(
+            [zeros, jax.lax.slice_in_dim(x, 0, n - shift, axis=axis)], axis
+        )
+        x = x + shifted
+        shift *= 2
+    return x
+
+
 def _select_best_fit(cap, capc, need):
     """Tightest-first take vector for one gang: the histogram threshold
     selection documented in assign_gangs. Shapes are [1, N] (2-D so the iota
@@ -105,7 +125,7 @@ def _select_best_fit(cap, capc, need):
     bin_totals = jnp.sum(
         jnp.where(key == bins, capc, 0), axis=1, keepdims=True
     )  # [_BINS, 1]
-    cum_bins = jnp.cumsum(bin_totals, axis=0)
+    cum_bins = _cumsum(bin_totals, axis=0)
     # threshold bucket: first where cumulative capacity covers the gang
     thresh = jnp.minimum(jnp.sum((cum_bins < need).astype(jnp.int32)), _BINS - 1)
     cum_at = jnp.sum(jnp.where(bins == thresh, cum_bins, 0))
@@ -113,7 +133,7 @@ def _select_best_fit(cap, capc, need):
     rem_t = need - (cum_at - tot_at)
     in_t = key == thresh
     capc_t = jnp.where(in_t, capc, 0)
-    prefix_t = jnp.cumsum(capc_t, axis=1) - capc_t
+    prefix_t = _cumsum(capc_t, axis=1) - capc_t
     take = jnp.where(
         key < thresh, capc, jnp.where(in_t, jnp.clip(rem_t - prefix_t, 0, capc), 0)
     )
@@ -284,6 +304,11 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
                    group_valid, order, use_pallas: bool = False):
     """Fused full-batch oracle: leftover -> capacity -> feasibility -> scores
     -> greedy gang assignment, one XLA computation.
+
+    Jitted as ONE computation (``use_pallas`` static): a batch is a single
+    dispatch + single async result, so a high-latency host<->device link
+    (the axon tunnel) pays one round-trip, not one per sub-kernel — the
+    eager ``top_k``/packing tail alone cost ~10x the batch compute there.
 
     ``use_pallas=True`` (single TPU device, broadcast [1,N] mask only) swaps
     the assignment scan for the fused VMEM-resident Pallas kernel

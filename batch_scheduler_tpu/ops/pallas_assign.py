@@ -48,7 +48,7 @@ def _kernel(order_ref, remaining_ref, left0_ref, group_req_ref, mask_ref,
     need = remaining_ref[g]
 
     left = left_scratch[:]  # [R, N]
-    req = group_req_ref[:]  # [1, R] (this step's group row via index map)
+    req = group_req_ref[0]  # [1, R] (this step's group row via index map)
     req_col = req.reshape(-1, 1)  # [R, 1]
 
     # ops.oracle._member_capacity in the kernel's transposed [R, N] layout
@@ -64,8 +64,8 @@ def _kernel(order_ref, remaining_ref, left0_ref, group_req_ref, mask_ref,
     feasible = _feasible.astype(jnp.int32)
 
     left_scratch[:] = left - take * req_col
-    takes_ref[:] = take
-    placed_ref[0, 0] = feasible
+    takes_ref[0] = take
+    placed_ref[:] = jnp.full((1, 1, 1), feasible, jnp.int32)
 
     @pl.when(s == num_steps - 1)
     def _():
@@ -88,18 +88,26 @@ def assign_gangs_pallas(left0, group_req, remaining, fit_mask, order,
     n, r = left0.shape
     g = group_req.shape[0]
 
+    # Per-group arrays carry their blocked axis as a leading rank-3 dim so the
+    # Mosaic (sublane, lane) tiling constraint falls on the trailing (1, r) /
+    # (1, n) dims, which equal the array dims — a (1, r) block on a rank-2
+    # [G, r] array is rejected by the TPU lowering (sublane block 1 vs G).
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # order, remaining
         grid=(g,),
         in_specs=[
             pl.BlockSpec((r, n), lambda s, order, rem: (0, 0)),  # left0^T
             # step s sees exactly group order[s]'s request row
-            pl.BlockSpec((1, r), lambda s, order, rem: (order[s], 0)),
+            pl.BlockSpec((1, 1, r), lambda s, order, rem: (order[s], 0, 0)),
             pl.BlockSpec((1, n), lambda s, order, rem: (0, 0)),  # mask
         ],
         out_specs=[
-            pl.BlockSpec((1, n), lambda s, order, rem: (order[s], 0)),  # takes
-            pl.BlockSpec((1, 1), lambda s, order, rem: (order[s], 0)),  # placed
+            pl.BlockSpec(
+                (1, 1, n), lambda s, order, rem: (order[s], 0, 0)
+            ),  # takes
+            pl.BlockSpec(
+                (1, 1, 1), lambda s, order, rem: (order[s], 0, 0)
+            ),  # placed
             pl.BlockSpec((r, n), lambda s, order, rem: (0, 0)),  # left_after^T
         ],
         scratch_shapes=[pltpu.VMEM((r, n), jnp.int32)],
@@ -108,10 +116,20 @@ def assign_gangs_pallas(left0, group_req, remaining, fit_mask, order,
         _kernel,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((g, n), jnp.int32),
-            jax.ShapeDtypeStruct((g, 1), jnp.int32),
+            jax.ShapeDtypeStruct((g, 1, n), jnp.int32),
+            jax.ShapeDtypeStruct((g, 1, 1), jnp.int32),
             jax.ShapeDtypeStruct((r, n), jnp.int32),
         ],
         interpret=interpret,
-    )(order, remaining, left0.T, group_req, fit_mask.astype(jnp.int32))
-    return takes, placed[:, 0].astype(bool), left_after_t.T
+    )(
+        order,
+        remaining,
+        left0.T,
+        group_req.reshape(g, 1, r),
+        fit_mask.astype(jnp.int32),
+    )
+    return (
+        takes.reshape(g, n),
+        placed[:, 0, 0].astype(bool),
+        left_after_t.T,
+    )
