@@ -299,9 +299,10 @@ def assign_gangs(left0, group_req, remaining, fit_mask, order):
 ASSIGNMENT_TOP_K = 128
 
 
-@partial(jax.jit, static_argnames=("use_pallas",))
+@partial(jax.jit, static_argnames=("use_pallas", "top_k"))
 def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
-                   group_valid, order, use_pallas: bool = False):
+                   group_valid, order, use_pallas: bool = False,
+                   top_k: int = ASSIGNMENT_TOP_K):
     """Fused full-batch oracle: leftover -> capacity -> feasibility -> scores
     -> greedy gang assignment, one XLA computation.
 
@@ -338,7 +339,11 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
             left, group_req, remaining, fit_mask, order
         )
     placed = placed & group_valid
-    k = min(ASSIGNMENT_TOP_K, assignment.shape[1])
+    # top_k: static width of the compact assignment readback. The default
+    # covers any gang; callers that know the batch's max remaining (see
+    # execute_batch_host) shrink it — the top-K rows dominate the per-batch
+    # host-link bytes, so a tight K is a direct fetch-latency win.
+    k = min(top_k, assignment.shape[1])
     assign_counts, assign_nodes = jax.lax.top_k(assignment, k)
     out = {
         "left": left,
@@ -362,12 +367,75 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
     return out
 
 
+def batch_top_k(n_bucket: int, remaining_max: int) -> int:
+    """Static top-K width ``execute_batch_host`` uses for a batch.
+
+    A gang's take touches at most ``remaining`` distinct nodes, so the
+    batch-wide max bounds the useful readback width. Rounded up to a power
+    of two and FLOORED at 16: every batch whose widest gang needs <= 16
+    nodes shares one jit signature (a churn loop's remaining_max jitters
+    tick to tick; per-value signatures would recompile mid-loop). Exposed so
+    tick-loop callers can fold the tier into their recompile accounting and
+    warm() the tiers they expect (ops.rescore.ChurnRescorer)."""
+    return min(
+        ASSIGNMENT_TOP_K,
+        n_bucket,
+        max(16, 1 << (max(remaining_max, 1) - 1).bit_length()),
+    )
+
+
+@partial(jax.jit, static_argnames=("use_pallas", "pack_assignment", "top_k"))
+def _batch_blob(alloc_lanes, requested, group_req, remaining, fit_mask,
+                group_valid, order, min_member, scheduled, matched,
+                ineligible, creation_rank, use_pallas: bool = False,
+                pack_assignment: bool = True,
+                top_k: int = ASSIGNMENT_TOP_K):
+    """One device computation for a whole control-plane batch: the fused
+    oracle + findMaxPG, with every O(G) host-needed output concatenated into
+    a single int32 blob. On a high-latency host<->device link (the axon
+    tunnel) the per-batch cost is then exactly one dispatch + one fetch
+    round-trip; the (G,N) tensors stay behind as device handles.
+
+    Blob layout (G = group bucket, K = top-K):
+      [0:G)        placed (0/1)
+      [G:2G)       gang_feasible (0/1)
+      [2G:3G)      progress (findMaxPG per-group progress)
+      [3G]         best group index
+      [3G+1]       best_exists (0/1)
+      [3G+2:...]   assignment top-K: packed (node<<16|count), G*K — or, when
+                   ``pack_assignment=False``, nodes then counts, 2*G*K
+    """
+    out = schedule_batch(alloc_lanes, requested, group_req, remaining,
+                         fit_mask, group_valid, order, use_pallas=use_pallas,
+                         top_k=top_k)
+    best, exists, progress = find_max_group(min_member, scheduled, matched,
+                                            ineligible, creation_rank)
+    if pack_assignment:
+        tail = out["assignment_packed"].reshape(-1)
+    else:
+        tail = jnp.concatenate(
+            [out["assignment_nodes"].reshape(-1),
+             out["assignment_counts"].reshape(-1)]
+        )
+    blob = jnp.concatenate(
+        [
+            out["placed"].astype(jnp.int32),
+            out["gang_feasible"].astype(jnp.int32),
+            progress.astype(jnp.int32),
+            jnp.stack([best, exists.astype(jnp.int32)]),
+            tail,
+        ]
+    )
+    return blob, out
+
+
 def execute_batch_host(batch_args, progress_args):
     """Run one fused batch + max-progress selection and fetch ONLY the O(G)
-    host vectors; the (G,N) tensors come back as device handles for lazy row
-    reads. The single batch-execution path shared by the in-process scorer
-    (core.oracle_scorer) and the sidecar server (service.server) — one place
-    to change when the oracle's outputs change."""
+    host vectors (as ONE packed transfer — see _batch_blob); the (G,N)
+    tensors come back as device handles for lazy row reads. The single
+    batch-execution path shared by the in-process scorer (core.oracle_scorer)
+    and the sidecar server (service.server) — one place to change when the
+    oracle's outputs change."""
     # The fused Pallas scan is single-device TPU + broadcast-mask only, and
     # Mosaic lowering is hardware-path-only (tests exercise interpret mode):
     # if it fails to compile/run on this chip, fall back to the lax.scan
@@ -378,14 +446,37 @@ def execute_batch_host(batch_args, progress_args):
         and jax.default_backend() == "tpu"
         and batch_args[4].shape[0] == 1
     )
+    # The packed form saturates per-node counts at 65535; a take can reach
+    # the gang's full remaining count on one node, so gate the compact form
+    # on the host-side remaining bound and fall back to the exact
+    # nodes+counts blob tail for wider gangs (or > 2**15-node buckets, where
+    # the node<<16 packing would overflow).
+    n_bucket = batch_args[0].shape[0]
+    remaining_host = np.asarray(batch_args[3])
+    remaining_max = int(remaining_host.max(initial=0))
+    pack = n_bucket <= 2**15 and remaining_max <= 2**16 - 1
+    top_k = batch_top_k(n_bucket, remaining_max)
+
+    def run(up: bool):
+        blob, out = _batch_blob(
+            *batch_args, *progress_args, use_pallas=up, pack_assignment=pack,
+            top_k=top_k,
+        )
+        # device_get is the sync point: a device-side kernel failure
+        # surfaces here, inside the caller's try
+        return np.asarray(jax.device_get(blob)), out
+
     if use_pallas:
         try:
-            out = schedule_batch(*batch_args, use_pallas=True)
-            # Async dispatch: a device-side kernel failure would otherwise
-            # surface at the later fetch, outside this try — block on one
-            # cheap output so the fallback actually engages.
-            jax.block_until_ready(out["placed"])
+            blob_np, out = run(True)
         except Exception as e:  # noqa: BLE001 — any lowering/runtime failure
+            # Only blame (and permanently disable) the pallas kernel if the
+            # scan path succeeds where it failed; if that fails too, the
+            # problem is the batch/link, not the kernel — surface it.
+            try:
+                blob_np, out = run(False)
+            except Exception:
+                raise e from None
             _pallas_enabled = False
             import warnings
 
@@ -393,32 +484,27 @@ def execute_batch_host(batch_args, progress_args):
                 f"pallas assignment kernel disabled after failure: {e!r}; "
                 "falling back to the lax.scan path"
             )
-            out = schedule_batch(*batch_args, use_pallas=False)
     else:
-        out = schedule_batch(*batch_args, use_pallas=False)
-    best, exists, progress = find_max_group(*progress_args)
-    fetch = {
-        "gang_feasible": out["gang_feasible"],
-        "placed": out["placed"],
-        "best": best,
-        "best_exists": exists,
-        "progress": progress,
+        blob_np, out = run(False)
+
+    g = batch_args[2].shape[0]
+    k = out["assignment_nodes"].shape[1]
+    tail = blob_np[3 * g + 2:]
+    if pack:
+        packed_np = tail.reshape(g, k)
+        nodes_np = packed_np >> 16
+        counts_np = packed_np & (2**16 - 1)
+    else:
+        nodes_np = tail[: g * k].reshape(g, k)
+        counts_np = tail[g * k:].reshape(g, k)
+    host = {
+        "placed": blob_np[:g].astype(bool),
+        "gang_feasible": blob_np[g:2 * g].astype(bool),
+        "progress": blob_np[2 * g:3 * g],
+        "best": blob_np[3 * g],
+        "best_exists": bool(blob_np[3 * g + 1]),
+        "assignment_nodes": nodes_np,
+        "assignment_counts": counts_np,
     }
-    # The packed form saturates per-node counts at 65535; a take can reach
-    # the gang's full remaining count on one node, so gate the compact fetch
-    # on the host-side remaining bound (batch_args[3]) and fall back to the
-    # exact two-array fetch for wider gangs.
-    packed = out.get("assignment_packed")
-    remaining_host = np.asarray(batch_args[3])
-    if packed is not None and int(remaining_host.max(initial=0)) <= 2**16 - 1:
-        fetch["assignment_packed"] = packed
-    else:
-        fetch["assignment_nodes"] = out["assignment_nodes"]
-        fetch["assignment_counts"] = out["assignment_counts"]
-    host = jax.device_get(fetch)
-    packed_np = host.pop("assignment_packed", None)
-    if packed_np is not None:
-        host["assignment_nodes"] = packed_np >> 16
-        host["assignment_counts"] = packed_np & (2**16 - 1)
     device_result = {"capacity": out["capacity"], "scores": out["scores"]}
     return host, device_result

@@ -27,7 +27,7 @@ import numpy as np
 
 from ..api.types import Node
 from .lanes import LaneSchema
-from .oracle import execute_batch_host
+from .oracle import batch_top_k, execute_batch_host
 from .snapshot import ClusterSnapshot, GroupDemand
 
 __all__ = ["ChurnRescorer", "TickResult"]
@@ -107,6 +107,7 @@ class ChurnRescorer:
         # compiles once per shape, and small ticks stay small.
         self._sticky = sticky_buckets
         self._sticky_buckets = (0, 0)
+        self._alloc_dev = None  # device-resident padded alloc (see tick)
 
     def tick(
         self,
@@ -146,8 +147,22 @@ class ChurnRescorer:
         )
         t_pack = time.perf_counter() - t0
 
+        args = snap.device_args()
+        if nodes is None:
+            # the alloc side never changes tick-to-tick: keep the padded
+            # array resident on device so steady ticks skip its host->device
+            # transfer (the largest per-tick input)
+            if (
+                self._alloc_dev is None
+                or self._alloc_dev.shape != args[0].shape
+            ):
+                import jax
+
+                self._alloc_dev = jax.device_put(args[0])
+            args = (self._alloc_dev,) + args[1:]
+
         t1 = time.perf_counter()
-        host, _device = execute_batch_host(snap.device_args(), snap.progress_args())
+        host, _device = execute_batch_host(args, snap.progress_args())
         t_device = time.perf_counter() - t1
 
         bucket_shape = (
@@ -157,6 +172,11 @@ class ChurnRescorer:
             # mask row rank: 1 (uniform broadcast) vs G (selectors/taints
             # present) is a distinct jit signature — count it as a recompile
             snap.fit_mask.shape[0],
+            # top-K readback tier (static in the batch's jit signature): a
+            # gang wider than any seen tier compiles — count it too
+            batch_top_k(
+                snap.alloc.shape[0], int(snap.remaining.max(initial=0))
+            ),
         )
         if bucket_shape not in self._shapes_seen:
             self._shapes_seen.add(bucket_shape)
@@ -178,7 +198,12 @@ class ChurnRescorer:
         self.device_times.append(t_device)
         return result
 
-    def warm(self, group_buckets: Sequence[int], with_selectors: bool = False) -> None:
+    def warm(
+        self,
+        group_buckets: Sequence[int],
+        with_selectors: bool = False,
+        max_remaining: int = 16,
+    ) -> None:
         """Precompile the oracle for the given gang-count buckets so no tick
         inside the churn loop ever pays a first-compile (~seconds on TPU).
 
@@ -186,8 +211,11 @@ class ChurnRescorer:
         (ops.snapshot._fit_mask fast path); groups with node selectors (or
         tainted nodes) produce the full ``[G,N]`` signature — a distinct
         compile. Pass ``with_selectors=True`` if churn traffic can carry
-        selectors, so both signatures are warm. Timing stats are reset
-        afterwards."""
+        selectors, so both signatures are warm. ``max_remaining`` is the
+        widest gang (members still needed) the loop will see: the batch's
+        top-K readback tier is static in its jit signature
+        (ops.oracle.batch_top_k), so a wider-than-warmed gang would compile
+        mid-loop. Timing stats are reset afterwards."""
         for gb in group_buckets:
             variants = [{}]
             if with_selectors:
@@ -196,7 +224,7 @@ class ChurnRescorer:
                 dummies = [
                     GroupDemand(
                         full_name=f"__warm__/{i}",
-                        min_member=1,
+                        min_member=max(1, max_remaining) if i == 0 else 1,
                         member_request={"cpu": 1},
                         has_pod=True,
                         **extra,
