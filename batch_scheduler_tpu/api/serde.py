@@ -59,7 +59,13 @@ def pod_group_from_dict(d: dict) -> PodGroup:
         spec=PodGroupSpec(
             min_member=spec.get("min_member", 0),
             priority_class_name=spec.get("priority_class_name", ""),
-            min_resources=spec.get("min_resources"),
+            # copied like every other nested container: typed objects must
+            # never alias the source dict (it may be an informer store entry)
+            min_resources=(
+                dict(spec["min_resources"])
+                if spec.get("min_resources") is not None
+                else None
+            ),
             max_schedule_time=spec.get("max_schedule_time"),
         ),
         status=PodGroupStatus(

@@ -90,6 +90,16 @@ class APIServer:
                 bucket.discard(key)
 
     def _notify(self, kind: str, event: WatchEvent) -> None:
+        """Fan an event out to every watcher.
+
+        ``event.obj`` is the STORED dict itself, shared by all watchers and
+        informer stores — never a per-event copy. Safe because stored dicts
+        are immutable once stored: every write verb replaces the store entry
+        with a new document (patch copy-on-writes via apply_merge_patch and
+        re-dicts metadata before stamping resource_version), and all raw
+        readers (informer stores/peek_raw/list_raw_by_label, the HTTP
+        gateway's serializer) are read-only by contract. GET/LIST responses
+        at the API boundary still deep-copy."""
         for q in self._watchers.get(kind, []):
             q.put(event)
 
@@ -131,7 +141,7 @@ class APIServer:
                 meta["uid"] = new_uid(kind.lower())
             store[key] = d
             self._index_add(kind, key, d)
-            self._notify(kind, WatchEvent(WatchEvent.ADDED, kind, json_deepcopy(d)))
+            self._notify(kind, WatchEvent(WatchEvent.ADDED, kind, d))
             return json_deepcopy(d)
 
     def get(self, kind: str, namespace: str, name: str) -> dict:
@@ -198,7 +208,7 @@ class APIServer:
             self._index_remove(kind, key, store[key])
             store[key] = d
             self._index_add(kind, key, d)
-            self._notify(kind, WatchEvent(WatchEvent.MODIFIED, kind, json_deepcopy(d)))
+            self._notify(kind, WatchEvent(WatchEvent.MODIFIED, kind, d))
             return json_deepcopy(d)
 
     def patch(self, kind: str, namespace: str, name: str, patch: dict) -> dict:
@@ -212,13 +222,16 @@ class APIServer:
             old = store[key]
             merged = apply_merge_patch(old, patch)
             self._rv += 1
-            merged.setdefault("metadata", {})["resource_version"] = self._rv
+            # apply_merge_patch shares untouched sub-trees with ``old``:
+            # give merged its OWN metadata dict before stamping the new
+            # resource_version, or the stamp would mutate the previous
+            # object (and every shared watch event holding it) in place
+            merged["metadata"] = dict(merged.get("metadata") or {})
+            merged["metadata"]["resource_version"] = self._rv
             self._index_remove(kind, key, old)
             store[key] = merged
             self._index_add(kind, key, merged)
-            self._notify(
-                kind, WatchEvent(WatchEvent.MODIFIED, kind, json_deepcopy(merged))
-            )
+            self._notify(kind, WatchEvent(WatchEvent.MODIFIED, kind, merged))
             return json_deepcopy(merged)
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
@@ -250,8 +263,10 @@ class APIServer:
         q: "queue.Queue[WatchEvent]" = queue.Queue()
         with self._lock:
             if replay:
+                # stored dicts are immutable once stored (see _notify):
+                # replayed events share them like live events do
                 for obj in self._kind_store(kind).values():
-                    q.put(WatchEvent(WatchEvent.ADDED, kind, json_deepcopy(obj)))
+                    q.put(WatchEvent(WatchEvent.ADDED, kind, obj))
             self._watchers.setdefault(kind, []).append(q)
         return q
 

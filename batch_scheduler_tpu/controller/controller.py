@@ -13,9 +13,16 @@ from __future__ import annotations
 
 import threading
 import time
+from dataclasses import replace
 from typing import Callable, List, Optional
 
-from ..api.types import PodGroup, PodGroupPhase, PodPhase, to_dict
+from ..api.types import (
+    PodGroup,
+    PodGroupPhase,
+    PodGroupStatus,
+    PodPhase,
+    to_dict,
+)
 from ..cache.pg_cache import PGStatusCache, PodGroupMatchStatus
 from ..client.apiserver import NotFoundError
 from ..client.clientset import Clientset
@@ -44,8 +51,14 @@ class PodGroupController:
         max_schedule_seconds: Optional[float] = None,
         resync_seconds: float = 0.5,
         clock: Callable[[], float] = time.monotonic,
+        pod_informer=None,
     ):
         self.client = client
+        # optional SharedInformer("Pod"): member-pod scans read uid/phase
+        # from its raw store (label-indexed, no copies) instead of a
+        # deep-copying API list per sync — the client-go lister pattern the
+        # reference controller uses (controller.go:148-176)
+        self._pod_informer = pod_informer
         self.pg_cache = pg_cache
         self.reject_pod = reject_pod
         self.add_to_backoff = add_to_backoff
@@ -146,9 +159,12 @@ class PodGroupController:
             self.pg_cache.set(key, pgs)
 
         # pgs.pod_group may alias pg (cache holds the informer object); diff
-        # against an immutable snapshot so cache syncs don't mask the patch
-        original = pg.deepcopy()
-        pg_copy = pg.deepcopy()
+        # against an immutable snapshot so cache syncs don't mask the patch.
+        # Only status is ever mutated here and its fields are all scalars,
+        # so a shallow field copy is a true snapshot — the full-object
+        # deepcopy this replaces was ~half the controller's sync cost.
+        original = replace(pg.status)
+        pg_copy = replace(pg, status=replace(pg.status))
         if pg_copy.status.phase == PodGroupPhase.EMPTY:
             pg_copy.status.phase = PodGroupPhase.PENDING
         elif (
@@ -157,8 +173,7 @@ class PodGroupController:
         ):
             # crash recovery: re-derive Scheduled from live member pods
             # (reference controller.go:201-222)
-            pods = self._member_pods(pg_copy)
-            pg_copy.status.scheduled = len(pods)
+            pg_copy.status.scheduled = len(self._member_phases(pg_copy))
             if pg_copy.status.scheduled > 0:
                 self._patch_if_changed(original, pg_copy)
 
@@ -203,19 +218,18 @@ class PodGroupController:
             PodGroupPhase.RUNNING,
             PodGroupPhase.SCHEDULING,
         ):
-            pods = self._member_pods(pg_copy)
+            members = self._member_phases(pg_copy)
             with pgs.count_lock:
                 not_pending = 0
                 running = 0
-                for pod in pods:
-                    phase = pod.status.phase
-                    if phase == PodPhase.RUNNING:
+                for uid, phase in members:
+                    if phase == PodPhase.RUNNING.value:
                         running += 1
-                    elif phase == PodPhase.SUCCEEDED:
-                        pgs.succeed[pod.metadata.uid] = ""
-                    elif phase == PodPhase.FAILED:
-                        pgs.failed[pod.metadata.uid] = ""
-                    if phase != PodPhase.PENDING:
+                    elif phase == PodPhase.SUCCEEDED.value:
+                        pgs.succeed[uid] = ""
+                    elif phase == PodPhase.FAILED.value:
+                        pgs.failed[uid] = ""
+                    if phase != PodPhase.PENDING.value:
                         not_pending += 1
                 pg_copy.status.failed = len(pgs.failed)
                 pg_copy.status.succeeded = len(pgs.succeed)
@@ -257,18 +271,42 @@ class PodGroupController:
 
     # -- helpers -----------------------------------------------------------
 
-    def _member_pods(self, pg: PodGroup) -> list:
-        return self.client.pods(pg.metadata.namespace).list(
-            label_selector={POD_GROUP_LABEL: pg.metadata.name}
-        )
+    def _member_phases(self, pg: PodGroup) -> list:
+        """(uid, phase-string) per member pod — the only fields the phase
+        machine reads. Informer-backed when available (raw dicts, no copy);
+        API list otherwise — including while the informer is still replaying
+        its initial list (controller start / leader failover), when a
+        partial store would under-count members and demote healthy gangs."""
+        if self._pod_informer is not None and self._pod_informer.has_synced():
+            return [
+                (
+                    (d.get("metadata") or {}).get("uid", ""),
+                    (d.get("status") or {}).get("phase", PodPhase.PENDING.value),
+                )
+                for d in self._pod_informer.list_raw_by_label(
+                    pg.metadata.namespace,
+                    {POD_GROUP_LABEL: pg.metadata.name},
+                )
+            ]
+        return [
+            (p.metadata.uid, p.status.phase.value)
+            for p in self.client.pods(pg.metadata.namespace).list(
+                label_selector={POD_GROUP_LABEL: pg.metadata.name}
+            )
+        ]
 
-    def _patch_if_changed(self, pg: PodGroup, pg_copy: PodGroup):
-        patch = create_merge_patch(to_dict(pg), to_dict(pg_copy))
-        if not patch:
+    def _patch_if_changed(self, original_status: PodGroupStatus, pg_copy: PodGroup):
+        """Status-only merge patch: the sync handler never mutates metadata
+        or spec, so the diff (and the serialisation cost) is confined to the
+        handful of scalar status fields."""
+        status_patch = create_merge_patch(
+            to_dict(original_status), to_dict(pg_copy.status)
+        )
+        if not status_patch:
             return None
         try:
-            return self.client.podgroups(pg.metadata.namespace).patch(
-                pg.metadata.name, patch
+            return self.client.podgroups(pg_copy.metadata.namespace).patch(
+                pg_copy.metadata.name, {"status": status_patch}
             )
         except NotFoundError:
             return None

@@ -463,25 +463,26 @@ class ScheduleOperation:
                 new_start = pg.status.schedule_start_time or time.time()
 
             if new_phase != pg.status.phase and self.pg_client is not None:
-                # Slow path — once per phase transition (≤2 per gang): the
-                # only place the object copy + live read + merge patch is
-                # paid. The per-pod fast path below is plain field writes;
-                # a full deepcopy per bound pod serialized 10k-pod runs on
-                # this lock (VERDICT r2 weak #2).
-                pg_copy = pg.deepcopy()
-                pg_copy.status.scheduled = new_scheduled
-                pg_copy.status.phase = new_phase
-                pg_copy.status.schedule_start_time = new_start
+                # Slow path — once per phase transition (≤2 per gang). A
+                # targeted status merge patch sets exactly the fields this
+                # transition owns: no live GET, no object copy, no full
+                # serialisation — the earlier GET+diff+deepcopy form held
+                # this lock for milliseconds and serialized every bind
+                # worker behind it (the postBind histogram showed 5.4ms/pod,
+                # almost all lock wait).
                 try:
-                    from ..api.types import to_dict
-
-                    live = self.pg_client.podgroups(pg.metadata.namespace).get(
-                        pg.metadata.name
-                    )
-                    patch = create_merge_patch(to_dict(live), to_dict(pg_copy))
                     updated = self.pg_client.podgroups(
                         pg.metadata.namespace
-                    ).patch(pg.metadata.name, patch)
+                    ).patch(
+                        pg.metadata.name,
+                        {
+                            "status": {
+                                "phase": new_phase.value,
+                                "scheduled": new_scheduled,
+                                "schedule_start_time": new_start,
+                            }
+                        },
+                    )
                     pg.status.phase = updated.status.phase
                 except Exception:
                     return

@@ -21,11 +21,13 @@ LessFn = Callable[[PodInfo, PodInfo], bool]
 
 
 class _Entry:
-    __slots__ = ("info", "less")
+    __slots__ = ("info", "less", "dead", "group")
 
-    def __init__(self, info: PodInfo, less: LessFn):
+    def __init__(self, info: PodInfo, less: LessFn, group: Optional[str] = None):
         self.info = info
         self.less = less
+        self.dead = False  # lazily-deleted (drained as part of its gang)
+        self.group = group
 
     def __lt__(self, other: "_Entry") -> bool:
         if self.less(self.info, other.info):
@@ -42,13 +44,20 @@ class SchedulingQueue:
         backoff_base: float = 1.0,
         backoff_cap: float = 10.0,
         clock: Callable[[], float] = time.monotonic,
+        group_key_fn: Optional[Callable[[PodInfo], Optional[str]]] = None,
     ):
         self._less = less_fn or (lambda a, b: a.timestamp < b.timestamp)
         self._backoff_base = backoff_base
         self._backoff_cap = backoff_cap
         self._clock = clock
+        self._group_key = group_key_fn
         self._cond = threading.Condition()
         self._active: list = []
+        self._active_dead = 0
+        # gang-unit admission index: group key -> live active entries, so a
+        # batch-planned gang's queued members drain in one cycle instead of
+        # one heap pop + full comparator churn each (pop_group)
+        self._groups: dict = {}
         self._backoff: list = []  # heap of (ready_at, seq, PodInfo)
         self._closed = False
         self._flusher = threading.Thread(
@@ -56,12 +65,43 @@ class SchedulingQueue:
         )
         self._flusher.start()
 
+    def _push_active_locked(self, info: PodInfo) -> None:
+        group = self._group_key(info) if self._group_key else None
+        entry = _Entry(info, self._less, group)
+        heapq.heappush(self._active, entry)
+        if group is not None:
+            self._groups.setdefault(group, set()).add(entry)
+
+    def _drop_from_group_locked(self, entry: "_Entry") -> None:
+        if entry.group is not None:
+            bucket = self._groups.get(entry.group)
+            if bucket is not None:
+                bucket.discard(entry)
+                if not bucket:
+                    del self._groups[entry.group]
+
     def push(self, info: PodInfo) -> None:
         if not info.timestamp:
             info.timestamp = self._clock()
         with self._cond:
-            heapq.heappush(self._active, _Entry(info, self._less))
+            self._push_active_locked(info)
             self._cond.notify()
+
+    def pop_group(self, group: str) -> list:
+        """Remove and return every queued member of ``group`` (arbitrary
+        order — the caller admits them against an already-priority-ordered
+        batch plan). Their heap entries are lazily deleted."""
+        with self._cond:
+            bucket = self._groups.pop(group, None)
+            if not bucket:
+                return []
+            out = []
+            for entry in bucket:
+                if not entry.dead:
+                    entry.dead = True
+                    self._active_dead += 1
+                    out.append(entry.info)
+            return out
 
     def push_backoff(self, info: PodInfo) -> None:
         """Re-queue an unschedulable pod after exponential backoff."""
@@ -77,30 +117,36 @@ class SchedulingQueue:
     def pop(self, timeout: Optional[float] = None) -> Optional[PodInfo]:
         deadline = None if timeout is None else self._clock() + timeout
         with self._cond:
-            while not self._active:
-                if self._closed:
-                    return None
-                wait = None
-                if deadline is not None:
-                    wait = deadline - self._clock()
-                    if wait <= 0:
+            while True:
+                while not self._active:
+                    if self._closed:
                         return None
-                if self._backoff:
-                    due = self._backoff[0][0] - self._clock()
-                    wait = due if wait is None else min(wait, due)
-                if wait is not None and wait <= 0:
+                    wait = None
+                    if deadline is not None:
+                        wait = deadline - self._clock()
+                        if wait <= 0:
+                            return None
+                    if self._backoff:
+                        due = self._backoff[0][0] - self._clock()
+                        wait = due if wait is None else min(wait, due)
+                    if wait is not None and wait <= 0:
+                        self._promote_locked()
+                        continue
+                    self._cond.wait(wait if wait is None else max(wait, 0.01))
                     self._promote_locked()
-                    continue
-                self._cond.wait(wait if wait is None else max(wait, 0.01))
-                self._promote_locked()
-            return heapq.heappop(self._active).info
+                entry = heapq.heappop(self._active)
+                if entry.dead:
+                    self._active_dead -= 1
+                    continue  # lazily-deleted (drained via pop_group)
+                self._drop_from_group_locked(entry)
+                return entry.info
 
     def _promote_locked(self) -> None:
         now = self._clock()
         moved = False
         while self._backoff and self._backoff[0][0] <= now:
             _, _, info = heapq.heappop(self._backoff)
-            heapq.heappush(self._active, _Entry(info, self._less))
+            self._push_active_locked(info)
             moved = True
         if moved:
             self._cond.notify_all()
@@ -113,7 +159,9 @@ class SchedulingQueue:
 
     def __len__(self) -> int:
         with self._cond:
-            return len(self._active) + len(self._backoff)
+            return (
+                len(self._active) - self._active_dead + len(self._backoff)
+            )
 
     def close(self) -> None:
         with self._cond:

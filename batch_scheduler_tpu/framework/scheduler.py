@@ -29,6 +29,17 @@ from .waiting import ALLOW, WaitingPod, WaitingPods
 __all__ = ["Scheduler", "FrameworkHandle"]
 
 
+def _gang_key(info: PodInfo) -> Optional[str]:
+    """namespace/group queue-index key for gang-unit admission (None for
+    non-gang pods)."""
+    from ..utils.labels import pod_group_name
+
+    name, ok = pod_group_name(info.pod)
+    if not ok:
+        return None
+    return f"{info.pod.metadata.namespace}/{name}"
+
+
 class FrameworkHandle:
     """What plugins see of the framework (reference framework.FrameworkHandle):
     waiting-pod access, the cluster snapshot provider, and the clientset."""
@@ -72,7 +83,13 @@ class Scheduler:
         # the FrameworkHandle); plugin_factory resolves the cycle
         self.plugin = plugin_factory(self.handle) if plugin_factory else plugin
         less = self.plugin.less if self.plugin is not None else None
-        self.queue = SchedulingQueue(less, backoff_base, backoff_cap, clock)
+        self.queue = SchedulingQueue(
+            less,
+            backoff_base,
+            backoff_cap,
+            clock,
+            group_key_fn=_gang_key,
+        )
         self._bind_workers = bind_workers
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
@@ -129,18 +146,30 @@ class Scheduler:
             info = self.queue.pop(timeout=0.2)
             if info is None:
                 continue
-            try:
-                with self._cycle_seconds.time():
-                    self._schedule_one(info)
-            except Exception:
-                # a broken cycle must not kill the loop; release any
-                # capacity assumed mid-cycle, then retry the pod
-                self.cluster.forget(info.pod.metadata.uid)
-                if self.plugin is not None:
-                    self.plugin.mark_dirty()
-                self.queue.push_backoff(info)
+            gang = self._run_cycle(info)
+            if gang is not None:
+                # gang-unit admission: the pod was placed through its
+                # gang's batch plan, so its queued siblings ride the same
+                # plan NOW — one drain instead of a heap pop + comparator
+                # churn + plan lookup cycle each. Members the plan can't
+                # seat fall through to the scan/backoff path as usual.
+                for sibling in self.queue.pop_group(gang):
+                    self._run_cycle(sibling)
 
-    def _schedule_one(self, info: PodInfo) -> None:
+    def _run_cycle(self, info: PodInfo) -> Optional[str]:
+        try:
+            with self._cycle_seconds.time():
+                return self._schedule_one(info)
+        except Exception:
+            # a broken cycle must not kill the loop; release any
+            # capacity assumed mid-cycle, then retry the pod
+            self.cluster.forget(info.pod.metadata.uid)
+            if self.plugin is not None:
+                self.plugin.mark_dirty()
+            self.queue.push_backoff(info)
+            return None
+
+    def _schedule_one(self, info: PodInfo) -> Optional[str]:
         self.stats["cycles"] += 1
         # liveness check: the queued copy may be stale (deleted, replaced,
         # already bound). Prefer the informer's raw store — same signal as
@@ -178,7 +207,7 @@ class Scheduler:
                 self._unschedulable(info, str(e))
                 return
 
-        node_name = self._select_node(pod)
+        node_name, from_plan = self._select_node(pod)
         if node_name is None:
             # preemption cycle (the role upstream kube-scheduler's
             # PostFilter plays for the reference, whose policy hooks are
@@ -202,7 +231,7 @@ class Scheduler:
 
         if self.plugin is None:
             self._bind(pod, node_name)
-            return
+            return None
 
         code, timeout = self.plugin.permit(pod, node_name)
         if code == StatusCode.SUCCESS:
@@ -216,10 +245,15 @@ class Scheduler:
             self.cluster.forget(pod.metadata.uid)
             self.plugin.mark_dirty()
             self._unschedulable(info, "permit denied")
+            return None
+        # plan-seated gang member admitted: tell the loop so queued
+        # siblings drain through the same plan in this cycle
+        return _gang_key(info) if from_plan else None
 
-    def _select_node(self, pod: Pod) -> Optional[str]:
+    def _select_node(self, pod: Pod) -> tuple:
         """Generic resource/selector/taint fit + plugin Filter, then highest
-        plugin Score wins (kube-scheduler's filter/score phases).
+        plugin Score wins (kube-scheduler's filter/score phases). Returns
+        ``(node_name_or_None, from_plan)``.
 
         Fast path: a plugin-suggested node (the gang's batch placement plan)
         is verified against that single node and taken — O(1) per pod
@@ -241,7 +275,7 @@ class Scheduler:
                         node, self.cluster.node_requested(hint), None
                     )
                     if rmath.resource_satisfied(left, require):
-                        return hint
+                        return hint, True
                 # plan slot unusable (node gone/full): fall through to the
                 # scan, which sees the live cluster
         best_name, best_score = None, None
@@ -267,7 +301,7 @@ class Scheduler:
             )
             if best_score is None or score > best_score:
                 best_name, best_score = node.metadata.name, score
-        return best_name
+        return best_name, False
 
     def _try_preempt(self, pod: Pod) -> bool:
         """Victim search + eviction for an unschedulable pod.

@@ -120,13 +120,19 @@ def new_plugin_runtime(
     config: Optional[PluginConfig] = None,
     lease=None,
     clock=None,
+    informers: Optional[SharedInformerFactory] = None,
 ) -> PluginRuntime:
     """Build plugin + controller + leader gate over an API server and a
-    framework handle. ``handle.cluster`` is the snapshot provider."""
+    framework handle. ``handle.cluster`` is the snapshot provider.
+
+    Pass ``informers`` to share one factory (and thus ONE watch stream +
+    typed rehydration per event per kind) with the embedding framework —
+    a second factory doubles every pod event's dispatch cost."""
     config = config or PluginConfig()
     pg_client = Clientset(api)
 
-    informers = SharedInformerFactory(api)
+    if informers is None:
+        informers = SharedInformerFactory(api)
     pg_informer = informers.pod_groups()
     lister = informers.pod_group_lister()
 
@@ -171,6 +177,7 @@ def new_plugin_runtime(
     controller = PodGroupController(
         client=pg_client,
         pg_informer=pg_informer,
+        pod_informer=informers.informer("Pod"),
         pg_cache=pg_cache,
         reject_pod=plugin.reject_pod,
         add_to_backoff=operation.add_to_deny_cache,

@@ -53,12 +53,15 @@ class SimCluster:
         )
         self.runtime = None
 
-        def plugin_factory(handle):
-            self.runtime = new_plugin_runtime(self.api, handle, config)
-            return self.runtime.plugin
-
-        # framework informers: nodes + pods feed ClusterState and the queue
+        # framework informers: nodes + pods feed ClusterState and the queue;
+        # shared with the plugin runtime so each event dispatches once
         self._fwk_informers = SharedInformerFactory(self.api)
+
+        def plugin_factory(handle):
+            self.runtime = new_plugin_runtime(
+                self.api, handle, config, informers=self._fwk_informers
+            )
+            return self.runtime.plugin
         self.scheduler = Scheduler(
             self.clientset,
             self.cluster,

@@ -112,6 +112,44 @@ class Histogram:
             s[1] += v
             s[2] += 1
 
+    def sum_count(self, **labels: str) -> Tuple[float, int]:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            s = self._series.get(key)
+            return (s[1], s[2]) if s else (0.0, 0)
+
+    def snapshot(self, **labels: str) -> Tuple[list, float, int]:
+        """(cumulative bucket counts, sum, count) — subtract two snapshots
+        to scope quantiles/totals to a measurement window on the
+        process-global registry (see quantile's ``since``)."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                return ([0] * len(self.buckets), 0.0, 0)
+            return (list(s[0]), s[1], s[2])
+
+    def quantile(self, q: float, since=None, **labels: str) -> float:
+        """Approximate quantile from the cumulative bucket counts (linear
+        interpolation within the covering bucket — what Prometheus'
+        histogram_quantile computes server-side). ``since`` (an earlier
+        ``snapshot()``) restricts to observations after that point."""
+        counts, _, total = self.snapshot(**labels)
+        if since is not None:
+            counts = [c - c0 for c, c0 in zip(counts, since[0])]
+            total -= since[2]
+        if total <= 0:
+            return 0.0
+        rank = q * total
+        prev_count, prev_bound = 0, 0.0
+        for i, b in enumerate(self.buckets):
+            if counts[i] >= rank:
+                span = counts[i] - prev_count
+                frac = 1.0 if span <= 0 else (rank - prev_count) / span
+                return prev_bound + (b - prev_bound) * frac
+            prev_count, prev_bound = counts[i], b
+        return self.buckets[-1]
+
     def time(self, **labels: str):
         """Context manager observing elapsed wall-clock seconds."""
         hist = self

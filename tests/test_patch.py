@@ -54,3 +54,71 @@ def test_apply_inverts_create():
     }
     patch = create_merge_patch(original, modified)
     assert apply_merge_patch(original, patch) == modified
+
+
+def test_fast_to_dict_matches_asdict():
+    """The explicit per-kind encoders must stay field-for-field identical to
+    the dataclasses.asdict fallback (api/types.to_dict fast path)."""
+    import dataclasses
+    import enum
+
+    from batch_scheduler_tpu.api.types import (
+        Container,
+        Node,
+        ObjectMeta,
+        Pod,
+        PodGroup,
+        PodGroupPhase,
+        PodGroupSpec,
+        PodGroupStatus,
+        PodPhase,
+        PodSpec,
+        PodStatus,
+        Taint,
+        Toleration,
+        to_dict,
+    )
+
+    def slow(obj):
+        def encode(v):
+            return v.value if isinstance(v, enum.Enum) else v
+
+        return dataclasses.asdict(
+            obj, dict_factory=lambda items: {k: encode(v) for k, v in items}
+        )
+
+    meta = ObjectMeta(
+        name="p1", namespace="ns", uid="u1", labels={"a": "b"},
+        annotations={"x": "y"}, owner_references=["u0"],
+        creation_timestamp=3.5, resource_version=7,
+    )
+    pod = Pod(
+        metadata=meta,
+        spec=PodSpec(
+            containers=[Container("c", {"cpu": 100}, {"cpu": 200})],
+            node_selector={"zone": "z1"},
+            tolerations=[Toleration("k", "Exists", "", "NoSchedule")],
+            priority=3,
+            node_name="n1",
+        ),
+        status=PodStatus(phase=PodPhase.RUNNING),
+    )
+    node = Node(metadata=meta)
+    node.spec.taints = [Taint("k", "v", "NoExecute")]
+    node.spec.unschedulable = True
+    node.status.allocatable = {"cpu": 8000}
+    node.status.capacity = {"cpu": 8000}
+    pg = PodGroup(
+        metadata=meta,
+        spec=PodGroupSpec(
+            min_member=5, priority_class_name="high",
+            min_resources={"cpu": 100}, max_schedule_time=60,
+        ),
+        status=PodGroupStatus(phase=PodGroupPhase.SCHEDULING, scheduled=2),
+    )
+    for obj in (pod, node, pg, pg.status, PodGroup()):
+        assert to_dict(obj) == slow(obj)
+    # fast output must not alias the source containers
+    d = to_dict(pod)
+    d["metadata"]["labels"]["a"] = "mutated"
+    assert pod.metadata.labels["a"] == "b"
