@@ -35,6 +35,14 @@ def _add_config_flag(p: argparse.ArgumentParser) -> None:
     )
 
 
+def _add_metrics_flag(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--metrics-port", type=int, default=None, metavar="PORT",
+        help="serve Prometheus /metrics (+/healthz) on this port (0 = "
+             "ephemeral; the bound port is printed)",
+    )
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="batch-scheduler-tpu",
@@ -65,6 +73,7 @@ def build_parser() -> argparse.ArgumentParser:
     sim.add_argument("--groups", type=int, default=10, help="synthetic scenario groups")
     sim.add_argument("--members", type=int, default=5, help="pods per synthetic group")
     sim.add_argument("--timeout", type=float, default=60.0)
+    _add_metrics_flag(sim)
     sim.add_argument("--settle", type=float, default=3.0,
                      help="finish early once group phases and bound counts "
                           "have been stable this many seconds (a denied gang "
@@ -79,6 +88,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="jit-compile the smallest bucket shape before accepting traffic "
              "(first TPU compile is ~20-40s; warmed shapes answer instantly)",
     )
+    _add_metrics_flag(serve)
 
     chk = sub.add_parser("check-config", help="validate a scheduler config JSON")
     _add_config_flag(chk)
@@ -161,6 +171,19 @@ def warm_oracle(nodes=None, groups=None, pods=None, remote_scorer=None) -> float
     return time.perf_counter() - t0
 
 
+def _maybe_serve_metrics(args):
+    """--metrics-port wiring shared by sim and serve: the reference's only
+    observability surface is the embedded kube-scheduler's /metrics
+    (SURVEY §5); ours exposes the bst_* series over the same protocol."""
+    if getattr(args, "metrics_port", None) is None:
+        return None
+    from ..utils.metrics import serve_metrics
+
+    server = serve_metrics(host="0.0.0.0", port=args.metrics_port)
+    print(f"metrics on :{server.server_address[1]}/metrics", flush=True)
+    return server
+
+
 def cmd_serve(args) -> int:
     from ..parallel.distributed import init_distributed
     from ..service.server import OracleServer
@@ -177,6 +200,8 @@ def cmd_serve(args) -> int:
 
     if args.warmup:
         print(f"warmup compile done in {warm_oracle():.1f}s", flush=True)
+
+    _maybe_serve_metrics(args)
 
     server = OracleServer(host=args.host, port=args.port)
     host, port = server.address
@@ -210,6 +235,8 @@ def cmd_sim(args) -> int:
     cfg = load_scheduler_config(args.config)
     if args.scorer:
         cfg.plugin_config.scorer = args.scorer
+
+    _maybe_serve_metrics(args)
 
     scorer = cfg.plugin_config.scorer
     oracle_client = None

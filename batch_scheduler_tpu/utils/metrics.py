@@ -112,12 +112,6 @@ class Histogram:
             s[1] += v
             s[2] += 1
 
-    def sum_count(self, **labels: str) -> Tuple[float, int]:
-        key = tuple(sorted(labels.items()))
-        with self._lock:
-            s = self._series.get(key)
-            return (s[1], s[2]) if s else (0.0, 0)
-
     def snapshot(self, **labels: str) -> Tuple[list, float, int]:
         """(cumulative bucket counts, sum, count) — subtract two snapshots
         to scope quantiles/totals to a measurement window on the

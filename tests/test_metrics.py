@@ -1,0 +1,117 @@
+"""The /metrics exposition surface (VERDICT r2: A5 'wire and test').
+
+The reference's only observability surface is the embedded kube-scheduler's
+Prometheus /metrics endpoint (SURVEY §5); ours must actually serve the
+bst_* series the stack records — scraped over HTTP here, not just rendered.
+"""
+
+from __future__ import annotations
+
+import urllib.request
+
+from batch_scheduler_tpu.utils.metrics import (
+    DEFAULT_REGISTRY,
+    Registry,
+    serve_metrics,
+)
+
+
+def _scrape(port: int, path: str = "/metrics") -> str:
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.read().decode()
+
+
+def test_serve_metrics_scrape_roundtrip():
+    reg = Registry()
+    reg.counter("test_total", "help text").inc(3)
+    reg.histogram("test_seconds", "h").observe(0.05)
+    server = serve_metrics(reg, port=0)
+    try:
+        port = server.server_address[1]
+        body = _scrape(port)
+        assert "# TYPE test_total counter" in body
+        assert "test_total 3" in body
+        assert "test_seconds_count 1" in body
+        assert '{le="+Inf"}' not in body or "test_seconds_bucket" in body
+        assert _scrape(port, "/healthz").strip() == "ok"
+    finally:
+        server.shutdown()
+
+
+def test_framework_series_render_after_a_run(tmp_path):
+    """Drive the race scenario end-to-end, then scrape: the headline series
+    (schedule cycle + oracle batch) must be present with nonzero counts."""
+    from batch_scheduler_tpu.sim import SimCluster
+    from batch_scheduler_tpu.sim.scenarios import race_scenario
+
+    cluster = SimCluster(scorer="oracle")
+    nodes, groups, pods_by_group = race_scenario()
+    cluster.add_nodes(nodes)
+    for pg in groups:
+        cluster.create_group(pg)
+    cluster.start()
+    try:
+        for pods in pods_by_group.values():
+            cluster.create_pods(pods)
+        assert cluster.wait_for(
+            lambda: cluster.scheduler.stats["binds"] >= 5, timeout=60.0
+        )
+    finally:
+        cluster.stop()
+
+    server = serve_metrics(DEFAULT_REGISTRY, port=0)
+    try:
+        body = _scrape(server.server_address[1])
+    finally:
+        server.shutdown()
+    for series in (
+        "bst_schedule_cycle_seconds",
+        "bst_oracle_batch_seconds",
+        "bst_pods_bound_total",
+        "bst_extension_point_seconds",
+    ):
+        assert f"{series}_count" in body or f"{series} " in body, series
+    # counts are nonzero: the run above actually observed into them
+    count_lines = {
+        line.rsplit(" ", 1)[0]: float(line.rsplit(" ", 1)[1])
+        for line in body.splitlines()
+        if "_count" in line and not line.startswith("#")
+    }
+    assert count_lines.get("bst_schedule_cycle_seconds_count", 0) > 0
+    assert count_lines.get("bst_oracle_batch_seconds_count", 0) > 0
+
+
+def test_histogram_quantile_and_snapshot_window():
+    reg = Registry()
+    h = reg.histogram("q_seconds", "h", buckets=(0.01, 0.1, 1.0, 10.0))
+    for _ in range(100):
+        h.observe(0.05)
+    snap = h.snapshot()
+    for _ in range(100):
+        h.observe(5.0)
+    # overall p50 falls on the boundary between the two equal-sized
+    # clusters (rank == cumulative count of the 0.05 bucket -> its bound);
+    # windowed p50 is in the 5.0 bucket only
+    assert 0.01 < h.quantile(0.5) <= 0.1
+    windowed = h.quantile(0.5, since=snap)
+    assert 1.0 < windowed <= 10.0
+    # sum/count deltas
+    _, total_sum, total_n = h.snapshot()
+    assert total_n == 200 and abs(total_sum - (100 * 0.05 + 100 * 5.0)) < 1e-6
+
+
+def test_cli_metrics_port_flag():
+    """--metrics-port 0 on sim binds an ephemeral /metrics endpoint."""
+    import argparse
+
+    from batch_scheduler_tpu.cmd.main import _maybe_serve_metrics
+
+    args = argparse.Namespace(metrics_port=0)
+    server = _maybe_serve_metrics(args)
+    try:
+        assert server is not None
+        body = _scrape(server.server_address[1])
+        assert "# TYPE" in body
+    finally:
+        server.shutdown()
+    assert _maybe_serve_metrics(argparse.Namespace(metrics_port=None)) is None
