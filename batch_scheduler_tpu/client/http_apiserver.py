@@ -41,7 +41,8 @@ class HTTPAPIServer:
         self.host = host
         self.port = port
         self.timeout = timeout
-        self._watches: Dict[int, tuple] = {}  # id(queue) -> (conn, resp, thread, stop)
+        # id(queue) -> {"conn", "resp", "thread", "stop"} (see watch())
+        self._watches: Dict[int, dict] = {}
         self._lock = threading.Lock()
 
     # -- request plumbing --------------------------------------------------
@@ -139,65 +140,141 @@ class HTTPAPIServer:
 
     def watch(self, kind: str, *, replay: bool = True) -> "queue.Queue[WatchEvent]":
         """Open a streaming watch; events arrive on the returned queue
-        (same contract as APIServer.watch)."""
+        (same contract as APIServer.watch).
+
+        Reflector semantics (client-go's relist, reference informers
+        factory.go:117-133 -> NewSharedIndexInformer): if the stream drops
+        for any reason other than stop_watch — gateway restart, LB blip,
+        half-open timeout — the reader reconnects with backoff and
+        ``replay=1``. The gateway replays current state terminated by a
+        BOOKMARK line; the reader forwards the replay (level-based
+        consumers overwrite) and, at the BOOKMARK, synthesizes DELETED
+        events for every object it had delivered that no longer exists —
+        so informers converge instead of freezing on a stale cache."""
         q: "queue.Queue[WatchEvent]" = queue.Queue()
-        conn = http.client.HTTPConnection(self.host, self.port)
-        path = (
-            self._collection_path(kind, None)
-            + f"?watch=1&replay={'1' if replay else '0'}"
-        )
-        conn.request("GET", path)
-        resp = conn.getresponse()
         stop = threading.Event()
+        entry = {"conn": None, "resp": None, "stop": stop}
+        # last-delivered object per key: the source for synthesized DELETEDs
+        known: Dict[tuple, dict] = {}
+
+        def connect(replay_flag: bool):
+            # Read timeout >> the gateway's 0.2s heartbeat: a half-open
+            # connection (no FIN/RST — host power loss, NAT drop) surfaces
+            # as socket.timeout instead of blocking readline forever
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=max(self.timeout, 5.0)
+            )
+            path = (
+                self._collection_path(kind, None)
+                + f"?watch=1&replay={'1' if replay_flag else '0'}"
+            )
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            with self._lock:
+                entry["conn"], entry["resp"] = conn, resp
+            if stop.is_set():  # lost the race with stop_watch
+                raise OSError("watch stopped")
+            return resp
+
+        def key_of(obj: dict) -> tuple:
+            meta = obj.get("metadata") or {}
+            return (meta.get("namespace", "default"), meta.get("name", ""))
+
+        def consume(resp, resyncing: bool) -> None:
+            """Forward events until the stream ends. ``resyncing``: treat
+            the leading replay (up to the BOOKMARK) as a relist to diff
+            against ``known`` — and, when the subscription was opened with
+            ``replay=False``, use it for that bookkeeping WITHOUT
+            forwarding (the caller opted out of replays)."""
+            replay_seen: set = set()
+            while not stop.is_set():
+                line = resp.fp.readline()
+                if not line or stop.is_set():
+                    return
+                line = line.strip()
+                if not line:
+                    continue  # heartbeat
+                ev = json.loads(line)
+                etype = ev.get("type")
+                if etype == "BOOKMARK":
+                    if resyncing:
+                        for gone_key in set(known) - replay_seen:
+                            q.put(
+                                WatchEvent(
+                                    WatchEvent.DELETED,
+                                    kind,
+                                    known.pop(gone_key),
+                                )
+                            )
+                        resyncing = False
+                    continue
+                obj = ev["object"]
+                k = key_of(obj)
+                in_replay = resyncing
+                if etype == WatchEvent.DELETED:
+                    known.pop(k, None)
+                else:
+                    known[k] = obj
+                    if resyncing:
+                        replay_seen.add(k)
+                if in_replay and not replay:
+                    continue  # resync bookkeeping only; caller opted out
+                q.put(WatchEvent(etype, kind, obj))
 
         def reader() -> None:
-            try:
-                while not stop.is_set():
-                    line = resp.fp.readline()
-                    if not line or stop.is_set():
-                        return  # stream closed or unsubscribed
-                    line = line.strip()
-                    if not line:
-                        continue  # heartbeat
-                    ev = json.loads(line)
-                    q.put(WatchEvent(ev["type"], kind, ev["object"]))
-            except (OSError, ValueError):
-                pass  # connection torn down by stop_watch or server exit
+            backoff = 0.2
+            first = True
+            while not stop.is_set():
+                established = False
+                try:
+                    # reconnects always replay: the relist is what resyncs
+                    resp = connect(replay if first else True)
+                    established = True
+                    consume(resp, resyncing=not first)
+                except (OSError, ValueError, http.client.HTTPException):
+                    pass  # fall through to reconnect (or exit if stopped)
+                if first:
+                    first = False
+                if stop.is_set():
+                    return
+                stop.wait(backoff)
+                # a stream that actually established resets the backoff
+                # (client-go behavior); repeated connect failures keep
+                # growing it toward the cap
+                backoff = 0.2 if established else min(backoff * 2, 5.0)
 
         t = threading.Thread(
             target=reader, name=f"http-watch-{kind}", daemon=True
         )
         t.start()
+        entry["thread"] = t
         with self._lock:
-            self._watches[id(q)] = (conn, resp, t, stop)
+            self._watches[id(q)] = entry
         return q
+
+    @staticmethod
+    def _close_entry(entry: dict) -> None:
+        entry["stop"].set()
+        # resp holds its own buffered socket file — closing the connection
+        # alone leaves the reader consuming buffered events
+        for field in ("resp", "conn"):
+            c = entry.get(field)
+            if c is not None:
+                try:
+                    c.close()
+                except OSError:
+                    pass
 
     def stop_watch(self, kind: str, q: queue.Queue) -> None:
         with self._lock:
             entry = self._watches.pop(id(q), None)
         if entry is None:
             return
-        conn, resp, _, stop = entry
-        stop.set()
-        # resp holds its own buffered socket file — closing the connection
-        # alone leaves the reader consuming buffered events
-        try:
-            resp.close()
-        except OSError:
-            pass
-        try:
-            conn.close()
-        except OSError:
-            pass
+        self._close_entry(entry)
 
     def close(self) -> None:
         with self._lock:
             entries = list(self._watches.values())
             self._watches.clear()
-        for conn, resp, _, stop in entries:
-            stop.set()
-            for c in (resp, conn):
-                try:
-                    c.close()
-                except OSError:
-                    pass
+        for entry in entries:
+            self._close_entry(entry)

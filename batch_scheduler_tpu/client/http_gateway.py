@@ -123,21 +123,55 @@ class _Handler(BaseHTTPRequestHandler):
             if name is not None:
                 self._send_json(200, self.api.get(kind, ns or "", name))
             elif qs.get("watch", ["0"])[0] in ("1", "true"):
-                self._stream_watch(kind, qs)
+                self._stream_watch(kind, ns, qs)
             else:
                 items = self.api.list(kind, ns, self._selector(qs))
                 self._send_json(200, {"items": items})
         except NotFoundError as e:
             self._send_error_json(404, str(e))
 
-    def _stream_watch(self, kind: str, qs) -> None:
+    def _stream_watch(self, kind: str, ns: Optional[str], qs) -> None:
+        """k8s-dialect watch stream, scoped to the URL's namespace and
+        labelSelector (a watch on /namespaces/ns/pods streams only ns —
+        ADVICE r2). Replay is served from a LIST taken after subscribing
+        (no missed-event window) and terminated by a BOOKMARK line, the
+        reflector's resync point: a reconnecting client diffs the replayed
+        state at the BOOKMARK against what it knew and synthesizes DELETED
+        events for objects that vanished while it was away (client-go's
+        relist, informers factory.go:117-133)."""
         replay = qs.get("replay", ["1"])[0] in ("1", "true")
-        events = self.api.watch(kind, replay=replay)
+        selector = self._selector(qs)
+
+        def in_scope(obj: dict) -> bool:
+            meta = obj.get("metadata") or {}
+            if ns is not None and meta.get("namespace", "default") != ns:
+                return False
+            if selector:
+                labels = meta.get("labels") or {}
+                return all(labels.get(k) == v for k, v in selector.items())
+            return True
+
+        def key_of(obj: dict) -> tuple:
+            meta = obj.get("metadata") or {}
+            return (meta.get("namespace", "default"), meta.get("name", ""))
+
+        # subscribe FIRST, then list: anything created between the two
+        # shows up twice (replay + live ADDED) — level-based consumers
+        # overwrite; nothing is missed
+        events = self.api.watch(kind, replay=False)
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "identity")
         self.end_headers()
+        sent: set = set()  # keys this stream has delivered as in-scope
         try:
+            if replay:
+                for obj in self.api.list(kind, ns, selector):
+                    sent.add(key_of(obj))
+                    line = json.dumps({"type": "ADDED", "object": obj}) + "\n"
+                    self.wfile.write(line.encode())
+                self.wfile.write(b'{"type": "BOOKMARK"}\n')
+                self.wfile.flush()
             while True:
                 try:
                     ev = events.get(timeout=0.2)
@@ -146,7 +180,25 @@ class _Handler(BaseHTTPRequestHandler):
                     self.wfile.write(b"\n")
                     self.wfile.flush()
                     continue
-                line = json.dumps({"type": ev.type, "object": ev.obj}) + "\n"
+                key = key_of(ev.obj)
+                etype = ev.type
+                if in_scope(ev.obj):
+                    if etype == "DELETED":
+                        sent.discard(key)
+                    else:
+                        # scope ENTRY (e.g. relabeled into the selector)
+                        # must read as ADDED to a scoped watcher
+                        if key not in sent:
+                            etype = "ADDED"
+                        sent.add(key)
+                elif key in sent:
+                    # scope EXIT: to this watcher the object is gone —
+                    # k8s scoped watches emit DELETED here, not silence
+                    sent.discard(key)
+                    etype = "DELETED"
+                else:
+                    continue  # never in scope for this stream
+                line = json.dumps({"type": etype, "object": ev.obj}) + "\n"
                 self.wfile.write(line.encode())
                 self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError, OSError):
