@@ -10,9 +10,13 @@ value = end-to-end wall-clock of a full gang-admission batch (host pack +
 device scoring + greedy placement + fetch) on the resolved JAX platform (the
 real TPU chip under the driver; CPU when the TPU is unreachable after
 retries). vs_baseline = speedup over the reference-equivalent serial
-PreFilter loop (findMaxPG + per-node cluster scan per pod, measured on a pod
-sample and scaled linearly — the reference's loop is O(pods) serial,
-reference pkg/scheduler/core/core.go:595-632,701-739).
+PreFilter loop (findMaxPG + per-node cluster scan per pod, reference
+pkg/scheduler/core/core.go:595-632,701-739), measured as a compiled C++
+full-admission mirror of its map-based scan (native/serial_baseline.cpp,
+``serial_native_map_s``); the sampled-and-scaled Python stand-in is the
+fallback denominator only when that binary is unavailable.
+``detail.vs_baseline_denominator`` records which one was used — see
+BASELINE.md for the full bracket.
 
 Run from the repo root (do NOT set PYTHONPATH: it breaks the axon TPU
 plugin; see .claude/skills/verify/SKILL.md).
@@ -168,6 +172,60 @@ def bench_oracle(nodes, groups, platform):
     }
 
 
+def bench_serial_native():
+    """The reference's serial hot loop in compiled C++ (native/
+    serial_baseline.cpp) — the defensible vs_baseline denominator
+    (VERDICT r2 weak #3: a Python stand-in understates a compiled Go loop).
+
+    Returns the parsed JSON dict, or None if the binary is missing and
+    cannot be built. Two variants bracket the reference:
+    ``serial_native_map_s`` mirrors the Go code's per-node string-keyed
+    resource maps (the faithful model; vs_baseline uses it);
+    ``serial_native_array_s`` is an idealized dense-lane serial rewrite —
+    reported for honesty, it is NOT the reference's data layout (it is this
+    repo's oracle design minus the batching)."""
+    import json as _json
+    import os
+    import subprocess
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    binary = os.path.join(root, "native", "serial_baseline")
+    if not os.path.exists(binary):
+        try:
+            subprocess.run(
+                ["make", "-C", os.path.join(root, "native"), "serial_baseline"],
+                capture_output=True,
+                timeout=120,
+                check=True,
+            )
+        except Exception as e:
+            print(f"native serial baseline build failed: {e!r}", file=sys.stderr)
+            return None
+    try:
+        r = subprocess.run(
+            [binary, str(NUM_NODES), str(NUM_GROUPS), str(MEMBERS)],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            check=True,
+        )
+        out = _json.loads(r.stdout.strip().splitlines()[-1])
+        # a stale binary from another revision must not crash the JSON
+        # contract or silently misdefine the denominator
+        if not isinstance(out, dict) or not isinstance(
+            out.get("serial_native_map_s"), (int, float)
+        ) or not isinstance(out.get("serial_native_array_s"), (int, float)):
+            print(
+                f"native serial baseline output unusable: {out!r:.200}",
+                file=sys.stderr,
+            )
+            return None
+        return out
+    except Exception as e:
+        print(f"native serial baseline run failed: {e!r}", file=sys.stderr)
+        return None
+
+
 def bench_serial(nodes, groups):
     """Reference-equivalent serial PreFilter loop cost, per pod: findMaxPG
     over all groups + running cluster-sum scan over all nodes."""
@@ -215,6 +273,7 @@ def main():
         nodes, groups = build_inputs()
         oracle = bench_oracle(nodes, groups, platform)
         serial = bench_serial(nodes, groups)
+        native = bench_serial_native()
     except Exception as e:  # noqa: BLE001 — the JSON line must still go out
         import traceback
 
@@ -232,7 +291,16 @@ def main():
 
     total_pods = NUM_GROUPS * MEMBERS
     scored_per_sec = total_pods * NUM_NODES / max(oracle["device_s"], 1e-9)
-    vs_baseline = serial["est_total_s"] / max(oracle["total_s"], 1e-9)
+    # Denominator of record: the NATIVE serial loop (C++ mirror of the
+    # reference's map-based per-pod scan, a full 10k-pod admission with the
+    # cluster filling), falling back to the Python stand-in estimate only
+    # when the native binary is unavailable.
+    if native is not None:
+        vs_baseline = native["serial_native_map_s"] / max(
+            oracle["total_s"], 1e-9
+        )
+    else:
+        vs_baseline = serial["est_total_s"] / max(oracle["total_s"], 1e-9)
 
     detail = {
         "pods_x_nodes_scored_per_sec": round(scored_per_sec),
@@ -241,10 +309,16 @@ def main():
         "steady_batch_s": round(oracle["steady_batch_s"], 4),
         "gangs_placed": oracle["gangs_placed"],
         "assignment_path": oracle["assignment_path"],
-        "serial_per_pod_s": round(serial["per_pod_s"], 6),
-        "serial_est_total_s": round(serial["est_total_s"], 2),
+        "serial_python_per_pod_s": round(serial["per_pod_s"], 6),
+        "serial_python_est_total_s": round(serial["est_total_s"], 2),
         "platform": platform,
     }
+    if native is not None:
+        detail["serial_native_map_s"] = native["serial_native_map_s"]
+        detail["serial_native_array_s"] = native["serial_native_array_s"]
+        detail["vs_baseline_denominator"] = "serial_native_map_s"
+    else:
+        detail["vs_baseline_denominator"] = "serial_python_est_total_s"
     if backend_err is not None:
         detail["backend_init_error"] = backend_err
     emit(round(oracle["total_s"], 4), round(vs_baseline, 1), detail)

@@ -1,0 +1,214 @@
+// serial_baseline: the reference's serial per-pod PreFilter hot loop in
+// C++, timed over a full 10k-pod admission — the defensible denominator for
+// bench.py's vs_baseline (a Python stand-in plausibly understates a
+// compiled Go loop by 10-50x).
+//
+// Models reference pkg/scheduler/core/core.go per scheduled pod:
+//   1. findMaxPG          O(groups)  progress argmax        (core.go:701-739)
+//   2. cluster feasibility O(nodes)  running left-resource sum with early
+//                                    exit vs the gang's pre-allocation
+//                                    (compareClusterResourceAndRequire,
+//                                     core.go:595-632, getPreAllocatedResource
+//                                     :774-793)
+//   3. node selection      O(nodes)  first node whose leftover fits one
+//                                    member (singleNodeResource +
+//                                    compareResourceAndRequire, :634-699),
+//                                    then commit the pod there
+// The cluster FILLS as the loop runs, so scan depth grows exactly as it
+// would for the reference scheduling the same workload serially.
+//
+// Two variants bracket the reference's cost:
+//   map:   per-node unordered_map<string,int64> resource lists — the data
+//          layout the Go code actually iterates (singleNodeResource builds
+//          maps per node per pod). bench.py computes vs_baseline against
+//          THIS one: it is the faithful model of the reference.
+//   array: flat int64 lanes — an idealized lower bound no map-based
+//          implementation reaches (it is this repo's oracle data layout,
+//          minus the batching). Reported alongside for honesty.
+//
+// Usage: serial_baseline [nodes] [groups] [members] [lanes]
+// Prints one JSON line.
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+using Clock = std::chrono::steady_clock;
+using Map = std::unordered_map<std::string, int64_t>;
+
+static const char* kLaneNames[] = {"cpu", "memory", "pods",
+                                   "nvidia.com/gpu", "ephemeral-storage"};
+
+struct Workload {
+  int32_t n, g, m, r;
+  std::vector<int64_t> alloc;    // [n][r]
+  std::vector<int64_t> req;      // member request [r]
+  std::vector<int32_t> min_member, scheduled, matched;
+};
+
+static Workload make_workload(int32_t n, int32_t g, int32_t m, int32_t r) {
+  // mirrors bench.py build_inputs: 64-cpu/256Gi/110-pod/8-gpu nodes,
+  // gangs of m members each needing 4 cpu / 8Gi / 1 gpu (+1 pod slot)
+  Workload w{n, g, m, r, {}, {}, {}, {}, {}};
+  const int64_t node_alloc[5] = {64000, 256LL << 30, 110, 8, 1LL << 40};
+  const int64_t member_req[5] = {4000, 8LL << 30, 1, 1, 0};
+  w.alloc.resize(size_t(n) * r);
+  for (int32_t i = 0; i < n; ++i)
+    for (int32_t l = 0; l < r; ++l) w.alloc[size_t(i) * r + l] = node_alloc[l];
+  w.req.assign(member_req, member_req + r);
+  w.min_member.assign(g, m);
+  w.scheduled.assign(g, 0);
+  w.matched.assign(g, 0);
+  return w;
+}
+
+// ---------------------------------------------------------------- array --
+
+static double run_array(Workload w) {
+  const int32_t n = w.n, g = w.g, r = w.r;
+  std::vector<int64_t> used(size_t(n) * r, 0);
+  std::vector<int64_t> prealloc(r), running(r), left(r);
+  const int64_t total_pods = int64_t(g) * w.m;
+  auto t0 = Clock::now();
+  for (int64_t pod = 0; pod < total_pods; ++pod) {
+    // 1. findMaxPG
+    int32_t best = 0, best_p = -1;
+    for (int32_t gi = 0; gi < g; ++gi) {
+      int32_t p =
+          int32_t((int64_t(w.matched[gi] + w.scheduled[gi]) * 1000) /
+                  w.min_member[gi]);
+      if (w.scheduled[gi] < w.min_member[gi] && p > best_p) {
+        best_p = p;
+        best = gi;
+      }
+    }
+    // gang to place this pod: round-robin through groups in order (the
+    // workload arrives gang by gang); max-progress group gets percent=1.0
+    int32_t gi = int32_t(pod / w.m);
+    int32_t remaining = w.min_member[gi] - w.scheduled[gi];
+    for (int32_t l = 0; l < r; ++l) prealloc[l] = w.req[l] * remaining;
+    prealloc[2] = remaining;  // pods lane: one slot per member
+
+    // 2. running cluster sum with early exit
+    std::memset(running.data(), 0, sizeof(int64_t) * r);
+    bool feasible = false;
+    for (int32_t i = 0; i < n && !feasible; ++i) {
+      const int64_t* a = &w.alloc[size_t(i) * r];
+      const int64_t* u = &used[size_t(i) * r];
+      feasible = true;
+      for (int32_t l = 0; l < r; ++l) {
+        int64_t lv = a[l] - u[l];
+        running[l] += lv > 0 ? lv : 0;
+        if (running[l] < prealloc[l]) feasible = false;
+      }
+    }
+    (void)best;
+    if (!feasible) continue;  // denied (never hits in this workload)
+
+    // 3. first node fitting one member; commit
+    for (int32_t i = 0; i < n; ++i) {
+      int64_t* u = &used[size_t(i) * r];
+      const int64_t* a = &w.alloc[size_t(i) * r];
+      bool fits = true;
+      for (int32_t l = 0; l < r; ++l)
+        if (a[l] - u[l] < w.req[l]) fits = false;
+      if (fits) {
+        // req[] already carries the member's pod slot in the pods lane
+        for (int32_t l = 0; l < r; ++l) u[l] += w.req[l];
+        w.scheduled[gi]++;
+        break;
+      }
+    }
+  }
+  return std::chrono::duration<double>(Clock::now() - t0).count();
+}
+
+// ------------------------------------------------------------------ map --
+
+static double run_map(Workload w) {
+  const int32_t n = w.n, g = w.g, r = w.r;
+  std::vector<Map> used(n);
+  Map member_req;
+  for (int32_t l = 0; l < r; ++l) member_req[kLaneNames[l]] = w.req[l];
+  const int64_t total_pods = int64_t(g) * w.m;
+  auto t0 = Clock::now();
+  for (int64_t pod = 0; pod < total_pods; ++pod) {
+    int32_t best = 0, best_p = -1;
+    for (int32_t gi = 0; gi < g; ++gi) {
+      int32_t p =
+          int32_t((int64_t(w.matched[gi] + w.scheduled[gi]) * 1000) /
+                  w.min_member[gi]);
+      if (w.scheduled[gi] < w.min_member[gi] && p > best_p) {
+        best_p = p;
+        best = gi;
+      }
+    }
+    (void)best;
+    int32_t gi = int32_t(pod / w.m);
+    int32_t remaining = w.min_member[gi] - w.scheduled[gi];
+    Map prealloc;
+    for (auto& kv : member_req) prealloc[kv.first] = kv.second * remaining;
+    prealloc["pods"] = remaining;
+
+    // singleNodeResource builds a fresh map per node per pod in the
+    // reference; mirror that allocation pattern
+    Map running;
+    bool feasible = false;
+    for (int32_t i = 0; i < n && !feasible; ++i) {
+      Map left;
+      for (int32_t l = 0; l < r; ++l) {
+        int64_t lv = w.alloc[size_t(i) * r + l];
+        auto it = used[i].find(kLaneNames[l]);
+        if (it != used[i].end()) lv -= it->second;
+        left[kLaneNames[l]] = lv > 0 ? lv : 0;
+      }
+      for (auto& kv : left) running[kv.first] += kv.second;
+      feasible = true;
+      for (auto& kv : prealloc)
+        if (running[kv.first] < kv.second) feasible = false;
+    }
+    if (!feasible) continue;
+
+    for (int32_t i = 0; i < n; ++i) {
+      bool fits = true;
+      for (int32_t l = 0; l < r; ++l) {
+        int64_t lv = w.alloc[size_t(i) * r + l];
+        auto it = used[i].find(kLaneNames[l]);
+        if (it != used[i].end()) lv -= it->second;
+        if (lv < w.req[l]) fits = false;
+      }
+      if (fits) {
+        // req[] already carries the member's pod slot in the pods lane
+        for (int32_t l = 0; l < r; ++l)
+          used[i][kLaneNames[l]] += w.req[l];
+        w.scheduled[gi]++;
+        break;
+      }
+    }
+  }
+  return std::chrono::duration<double>(Clock::now() - t0).count();
+}
+
+int main(int argc, char** argv) {
+  int32_t n = argc > 1 ? std::atoi(argv[1]) : 5000;
+  int32_t g = argc > 2 ? std::atoi(argv[2]) : 1000;
+  int32_t m = argc > 3 ? std::atoi(argv[3]) : 10;
+  int32_t r = argc > 4 ? std::atoi(argv[4]) : 5;
+  if (r > 5) r = 5;
+  Workload w = make_workload(n, g, m, r);
+  double t_array = run_array(w);
+  double t_map = run_map(w);
+  int64_t pods = int64_t(g) * m;
+  std::printf(
+      "{\"serial_native_array_s\": %.4f, \"serial_native_map_s\": %.4f, "
+      "\"pods\": %lld, \"nodes\": %d, \"per_pod_array_us\": %.2f, "
+      "\"per_pod_map_us\": %.2f}\n",
+      t_array, t_map, (long long)pods, n, t_array / pods * 1e6,
+      t_map / pods * 1e6);
+  return 0;
+}
