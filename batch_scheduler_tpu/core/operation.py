@@ -248,18 +248,30 @@ class ScheduleOperation:
                 return node
         return None
 
-    def on_assume(self, pod: Pod, node_name: str) -> None:
-        """Called after the framework assumes a pod onto a node. A plan-
-        covered gang member's capacity charge is exactly what the batch
-        already planned — credit the version bump instead of invalidating.
-        Everything else (non-gang pods, planless gangs) dirties the batch."""
-        if self.scorer_kind == "oracle" and self.oracle is not None:
+    def on_assume(
+        self, pod: Pod, node_name: str, from_plan: bool = False
+    ) -> None:
+        """Called after the framework assumes a pod onto a node. A gang
+        member SEATED THROUGH the plan (``from_plan``, the scheduler's O(1)
+        hint path) whose plan was stamped by the CURRENT batch is exactly
+        the capacity charge that batch already accounted — credit the
+        version bump instead of invalidating. Everything else — non-gang
+        pods, planless gangs, scan fallbacks (even onto a planned node:
+        the slot bookkeeping may not match), and placements against a
+        superseded batch's plan — dirties the batch, since its per-node
+        rows now diverge from reality (ADVICE r2)."""
+        if self.scorer_kind == "oracle" and self.oracle is not None and from_plan:
             pg_name, ok = pod_group_name(pod)
             if ok:
                 pgs = self.status_cache.get(
                     f"{pod.metadata.namespace}/{pg_name}"
                 )
-                if pgs is not None and pgs.placement_plan is not None:
+                if (
+                    pgs is not None
+                    and pgs.placement_plan is not None
+                    and node_name in pgs.placement_plan
+                    and pgs.plan_batch_seq == self.oracle.batches_run
+                ):
                     self.oracle.credit_expected_change(1)
                     return
         self.mark_dirty()

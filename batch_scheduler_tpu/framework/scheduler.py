@@ -223,9 +223,12 @@ class Scheduler:
         if self.plugin is not None:
             # Let the plugin decide whether this assume invalidates its
             # batch (plan-covered gang members are pre-accounted).
+            # from_plan distinguishes a plan-seated pod from a scan
+            # fallback that happened to land on a planned node — only the
+            # former matches the batch's accounting (ADVICE r2).
             on_assume = getattr(self.plugin, "on_assume", None)
             if on_assume is not None:
-                on_assume(pod, node_name)
+                on_assume(pod, node_name, from_plan)
             else:
                 self.plugin.mark_dirty()
 

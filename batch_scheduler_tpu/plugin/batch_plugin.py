@@ -135,8 +135,8 @@ class BatchSchedulingPlugin:
         pod, letting the framework skip the full node scan."""
         return self.operation.suggested_node(pod)
 
-    def on_assume(self, pod: Pod, node_name: str) -> None:
-        self.operation.on_assume(pod, node_name)
+    def on_assume(self, pod: Pod, node_name: str, from_plan: bool = False) -> None:
+        self.operation.on_assume(pod, node_name, from_plan)
 
     # ------------------------------------------------------------------
     # gang release choreography (the batchScheduler interface,

@@ -463,9 +463,19 @@ def main():
     for c in [int(x) for x in args.configs.split(",")]:
         try:
             CONFIGS[c]()
-        except AssertionError as e:
-            failures.append((c, str(e)))
-            print(f"# config {c} FAILED: {e}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — record ANY failure and keep
+            # going: a crash in one config (OverflowError, timeout, ...)
+            # must not lose the remaining configs' numbers or the
+            # exits-nonzero contract (ADVICE r2)
+            failures.append((c, f"{type(e).__name__}: {e}"))
+            if not isinstance(e, AssertionError):
+                import traceback
+
+                traceback.print_exc(file=sys.stderr)
+            print(
+                f"# config {c} FAILED: {type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
     if failures:
         sys.exit(1)
 
