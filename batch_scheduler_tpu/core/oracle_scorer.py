@@ -97,9 +97,15 @@ class _BatchState:
 class OracleScorer:
     """Caches one batch of oracle results; invalidated by ``mark_dirty``."""
 
-    def __init__(self, min_batch_interval: float = 0.0):
+    def __init__(self, min_batch_interval: float = 0.0, scan_mesh=None):
         self._dirty = True
         self._state: Optional[_BatchState] = None
+        # Multi-chip layout: when set (parallel.global_mesh() on a >1-chip
+        # deployment), batches shard the O(G*N*R) scoring over the mesh and
+        # replicate the sequential gang scan's inputs (the measured layout
+        # choice — ops.oracle.schedule_batch's scan_mesh, README scaling
+        # note, benchmarks/sharding_scaling.py). None = single device.
+        self.scan_mesh = scan_mesh
         self._refresh_lock = threading.Lock()
         self._cluster_version = None
         self.batches_run = 0
@@ -192,7 +198,7 @@ class OracleScorer:
         host result dict and a lazy (G,N)-row fetcher. RemoteScorer swaps
         this for the sidecar round-trip."""
         host, device_result = execute_batch_host(
-            snap.device_args(), snap.progress_args()
+            snap.device_args(), snap.progress_args(), scan_mesh=self.scan_mesh
         )
 
         def row_fetcher(kind: str, g: int) -> np.ndarray:

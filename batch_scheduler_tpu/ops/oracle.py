@@ -299,10 +299,10 @@ def assign_gangs(left0, group_req, remaining, fit_mask, order):
 ASSIGNMENT_TOP_K = 128
 
 
-@partial(jax.jit, static_argnames=("use_pallas", "top_k"))
+@partial(jax.jit, static_argnames=("use_pallas", "top_k", "scan_mesh"))
 def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
                    group_valid, order, use_pallas: bool = False,
-                   top_k: int = ASSIGNMENT_TOP_K):
+                   top_k: int = ASSIGNMENT_TOP_K, scan_mesh=None):
     """Fused full-batch oracle: leftover -> capacity -> feasibility -> scores
     -> greedy gang assignment, one XLA computation.
 
@@ -328,15 +328,35 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
     cap = group_capacity(left, group_req, fit_mask)
     feasible = gang_feasible(cap, remaining, group_valid)
     scores = score_nodes(cap)
+    if scan_mesh is not None:
+        # GSPMD layout for multi-chip batches: the O(G*N*R) scoring above
+        # runs sharded, but the greedy gang scan is SEQUENTIAL over groups
+        # with a carried [N,R] leftover — partitioned inputs drag
+        # collectives through every one of its G steps (measured 6x SLOWER
+        # than one device on an 8-way mesh; benchmarks/sharding_scaling.py).
+        # Replicating its inputs costs a one-time handful of collectives
+        # (5 in the measured module, SHARDING_r03.json), after which every
+        # device runs the scan locally with zero per-step traffic.
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        repl = NamedSharding(scan_mesh, PartitionSpec())
+        scan_left, scan_gr, scan_rem, scan_fm = (
+            jax.lax.with_sharding_constraint(x, repl)
+            for x in (left, group_req, remaining, fit_mask)
+        )
+    else:
+        scan_left, scan_gr, scan_rem, scan_fm = (
+            left, group_req, remaining, fit_mask,
+        )
     if use_pallas and fit_mask.shape[0] == 1:
         from .pallas_assign import assign_gangs_pallas
 
         assignment, placed, left_after = assign_gangs_pallas(
-            left, group_req, remaining, fit_mask, order
+            scan_left, scan_gr, scan_rem, scan_fm, order
         )
     else:
         assignment, placed, left_after = assign_gangs(
-            left, group_req, remaining, fit_mask, order
+            scan_left, scan_gr, scan_rem, scan_fm, order
         )
     placed = placed & group_valid
     # top_k: static width of the compact assignment readback. The default
@@ -384,12 +404,15 @@ def batch_top_k(n_bucket: int, remaining_max: int) -> int:
     )
 
 
-@partial(jax.jit, static_argnames=("use_pallas", "pack_assignment", "top_k"))
+@partial(
+    jax.jit,
+    static_argnames=("use_pallas", "pack_assignment", "top_k", "scan_mesh"),
+)
 def _batch_blob(alloc_lanes, requested, group_req, remaining, fit_mask,
                 group_valid, order, min_member, scheduled, matched,
                 ineligible, creation_rank, use_pallas: bool = False,
                 pack_assignment: bool = True,
-                top_k: int = ASSIGNMENT_TOP_K):
+                top_k: int = ASSIGNMENT_TOP_K, scan_mesh=None):
     """One device computation for a whole control-plane batch: the fused
     oracle + findMaxPG, with every O(G) host-needed output concatenated into
     a single int32 blob. On a high-latency host<->device link (the axon
@@ -407,7 +430,7 @@ def _batch_blob(alloc_lanes, requested, group_req, remaining, fit_mask,
     """
     out = schedule_batch(alloc_lanes, requested, group_req, remaining,
                          fit_mask, group_valid, order, use_pallas=use_pallas,
-                         top_k=top_k)
+                         top_k=top_k, scan_mesh=scan_mesh)
     best, exists, progress = find_max_group(min_member, scheduled, matched,
                                             ineligible, creation_rank)
     if pack_assignment:
@@ -429,7 +452,7 @@ def _batch_blob(alloc_lanes, requested, group_req, remaining, fit_mask,
     return blob, out
 
 
-def execute_batch_host(batch_args, progress_args):
+def execute_batch_host(batch_args, progress_args, scan_mesh=None):
     """Run one fused batch + max-progress selection and fetch ONLY the O(G)
     host vectors (as ONE packed transfer — see _batch_blob); the (G,N)
     tensors come back as device handles for lazy row reads. The single
@@ -460,7 +483,7 @@ def execute_batch_host(batch_args, progress_args):
     def run(up: bool):
         blob, out = _batch_blob(
             *batch_args, *progress_args, use_pallas=up, pack_assignment=pack,
-            top_k=top_k,
+            top_k=top_k, scan_mesh=scan_mesh,
         )
         # device_get is the sync point: a device-side kernel failure
         # surfaces here, inside the caller's try

@@ -103,8 +103,20 @@ def shard_snapshot_args(mesh: Mesh, args: tuple) -> tuple:
     )
 
 
-def sharded_schedule_batch(mesh: Mesh, args: tuple):
+def sharded_schedule_batch(mesh: Mesh, args: tuple, replicated_scan: bool = True):
     """One fused oracle batch with inputs sharded over the mesh; XLA/GSPMD
-    partitions the kernels and inserts the cross-chip collectives."""
+    partitions the kernels and inserts the cross-chip collectives.
+
+    ``replicated_scan`` (default, the production layout): the O(G·N·R)
+    scoring runs sharded, then the sequential gang scan's inputs are
+    replicated up front so its G steps run collective-free on every chip —
+    the measured compiled module carries 5 one-time collectives total,
+    versus ~50 collective sites INSIDE the scan loop (executed per step)
+    when the scan state is partitioned, which ran 6x slower than a single
+    device on the 8-way virtual mesh (benchmarks/sharding_scaling.py,
+    SHARDING_r03.json; virtual-mesh caveats in the README scaling note).
+    Pass False to measure the naive fully-partitioned layout."""
     sharded = shard_snapshot_args(mesh, args)
-    return okern.schedule_batch(*sharded)
+    return okern.schedule_batch(
+        *sharded, scan_mesh=mesh if replicated_scan else None
+    )

@@ -73,7 +73,14 @@ class _Handler(socketserver.BaseRequestHandler):
                 elif msg_type == proto.MsgType.SCHEDULE_REQ:
                     req = proto.unpack_schedule_request(payload)
                     args, progress_args, (n, g) = _pad_request(req)
-                    host, last_batch = execute_batch_host(args, progress_args)
+                    mesh = self.server.scan_mesh
+                    if mesh is not None:
+                        from ..parallel.mesh import shard_snapshot_args
+
+                        args = shard_snapshot_args(mesh, args)
+                    host, last_batch = execute_batch_host(
+                        args, progress_args, scan_mesh=mesh
+                    )
                     last_counts = (n, g)
                     batch_seq += 1
                     resp = proto.ScheduleResponse(
@@ -125,6 +132,14 @@ class OracleServer(socketserver.ThreadingTCPServer):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         super().__init__((host, port), _Handler)
+        # Multi-chip deployments (v5e-4 DP config of BASELINE, or a full
+        # slice after init_distributed) shard batches over the global mesh
+        # with the replicated-scan layout; one chip stays single-device.
+        import jax
+
+        from ..parallel.distributed import global_mesh
+
+        self.scan_mesh = global_mesh() if len(jax.devices()) > 1 else None
 
     @property
     def address(self):
