@@ -307,20 +307,34 @@ class Scheduler:
         return best_name, False
 
     def _try_preempt(self, pod: Pod) -> bool:
-        """Victim search + eviction for an unschedulable pod.
+        """Victim search + eviction for an unschedulable pod — the role
+        upstream kube-scheduler's PostFilter (preemption) plays for the
+        reference, whose policy surface is the PreFilterExtensions hooks
+        (reference core.go:203-260, batchscheduler.go:116-144).
 
-        Per node: dry-run removing strictly-lower-priority pods (tightest
-        legality via the plugin's preempt_remove_pod policy — online/offline
-        rules, Scheduled/Running gangs protected, no self-preemption,
-        reference core.go:203-260) until the pod would fit. On the first
-        node where that works, evict the chosen victims: waiting (assumed)
-        pods are rejected back to the queue, bound pods are deleted. Returns
-        True if victims were evicted."""
+        Per candidate node (skipping nodes whose free resources ALREADY
+        satisfy the pod — those were rejected for non-resource reasons and
+        eviction there frees nothing): dry-run removing strictly-lower-
+        priority pods (tightest legality via the plugin's
+        preempt_remove_pod policy — online/offline rules, Scheduled/Running
+        gangs protected, no self-preemption), lowest priority first, until
+        the pod would fit, then reprieve victims that turned out
+        unnecessary (highest priority first). Then — kube-scheduler's
+        pickOneNodeForPreemption precedence, not first-fit — pick the node
+        with the lowest highest-victim priority, then the smallest victim
+        priority sum, then the fewest victims, then node order. Evict only
+        on the chosen node: a waiting (permitted-but-unbound) victim has
+        its Permit wait rejected so its assumed capacity releases, and
+        every victim is then deleted (k8s eviction semantics — its gang's
+        remaining members retry from Permit/backoff and the controller
+        demotes the gang). Returns True if victims were evicted."""
         if self.plugin is None:
             return False
         require = dict(pod.resource_require())
         require["pods"] = require.get("pods", 0) + 1
 
+        best_victims: Optional[List[Pod]] = None
+        best_key = None
         for node in self.cluster.list_nodes():
             if node.spec.unschedulable or not rmath.check_fit(pod, node):
                 continue
@@ -331,12 +345,15 @@ class Scheduler:
             left = rmath.single_node_left(
                 node, self.cluster.node_requested(node.metadata.name), None
             )
+            if rmath.resource_satisfied(left, require):
+                continue  # not resource-blocked here; eviction is waste
             victims: List[Pod] = []
             freed: dict = {}
             candidates = sorted(
                 self.cluster.pods_on(node.metadata.name),
                 key=lambda p: p.spec.priority,
             )
+            satisfied = False
             for victim in candidates:
                 if victim.spec.priority >= pod.spec.priority:
                     break  # sorted ascending: no lower-priority victims left
@@ -351,9 +368,38 @@ class Scheduler:
                 if rmath.resource_satisfied(
                     rmath.add_resources(left, freed), require
                 ):
-                    self._evict(victims)
-                    return True
-        return False
+                    satisfied = True
+                    break
+            if not satisfied:
+                continue
+            # reprieve pass (upstream semantics): the greedy lowest-first
+            # sweep can include victims a later, bigger victim made
+            # unnecessary — give back any (highest priority first) whose
+            # removal still leaves the pod fitting
+            for victim in sorted(
+                victims, key=lambda p: p.spec.priority, reverse=True
+            ):
+                vreq = dict(victim.resource_require())
+                vreq["pods"] = vreq.get("pods", 0) + 1
+                without = rmath.add_resources(
+                    freed, {k: -v for k, v in vreq.items()}
+                )
+                if rmath.resource_satisfied(
+                    rmath.add_resources(left, without), require
+                ):
+                    victims.remove(victim)
+                    freed = without
+            key = (
+                max(v.spec.priority for v in victims),
+                sum(v.spec.priority for v in victims),
+                len(victims),
+            )
+            if best_key is None or key < best_key:
+                best_key, best_victims = key, list(victims)
+        if best_victims is None:
+            return False
+        self._evict(best_victims)
+        return True
 
     def _evict(self, victims: List[Pod]) -> None:
         for victim in victims:
