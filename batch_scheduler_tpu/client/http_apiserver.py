@@ -27,6 +27,7 @@ from .apiserver import (
     NotFoundError,
     WatchEvent,
 )
+from ..utils.throttle import TokenBucket
 from .http_gateway import CRD_PATH, KIND_ROUTES
 from ..api.types import to_dict
 
@@ -37,10 +38,23 @@ class HTTPAPIServer:
     """APIServer-interface client over HTTP (one connection per request;
     watches hold a streaming connection + reader thread per subscription)."""
 
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout: float = 10.0,
+        qps: float = 10.0,
+        burst: int = 20,
+    ):
         self.host = host
         self.port = port
         self.timeout = timeout
+        # Client-side flow control at the reference's defaults (QPS=10 /
+        # Burst=20, batchscheduler.go:391-392): every request verb takes a
+        # token first, so the controller's resync across all groups cannot
+        # stampede a real API server. Watch streams pace themselves via
+        # the reflector's reconnect backoff instead. qps<=0 disables.
+        self._limiter = TokenBucket(qps, burst)
         # id(queue) -> {"conn", "resp", "thread", "stop"} (see watch())
         self._watches: Dict[int, dict] = {}
         self._lock = threading.Lock()
@@ -48,6 +62,7 @@ class HTTPAPIServer:
     # -- request plumbing --------------------------------------------------
 
     def _request(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        self._limiter.acquire()
         conn = http.client.HTTPConnection(self.host, self.port, timeout=self.timeout)
         try:
             payload = None if body is None else json.dumps(body)
