@@ -8,15 +8,22 @@ scan into ONE ``pallas_call``:
 - the leftover lanes live in a VMEM scratch buffer for the entire sweep
   (transposed to ``[R, N]`` so the big node axis sits on the 128-wide lane
   dimension — ``[N, R]`` would use 5 of 128 lanes);
-- the scan order and per-group remaining counts are scalar-prefetched to
-  SMEM, and drive the *index maps*: step ``s`` DMAs exactly group
-  ``order[s]``'s request row in and its take row out;
+- groups are pre-permuted into scan order (an XLA gather outside the
+  kernel), so grid step ``s`` handles the contiguous chunk
+  ``[s*CHUNK, (s+1)*CHUNK)`` with an UNROLLED inner loop — amortizing the
+  per-step grid/DMA overhead that dominates at one group per step (the
+  per-step compute is ~40k int32 elements; measured ~65us/step fixed cost)
+  — and writes one contiguous ``(CHUNK, N)`` takes block;
+- per-group remaining counts are scalar-prefetched to SMEM; outputs are
+  un-permuted back to group order after the call (``argsort(order)``);
 - per-step selection is the same sortless histogram threshold as the scan
   path (see assign_gangs' docstring) — the two implementations are asserted
-  equivalent in tests/test_pallas.py.
+  equivalent in tests/test_pallas.py and on hardware by
+  benchmarks/tpu_smoke.py.
 
 Used for the single-device batch when the fit mask is the broadcast ``[1,N]``
-fast path (no selectors/taints — the common case and the bench shape); the
+fast path (no selectors/taints — the common case and the bench shape); a
+group bucket that doesn't divide by CHUNK is padded with inert rows. The
 ``lax.scan`` path remains the general fallback and the GSPMD-sharded path
 (a pallas_call is a black box to the partitioner).
 """
@@ -32,10 +39,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .oracle import _BIG, _exact_floordiv, _select_best_fit
 
-__all__ = ["assign_gangs_pallas"]
+__all__ = ["assign_gangs_pallas", "CHUNK"]
+
+# Groups per grid step. 8 matches the int32 sublane tile (the (CHUNK, N)
+# output block is exactly one tile row-group) and amortizes the fixed
+# per-step cost ~8x; group counts that don't divide are padded with inert
+# rows (see assign_gangs_pallas).
+CHUNK = 8
 
 
-def _kernel(order_ref, remaining_ref, left0_ref, group_req_ref, mask_ref,
+def _kernel(remaining_ref, left0_ref, group_req_ref, mask_ref,
             takes_ref, placed_ref, left_after_ref, left_scratch):
     s = pl.program_id(0)
     num_steps = pl.num_programs(0)
@@ -44,28 +57,33 @@ def _kernel(order_ref, remaining_ref, left0_ref, group_req_ref, mask_ref,
     def _():
         left_scratch[:] = left0_ref[:]
 
-    g = order_ref[s]
-    need = remaining_ref[g]
+    mask = mask_ref[:].astype(jnp.int32)
+    placed_rows = []
+    # groups arrive pre-permuted into scan order: this step's chunk is rows
+    # [s*CHUNK, (s+1)*CHUNK) of the sorted arrays; j is static (unrolled)
+    for j in range(CHUNK):
+        need = remaining_ref[s * CHUNK + j]
+        left = left_scratch[:]  # [R, N]
+        req = group_req_ref[j]  # [R] (this chunk's block, static row)
+        req_col = req.reshape(-1, 1)  # [R, 1]
 
-    left = left_scratch[:]  # [R, N]
-    req = group_req_ref[0]  # [1, R] (this step's group row via index map)
-    req_col = req.reshape(-1, 1)  # [R, 1]
+        # ops.oracle._member_capacity in the kernel's transposed [R, N]
+        # layout (lanes on axis 0 so the node axis rides the 128-wide lane
+        # dimension)
+        safe_req = jnp.clip(req_col, 1, _BIG)
+        lpos = jnp.clip(left, 0, _BIG)
+        per_lane = jnp.where(req_col > 0, _exact_floordiv(lpos, safe_req), _BIG)
+        cap = jnp.min(per_lane, axis=0, keepdims=True)  # [1, N]
+        cap = cap * mask
 
-    # ops.oracle._member_capacity in the kernel's transposed [R, N] layout
-    # (lanes on axis 0 so the node axis rides the 128-wide lane dimension)
-    safe_req = jnp.clip(req_col, 1, _BIG)
-    lpos = jnp.clip(left, 0, _BIG)
-    per_lane = jnp.where(req_col > 0, _exact_floordiv(lpos, safe_req), _BIG)
-    cap = jnp.min(per_lane, axis=0, keepdims=True)  # [1, N]
-    cap = cap * mask_ref[:].astype(jnp.int32)
+        capc = jnp.minimum(cap, need)
+        take, _feasible = _select_best_fit(cap, capc, need)
 
-    capc = jnp.minimum(cap, need)
-    take, _feasible = _select_best_fit(cap, capc, need)
-    feasible = _feasible.astype(jnp.int32)
+        left_scratch[:] = left - take * req_col
+        takes_ref[j] = take[0]
+        placed_rows.append(_feasible.astype(jnp.int32))
 
-    left_scratch[:] = left - take * req_col
-    takes_ref[0] = take
-    placed_ref[:] = jnp.full((1, 1, 1), feasible, jnp.int32)
+    placed_ref[:] = jnp.stack(placed_rows).reshape(CHUNK, 1)
 
     @pl.when(s == num_steps - 1)
     def _():
@@ -88,48 +106,52 @@ def assign_gangs_pallas(left0, group_req, remaining, fit_mask, order,
     n, r = left0.shape
     g = group_req.shape[0]
 
-    # Per-group arrays carry their blocked axis as a leading rank-3 dim so the
-    # Mosaic (sublane, lane) tiling constraint falls on the trailing (1, r) /
-    # (1, n) dims, which equal the array dims — a (1, r) block on a rank-2
-    # [G, r] array is rejected by the TPU lowering (sublane block 1 vs G).
+    # pre-permute groups into scan order so each grid step reads/writes
+    # contiguous chunk blocks; outputs are scattered back below. Pad the
+    # group axis to a CHUNK multiple — pad rows carry remaining=0, take
+    # nothing, and run AFTER every real group, so the leftover evolution is
+    # untouched (their rows are sliced off below).
+    group_req_sorted = jnp.take(group_req, order, axis=0)
+    remaining_sorted = jnp.take(remaining, order, axis=0)
+    g_pad = -(-g // CHUNK) * CHUNK
+    if g_pad != g:
+        group_req_sorted = jnp.pad(group_req_sorted, ((0, g_pad - g), (0, 0)))
+        remaining_sorted = jnp.pad(remaining_sorted, ((0, g_pad - g),))
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # order, remaining
-        grid=(g,),
+        num_scalar_prefetch=1,  # remaining (sorted)
+        grid=(g_pad // CHUNK,),
         in_specs=[
-            pl.BlockSpec((r, n), lambda s, order, rem: (0, 0)),  # left0^T
-            # step s sees exactly group order[s]'s request row
-            pl.BlockSpec((1, 1, r), lambda s, order, rem: (order[s], 0, 0)),
-            pl.BlockSpec((1, n), lambda s, order, rem: (0, 0)),  # mask
+            pl.BlockSpec((r, n), lambda s, rem: (0, 0)),  # left0^T
+            # step s sees its chunk of the sorted request rows
+            pl.BlockSpec((CHUNK, r), lambda s, rem: (s, 0)),
+            pl.BlockSpec((1, n), lambda s, rem: (0, 0)),  # mask
         ],
         out_specs=[
-            pl.BlockSpec(
-                (1, 1, n), lambda s, order, rem: (order[s], 0, 0)
-            ),  # takes
-            pl.BlockSpec(
-                (1, 1, 1), lambda s, order, rem: (order[s], 0, 0)
-            ),  # placed
-            pl.BlockSpec((r, n), lambda s, order, rem: (0, 0)),  # left_after^T
+            pl.BlockSpec((CHUNK, n), lambda s, rem: (s, 0)),  # takes
+            pl.BlockSpec((CHUNK, 1), lambda s, rem: (s, 0)),  # placed
+            pl.BlockSpec((r, n), lambda s, rem: (0, 0)),  # left_after^T
         ],
         scratch_shapes=[pltpu.VMEM((r, n), jnp.int32)],
     )
-    takes, placed, left_after_t = pl.pallas_call(
+    takes_sorted, placed_sorted, left_after_t = pl.pallas_call(
         _kernel,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((g, 1, n), jnp.int32),
-            jax.ShapeDtypeStruct((g, 1, 1), jnp.int32),
+            jax.ShapeDtypeStruct((g_pad, n), jnp.int32),
+            jax.ShapeDtypeStruct((g_pad, 1), jnp.int32),
             jax.ShapeDtypeStruct((r, n), jnp.int32),
         ],
         interpret=interpret,
     )(
-        order,
-        remaining,
+        remaining_sorted,
         left0.T,
-        group_req.reshape(g, 1, r),
+        group_req_sorted,
         fit_mask.astype(jnp.int32),
     )
-    return (
-        takes.reshape(g, n),
-        placed[:, 0, 0].astype(bool),
-        left_after_t.T,
-    )
+    # scatter back to group order (the scan path's un-permute idiom)
+    takes = jnp.zeros((g, n), jnp.int32).at[order].set(takes_sorted[:g])
+    placed = (
+        jnp.zeros((g,), jnp.int32).at[order].set(placed_sorted[:g, 0])
+    ).astype(bool)
+    return takes, placed, left_after_t.T
