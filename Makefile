@@ -38,6 +38,16 @@ ladder:
 smoke-tpu:
 	$(PY) benchmarks/tpu_smoke.py
 
+# GSPMD layout measurement on the 8-device virtual CPU mesh (collective
+# counts per layout; see README "Measured layout choice")
+sharding:
+	$(PY) benchmarks/sharding_scaling.py
+
+# the reference's serial hot loop in C++ — bench.py's vs_baseline denominator
+serial-baseline:
+	$(MAKE) -C native serial_baseline
+	./native/serial_baseline
+
 # driver-style entry checks: single-chip jit + 8-device sharded dry run.
 # NB: this environment's sitecustomize registers the TPU plugin and overrides
 # the jax_platforms config — env vars alone don't switch to CPU; the config
