@@ -38,8 +38,13 @@ class SimCluster:
         controller_resync_seconds: float = 0.1,
         enabled_points=None,
         min_batch_interval: float = 0.0,
+        api=None,
     ):
-        self.api = APIServer()
+        # ``api``: any APIServer-interface implementation — pass an
+        # HTTPAPIServer to run the WHOLE stack (scheduler, plugin runtime,
+        # controller, informers, kubelet) against a remote k8s-shaped
+        # endpoint instead of the in-memory server
+        self.api = api if api is not None else APIServer()
         self.clientset = Clientset(self.api)
         self.cluster = ClusterState()
 

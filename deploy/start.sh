@@ -6,8 +6,11 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 # sidecar: the TPU oracle service (packed-array protocol, port 9090),
-# warmed so the first scheduling round isn't waiting on a jit compile
-nohup python -m batch_scheduler_tpu serve --port 9090 --warmup > oracle.log 2>&1 &
+# warmed so the first scheduling round isn't waiting on a jit compile;
+# Prometheus /metrics on 9091 (the reference's only observability surface
+# is the embedded kube-scheduler's /metrics — SURVEY.md §5)
+nohup python -m batch_scheduler_tpu serve --port 9090 --warmup \
+  --metrics-port 9091 > oracle.log 2>&1 &
 ORACLE_PID=$!
 trap 'kill "$ORACLE_PID" 2>/dev/null || true' EXIT
 echo "oracle sidecar pid $ORACLE_PID"

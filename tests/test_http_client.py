@@ -291,3 +291,47 @@ def test_watch_scope_transitions_emit_added_and_deleted(remote):
     finally:
         resp.close()
         conn.close()
+
+
+def test_full_stack_schedules_over_http():
+    """The ENTIRE framework — scheduler loop, plugin runtime, controller,
+    informers (reflector watches), sim kubelet — running against the HTTP
+    gateway instead of the in-memory API server: the reference race demo
+    must settle identically over the wire (client-go deployment shape,
+    reference clientset.go:58-97)."""
+    from batch_scheduler_tpu.api.types import PodGroupPhase
+    from batch_scheduler_tpu.sim import SimCluster
+    from batch_scheduler_tpu.sim.scenarios import race_scenario
+
+    backing = APIServer()
+    server = serve_gateway(backing)
+    host, port = server.server_address[:2]
+    # generous flow-control: the point here is correctness over the wire
+    api = HTTPAPIServer(host, port, qps=500.0, burst=200)
+    cluster = SimCluster(scorer="oracle", api=api)
+    nodes, groups, pods_by_group = race_scenario()
+    cluster.add_nodes(nodes)
+    for pg in groups:
+        cluster.create_group(pg)
+    cluster.start()
+    try:
+        for pods in pods_by_group.values():
+            cluster.create_pods(pods)
+        assert cluster.wait_for(
+            lambda: cluster.scheduler.stats["binds"] >= 5, timeout=60.0
+        ), cluster.scheduler.stats
+        # gang exclusivity holds across the wire: race1 fully bound,
+        # race2 bound nothing
+        assert cluster.wait_for_group_phase(
+            "web-group-race1", PodGroupPhase.RUNNING, timeout=30.0
+        )
+        bound2 = [
+            p for p in cluster.member_pods("web-group-race2") if p.spec.node_name
+        ]
+        assert bound2 == [], [p.metadata.name for p in bound2]
+        assert cluster.scheduler.stats["binds"] == 5
+    finally:
+        cluster.stop()
+        api.close()
+        server.shutdown()
+        server.server_close()
