@@ -146,15 +146,22 @@ def bench_oracle(nodes, groups, platform):
         use_pallas = False
         compact_fetch(schedule_batch(*warm.device_args(), use_pallas=False))
 
-    # timed: full end-to-end batch — host snapshot pack, device batch, fetch
-    t0 = time.perf_counter()
-    snap = ClusterSnapshot(nodes, {}, groups)
-    t_pack = time.perf_counter() - t0
-    t1 = time.perf_counter()
-    out = schedule_batch(*snap.device_args(), use_pallas=use_pallas)
-    host = compact_fetch(out)
-    t_device = time.perf_counter() - t1
-    total = t_pack + t_device
+    # timed: full end-to-end batch — host snapshot pack, device batch,
+    # fetch. Median of three passes: the remote host-device link's
+    # dispatch+sync round trip dominates the wall and is noisy (~65ms +-
+    # tens of ms through the axon tunnel); a single draw over- or
+    # under-states the steady number run to run.
+    passes = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        snap = ClusterSnapshot(nodes, {}, groups)
+        t_pack = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        out = schedule_batch(*snap.device_args(), use_pallas=use_pallas)
+        host = compact_fetch(out)
+        t_device = time.perf_counter() - t1
+        passes.append((t_pack + t_device, t_pack, t_device))
+    total, t_pack, t_device = sorted(passes)[1]
 
     placed = int(np.asarray(host["placed"]).sum())
     # device-only re-run for steady-state batch latency (jit cache hot)
