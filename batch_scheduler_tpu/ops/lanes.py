@@ -136,9 +136,8 @@ class LaneSchema:
             i = self.index.get(name)
             if i is None:
                 raise KeyError(f"resource {name!r} not in lane schema {self.names}")
-            dev = _to_device_unit(name, int(value), capacity=capacity)
-            vec[i] = _apply_shift(dev, self.shifts[i], capacity=capacity)
-        cap_bound = int(LANE_MAX) - 1 if capacity else int(LANE_MAX)
+            vec[i] = self._lane_value(i, name, value, capacity)
+        cap_bound = self._domain_bound(capacity)
         if (vec > cap_bound).any() or (vec < -cap_bound).any():
             if not self._warned_clamp:
                 self._warned_clamp = True
@@ -150,6 +149,42 @@ class LaneSchema:
                 )
             np.clip(vec, -cap_bound, cap_bound, out=vec)
         return vec.astype(np.int32)
+
+    def _lane_value(self, i: int, name: str, value: int, capacity: bool) -> int:
+        """The shifted device-unit value lane ``i`` would store for
+        ``value`` — THE conversion, shared by pack() and covers() so the
+        cache-validity predicate can never diverge from actual packing."""
+        dev = _to_device_unit(name, int(value), capacity=capacity)
+        return _apply_shift(dev, self.shifts[i], capacity=capacity)
+
+    @staticmethod
+    def _domain_bound(capacity: bool) -> int:
+        return int(LANE_MAX) - 1 if capacity else int(LANE_MAX)
+
+    def covers(self, resource_dicts: Sequence[Dict[str, int]]) -> bool:
+        """True iff every name is in the schema AND every (request-side)
+        value packs exactly (no clamp) — the validity check for reusing a
+        cached schema across snapshots (core.oracle_scorer) instead of
+        re-collecting."""
+        bound = self._domain_bound(capacity=False)
+        for d in resource_dicts:
+            for name, value in d.items():
+                i = self.index.get(name)
+                if i is None:
+                    return False
+                v = self._lane_value(i, name, value, capacity=False)
+                if v > bound or v < -bound:
+                    return False
+        return True
+
+    def covers_names(self, resource_dicts: Sequence[Dict[str, int]]) -> bool:
+        """Names-only coverage (no value-domain check): the cheap guard for
+        dicts whose values are bounded by already-covered capacities (a
+        node's requested sum never exceeds its allocatable)."""
+        index = self.index
+        return all(
+            name in index for d in resource_dicts for name in d
+        )
 
     def pack_many(
         self, dicts: Sequence[Dict[str, int]], *, capacity: bool = False

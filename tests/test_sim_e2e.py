@@ -355,3 +355,40 @@ def test_preemption_prefers_low_priority_victims_over_fewest(sim):
     assert cluster.clientset.pods().get("online-0").spec.node_name == "n2"
     assert len(cluster.member_pods("highgang")) == 1
     assert len(cluster.member_pods("lowgang")) == 1
+
+
+def test_new_extended_resource_after_first_batch(sim):
+    """Schema-cache correctness: a later gang introducing a resource name
+    the cached lane schema has never seen forces a fresh collect (not a
+    KeyError, not a silent drop) and the gang is correctly denied when no
+    node exposes it."""
+    cluster = sim(scorer="oracle")
+    cluster.add_nodes([make_sim_node("n1", {"cpu": "8", "pods": "20"})])
+    cluster.create_group(make_sim_group("plain", 2))
+    cluster.start()
+    cluster.create_pods(make_member_pods("plain", 2, {"cpu": "1"}))
+    assert cluster.wait_for(
+        lambda: cluster.scheduler.stats["binds"] >= 2, timeout=30.0
+    ), cluster.scheduler.stats
+
+    # second gang needs an accelerator no node has — arrives after the
+    # schema was collected and cached for the first batch
+    cluster.create_group(make_sim_group("accel", 2))
+    pods = make_member_pods("accel", 2, {"cpu": "1", "fake.com/npu": "1"})
+    cluster.create_pods(pods)
+    # positive denial signal (NOT a crash: a broken batch would requeue via
+    # the cycle's exception path without counting an unschedulable denial)
+    assert cluster.wait_for(
+        lambda: cluster.scheduler.stats["unschedulable"] >= 2, timeout=20.0
+    ), cluster.scheduler.stats
+    bound = [p for p in cluster.member_pods("accel") if p.spec.node_name]
+    assert bound == [], [p.metadata.name for p in bound]
+    assert cluster.scheduler.stats["binds"] == 2
+
+    # the scheduler is still alive after the schema rebuild: a third,
+    # feasible gang binds normally
+    cluster.create_group(make_sim_group("after", 2))
+    cluster.create_pods(make_member_pods("after", 2, {"cpu": "1"}))
+    assert cluster.wait_for(
+        lambda: cluster.scheduler.stats["binds"] >= 4, timeout=30.0
+    ), cluster.scheduler.stats
