@@ -326,3 +326,71 @@ def test_raw_lane_paths_reject_out_of_domain_values():
     bad["alloc"] = np.full((n, r), 2**30 + 1, np.int32)
     with pytest.raises(OverflowError):
         pad_oracle_batch(**bad)
+
+
+def _assign_gangs_python(left0, group_req, remaining, fit_mask, order):
+    """Independent pure-Python mirror of assign_gangs' documented greedy
+    semantics (tightest-first histogram selection, priority order): the
+    third implementation both device paths (lax.scan and the pallas
+    kernel) are checked against, so a shared bug in the array math can't
+    hide behind scan-vs-pallas equality."""
+    BINS = 128
+    left = left0.astype(np.int64).copy()  # [N, R]
+    n = left.shape[0]
+    g = group_req.shape[0]
+    takes = np.zeros((g, n), dtype=np.int64)
+    placed = np.zeros(g, dtype=bool)
+    for s in range(g):
+        gi = int(order[s])
+        req = group_req[gi].astype(np.int64)
+        need = int(remaining[gi])
+        mask_row = fit_mask[0] if fit_mask.shape[0] == 1 else fit_mask[gi]
+        cap = np.empty(n, dtype=np.int64)
+        for i in range(n):
+            per = [
+                left[i, l] // req[l]
+                for l in range(len(req))
+                if req[l] > 0
+            ]
+            c = min(per) if per else 2**30
+            cap[i] = max(0, min(c, 2**30)) if mask_row[i] else 0
+        capc = np.minimum(cap, need)
+        if capc.sum() < need:
+            continue
+        placed[gi] = True
+        # tightest-first: ascending min(cap, BINS-1), then node index;
+        # full capc from earlier nodes, remainder at the boundary
+        key = np.minimum(cap, BINS - 1)
+        taken = 0
+        for i in sorted(range(n), key=lambda i: (key[i], i)):
+            if taken >= need:
+                break
+            t = min(int(capc[i]), need - taken)
+            takes[gi, i] = t
+            taken += t
+        left -= takes[gi][:, None] * req[None, :]
+    return takes, placed, left
+
+
+def test_assign_gangs_fuzz_vs_python_mirror():
+    rng = np.random.default_rng(11)
+    for trial in range(12):
+        n = int(rng.integers(1, 20))
+        g = int(rng.integers(1, 10))
+        r = int(rng.integers(1, 4))
+        left0 = rng.integers(0, 50, size=(n, r)).astype(np.int32)
+        group_req = rng.integers(0, 6, size=(g, r)).astype(np.int32)
+        remaining = rng.integers(0, 20, size=g).astype(np.int32)
+        order = rng.permutation(g).astype(np.int32)
+        # alternate broadcast [1,N] and per-group [G,N] masks, mostly-true
+        rows = 1 if trial % 2 == 0 else g
+        fit_mask = rng.random((rows, n)) > 0.2
+
+        dev = assign_gangs(left0, group_req, remaining, fit_mask, order)
+        takes_d, placed_d, left_d = [np.asarray(x) for x in dev]
+        takes_p, placed_p, left_p = _assign_gangs_python(
+            left0, group_req, remaining, fit_mask, order
+        )
+        np.testing.assert_array_equal(placed_d, placed_p, err_msg=f"trial {trial}")
+        np.testing.assert_array_equal(takes_d, takes_p, err_msg=f"trial {trial}")
+        np.testing.assert_array_equal(left_d, left_p, err_msg=f"trial {trial}")
