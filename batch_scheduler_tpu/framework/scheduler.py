@@ -20,6 +20,7 @@ from ..client.apiserver import NotFoundError
 from ..client.clientset import Clientset
 from ..core import resources as rmath
 from ..utils.errors import SchedulingError
+from ..utils.labels import pod_group_name
 from ..utils.metrics import DEFAULT_REGISTRY
 from .cluster import ClusterState
 from .queue import SchedulingQueue
@@ -32,8 +33,6 @@ __all__ = ["Scheduler", "FrameworkHandle"]
 def _gang_key(info: PodInfo) -> Optional[str]:
     """namespace/group queue-index key for gang-unit admission (None for
     non-gang pods)."""
-    from ..utils.labels import pod_group_name
-
     name, ok = pod_group_name(info.pod)
     if not ok:
         return None

@@ -23,6 +23,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
+import jax
 import numpy as np
 
 from ..api.types import Node
@@ -156,8 +157,6 @@ class ChurnRescorer:
                 self._alloc_dev is None
                 or self._alloc_dev.shape != args[0].shape
             ):
-                import jax
-
                 self._alloc_dev = jax.device_put(args[0])
             args = (self._alloc_dev,) + args[1:]
 

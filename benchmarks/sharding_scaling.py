@@ -46,7 +46,6 @@ import time
 
 import numpy as np
 
-GPU = "nvidia.com/gpu"
 COLLECTIVES = (
     "all-reduce",
     "all-gather",
@@ -57,25 +56,12 @@ COLLECTIVES = (
 ITERS = 5
 
 
-def build_args(num_nodes=5000, num_groups=1000, members=10):
-    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot, GroupDemand
-    from batch_scheduler_tpu.sim.scenarios import make_sim_node
+def build_args():
+    """The bench.py headline workload (config-4 shape), packed."""
+    import bench
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot
 
-    nodes = [
-        make_sim_node(
-            f"n{i:05d}", {"cpu": "64", "memory": "256Gi", "pods": "110", GPU: "8"}
-        )
-        for i in range(num_nodes)
-    ]
-    groups = [
-        GroupDemand(
-            full_name=f"default/gang-{g:04d}",
-            min_member=members,
-            member_request={"cpu": 4000, "memory": 8 * 1024**3, GPU: 1},
-            creation_ts=float(g),
-        )
-        for g in range(num_groups)
-    ]
+    nodes, groups = bench.build_inputs()
     return ClusterSnapshot(nodes, {}, groups).device_args()
 
 
