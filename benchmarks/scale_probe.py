@@ -1,0 +1,113 @@
+"""Scale headroom probe: the north-star shape x5 on one chip.
+
+50k pods (5k gangs x 10) / 20k nodes — bucketed to [8192 groups x 32768
+nodes x 5 lanes] — through the fused oracle batch on the default platform.
+Reports first-call (compile) latency, sustained pipelined per-batch time,
+and that every gang places. Run from the repo root:
+``python benchmarks/scale_probe.py``. Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+NUM_NODES = 20000
+NUM_GROUPS = 5000
+MEMBERS = 10
+PIPELINE_N = 8
+GPU = "nvidia.com/gpu"
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    from batch_scheduler_tpu.ops.oracle import schedule_batch
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot, GroupDemand
+    from batch_scheduler_tpu.sim.scenarios import make_sim_node
+
+    nodes = [
+        make_sim_node(
+            f"n{i:05d}", {"cpu": "64", "memory": "256Gi", "pods": "110", GPU: "8"}
+        )
+        for i in range(NUM_NODES)
+    ]
+    groups = [
+        GroupDemand(
+            full_name=f"default/g{g:05d}",
+            min_member=MEMBERS,
+            member_request={"cpu": 4000, "memory": 8 * 1024**3, GPU: 1},
+            creation_ts=float(g),
+        )
+        for g in range(NUM_GROUPS)
+    ]
+    platform = jax.default_backend()
+    use_pallas = platform == "tpu"
+
+    t0 = time.perf_counter()
+    snap = ClusterSnapshot(nodes, {}, groups)
+    t_pack = time.perf_counter() - t0
+    args = jax.device_put(snap.device_args())
+    jax.block_until_ready(args)
+
+    t1 = time.perf_counter()
+    out = schedule_batch(*args, use_pallas=use_pallas)
+    jax.block_until_ready(out["placed"])
+    t_first = time.perf_counter() - t1
+    placed = int(np.asarray(jax.device_get(out["placed"])).sum())
+
+    t2 = time.perf_counter()
+    outs = [
+        schedule_batch(*args, use_pallas=use_pallas)["placed"]
+        for _ in range(PIPELINE_N)
+    ]
+    jax.block_until_ready(outs)
+    t_batch = (time.perf_counter() - t2) / PIPELINE_N
+
+    g_b, n_b, r = snap.shape
+    print(
+        json.dumps(
+            {
+                "metric": "scale_probe_50kpod_20knode_batch",
+                "value": round(t_batch, 4),
+                "unit": "s_sustained_per_batch",
+                "detail": {
+                    "platform": platform,
+                    "bucket_shape": [g_b, n_b, r],
+                    "pods": NUM_GROUPS * MEMBERS,
+                    "nodes": NUM_NODES,
+                    "gangs_placed": placed,
+                    "gangs": NUM_GROUPS,
+                    "pack_s": round(t_pack, 3),
+                    "first_call_s": round(t_first, 3),
+                    "assignment_path": "pallas" if use_pallas else "scan",
+                    "pods_x_nodes_scored_per_sec": round(
+                        NUM_GROUPS * MEMBERS * NUM_NODES / max(t_batch, 1e-9)
+                    ),
+                },
+            }
+        )
+    )
+    return 0 if placed == NUM_GROUPS else 1
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception as e:  # noqa: BLE001 — one JSON line, always
+        print(
+            json.dumps(
+                {
+                    "metric": "scale_probe_50kpod_20knode_batch",
+                    "value": -1.0,
+                    "unit": "s_sustained_per_batch",
+                    "detail": {"error": repr(e)[:500]},
+                }
+            )
+        )
+        sys.exit(1)
