@@ -184,11 +184,30 @@ def _maybe_serve_metrics(args):
     return server
 
 
+def _resolve_backend_or_degrade() -> None:
+    """Probe the accelerator backend before first device use: a hung TPU
+    tunnel would otherwise wedge the process inside the first compile with
+    no error (utils.backend). On failure the process degrades to CPU and
+    keeps serving/scheduling — degradation is printed, not silent."""
+    from ..utils.backend import resolve_platform
+
+    platform, err = resolve_platform()
+    if err is not None:
+        print(
+            f"accelerator backend unavailable ({err}); degraded to "
+            f"platform={platform}",
+            file=sys.stderr,
+        )
+
+
 def cmd_serve(args) -> int:
     from ..parallel.distributed import init_distributed
     from ..service.server import OracleServer
 
-    # multi-host slice bootstrap (no-op unless BST_COORDINATOR is set)
+    # multi-host slice bootstrap (no-op unless BST_COORDINATOR is set).
+    # MUST precede the backend probe: the probe's degradation path
+    # initializes the backend, after which jax.distributed.initialize
+    # refuses to run.
     if init_distributed():
         import jax
 
@@ -197,6 +216,8 @@ def cmd_serve(args) -> int:
             f"{jax.process_count()}, {len(jax.devices())} global devices",
             flush=True,
         )
+    else:
+        _resolve_backend_or_degrade()
 
     if args.warmup:
         print(f"warmup compile done in {warm_oracle():.1f}s", flush=True)
@@ -237,6 +258,7 @@ def cmd_sim(args) -> int:
         cfg.plugin_config.scorer = args.scorer
 
     _maybe_serve_metrics(args)
+    _resolve_backend_or_degrade()
 
     scorer = cfg.plugin_config.scorer
     oracle_client = None

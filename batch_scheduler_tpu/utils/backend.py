@@ -1,0 +1,77 @@
+"""Accelerator backend probing: survive a TPU plugin that HANGS.
+
+The axon TPU plugin can raise UNAVAILABLE on first contact — or hang
+indefinitely inside ``jax.default_backend()`` when its tunnel is down
+(observed: >90s, no exception). A hang at first device use would wedge the
+CLI (``sim`` warms the oracle, ``serve`` compiles on accept) with no error.
+So the default backend is probed in a SUBPROCESS with a hard timeout; only
+a probe that proves the backend healthy lets this process use it.
+Otherwise the process degrades to CPU (config update before any backend
+init here) and keeps working. Shared by ``bench.py`` and the CLI.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from typing import Optional, Tuple
+
+__all__ = ["resolve_platform"]
+
+PROBE_TIMEOUT_S = 75.0
+RETRIES = 2
+RETRY_DELAY_S = 10.0
+
+_resolved: Optional[Tuple[str, Optional[str]]] = None
+
+
+def resolve_platform(
+    retries: int = RETRIES,
+    probe_timeout_s: float = PROBE_TIMEOUT_S,
+    retry_delay_s: float = RETRY_DELAY_S,
+) -> Tuple[str, Optional[str]]:
+    """Returns (platform, error_or_None); caches per process.
+
+    On probe failure the process's jax config is switched to CPU before
+    any backend initialization, so later device use cannot hang.
+    """
+    global _resolved
+    if _resolved is not None:
+        return _resolved
+
+    # Already pinned to CPU (tests' conftest, an earlier degradation, or an
+    # operator override): the accelerator probe is pure overhead — and up
+    # to ~160s of timeouts when the tunnel is hung. Reading the config does
+    # not initialize a backend.
+    import jax
+
+    if jax.config.jax_platforms == "cpu":
+        _resolved = ("cpu", None)
+        return _resolved
+
+    last_err = None
+    for attempt in range(retries):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print('PLATFORM=' + jax.default_backend())"],
+                timeout=probe_timeout_s,
+                capture_output=True,
+                text=True,
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"backend probe hang (> {probe_timeout_s}s)"
+            print(f"probe attempt {attempt + 1}: {last_err}", file=sys.stderr)
+            continue
+        marker = [l for l in r.stdout.splitlines() if l.startswith("PLATFORM=")]
+        if r.returncode == 0 and marker:
+            _resolved = (marker[-1].removeprefix("PLATFORM="), None)
+            return _resolved
+        last_err = f"probe rc={r.returncode}: {r.stderr.strip()[-300:]}"
+        print(f"probe attempt {attempt + 1} failed: {last_err}", file=sys.stderr)
+        time.sleep(retry_delay_s)
+
+    jax.config.update("jax_platforms", "cpu")
+    _resolved = (jax.default_backend(), str(last_err))
+    return _resolved

@@ -38,49 +38,14 @@ GPU = "nvidia.com/gpu"
 
 METRIC = "kwok_10k_pod_5k_node_gang_schedule_wall_clock"
 
-BACKEND_RETRIES = 2
-BACKEND_PROBE_TIMEOUT_S = 75.0
-BACKEND_RETRY_DELAY_S = 10.0
-
-
 def resolve_platform():
-    """Pick a JAX platform, surviving TPU-backend failures AND hangs.
+    """Pick a JAX platform, surviving TPU-backend failures AND hangs — the
+    shared subprocess-probe helper (batch_scheduler_tpu.utils.backend; the
+    CLI's sim/serve use the same guard). Returns (platform, error_or_None).
+    """
+    from batch_scheduler_tpu.utils.backend import resolve_platform as _resolve
 
-    The axon TPU plugin can raise UNAVAILABLE on first contact — or hang
-    indefinitely inside ``jax.default_backend()`` when the tunnel is down
-    (observed: >90s with no exception). A hang in-process would wedge the
-    benchmark past the driver's timeout with no JSON line, so the default
-    backend is probed in a SUBPROCESS with a hard timeout; only a probe that
-    proves the backend healthy lets this process use it. Otherwise degrade
-    to CPU (config update before any backend init here) so the benchmark
-    still produces a number. Returns (platform, error_or_None)."""
-    import subprocess
-
-    last_err = None
-    for attempt in range(BACKEND_RETRIES):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print('PLATFORM=' + jax.default_backend())"],
-                timeout=BACKEND_PROBE_TIMEOUT_S,
-                capture_output=True,
-                text=True,
-            )
-        except subprocess.TimeoutExpired:
-            last_err = f"backend probe hang (> {BACKEND_PROBE_TIMEOUT_S}s)"
-            print(f"probe attempt {attempt + 1}: {last_err}", file=sys.stderr)
-            continue
-        marker = [l for l in r.stdout.splitlines() if l.startswith("PLATFORM=")]
-        if r.returncode == 0 and marker:
-            return marker[-1].removeprefix("PLATFORM="), None
-        last_err = f"probe rc={r.returncode}: {r.stderr.strip()[-300:]}"
-        print(f"probe attempt {attempt + 1} failed: {last_err}", file=sys.stderr)
-        time.sleep(BACKEND_RETRY_DELAY_S)
-
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    return jax.default_backend(), str(last_err)
+    return _resolve()
 
 
 def build_inputs():
