@@ -1,0 +1,70 @@
+"""utils.backend: the probe-and-degrade guard for hung accelerator plugins."""
+
+from __future__ import annotations
+
+import subprocess
+
+import pytest
+
+from batch_scheduler_tpu.utils import backend
+
+
+@pytest.fixture(autouse=True)
+def _reset_cache():
+    saved = backend._resolved
+    backend._resolved = None
+    yield
+    backend._resolved = saved
+
+
+def test_pinned_cpu_skips_probe(monkeypatch):
+    """With the platform already pinned to cpu (this test session), the
+    subprocess probe must not run at all."""
+    def boom(*a, **kw):
+        raise AssertionError("probe subprocess must not be spawned")
+
+    monkeypatch.setattr(subprocess, "run", boom)
+    platform, err = backend.resolve_platform()
+    assert (platform, err) == ("cpu", None)
+
+
+def test_result_is_cached(monkeypatch):
+    calls = []
+
+    def fake_run(*a, **kw):
+        calls.append(1)
+        raise AssertionError("unexpected")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    backend.resolve_platform()
+    backend.resolve_platform()
+    assert calls == []  # pinned-cpu shortcut, and cached on repeat
+
+
+def test_hang_degrades_to_cpu(monkeypatch):
+    """A probe that times out every attempt degrades to CPU with the error
+    recorded (the hung-tunnel path, exercised for real this round)."""
+    import jax
+
+    # bypass the pinned-cpu shortcut to reach the probe loop
+    monkeypatch.setattr(
+        type(jax.config), "jax_platforms", property(lambda self: "axon"),
+        raising=False,
+    )
+
+    def fake_run(*a, **kw):
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=kw.get("timeout"))
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    updates = []
+    monkeypatch.setattr(
+        jax.config, "update", lambda k, v: updates.append((k, v))
+    )
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+
+    platform, err = backend.resolve_platform(
+        retries=2, probe_timeout_s=0.01, retry_delay_s=0.0
+    )
+    assert platform == "cpu"
+    assert "hang" in err
+    assert ("jax_platforms", "cpu") in updates
