@@ -48,6 +48,9 @@ __all__ = [
     "assign_gangs",
     "schedule_batch",
     "execute_batch_host",
+    "dispatch_batch",
+    "collect_batch",
+    "PendingBatch",
 ]
 
 # Plain int (not a device array) so pallas kernels can share these helpers
@@ -452,18 +455,34 @@ def _batch_blob(alloc_lanes, requested, group_req, remaining, fit_mask,
     return blob, out
 
 
-def execute_batch_host(batch_args, progress_args, scan_mesh=None):
-    """Run one fused batch + max-progress selection and fetch ONLY the O(G)
-    host vectors (as ONE packed transfer — see _batch_blob); the (G,N)
-    tensors come back as device handles for lazy row reads. The single
-    batch-execution path shared by the in-process scorer (core.oracle_scorer)
-    and the sidecar server (service.server) — one place to change when the
-    oracle's outputs change."""
+class PendingBatch:
+    """An in-flight fused batch: dispatched, device->host copy started, not
+    yet synced. Produced by ``dispatch_batch``; ``collect_batch`` is the
+    sync point. Holding one of these while doing other host work (packing
+    the next snapshot, admission bookkeeping, sleeping out a tick interval)
+    hides the host<->device link round-trip — the dominant per-batch cost on
+    a tunneled TPU — behind that work."""
+
+    __slots__ = ("blob", "out", "pack", "used_pallas", "_rerun")
+
+    def __init__(self, blob, out, pack, used_pallas, rerun):
+        self.blob = blob
+        self.out = out
+        self.pack = pack
+        self.used_pallas = used_pallas
+        self._rerun = rerun
+
+
+def dispatch_batch(batch_args, progress_args, scan_mesh=None) -> PendingBatch:
+    """Launch one fused batch + max-progress selection WITHOUT waiting for
+    the result, and start an async device->host copy of the packed O(G)
+    blob. Compilation (including a Pallas Mosaic lowering failure) surfaces
+    here synchronously; device execution and the transfer proceed in the
+    background until ``collect_batch``."""
     # The fused Pallas scan is single-device TPU + broadcast-mask only, and
     # Mosaic lowering is hardware-path-only (tests exercise interpret mode):
     # if it fails to compile/run on this chip, fall back to the lax.scan
     # form permanently for the process rather than failing every batch.
-    global _pallas_enabled
     use_pallas = (
         _pallas_enabled
         and jax.default_backend() == "tpu"
@@ -481,37 +500,75 @@ def execute_batch_host(batch_args, progress_args, scan_mesh=None):
     top_k = batch_top_k(n_bucket, remaining_max)
 
     def run(up: bool):
-        blob, out = _batch_blob(
+        return _batch_blob(
             *batch_args, *progress_args, use_pallas=up, pack_assignment=pack,
             top_k=top_k, scan_mesh=scan_mesh,
         )
-        # device_get is the sync point: a device-side kernel failure
-        # surfaces here, inside the caller's try
-        return np.asarray(jax.device_get(blob)), out
 
     if use_pallas:
         try:
-            blob_np, out = run(True)
-        except Exception as e:  # noqa: BLE001 — any lowering/runtime failure
+            blob, out = run(True)
+        except Exception as e:  # noqa: BLE001 — lowering/compile failure
             # Only blame (and permanently disable) the pallas kernel if the
-            # scan path succeeds where it failed; if that fails too, the
-            # problem is the batch/link, not the kernel — surface it.
+            # scan path EXECUTES where it failed — a cache-hit dispatch
+            # alone proves nothing, so force the device round-trip here. If
+            # that fails too, the problem is the batch/link, not the
+            # kernel — surface the original error.
             try:
-                blob_np, out = run(False)
+                blob, out = run(False)
+                np.asarray(jax.device_get(blob))
             except Exception:
                 raise e from None
-            _pallas_enabled = False
-            import warnings
-
-            warnings.warn(
-                f"pallas assignment kernel disabled after failure: {e!r}; "
-                "falling back to the lax.scan path"
-            )
+            _disable_pallas(e)
+            use_pallas = False
     else:
-        blob_np, out = run(False)
+        blob, out = run(False)
 
-    g = batch_args[2].shape[0]
+    # Queue the D2H copy now so it rides behind the computation instead of
+    # waiting for the collect call (optional API; device_get works without).
+    try:
+        blob.copy_to_host_async()
+    except (AttributeError, RuntimeError):
+        pass
+    return PendingBatch(blob, out, pack, use_pallas, run)
+
+
+def _disable_pallas(e: Exception) -> None:
+    global _pallas_enabled
+    _pallas_enabled = False
+    import warnings
+
+    warnings.warn(
+        f"pallas assignment kernel disabled after failure: {e!r}; "
+        "falling back to the lax.scan path"
+    )
+
+
+def collect_batch(pending: PendingBatch):
+    """Sync point for a ``dispatch_batch`` launch: wait for the packed blob,
+    unpack the O(G) host vectors, and hand back the (G,N) device handles.
+    A device-side kernel failure surfaces here; if the Pallas path was used,
+    the batch re-runs once on the lax.scan form before the kernel is blamed
+    and permanently disabled (same policy as the synchronous path)."""
+    try:
+        blob_np = np.asarray(jax.device_get(pending.blob))
+        out = pending.out
+    except Exception as e:  # noqa: BLE001 — device-side runtime failure
+        if not pending.used_pallas:
+            raise
+        # Only blame (and permanently disable) the pallas kernel if the
+        # scan path succeeds where it failed; if that fails too, the
+        # problem is the batch/link, not the kernel — surface it.
+        try:
+            blob, out = pending._rerun(False)
+            blob_np = np.asarray(jax.device_get(blob))
+        except Exception:
+            raise e from None
+        _disable_pallas(e)
+
+    g = out["assignment_nodes"].shape[0]
     k = out["assignment_nodes"].shape[1]
+    pack = pending.pack
     tail = blob_np[3 * g + 2:]
     if pack:
         packed_np = tail.reshape(g, k)
@@ -531,3 +588,15 @@ def execute_batch_host(batch_args, progress_args, scan_mesh=None):
     }
     device_result = {"capacity": out["capacity"], "scores": out["scores"]}
     return host, device_result
+
+
+def execute_batch_host(batch_args, progress_args, scan_mesh=None):
+    """Run one fused batch + max-progress selection and fetch ONLY the O(G)
+    host vectors (as ONE packed transfer — see _batch_blob); the (G,N)
+    tensors come back as device handles for lazy row reads. The single
+    batch-execution path shared by the in-process scorer (core.oracle_scorer)
+    and the sidecar server (service.server) — one place to change when the
+    oracle's outputs change. Synchronous form of dispatch_batch +
+    collect_batch; pipelined callers (ops.rescore.ChurnRescorer's
+    tick_dispatch/tick_collect) use the split halves directly."""
+    return collect_batch(dispatch_batch(batch_args, progress_args, scan_mesh))

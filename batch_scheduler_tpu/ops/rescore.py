@@ -28,10 +28,10 @@ import numpy as np
 
 from ..api.types import Node
 from .lanes import LaneSchema
-from .oracle import batch_top_k, execute_batch_host
+from .oracle import batch_top_k, collect_batch, dispatch_batch
 from .snapshot import ClusterSnapshot, GroupDemand
 
-__all__ = ["ChurnRescorer", "TickResult"]
+__all__ = ["ChurnRescorer", "TickResult", "PendingTick"]
 
 
 @dataclass
@@ -55,6 +55,19 @@ class TickResult:
             for i, name in enumerate(self.snapshot.group_names)
             if placed[i]
         ]
+
+
+@dataclass
+class PendingTick:
+    """A dispatched-but-uncollected tick (ChurnRescorer.tick_dispatch):
+    holds the snapshot the batch was computed against and the in-flight
+    device handle."""
+
+    pending: object  # ops.oracle.PendingBatch
+    snapshot: ClusterSnapshot
+    pack_seconds: float
+    dispatch_seconds: float
+    bucket_shape: tuple
 
 
 class ChurnRescorer:
@@ -99,6 +112,11 @@ class ChurnRescorer:
         self.latencies: List[float] = []
         self.pack_times: List[float] = []
         self.device_times: List[float] = []
+        # device_times split: dispatch-side block vs collect-side block —
+        # the signal that says whether a pipelined loop actually overlapped
+        # the link round-trip or just moved it
+        self.dispatch_times: List[float] = []
+        self.collect_times: List[float] = []
         self._shapes_seen: set = set()
         self.recompiles = 0
         # Sticky buckets pin the padded shape to the largest seen — ZERO
@@ -125,6 +143,29 @@ class ChurnRescorer:
         ``nodes`` overrides the node set for this tick (node churn); by
         default the constructor's node list is used (pod/group churn only).
         """
+        return self.tick_collect(
+            self.tick_dispatch(node_requested, groups, nodes)
+        )
+
+    def tick_dispatch(
+        self,
+        node_requested: Optional[Dict[str, Dict[str, int]]],
+        groups: Sequence[GroupDemand],
+        nodes: Optional[Sequence[Node]] = None,
+    ) -> "PendingTick":
+        """The dispatch half of ``tick``: pack the snapshot and launch the
+        fused batch WITHOUT waiting for its result (ops.oracle's
+        dispatch_batch/collect_batch split). A one-tick-deep pipeline —
+        dispatch the batch for the current state, do a tick's worth of host
+        work (or sleep out the interval), collect at the next boundary —
+        hides the host<->device link round-trip, which on a tunneled TPU is
+        ~6x the device compute itself.
+
+        Staleness contract: the collected result reflects occupancy AT
+        DISPATCH. Admitting it later is safe exactly when capacity has not
+        shrunk in between — releases and arrivals only add slack, so the
+        churn loop qualifies; node removal or external placements would
+        need a host-side re-verify before admit."""
         if nodes is not None and node_requested is None:
             # the dense occupancy state is indexed by the constructor's node
             # list; scoring a different node set against it would silently
@@ -161,8 +202,8 @@ class ChurnRescorer:
             args = (self._alloc_dev,) + args[1:]
 
         t1 = time.perf_counter()
-        host, _device = execute_batch_host(args, snap.progress_args())
-        t_device = time.perf_counter() - t1
+        pending = dispatch_batch(args, snap.progress_args())
+        t_dispatch = time.perf_counter() - t1
 
         bucket_shape = (
             snap.group_req.shape[0],
@@ -185,16 +226,35 @@ class ChurnRescorer:
                 max(self._sticky_buckets[0], bucket_shape[0]),
                 max(self._sticky_buckets[1], bucket_shape[1]),
             )
-        result = TickResult(
-            host=host,
+        return PendingTick(
+            pending=pending,
             snapshot=snap,
             pack_seconds=t_pack,
-            device_seconds=t_device,
+            dispatch_seconds=t_dispatch,
             bucket_shape=bucket_shape,
         )
+
+    def tick_collect(self, pend: "PendingTick") -> TickResult:
+        """The sync half of ``tick_dispatch``: wait for (or, pipelined, just
+        pick up) the batch result and record the tick's host-blocking cost.
+        ``device_seconds`` is dispatch + collect blocking time — in a
+        pipelined loop the transfer rode the interval, so it measures only
+        what the host actually stalled."""
+        t0 = time.perf_counter()
+        host, _device = collect_batch(pend.pending)
+        t_collect = time.perf_counter() - t0
+        result = TickResult(
+            host=host,
+            snapshot=pend.snapshot,
+            pack_seconds=pend.pack_seconds,
+            device_seconds=pend.dispatch_seconds + t_collect,
+            bucket_shape=pend.bucket_shape,
+        )
         self.latencies.append(result.total_seconds)
-        self.pack_times.append(t_pack)
-        self.device_times.append(t_device)
+        self.pack_times.append(result.pack_seconds)
+        self.device_times.append(result.device_seconds)
+        self.dispatch_times.append(pend.dispatch_seconds)
+        self.collect_times.append(t_collect)
         return result
 
     def warm(
@@ -231,9 +291,7 @@ class ChurnRescorer:
                     for i in range(gb)
                 ]
                 self.tick(None, dummies)
-        self.latencies.clear()
-        self.pack_times.clear()
-        self.device_times.clear()
+        self.clear_stats()
 
     # -- occupancy bookkeeping (dense fast path) ---------------------------
 
@@ -275,6 +333,28 @@ class ChurnRescorer:
 
     # -- stats -------------------------------------------------------------
 
+    def _stat_series(self) -> tuple:
+        return (
+            self.latencies,
+            self.pack_times,
+            self.device_times,
+            self.dispatch_times,
+            self.collect_times,
+        )
+
+    def clear_stats(self) -> None:
+        """Drop recorded tick timings (e.g. after a warmup or an admission
+        burst that should not count toward the steady-state summary)."""
+        for series in self._stat_series():
+            series.clear()
+
+    def drop_last_stats(self) -> None:
+        """Un-record the most recent collected tick (e.g. an unmeasured
+        pipeline-drain collect after a benchmark loop)."""
+        for series in self._stat_series():
+            if series:
+                series.pop()
+
     def percentile(self, q: float) -> float:
         if not self.latencies:
             return 0.0
@@ -288,6 +368,8 @@ class ChurnRescorer:
             "max_s": round(max(self.latencies), 5) if self.latencies else 0.0,
             "p50_pack_s": round(float(np.median(self.pack_times)), 5) if self.pack_times else 0.0,
             "p50_device_s": round(float(np.median(self.device_times)), 5) if self.device_times else 0.0,
+            "p50_dispatch_s": round(float(np.median(self.dispatch_times)), 5) if self.dispatch_times else 0.0,
+            "p50_collect_s": round(float(np.median(self.collect_times)), 5) if self.collect_times else 0.0,
             "bucket_shapes": sorted(self._shapes_seen),
             "recompiles": self.recompiles,
         }
