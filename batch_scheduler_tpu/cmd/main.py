@@ -73,6 +73,13 @@ def build_parser() -> argparse.ArgumentParser:
     sim.add_argument("--groups", type=int, default=10, help="synthetic scenario groups")
     sim.add_argument("--members", type=int, default=5, help="pods per synthetic group")
     sim.add_argument("--timeout", type=float, default=60.0)
+    sim.add_argument(
+        "--oracle-background-refresh",
+        action="store_true",
+        help="re-batch the oracle on a daemon thread while cycles keep "
+             "reading the stale (known-complete) batch — takes the device "
+             "round-trip off the scheduling critical path",
+    )
     _add_metrics_flag(sim)
     sim.add_argument("--settle", type=float, default=3.0,
                      help="finish early once group phases and bound counts "
@@ -273,6 +280,7 @@ def cmd_sim(args) -> int:
         scorer=scorer,
         max_schedule_minutes=cfg.plugin_config.max_schedule_minutes,
         enabled_points=cfg.enabled_points,
+        oracle_background_refresh=args.oracle_background_refresh,
     )
 
     nodes: List[Node] = []

@@ -69,6 +69,7 @@ class ScheduleOperation:
         scorer: "str | OracleScorer" = "oracle",
         clock: Callable[[], float] = time.monotonic,
         min_batch_interval: float = 0.0,
+        background_refresh: bool = False,
     ):
         self.status_cache = status_cache
         self.cluster = cluster
@@ -83,14 +84,24 @@ class ScheduleOperation:
                 )
             self.scorer_kind = scorer
             self.oracle = (
-                OracleScorer(min_batch_interval=min_batch_interval)
+                OracleScorer(
+                    min_batch_interval=min_batch_interval,
+                    background_refresh=background_refresh,
+                )
                 if scorer == "oracle"
                 else None
             )
         else:
-            # a scorer instance (e.g. RemoteScorer backed by the sidecar)
+            # a scorer instance (e.g. RemoteScorer backed by the sidecar);
+            # apply requested batching behavior rather than silently
+            # dropping it — but only when asked, so an instance configured
+            # directly keeps its own settings
             self.scorer_kind = "oracle"
             self.oracle = scorer
+            if min_batch_interval:
+                scorer.min_batch_interval = min_batch_interval
+            if background_refresh:
+                scorer.background_refresh = True
         self.last_denied_pg = TTLCache(DENY_CACHE_DEFAULT_TTL, DENY_CACHE_JANITOR, clock=clock)
         self.last_permitted_pod = TTLCache(PERMITTED_CACHE_DEFAULT_TTL, DENY_CACHE_JANITOR, clock=clock)
         self._lock = threading.RLock()

@@ -97,9 +97,34 @@ class _BatchState:
 class OracleScorer:
     """Caches one batch of oracle results; invalidated by ``mark_dirty``."""
 
-    def __init__(self, min_batch_interval: float = 0.0, scan_mesh=None):
-        self._dirty = True
+    def __init__(
+        self,
+        min_batch_interval: float = 0.0,
+        scan_mesh=None,
+        background_refresh: bool = False,
+    ):
+        # Dirty tracking is a GENERATION pair, not a bool: refresh() clears
+        # staleness by recording the generation it observed BEFORE packing
+        # its snapshot, so a mark_dirty landing while the batch is on the
+        # device (routine once background_refresh runs batches concurrently
+        # with scheduling cycles) advances the generation past the recorded
+        # one and the batch stays stale — a plain `_dirty = False` at
+        # completion would clobber that invalidation.
+        self._dirty_gen = 1
+        self._clean_gen = 0
         self._state: Optional[_BatchState] = None
+        # Background refresh: a stale-but-servable batch (every queried group
+        # known) re-batches on a daemon thread while callers keep reading the
+        # old answers — the device round-trip leaves the scheduling cycle's
+        # critical path. Staleness is bounded by one batch time, the same
+        # class as min_batch_interval coalescing (denials are 20s-sticky
+        # regardless). A missing group or a failed background batch still
+        # forces the BLOCKING path so transport errors surface in a cycle
+        # instead of decaying into an invisible all-deny.
+        self.background_refresh = background_refresh
+        self._bg_thread: Optional[threading.Thread] = None
+        self._bg_lock = threading.Lock()
+        self._bg_error: Optional[Exception] = None
         # Multi-chip layout: when set (parallel.global_mesh() on a >1-chip
         # deployment), batches shard the O(G*N*R) scoring over the mesh and
         # replicate the sequential gang scan's inputs (the measured layout
@@ -133,7 +158,10 @@ class OracleScorer:
         self._stats_lock = threading.Lock()
 
     def mark_dirty(self) -> None:
-        self._dirty = True
+        # GIL-level increment; a lost update between two racing markers
+        # still leaves the generation ahead of _clean_gen, which is all
+        # _stale needs
+        self._dirty_gen += 1
 
     def credit_expected_change(self, n: int = 1) -> None:
         """Record n cluster-version bumps as pre-accounted by the current
@@ -151,9 +179,11 @@ class OracleScorer:
     def refresh(self, cluster, status_cache: PGStatusCache) -> None:
         """Rebuild the snapshot and run one fused oracle batch."""
         t0 = time.perf_counter()
-        # Credits and the version base are taken BEFORE reading state: any
-        # change landing mid-refresh leaves version() ahead of the base and
-        # re-batches conservatively.
+        # Credits, the dirty generation, and the version base are all taken
+        # BEFORE reading state: any change landing mid-refresh leaves
+        # version() ahead of the base (or the generation ahead of the one
+        # recorded at completion) and re-batches conservatively.
+        dirty_gen = self._dirty_gen
         with self._credits_lock:
             self._version_credits = 0
         version_fn = getattr(cluster, "version", None)
@@ -198,7 +228,7 @@ class OracleScorer:
         )
         self._state = _BatchState(snap, host, max_group, row_fetcher)
         self._cluster_version = version_base
-        self._dirty = False
+        self._clean_gen = dirty_gen  # compare-and-clear: later marks survive
         self.batches_run += 1
         self._last_batch_t = time.monotonic()
         with self._stats_lock:
@@ -231,7 +261,7 @@ class OracleScorer:
         return host, row_fetcher
 
     def _stale(self, cluster) -> bool:
-        if self._dirty or self._state is None:
+        if self._dirty_gen != self._clean_gen or self._state is None:
             return True
         version_fn = getattr(cluster, "version", None)
         if callable(version_fn):
@@ -263,16 +293,50 @@ class OracleScorer:
         if not self._stale(cluster):
             if not self._group_missing(group):
                 return
-        elif (
-            not self._group_missing(group)
-            and self._state is not None
-            and self.min_batch_interval > 0
-            and time.monotonic() - self._last_batch_t < self.min_batch_interval
-        ):
-            return
+        elif not self._group_missing(group) and self._state is not None:
+            if (
+                self.min_batch_interval > 0
+                and time.monotonic() - self._last_batch_t < self.min_batch_interval
+            ):
+                return
+            if self.background_refresh and self._bg_error is None:
+                self._kick_background_refresh(cluster, status_cache)
+                return
         with self._refresh_lock:
             if self._stale(cluster) or self._group_missing(group):
+                # a background failure is consumed here: this blocking
+                # refresh either succeeds (recovery) or raises into the
+                # caller's cycle (visible failure)
+                self._bg_error = None
                 self.refresh(cluster, status_cache)
+
+    def drain_background(self, timeout: float = 10.0) -> None:
+        """Wait out any in-flight background batch. MUST be called before
+        process teardown when background_refresh is on: a daemon thread dying
+        inside an XLA call while the runtime is being destroyed aborts the
+        process."""
+        self.background_refresh = False  # no new kicks after drain
+        t = self._bg_thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+
+    def _kick_background_refresh(self, cluster, status_cache: PGStatusCache) -> None:
+        with self._bg_lock:
+            if self._bg_thread is not None and self._bg_thread.is_alive():
+                return
+
+            def _run() -> None:
+                try:
+                    with self._refresh_lock:
+                        if self._stale(cluster):
+                            self.refresh(cluster, status_cache)
+                except Exception as e:  # noqa: BLE001 — surfaced via _bg_error
+                    self._bg_error = e
+
+            self._bg_thread = threading.Thread(
+                target=_run, name="oracle-refresh", daemon=True
+            )
+            self._bg_thread.start()
 
     # -- query API (host-side, post-batch) ---------------------------------
 

@@ -463,14 +463,17 @@ class PendingBatch:
     hides the host<->device link round-trip — the dominant per-batch cost on
     a tunneled TPU — behind that work."""
 
-    __slots__ = ("blob", "out", "pack", "used_pallas", "_rerun")
+    __slots__ = ("blob", "out", "pack", "used_pallas", "_rerun", "blob_np")
 
-    def __init__(self, blob, out, pack, used_pallas, rerun):
+    def __init__(self, blob, out, pack, used_pallas, rerun, blob_np=None):
         self.blob = blob
         self.out = out
         self.pack = pack
         self.used_pallas = used_pallas
         self._rerun = rerun
+        # already-fetched host copy (a dispatch-side fallback proves the
+        # scan path by fetching; don't pay the link round-trip twice)
+        self.blob_np = blob_np
 
 
 def dispatch_batch(batch_args, progress_args, scan_mesh=None) -> PendingBatch:
@@ -505,18 +508,20 @@ def dispatch_batch(batch_args, progress_args, scan_mesh=None) -> PendingBatch:
             top_k=top_k, scan_mesh=scan_mesh,
         )
 
+    blob_np = None
     if use_pallas:
         try:
             blob, out = run(True)
         except Exception as e:  # noqa: BLE001 — lowering/compile failure
             # Only blame (and permanently disable) the pallas kernel if the
             # scan path EXECUTES where it failed — a cache-hit dispatch
-            # alone proves nothing, so force the device round-trip here. If
-            # that fails too, the problem is the batch/link, not the
-            # kernel — surface the original error.
+            # alone proves nothing, so force the device round-trip here (and
+            # keep the fetched copy for collect). If that fails too, the
+            # problem is the batch/link, not the kernel — surface the
+            # original error.
             try:
                 blob, out = run(False)
-                np.asarray(jax.device_get(blob))
+                blob_np = np.asarray(jax.device_get(blob))
             except Exception:
                 raise e from None
             _disable_pallas(e)
@@ -526,11 +531,12 @@ def dispatch_batch(batch_args, progress_args, scan_mesh=None) -> PendingBatch:
 
     # Queue the D2H copy now so it rides behind the computation instead of
     # waiting for the collect call (optional API; device_get works without).
-    try:
-        blob.copy_to_host_async()
-    except (AttributeError, RuntimeError):
-        pass
-    return PendingBatch(blob, out, pack, use_pallas, run)
+    if blob_np is None:
+        try:
+            blob.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+    return PendingBatch(blob, out, pack, use_pallas, run, blob_np)
 
 
 def _disable_pallas(e: Exception) -> None:
@@ -551,7 +557,11 @@ def collect_batch(pending: PendingBatch):
     the batch re-runs once on the lax.scan form before the kernel is blamed
     and permanently disabled (same policy as the synchronous path)."""
     try:
-        blob_np = np.asarray(jax.device_get(pending.blob))
+        blob_np = (
+            pending.blob_np
+            if pending.blob_np is not None
+            else np.asarray(jax.device_get(pending.blob))
+        )
         out = pending.out
     except Exception as e:  # noqa: BLE001 — device-side runtime failure
         if not pending.used_pallas:

@@ -44,6 +44,10 @@ class PluginConfig:
     # every invalidation; >0 bounds batch rate under churn — denials are
     # already 20s-sticky so bounded staleness is inside existing semantics).
     min_batch_interval_seconds: float = 0.0
+    # Re-batch on a daemon thread while serving the stale (but
+    # known-complete) batch — takes the device round-trip off the
+    # scheduling cycle's critical path (see OracleScorer.background_refresh).
+    oracle_background_refresh: bool = False
     controller_workers: int = 10
     leader_poll_seconds: float = 1.0
     lease_renew_seconds: float = 3.0
@@ -112,6 +116,11 @@ class PluginRuntime:
         self.plugin.stop()
         self.controller.stop()
         self.informers.stop()
+        oracle = getattr(self.operation, "oracle", None)
+        if oracle is not None:
+            # let any in-flight background batch finish before the process
+            # (and with it the XLA runtime) can go away
+            oracle.drain_background()
 
 
 def new_plugin_runtime(
@@ -149,6 +158,7 @@ def new_plugin_runtime(
         pg_lister=pg_informer.get_typed,
         scorer=config.scorer,
         min_batch_interval=config.min_batch_interval_seconds,
+        background_refresh=config.oracle_background_refresh,
         **kwargs,
     )
 

@@ -38,6 +38,7 @@ class SimCluster:
         controller_resync_seconds: float = 0.1,
         enabled_points=None,
         min_batch_interval: float = 0.0,
+        oracle_background_refresh: bool = False,
         api=None,
     ):
         # ``api``: any APIServer-interface implementation — pass an
@@ -54,6 +55,7 @@ class SimCluster:
             max_schedule_minutes=max_schedule_minutes,
             controller_resync_seconds=controller_resync_seconds,
             min_batch_interval_seconds=min_batch_interval,
+            oracle_background_refresh=oracle_background_refresh,
             **kwargs,
         )
         self.runtime = None

@@ -378,6 +378,9 @@ def config6_framework_e2e(num_nodes=5000, num_groups=1000, members=10):
         backoff_cap=5.0,
         controller_resync_seconds=2.0,
         min_batch_interval=1.0,
+        # re-batches ride a daemon thread: gang completions dirty the batch,
+        # but queued pods keep draining through the last plan meanwhile
+        oracle_background_refresh=True,
     )
     nodes_typed = [
         make_sim_node(

@@ -209,3 +209,65 @@ def test_compare_queue_ordering():
     assert not op.compare(pb, 1.0, pa, 9.0)
     pa2 = make_pod("pa2", group="alpha", requests={"cpu": "1"})
     assert op.compare(pa, 1.0, pa2, 2.0)         # same group: queue timestamp
+
+
+def test_background_refresh_serves_stale_then_recovers():
+    """background_refresh=True: a dirty-but-servable batch answers from the
+    old state immediately while a daemon thread re-batches; a missing group
+    still blocks; a failed background batch surfaces in a later cycle."""
+    import time as _time
+
+    op, cache, cluster, pods = build_race("oracle")
+    oracle = op.oracle
+    oracle.background_refresh = True
+
+    # first ensure_fresh: no state yet -> blocking refresh
+    oracle.ensure_fresh(cluster, cache, group="default/race1")
+    assert oracle.batches_run == 1
+
+    # dirty + servable -> immediate return (stale answers), background batch
+    oracle.mark_dirty()
+    oracle.ensure_fresh(cluster, cache, group="default/race1")
+    assert oracle.gang_feasible("default/race1")  # served without blocking
+    deadline = _time.monotonic() + 5.0
+    while oracle.batches_run < 2 and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    assert oracle.batches_run == 2  # the daemon thread re-batched
+
+    # a group missing from the snapshot forces the blocking path
+    pg = make_group("late", 1, creation_ts=9.0)
+    status_for(pg, cache, rep_pod=make_pod("late-0", group="late", requests={"cpu": "1"}))
+    oracle.mark_dirty()
+    oracle.ensure_fresh(cluster, cache, group="default/late")
+    assert oracle.batches_run == 3
+    assert oracle.gang_feasible("default/late")
+
+    # background failure -> recorded, then consumed by a blocking refresh
+    oracle._bg_error = RuntimeError("link down")
+    oracle.mark_dirty()
+    oracle.ensure_fresh(cluster, cache, group="default/race1")  # blocking path
+    assert oracle._bg_error is None
+    assert oracle.batches_run == 4
+
+
+def test_mark_dirty_during_refresh_survives():
+    """Compare-and-clear: an invalidation landing while the batch is on the
+    device (routine with background_refresh) must leave the batch stale —
+    refresh() records the generation it observed BEFORE packing, not a
+    blanket 'clean now'."""
+    op, cache, cluster, pods = build_race("oracle")
+    oracle = op.oracle
+    real_execute = oracle._execute
+
+    def execute_and_invalidate(snap):
+        out = real_execute(snap)
+        oracle.mark_dirty()  # a gang completed while the batch was in flight
+        return out
+
+    oracle._execute = execute_and_invalidate
+    oracle.ensure_fresh(cluster, cache, group="default/race1")
+    oracle._execute = real_execute
+    assert oracle._stale(cluster)  # the mid-flight invalidation survived
+    oracle.ensure_fresh(cluster, cache, group="default/race1")
+    assert not oracle._stale(cluster)
+    assert oracle.batches_run == 2

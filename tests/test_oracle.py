@@ -2,6 +2,7 @@
 batched TPU path and the serial reference-parity path."""
 
 import numpy as np
+import pytest
 
 from batch_scheduler_tpu.cache import PGStatusCache
 from batch_scheduler_tpu.core.resources import find_max_group_serial
@@ -207,6 +208,66 @@ def test_snapshot_fit_mask_selector():
     out = schedule_batch(*snap.device_args())
     alloc = np.asarray(out["assignment"])
     assert alloc[0, 0] == 2 and alloc[0, 1] == 0
+
+
+def test_collect_batch_fallback_policy():
+    """The Pallas-blame policy at the collect sync point: a device failure on
+    a pallas-dispatched batch re-runs once on the scan form; only if the
+    scan succeeds is the kernel disabled for the process. A scan-path (or
+    non-pallas) failure surfaces unchanged."""
+    import warnings
+
+    from batch_scheduler_tpu.ops import oracle as omod
+    from batch_scheduler_tpu.ops.oracle import (
+        PendingBatch,
+        collect_batch,
+        dispatch_batch,
+    )
+
+    nodes = [make_node("n0", {"cpu": "8", "memory": "8Gi", "pods": "10"})]
+    groups = [GroupDemand("default/g", 2, member_request={"cpu": 1000})]
+    snap = ClusterSnapshot(nodes, {}, groups)
+    good = dispatch_batch(snap.device_args(), snap.progress_args())
+    good_blob = good.blob
+    good_out = good.out
+
+    class Boom:
+        def __array__(self, dtype=None):
+            raise RuntimeError("device exploded")
+
+    saved = omod._pallas_enabled
+    try:
+        # pallas batch fails at collect, scan rerun succeeds -> result comes
+        # back, kernel disabled, warning emitted
+        omod._pallas_enabled = True
+        pend = PendingBatch(
+            Boom(), good_out, good.pack, True, lambda up: (good_blob, good_out)
+        )
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            host, _ = collect_batch(pend)
+        assert host["placed"][:1].tolist() == [True]
+        assert omod._pallas_enabled is False
+        assert any("pallas" in str(x.message) for x in w)
+
+        # non-pallas batch failing surfaces directly, flag untouched
+        omod._pallas_enabled = True
+        pend2 = PendingBatch(Boom(), good_out, good.pack, False, None)
+        with pytest.raises(RuntimeError, match="device exploded"):
+            collect_batch(pend2)
+        assert omod._pallas_enabled is True
+
+        # pallas batch fails AND the scan rerun fails -> the ORIGINAL error
+        # surfaces and the kernel is NOT blamed
+        def bad_rerun(up):
+            raise ValueError("link down")
+
+        pend3 = PendingBatch(Boom(), good_out, good.pack, True, bad_rerun)
+        with pytest.raises(RuntimeError, match="device exploded"):
+            collect_batch(pend3)
+        assert omod._pallas_enabled is True
+    finally:
+        omod._pallas_enabled = saved
 
 
 def test_find_max_group_matches_serial():
