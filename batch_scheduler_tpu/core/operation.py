@@ -101,7 +101,18 @@ class ScheduleOperation:
             if min_batch_interval:
                 scorer.min_batch_interval = min_batch_interval
             if background_refresh:
-                scorer.background_refresh = True
+                if getattr(scorer, "supports_background_refresh", True):
+                    scorer.background_refresh = True
+                else:
+                    import warnings
+
+                    warnings.warn(
+                        "background_refresh requested but "
+                        f"{type(scorer).__name__} does not support it "
+                        "(single-connection transports would stall row "
+                        "reads behind the background batch); running with "
+                        "blocking refresh"
+                    )
         self.last_denied_pg = TTLCache(DENY_CACHE_DEFAULT_TTL, DENY_CACHE_JANITOR, clock=clock)
         self.last_permitted_pod = TTLCache(PERMITTED_CACHE_DEFAULT_TTL, DENY_CACHE_JANITOR, clock=clock)
         self._lock = threading.RLock()

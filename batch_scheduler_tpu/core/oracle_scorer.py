@@ -97,6 +97,8 @@ class _BatchState:
 class OracleScorer:
     """Caches one batch of oracle results; invalidated by ``mark_dirty``."""
 
+    supports_background_refresh = True
+
     def __init__(
         self,
         min_batch_interval: float = 0.0,
@@ -226,6 +228,16 @@ class OracleScorer:
             if bool(host["best_exists"]) and int(host["best"]) < len(snap.group_names)
             else ""
         )
+        # Credits issued while this batch was packing/on-device offset the
+        # OLD batch's staleness check and die with it: their version bumps
+        # may or may not have made this snapshot (the assume could land
+        # before or after the cluster read), so carrying them into the new
+        # base could mark a snapshot that predates an assume as fresh — its
+        # divergent plan would then serve until gang completion. Zeroing is
+        # the conservative direction: any bump during the window leaves
+        # version() ahead of the base and the batch re-runs.
+        with self._credits_lock:
+            self._version_credits = 0
         self._state = _BatchState(snap, host, max_group, row_fetcher)
         self._cluster_version = version_base
         self._clean_gen = dirty_gen  # compare-and-clear: later marks survive
@@ -314,14 +326,22 @@ class OracleScorer:
         """Wait out any in-flight background batch. MUST be called before
         process teardown when background_refresh is on: a daemon thread dying
         inside an XLA call while the runtime is being destroyed aborts the
-        process."""
-        self.background_refresh = False  # no new kicks after drain
-        t = self._bg_thread
+        process. The flag flip and the thread read share _bg_lock with the
+        kick path (which rechecks the flag under it), so no new thread can
+        start after this returns."""
+        with self._bg_lock:
+            self.background_refresh = False  # no new kicks after drain
+            t = self._bg_thread
         if t is not None and t.is_alive():
             t.join(timeout)
 
     def _kick_background_refresh(self, cluster, status_cache: PGStatusCache) -> None:
         with self._bg_lock:
+            # recheck under the lock: ensure_fresh's unlocked read can race
+            # a concurrent drain_background, and spawning after the drain
+            # would resurrect the teardown abort it exists to prevent
+            if not self.background_refresh:
+                return
             if self._bg_thread is not None and self._bg_thread.is_alive():
                 return
 

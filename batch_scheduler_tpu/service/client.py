@@ -74,6 +74,13 @@ class OracleClient:
 class RemoteScorer(OracleScorer):
     """OracleScorer whose batch executes on the sidecar service."""
 
+    # A background batch would hold the single connection's lock for the
+    # whole sidecar round-trip, so any uncached row read in a scheduling
+    # cycle would stall behind it — the critical-path cost would come back
+    # hidden inside node_capacity/node_score. Until the client muxes
+    # requests (or uses a second connection), background refresh is refused.
+    supports_background_refresh = False
+
     def __init__(self, client: OracleClient):
         super().__init__()
         self._client = client

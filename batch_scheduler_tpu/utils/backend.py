@@ -44,9 +44,22 @@ def resolve_platform(
     # operator override): the accelerator probe is pure overhead — and up
     # to ~160s of timeouts when the tunnel is hung. Reading the config does
     # not initialize a backend.
+    #
+    # The ENV pin is checked separately from the config: an accelerator
+    # plugin registered at interpreter start (this environment's axon
+    # sitecustomize) OVERRIDES jax_platforms to "<plugin>,cpu", so an
+    # operator's JAX_PLATFORMS=cpu never reaches the config — honoring the
+    # env var directly is what makes `JAX_PLATFORMS=cpu <anything>` safe
+    # even while the plugin's transport is hung.
+    import os
+
     import jax
 
-    if jax.config.jax_platforms == "cpu":
+    if (
+        jax.config.jax_platforms == "cpu"
+        or os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+    ):
+        jax.config.update("jax_platforms", "cpu")
         _resolved = ("cpu", None)
         return _resolved
 

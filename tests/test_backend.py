@@ -41,12 +41,38 @@ def test_result_is_cached(monkeypatch):
     assert calls == []  # pinned-cpu shortcut, and cached on repeat
 
 
+def test_env_pin_wins_over_plugin_config_override(monkeypatch):
+    """JAX_PLATFORMS=cpu in the ENV is honored even when a plugin registered
+    at interpreter start rewrote the config to "axon,cpu" (this
+    environment's sitecustomize): no probe, config forced back to cpu."""
+    import jax
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setattr(
+        type(jax.config), "jax_platforms", property(lambda self: "axon,cpu"),
+        raising=False,
+    )
+
+    def boom(*a, **kw):
+        raise AssertionError("probe subprocess must not be spawned")
+
+    monkeypatch.setattr(subprocess, "run", boom)
+    updates = []
+    monkeypatch.setattr(
+        jax.config, "update", lambda k, v: updates.append((k, v))
+    )
+    platform, err = backend.resolve_platform()
+    assert (platform, err) == ("cpu", None)
+    assert ("jax_platforms", "cpu") in updates
+
+
 def test_hang_degrades_to_cpu(monkeypatch):
     """A probe that times out every attempt degrades to CPU with the error
     recorded (the hung-tunnel path, exercised for real this round)."""
     import jax
 
-    # bypass the pinned-cpu shortcut to reach the probe loop
+    # bypass the pinned-cpu shortcuts (config AND env) to reach the probe
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
     monkeypatch.setattr(
         type(jax.config), "jax_platforms", property(lambda self: "axon"),
         raising=False,

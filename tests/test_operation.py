@@ -271,3 +271,58 @@ def test_mark_dirty_during_refresh_survives():
     oracle.ensure_fresh(cluster, cache, group="default/race1")
     assert not oracle._stale(cluster)
     assert oracle.batches_run == 2
+
+
+def test_credits_issued_during_refresh_die_with_old_batch():
+    """A plan-covered assume landing while a (background) batch is packing /
+    on-device credits the OLD batch; the NEW batch must not inherit the
+    offset — its snapshot may predate the assume, so it re-batches instead
+    of serving a divergent plan as fresh."""
+    op, cache, cluster, pods = build_race("oracle")
+    cluster.version_counter = 7
+    cluster.version = lambda: cluster.version_counter
+    oracle = op.oracle
+    oracle.ensure_fresh(cluster, cache, group="default/race1")
+    assert not oracle._stale(cluster)
+
+    real_execute = oracle._execute
+
+    def execute_with_interleaved_assume(snap):
+        out = real_execute(snap)
+        # while the batch is on the device: a member assumes through the
+        # old batch's plan (version bump + matching credit)
+        cluster.version_counter += 1
+        oracle.credit_expected_change(1)
+        return out
+
+    oracle.mark_dirty()
+    oracle._execute = execute_with_interleaved_assume
+    oracle.ensure_fresh(cluster, cache, group="default/race1")
+    oracle._execute = real_execute
+    # the new batch's base predates the bump and the credit was discarded
+    assert oracle._stale(cluster)
+    oracle.ensure_fresh(cluster, cache, group="default/race1")
+    assert not oracle._stale(cluster)
+
+
+def test_background_refresh_refused_on_unsupporting_scorer():
+    """A scorer instance that declares supports_background_refresh=False
+    (RemoteScorer: single-connection transport) is left on the blocking
+    path, with a warning."""
+    import warnings
+
+    from batch_scheduler_tpu.core.oracle_scorer import OracleScorer
+
+    class SingleConn(OracleScorer):
+        supports_background_refresh = False
+
+    node = make_node("n1", {"cpu": "8", "memory": "32Gi", "pods": "110"})
+    scorer = SingleConn()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        ScheduleOperation(
+            PGStatusCache(), FakeCluster([node]), scorer=scorer,
+            background_refresh=True,
+        )
+    assert scorer.background_refresh is False
+    assert any("background_refresh" in str(x.message) for x in w)
