@@ -186,8 +186,6 @@ class OracleScorer:
         # version() ahead of the base (or the generation ahead of the one
         # recorded at completion) and re-batches conservatively.
         dirty_gen = self._dirty_gen
-        with self._credits_lock:
-            self._version_credits = 0
         version_fn = getattr(cluster, "version", None)
         version_base = version_fn() if callable(version_fn) else None
         statuses = status_cache.snapshot()
@@ -228,20 +226,23 @@ class OracleScorer:
             if bool(host["best_exists"]) and int(host["best"]) < len(snap.group_names)
             else ""
         )
+        self._state = _BatchState(snap, host, max_group, row_fetcher)
+        self._cluster_version = version_base
+        self._clean_gen = dirty_gen  # compare-and-clear: later marks survive
+        self.batches_run += 1
         # Credits issued while this batch was packing/on-device offset the
         # OLD batch's staleness check and die with it: their version bumps
         # may or may not have made this snapshot (the assume could land
         # before or after the cluster read), so carrying them into the new
         # base could mark a snapshot that predates an assume as fresh — its
-        # divergent plan would then serve until gang completion. Zeroing is
-        # the conservative direction: any bump during the window leaves
-        # version() ahead of the base and the batch re-runs.
+        # divergent plan would then serve until gang completion. The zero
+        # comes AFTER the publication above: a credit landing mid-publish is
+        # still an old-plan credit (on_assume matches plan_batch_seq against
+        # batches_run) and must die; one landing after the zero can only be
+        # against the new batch. Either race direction errs toward an extra
+        # re-batch, never toward serving a divergent plan as fresh.
         with self._credits_lock:
             self._version_credits = 0
-        self._state = _BatchState(snap, host, max_group, row_fetcher)
-        self._cluster_version = version_base
-        self._clean_gen = dirty_gen  # compare-and-clear: later marks survive
-        self.batches_run += 1
         self._last_batch_t = time.monotonic()
         with self._stats_lock:
             self.pack_seconds.append(t_pack - t0)

@@ -392,8 +392,14 @@ def config6_framework_e2e(num_nodes=5000, num_groups=1000, members=10):
     cluster.add_nodes(nodes_typed)
     member_req = {"cpu": 4000, "memory": 8 * 1024**3, GPU: 1}
     groups_typed = []
+    # recent stamps with preserved order: epoch-scale creation_ts would trip
+    # the controller's 48h GC horizon once gangs schedule, silencing its
+    # post-schedule reconciliation and flattering the measured host load
+    base_ts = time.time() - num_groups * 1e-3
     for g in range(num_groups):
-        pg = make_sim_group(f"gang-{g:04d}", members, creation_ts=float(g))
+        pg = make_sim_group(
+            f"gang-{g:04d}", members, creation_ts=base_ts + g * 1e-3
+        )
         # spec-level member shape: demand rows are real before any pod
         # arrives, so the first batch can plan every gang
         pg.spec.min_resources = dict(member_req)
