@@ -34,6 +34,22 @@ from .snapshot import ClusterSnapshot, GroupDemand
 __all__ = ["ChurnRescorer", "TickResult", "PendingTick"]
 
 
+@jax.jit
+def _scatter_add_rows(req, rows, updates):
+    """Apply admit/release deltas to the device-resident occupancy array:
+    steady ticks move only the delta rows (KBs) over the host link, not the
+    whole [N,R] array. NOT donated — in a pipelined loop the previous
+    tick's batch can still hold the old buffer as a live input; the update
+    allocates on device, where the [N,R] copy is effectively free."""
+    return req.at[rows].add(updates)
+
+
+# Fixed delta width: every scatter shares ONE jit signature per [N,R]
+# shape (warmed at first upload), so no steady tick can hit a mid-loop
+# compile. Bigger bursts fall back to a full mirror re-upload.
+_DELTA_BUCKET = 64
+
+
 @dataclass
 class TickResult:
     """One re-score round: the oracle's O(G) answers + timing breakdown."""
@@ -127,6 +143,14 @@ class ChurnRescorer:
         self._sticky = sticky_buckets
         self._sticky_buckets = (0, 0)
         self._alloc_dev = None  # device-resident padded alloc (see tick)
+        # Device-resident occupancy: the padded requested array stays on
+        # device; admit/release append deltas here and the dispatch fast
+        # path scatter-adds them instead of re-uploading [N,R] every tick.
+        # Invariant: _req_dev == padded(mirror at last upload) + every delta
+        # appended since; any failure drops _req_dev and the next tick
+        # re-uploads the numpy mirror (the ground truth) and clears deltas.
+        self._req_dev = None
+        self._req_deltas: List[tuple] = []  # (row_idx[int32], update[?,R])
 
     def tick(
         self,
@@ -200,6 +224,10 @@ class ChurnRescorer:
             ):
                 self._alloc_dev = jax.device_put(args[0])
             args = (self._alloc_dev,) + args[1:]
+        if nodes is None and node_requested is None:
+            # occupancy stays on device too: steady ticks ship only the
+            # admit/release deltas accrued since the last dispatch
+            args = (args[0], self._requested_device(args[1])) + args[2:]
 
         t1 = time.perf_counter()
         pending = dispatch_batch(args, snap.progress_args())
@@ -233,6 +261,50 @@ class ChurnRescorer:
             dispatch_seconds=t_dispatch,
             bucket_shape=bucket_shape,
         )
+
+    def _requested_device(self, padded_requested: np.ndarray):
+        """Return the device-resident padded occupancy array, synced to the
+        numpy mirror: first use (or any post-failure resync) uploads the
+        mirror whole and drops queued deltas; steady ticks scatter-add only
+        the queued admit/release rows (bucketed so the jit signature is
+        stable). On any failure the device copy is dropped — the next tick
+        re-uploads ground truth."""
+        try:
+            deltas = self._req_deltas
+            rows_total = sum(len(d[0]) for d in deltas)
+            if (
+                self._req_dev is None
+                or self._req_dev.shape != padded_requested.shape
+                or rows_total > _DELTA_BUCKET  # burst: re-upload is cheaper
+            ):
+                deltas.clear()
+                self._req_dev = jax.device_put(padded_requested)
+                # compile the (sole) scatter signature now, outside any
+                # tick budget — a zero delta is a numeric no-op
+                self._req_dev = _scatter_add_rows(
+                    self._req_dev,
+                    np.zeros(_DELTA_BUCKET, dtype=np.int32),
+                    np.zeros(
+                        (_DELTA_BUCKET, padded_requested.shape[1]),
+                        dtype=np.int32,
+                    ),
+                )
+                return self._req_dev
+            if deltas:
+                rows = np.concatenate([d[0] for d in deltas])
+                ups = np.concatenate([d[1] for d in deltas])
+                deltas.clear()
+                pad = _DELTA_BUCKET - len(rows)
+                rows = np.concatenate([rows, np.zeros(pad, dtype=np.int32)])
+                ups = np.concatenate(
+                    [ups, np.zeros((pad, ups.shape[1]), dtype=np.int32)]
+                )
+                self._req_dev = _scatter_add_rows(self._req_dev, rows, ups)
+            return self._req_dev
+        except Exception:
+            self._req_dev = None
+            self._req_deltas.clear()
+            raise
 
     def tick_collect(self, pend: "PendingTick") -> TickResult:
         """The sync half of ``tick_dispatch``: wait for (or, pipelined, just
@@ -319,13 +391,21 @@ class ChurnRescorer:
         mask = counts > 0
         idx, cnt = nodes_idx[mask], counts[mask].astype(np.int64)
         vec = self._member_lane_vec(group)
-        self.requested_lanes[idx] += (cnt[:, None] * vec[None, :]).astype(np.int32)
-        self._running[full_name] = (idx, cnt, vec)
+        update = (cnt[:, None] * vec[None, :]).astype(np.int32)
+        self.requested_lanes[idx] += update
+        if self._req_dev is not None:
+            # only queue while a device copy exists to drain into — the
+            # upload path rebuilds from the mirror and discards the queue
+            self._req_deltas.append((idx.astype(np.int32), update))
+        self._running[full_name] = (idx, update)
 
     def release(self, full_name: str) -> None:
-        """A running gang finished: free its occupancy."""
-        idx, cnt, vec = self._running.pop(full_name)
-        self.requested_lanes[idx] -= (cnt[:, None] * vec[None, :]).astype(np.int32)
+        """A running gang finished: free its occupancy (the exact negation
+        of the admit-time update, by construction)."""
+        idx, update = self._running.pop(full_name)
+        self.requested_lanes[idx] -= update
+        if self._req_dev is not None:
+            self._req_deltas.append((idx.astype(np.int32), -update))
 
     @property
     def running(self) -> List[str]:

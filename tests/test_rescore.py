@@ -169,3 +169,55 @@ def test_pipelined_stats_recorded_per_collect():
     r.tick_collect(pend)
     assert len(r.latencies) == 1
     assert len(r.dispatch_times) == len(r.collect_times) == 1
+
+
+def test_device_resident_occupancy_matches_reupload():
+    """The delta-scatter device occupancy path must be indistinguishable
+    from re-uploading the numpy mirror every tick, across admits, releases,
+    and arrivals (the mirror is ground truth; the device copy is an
+    optimization for the host link)."""
+    nodes = _nodes(6, cpu="8")
+
+    def drive(force_reupload):
+        r = ChurnRescorer(nodes)
+        placed_seq = []
+        pending = [_gang(f"g{i}", 3, ts=float(i)) for i in range(6)]
+        for t in range(6):
+            if force_reupload:
+                r._req_dev = None
+                r._req_deltas.clear()  # mirror is ground truth
+            out = r.tick(None, pending)
+            placed = sorted(out.placed_groups())
+            for g in list(pending):
+                if g.full_name in placed:
+                    r.admit(out, g.full_name)
+                    pending.remove(g)
+            placed_seq.append(placed)
+            if t == 2 and r.running:
+                r.release(sorted(r.running)[0])
+                pending.append(_gang(f"h{t}", 2, ts=10.0 + t))
+        return placed_seq, r.requested_lanes.copy()
+
+    seq_dev, mirror_dev = drive(force_reupload=False)
+    seq_up, mirror_up = drive(force_reupload=True)
+    assert seq_dev == seq_up
+    np.testing.assert_array_equal(mirror_dev, mirror_up)
+
+
+def test_device_occupancy_resyncs_after_failure():
+    """A failed delta application drops the device copy; the next tick
+    re-uploads the mirror and still scores correctly."""
+    nodes = _nodes(4, cpu="4")
+    r = ChurnRescorer(nodes)
+    out = r.tick(None, [_gang("a", 4)])
+    r.admit(out, "default/a")
+    # poison the queued delta so the scatter path raises
+    r._req_deltas.append(("not-an-array", "nope"))
+    with pytest.raises(Exception):
+        r.tick(None, [_gang("b", 2, ts=1.0)])
+    assert r._req_dev is None and r._req_deltas == []
+    # recovery: mirror re-uploads; capacity math reflects the admit
+    out2 = r.tick(None, [_gang("big", 16, ts=2.0), _gang("small", 2, ts=3.0)])
+    placed = out2.placed_groups()
+    assert "default/big" not in placed  # 16 cpus no longer free (4 admitted)
+    assert "default/small" in placed
