@@ -13,7 +13,7 @@ build: vet native
 
 # go-vet analog: byte-compile every module, fail on syntax errors
 vet:
-	$(PY) -m compileall -q batch_scheduler_tpu tests bench.py __graft_entry__.py
+	$(PY) -m compileall -q batch_scheduler_tpu tests benchmarks bench.py __graft_entry__.py
 
 # the native C++ sidecar client + bench harness
 native:
