@@ -269,12 +269,23 @@ def cmd_sim(args) -> int:
 
     scorer = cfg.plugin_config.scorer
     oracle_client = None
+    remote_scorer = None
     if args.oracle_addr:
         from ..service.client import OracleClient, RemoteScorer
 
         host, _, port = args.oracle_addr.rpartition(":")
         oracle_client = OracleClient(host or "127.0.0.1", int(port))
-        scorer = RemoteScorer(oracle_client)
+        # background refresh needs a second connection so row reads on the
+        # current batch never contend with the in-flight background batch
+        bg_client = None
+        if args.oracle_background_refresh:
+            try:
+                bg_client = OracleClient(host or "127.0.0.1", int(port))
+            except OSError:
+                oracle_client.close()
+                raise
+        scorer = RemoteScorer(oracle_client, background_client=bg_client)
+        remote_scorer = scorer
 
     cluster = SimCluster(
         scorer=scorer,
@@ -383,8 +394,8 @@ def cmd_sim(args) -> int:
             print(f"oracle stats: {oracle.stats()}")
     finally:
         cluster.stop()
-        if oracle_client is not None:
-            oracle_client.close()
+        if remote_scorer is not None:
+            remote_scorer.close()  # closes both connections
     return 0
 
 

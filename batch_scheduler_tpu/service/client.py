@@ -72,18 +72,34 @@ class OracleClient:
 
 
 class RemoteScorer(OracleScorer):
-    """OracleScorer whose batch executes on the sidecar service."""
+    """OracleScorer whose batch executes on the sidecar service.
 
-    # A background batch would hold the single connection's lock for the
-    # whole sidecar round-trip, so any uncached row read in a scheduling
-    # cycle would stall behind it — the critical-path cost would come back
-    # hidden inside node_capacity/node_score. Until the client muxes
-    # requests (or uses a second connection), background refresh is refused.
-    supports_background_refresh = False
+    With one connection, background refresh is refused: a background batch
+    would hold the connection's lock for the whole sidecar round-trip, so
+    any uncached row read in a scheduling cycle would stall behind it —
+    the critical-path cost would come back hidden inside
+    node_capacity/node_score.
 
-    def __init__(self, client: OracleClient):
+    Pass ``background_client`` (a second connection to the same server) to
+    lift that: batches alternate between the two connections, and each
+    batch's row fetcher is pinned to the connection that executed it (the
+    server keeps batch state per connection), so row reads on the current
+    batch never contend with the next batch running on the other
+    connection."""
+
+    def __init__(
+        self, client: OracleClient, background_client: OracleClient = None
+    ):
         super().__init__()
-        self._client = client
+        self._clients = [client] if background_client is None else [
+            client, background_client,
+        ]
+        self._next = 0
+        self.supports_background_refresh = background_client is not None
+
+    def close(self) -> None:
+        for c in self._clients:
+            c.close()
 
     def _execute(self, snap: ClusterSnapshot):
         # fit_mask may be the [1,N] broadcast fast path; the wire encoder
@@ -102,7 +118,12 @@ class RemoteScorer(OracleScorer):
             ineligible=snap.ineligible,
             creation_rank=snap.creation_rank,
         )
-        resp = self._client.schedule(req)
+        # _execute calls are serialized by the scorer's _refresh_lock;
+        # alternating here means a background batch runs on the connection
+        # the CURRENT batch's rows are not being read from
+        client = self._clients[self._next]
+        self._next = (self._next + 1) % len(self._clients)
+        resp = client.schedule(req)
         host = {
             "gang_feasible": resp.gang_feasible,
             "placed": resp.placed,
@@ -115,9 +136,9 @@ class RemoteScorer(OracleScorer):
         batch_seq = resp.batch_seq
 
         def row_fetcher(kind: str, g: int) -> np.ndarray:
-            # the captured batch_seq pins this fetcher to ITS batch: if a
-            # newer batch has run on the connection, the server answers an
-            # in-band stale-batch error instead of another batch's row
-            return self._client.row(kind, g, batch_seq)
+            # the captured batch_seq pins this fetcher to ITS batch ON ITS
+            # connection: if a newer batch has run there, the server answers
+            # an in-band stale-batch error instead of another batch's row
+            return client.row(kind, g, batch_seq)
 
         return host, row_fetcher

@@ -192,3 +192,60 @@ def test_native_client_protocol_constants_in_sync():
         m = re.search(rf"{name}\s*=\s*(\d+)", src)
         assert m, f"{name} not found in bsp_client.cpp"
         assert int(m.group(1)) == value, f"{name} drifted: C++ {m.group(1)} != py {value}"
+
+
+def test_remote_scorer_dual_connection_background_refresh(server):
+    """Two connections unlock background refresh remotely: batches
+    alternate between the connections, each batch's rows answer from the
+    connection that executed it (no stale-batch errors across the
+    alternation), and the operation accepts background_refresh without the
+    single-connection downgrade warning."""
+    import warnings
+
+    host, port = server.address
+    c_fg, c_bg = OracleClient(host, port), OracleClient(host, port)
+    scorer = RemoteScorer(c_fg, background_client=c_bg)
+    assert scorer.supports_background_refresh
+
+    node = make_node("n1", {"cpu": "8", "memory": "32Gi", "pods": "110"})
+    cluster = FakeCluster([node])
+    cache = PGStatusCache()
+    pg = make_group("dual", 2, creation_ts=1.0)
+    members = [
+        make_pod(f"dual-{i}", group="dual", requests={"cpu": "1"})
+        for i in range(2)
+    ]
+    status_for(pg, cache, rep_pod=members[0])
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        op = ScheduleOperation(
+            cache, cluster, scorer=scorer, background_refresh=True
+        )
+    assert not any("background_refresh" in str(x.message) for x in w)
+    assert scorer.background_refresh is True
+
+    import time as _time
+
+    scorer.ensure_fresh(cluster, cache, group="default/dual")  # blocking: no state yet
+    assert scorer.batches_run == 1
+
+    # each round: a BACKGROUND batch runs on the alternate connection while
+    # rows keep answering from the current batch's connection (a wrong
+    # routing would answer stale-batch in-band)
+    for round_no in range(3):
+        scorer.mark_dirty()
+        scorer.ensure_fresh(cluster, cache, group="default/dual")  # kicks bg
+        assert scorer._bg_thread is not None  # background path actually ran
+        assert op.score(members[0], "n1") > -(2**30)  # stale rows still served
+        deadline = _time.monotonic() + 10.0
+        while (
+            scorer.batches_run < round_no + 2
+            and _time.monotonic() < deadline
+        ):
+            _time.sleep(0.01)
+        assert scorer.batches_run == round_no + 2, scorer._bg_error
+        assert op.score(members[0], "n1") > -(2**30)  # fresh batch's rows
+    assert scorer._bg_error is None
+    scorer.drain_background()
+    scorer.close()
