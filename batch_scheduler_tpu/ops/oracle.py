@@ -71,7 +71,13 @@ _BINS = 128
 
 # Process-wide gate for the fused pallas assignment kernel; flipped off on
 # the first hardware failure (see execute_batch_host) or via env var.
-_pallas_enabled = os.environ.get("BST_DISABLE_PALLAS", "") != "1"
+# Pallas enablement is PER MASK MODE: a lowering/runtime failure on one
+# kernel variant (e.g. the per-group [G,N] mask path) disables only that
+# variant — it must not poison the other, independently proven one.
+_pallas_enabled = {
+    mode: os.environ.get("BST_DISABLE_PALLAS", "") != "1"
+    for mode in ("broadcast", "per_group")
+}
 
 
 @jax.jit
@@ -314,9 +320,11 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
     (the axon tunnel) pays one round-trip, not one per sub-kernel — the
     eager ``top_k``/packing tail alone cost ~10x the batch compute there.
 
-    ``use_pallas=True`` (single TPU device, broadcast [1,N] mask only) swaps
-    the assignment scan for the fused VMEM-resident Pallas kernel
-    (ops.pallas_assign); the GSPMD-sharded path keeps the lax.scan form.
+    ``use_pallas=True`` (single TPU device) swaps the assignment scan for
+    the fused VMEM-resident Pallas kernel (ops.pallas_assign), which
+    handles both the broadcast [1,N] mask and the per-group [G,N] mask;
+    the GSPMD-sharded path keeps the lax.scan form (a pallas_call is a
+    black box to the partitioner).
 
     This is the ``fit()`` of SURVEY.md §7: everything the control plane needs
     for one scheduling batch in a single device round-trip.
@@ -351,7 +359,7 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
         scan_left, scan_gr, scan_rem, scan_fm = (
             left, group_req, remaining, fit_mask,
         )
-    if use_pallas and fit_mask.shape[0] == 1:
+    if use_pallas:
         from .pallas_assign import assign_gangs_pallas
 
         assignment, placed, left_after = assign_gangs_pallas(
@@ -463,9 +471,14 @@ class PendingBatch:
     hides the host<->device link round-trip — the dominant per-batch cost on
     a tunneled TPU — behind that work."""
 
-    __slots__ = ("blob", "out", "pack", "used_pallas", "_rerun", "blob_np")
+    __slots__ = (
+        "blob", "out", "pack", "used_pallas", "_rerun", "blob_np", "mask_mode"
+    )
 
-    def __init__(self, blob, out, pack, used_pallas, rerun, blob_np=None):
+    def __init__(
+        self, blob, out, pack, used_pallas, rerun, blob_np=None,
+        mask_mode="broadcast",
+    ):
         self.blob = blob
         self.out = out
         self.pack = pack
@@ -474,6 +487,7 @@ class PendingBatch:
         # already-fetched host copy (a dispatch-side fallback proves the
         # scan path by fetching; don't pay the link round-trip twice)
         self.blob_np = blob_np
+        self.mask_mode = mask_mode
 
 
 def dispatch_batch(batch_args, progress_args, scan_mesh=None) -> PendingBatch:
@@ -482,15 +496,14 @@ def dispatch_batch(batch_args, progress_args, scan_mesh=None) -> PendingBatch:
     blob. Compilation (including a Pallas Mosaic lowering failure) surfaces
     here synchronously; device execution and the transfer proceed in the
     background until ``collect_batch``."""
-    # The fused Pallas scan is single-device TPU + broadcast-mask only, and
-    # Mosaic lowering is hardware-path-only (tests exercise interpret mode):
-    # if it fails to compile/run on this chip, fall back to the lax.scan
-    # form permanently for the process rather than failing every batch.
-    use_pallas = (
-        _pallas_enabled
-        and jax.default_backend() == "tpu"
-        and batch_args[4].shape[0] == 1
-    )
+    # The fused Pallas scan is single-device TPU only (both mask modes —
+    # broadcast [1,N] and per-group [G,N]), and Mosaic lowering is
+    # hardware-path-only (tests exercise interpret mode): if a variant
+    # fails to compile/run on this chip, fall back to the lax.scan form
+    # permanently for the process FOR THAT VARIANT rather than failing
+    # every batch.
+    mask_mode = "per_group" if batch_args[4].shape[0] != 1 else "broadcast"
+    use_pallas = _pallas_enabled[mask_mode] and jax.default_backend() == "tpu"
     # The packed form saturates per-node counts at 65535; a take can reach
     # the gang's full remaining count on one node, so gate the compact form
     # on the host-side remaining bound and fall back to the exact
@@ -524,7 +537,7 @@ def dispatch_batch(batch_args, progress_args, scan_mesh=None) -> PendingBatch:
                 blob_np = np.asarray(jax.device_get(blob))
             except Exception:
                 raise e from None
-            _disable_pallas(e)
+            _disable_pallas(e, mask_mode)
             use_pallas = False
     else:
         blob, out = run(False)
@@ -536,17 +549,19 @@ def dispatch_batch(batch_args, progress_args, scan_mesh=None) -> PendingBatch:
             blob.copy_to_host_async()
         except (AttributeError, RuntimeError):
             pass
-    return PendingBatch(blob, out, pack, use_pallas, run, blob_np)
+    return PendingBatch(
+        blob, out, pack, use_pallas, run, blob_np, mask_mode
+    )
 
 
-def _disable_pallas(e: Exception) -> None:
-    global _pallas_enabled
-    _pallas_enabled = False
+def _disable_pallas(e: Exception, mask_mode: str) -> None:
+    _pallas_enabled[mask_mode] = False
     import warnings
 
     warnings.warn(
-        f"pallas assignment kernel disabled after failure: {e!r}; "
-        "falling back to the lax.scan path"
+        f"pallas assignment kernel ({mask_mode} mask) disabled after "
+        f"failure: {e!r}; falling back to the lax.scan path for that "
+        "mask mode"
     )
 
 
@@ -574,7 +589,7 @@ def collect_batch(pending: PendingBatch):
             blob_np = np.asarray(jax.device_get(blob))
         except Exception:
             raise e from None
-        _disable_pallas(e)
+        _disable_pallas(e, pending.mask_mode)
 
     g = out["assignment_nodes"].shape[0]
     k = out["assignment_nodes"].shape[1]

@@ -21,11 +21,13 @@ scan into ONE ``pallas_call``:
   equivalent in tests/test_pallas.py and on hardware by
   benchmarks/tpu_smoke.py.
 
-Used for the single-device batch when the fit mask is the broadcast ``[1,N]``
-fast path (no selectors/taints — the common case and the bench shape); a
-group bucket that doesn't divide by CHUNK is padded with inert rows. The
-``lax.scan`` path remains the general fallback and the GSPMD-sharded path
-(a pallas_call is a black box to the partitioner).
+Used for the single-device batch. The fit mask may be the broadcast
+``[1,N]`` row (no selectors/taints — the common case and the bench shape,
+kept grid-resident) or the per-group ``[G,N]`` mask (selector/taint
+workloads), whose rows are pre-permuted and DMA'd chunk-by-chunk like the
+request rows. A group bucket that doesn't divide by CHUNK is padded with
+inert rows. The ``lax.scan`` path remains the fallback and the
+GSPMD-sharded path (a pallas_call is a black box to the partitioner).
 """
 
 from __future__ import annotations
@@ -49,7 +51,8 @@ CHUNK = 8
 
 
 def _kernel(remaining_ref, left0_ref, group_req_ref, mask_ref,
-            takes_ref, placed_ref, left_after_ref, left_scratch):
+            takes_ref, placed_ref, left_after_ref, left_scratch,
+            *, per_group_mask: bool):
     s = pl.program_id(0)
     num_steps = pl.num_programs(0)
 
@@ -57,11 +60,16 @@ def _kernel(remaining_ref, left0_ref, group_req_ref, mask_ref,
     def _():
         left_scratch[:] = left0_ref[:]
 
-    mask = mask_ref[:].astype(jnp.int32)
+    if not per_group_mask:
+        mask = mask_ref[:].astype(jnp.int32)  # [1, N] broadcast row
     placed_rows = []
     # groups arrive pre-permuted into scan order: this step's chunk is rows
     # [s*CHUNK, (s+1)*CHUNK) of the sorted arrays; j is static (unrolled)
     for j in range(CHUNK):
+        if per_group_mask:
+            # this chunk's mask rows arrived pre-permuted like the request
+            # rows; j is static, so this is a static row read
+            mask = mask_ref[j].reshape(1, -1).astype(jnp.int32)
         need = remaining_ref[s * CHUNK + j]
         left = left_scratch[:]  # [R, N]
         req = group_req_ref[j]  # [R] (this chunk's block, static row)
@@ -93,18 +101,23 @@ def _kernel(remaining_ref, left0_ref, group_req_ref, mask_ref,
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def assign_gangs_pallas(left0, group_req, remaining, fit_mask, order,
                         *, interpret: bool = False):
-    """Drop-in for ``ops.oracle.assign_gangs`` (same signature/returns) with
-    the restriction fit_mask.shape[0] == 1 (broadcast fast path).
+    """Drop-in for ``ops.oracle.assign_gangs`` (same signature/returns).
+
+    ``fit_mask`` may be the broadcast ``[1,N]`` row (kept resident in the
+    grid, the common no-selector case) or the full ``[G,N]`` per-group
+    mask (selector/taint workloads): mask rows are pre-permuted into scan
+    order alongside the request rows and DMA'd per chunk.
 
     Returns (alloc[G,N] i32, placed[G] bool, left_after[N,R] i32).
     """
-    if fit_mask.shape[0] != 1:
-        raise ValueError(
-            "assign_gangs_pallas requires the broadcast [1,N] fit mask; "
-            "use ops.oracle.assign_gangs for per-group masks"
-        )
     n, r = left0.shape
     g = group_req.shape[0]
+    per_group_mask = fit_mask.shape[0] != 1
+    if per_group_mask and fit_mask.shape[0] != g:
+        raise ValueError(
+            f"fit_mask rows {fit_mask.shape[0]} must be 1 or match "
+            f"group count {g}"
+        )
 
     # pre-permute groups into scan order so each grid step reads/writes
     # contiguous chunk blocks; outputs are scattered back below. Pad the
@@ -113,11 +126,21 @@ def assign_gangs_pallas(left0, group_req, remaining, fit_mask, order,
     # untouched (their rows are sliced off below).
     group_req_sorted = jnp.take(group_req, order, axis=0)
     remaining_sorted = jnp.take(remaining, order, axis=0)
+    mask_in = fit_mask.astype(jnp.int32)
+    if per_group_mask:
+        mask_in = jnp.take(mask_in, order, axis=0)
     g_pad = -(-g // CHUNK) * CHUNK
     if g_pad != g:
         group_req_sorted = jnp.pad(group_req_sorted, ((0, g_pad - g), (0, 0)))
         remaining_sorted = jnp.pad(remaining_sorted, ((0, g_pad - g),))
+        if per_group_mask:
+            mask_in = jnp.pad(mask_in, ((0, g_pad - g), (0, 0)))
 
+    mask_spec = (
+        pl.BlockSpec((CHUNK, n), lambda s, rem: (s, 0))  # chunk's mask rows
+        if per_group_mask
+        else pl.BlockSpec((1, n), lambda s, rem: (0, 0))  # broadcast row
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,  # remaining (sorted)
         grid=(g_pad // CHUNK,),
@@ -125,7 +148,7 @@ def assign_gangs_pallas(left0, group_req, remaining, fit_mask, order,
             pl.BlockSpec((r, n), lambda s, rem: (0, 0)),  # left0^T
             # step s sees its chunk of the sorted request rows
             pl.BlockSpec((CHUNK, r), lambda s, rem: (s, 0)),
-            pl.BlockSpec((1, n), lambda s, rem: (0, 0)),  # mask
+            mask_spec,
         ],
         out_specs=[
             pl.BlockSpec((CHUNK, n), lambda s, rem: (s, 0)),  # takes
@@ -135,7 +158,7 @@ def assign_gangs_pallas(left0, group_req, remaining, fit_mask, order,
         scratch_shapes=[pltpu.VMEM((r, n), jnp.int32)],
     )
     takes_sorted, placed_sorted, left_after_t = pl.pallas_call(
-        _kernel,
+        functools.partial(_kernel, per_group_mask=per_group_mask),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((g_pad, n), jnp.int32),
@@ -147,7 +170,7 @@ def assign_gangs_pallas(left0, group_req, remaining, fit_mask, order,
         remaining_sorted,
         left0.T,
         group_req_sorted,
-        fit_mask.astype(jnp.int32),
+        mask_in,
     )
     # scatter back to group order (the scan path's un-permute idiom)
     takes = jnp.zeros((g, n), jnp.int32).at[order].set(takes_sorted[:g])

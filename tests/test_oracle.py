@@ -235,39 +235,46 @@ def test_collect_batch_fallback_policy():
         def __array__(self, dtype=None):
             raise RuntimeError("device exploded")
 
-    saved = omod._pallas_enabled
+    saved = dict(omod._pallas_enabled)
     try:
         # pallas batch fails at collect, scan rerun succeeds -> result comes
-        # back, kernel disabled, warning emitted
-        omod._pallas_enabled = True
+        # back, the FAILING VARIANT disabled (the other mode untouched),
+        # warning emitted
+        omod._pallas_enabled.update(broadcast=True, per_group=True)
         pend = PendingBatch(
-            Boom(), good_out, good.pack, True, lambda up: (good_blob, good_out)
+            Boom(), good_out, good.pack, True,
+            lambda up: (good_blob, good_out), mask_mode="broadcast",
         )
         with warnings.catch_warnings(record=True) as w:
             warnings.simplefilter("always")
             host, _ = collect_batch(pend)
         assert host["placed"][:1].tolist() == [True]
-        assert omod._pallas_enabled is False
+        assert omod._pallas_enabled["broadcast"] is False
+        assert omod._pallas_enabled["per_group"] is True  # not poisoned
         assert any("pallas" in str(x.message) for x in w)
 
-        # non-pallas batch failing surfaces directly, flag untouched
-        omod._pallas_enabled = True
+        # non-pallas batch failing surfaces directly, flags untouched
+        omod._pallas_enabled.update(broadcast=True, per_group=True)
         pend2 = PendingBatch(Boom(), good_out, good.pack, False, None)
         with pytest.raises(RuntimeError, match="device exploded"):
             collect_batch(pend2)
-        assert omod._pallas_enabled is True
+        assert omod._pallas_enabled["broadcast"] is True
 
         # pallas batch fails AND the scan rerun fails -> the ORIGINAL error
         # surfaces and the kernel is NOT blamed
         def bad_rerun(up):
             raise ValueError("link down")
 
-        pend3 = PendingBatch(Boom(), good_out, good.pack, True, bad_rerun)
+        pend3 = PendingBatch(
+            Boom(), good_out, good.pack, True, bad_rerun,
+            mask_mode="per_group",
+        )
         with pytest.raises(RuntimeError, match="device exploded"):
             collect_batch(pend3)
-        assert omod._pallas_enabled is True
+        assert omod._pallas_enabled["per_group"] is True
     finally:
-        omod._pallas_enabled = saved
+        omod._pallas_enabled.clear()
+        omod._pallas_enabled.update(saved)
 
 
 def test_find_max_group_matches_serial():

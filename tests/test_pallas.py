@@ -45,10 +45,41 @@ def test_pallas_matches_scan_fuzz():
         _run_both(left, group_req, remaining, mask, order)
 
 
-def test_pallas_rejects_full_mask():
+def test_pallas_matches_scan_per_group_mask_fuzz():
+    """The [G,N] selector-mask path: mask rows ride the chunked DMA like
+    the request rows, pre-permuted into scan order."""
+    rng = np.random.default_rng(11)
+    for trial in range(8):
+        n = int(rng.integers(1, 24))
+        g = int(rng.integers(1, 12))
+        r = int(rng.integers(1, 5))
+        left = rng.integers(0, 40, size=(n, r)).astype(np.int32)
+        group_req = rng.integers(0, 6, size=(g, r)).astype(np.int32)
+        remaining = rng.integers(0, 10, size=g).astype(np.int32)
+        order = rng.permutation(g).astype(np.int32)
+        mask = rng.random((g, n)) < 0.7  # per-group node eligibility
+        _run_both(left, group_req, remaining, mask, order)
+
+
+def test_pallas_per_group_mask_selector_semantics():
+    """A gang selecting one zone places only on its nodes even when the
+    other zone has more room (the fit-mask contract the [G,N] path owns)."""
+    left = np.array([[4000, 10], [8000, 10]], dtype=np.int32)  # n0 east, n1 west
+    group_req = np.array([[1000, 1], [1000, 1]], dtype=np.int32)
+    remaining = np.array([3, 3], dtype=np.int32)
+    mask = np.array([[True, False], [True, True]])  # g0 pinned to n0
+    alloc, placed, _ = _run_both(
+        left, group_req, remaining, mask, np.array([0, 1], np.int32)
+    )
+    assert placed.tolist() == [True, True]
+    assert alloc[0, 1] == 0 and alloc[0, 0] == 3  # g0 never touches west
+
+
+def test_pallas_rejects_mismatched_mask_rows():
     left = np.zeros((2, 2), np.int32)
     with pytest.raises(ValueError):
         assign_gangs_pallas(
             left, np.zeros((3, 2), np.int32), np.zeros(3, np.int32),
-            np.ones((3, 2), bool), np.arange(3, dtype=np.int32),
+            np.ones((2, 2), bool),  # neither 1 nor G rows
+            np.arange(3, dtype=np.int32),
         )
