@@ -1,0 +1,18 @@
+# Container image for the scheduler + oracle sidecar (parity with the
+# reference's 5-line centos7-plus-binary image, reference Dockerfile:1-5).
+# In a real TPU deployment, base this on a TPU-enabled JAX image (the
+# libtpu wheel is host-specific); the slim base below serves the CPU
+# fallback / control-plane-only shape.
+FROM python:3.12-slim
+
+WORKDIR /app
+COPY batch_scheduler_tpu/ batch_scheduler_tpu/
+COPY deploy/ deploy/
+COPY examples/ examples/
+COPY native/ native/
+RUN pip install --no-cache-dir jax numpy \
+    && (command -v g++ >/dev/null && make -C native || true)
+
+# sidecar by default; `sim`/`check-config` via `docker run <img> sim ...`
+ENTRYPOINT ["python", "-m", "batch_scheduler_tpu"]
+CMD ["serve", "--host", "0.0.0.0", "--port", "9090", "--warmup"]
