@@ -5,13 +5,17 @@
 # fallback / control-plane-only shape.
 FROM python:3.12-slim
 
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+
 WORKDIR /app
 COPY batch_scheduler_tpu/ batch_scheduler_tpu/
 COPY deploy/ deploy/
 COPY examples/ examples/
 COPY native/ native/
-RUN pip install --no-cache-dir jax numpy \
-    && (command -v g++ >/dev/null && make -C native || true)
+RUN pip install --no-cache-dir jax numpy pyyaml \
+    && make -C native clean all
 
 # sidecar by default; `sim`/`check-config` via `docker run <img> sim ...`
 ENTRYPOINT ["python", "-m", "batch_scheduler_tpu"]

@@ -25,10 +25,14 @@ plugin; see .claude/skills/verify/SKILL.md).
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+# run from any cwd: resolve the package (and artifacts) via this file
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 NUM_NODES = 5000
 NUM_GROUPS = 1000
@@ -254,6 +258,28 @@ def emit(value, vs_baseline, detail):
     )
 
 
+def recorded_tpu_artifacts():
+    """Repo-committed bench artifacts whose recorded platform is 'tpu' —
+    attached to any degraded (non-TPU or crashed) line so a CPU fallback
+    run is never mistaken for the framework's best hardware evidence.
+    Resolved against the repo root, not the cwd, like every other path
+    here; each candidate's JSON is checked, not just its filename."""
+    import glob
+    import json as _json
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    out = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                rec = _json.load(f)
+            if rec.get("detail", {}).get("platform") == "tpu":
+                out.append(os.path.basename(path))
+        except (OSError, ValueError):
+            continue
+    return out
+
+
 def main():
     platform, backend_err = "unknown", None
     try:
@@ -266,15 +292,15 @@ def main():
         import traceback
 
         traceback.print_exc()
-        emit(
-            -1.0,
-            0.0,
-            {
-                "platform": platform,
-                "error": repr(e)[:500],
-                "backend_init_error": backend_err,
-            },
-        )
+        crash_detail = {
+            "platform": platform,
+            "error": repr(e)[:500],
+            "backend_init_error": backend_err,
+        }
+        recorded = recorded_tpu_artifacts()
+        if recorded:
+            crash_detail["recorded_tpu_artifacts"] = recorded
+        emit(-1.0, 0.0, crash_detail)
         return
 
     total_pods = NUM_GROUPS * MEMBERS
@@ -310,6 +336,10 @@ def main():
         detail["vs_baseline_denominator"] = "serial_python_est_total_s"
     if backend_err is not None:
         detail["backend_init_error"] = backend_err
+    if platform != "tpu":
+        recorded = recorded_tpu_artifacts()
+        if recorded:
+            detail["recorded_tpu_artifacts"] = recorded
     emit(round(oracle["total_s"], 4), round(vs_baseline, 1), detail)
 
 
