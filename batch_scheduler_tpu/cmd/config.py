@@ -31,6 +31,17 @@ PLUGIN_NAME = "batch-scheduler"
 _ACCEPTED_KINDS = {"SchedulerConfiguration", "KubeSchedulerConfiguration"}
 
 
+def _require_bool(args: dict, key: str, default: bool = False) -> bool:
+    """Strict JSON-boolean read: ``bool("false")`` is True, so a string here
+    would silently mean the opposite of what the operator wrote."""
+    value = args.get(key, default)
+    if not isinstance(value, bool):
+        raise ValueError(
+            f"pluginConfig args.{key} must be a JSON boolean, got {value!r}"
+        )
+    return value
+
+
 @dataclass
 class SchedulerConfiguration:
     plugin_config: PluginConfig = field(default_factory=PluginConfig)
@@ -70,6 +81,12 @@ class SchedulerConfiguration:
             scorer=args.get("scorer", "oracle"),
             controller_workers=int(args.get("controller_workers", 10)),
             leader_poll_seconds=float(args.get("leader_poll_seconds", 1.0)),
+            min_batch_interval_seconds=float(
+                args.get("min_batch_interval_seconds", 0.0)
+            ),
+            oracle_background_refresh=_require_bool(
+                args, "oracle_background_refresh"
+            ),
         )
         return cls(
             plugin_config=plugin_config,

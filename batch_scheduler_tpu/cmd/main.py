@@ -121,6 +121,12 @@ def cmd_check_config(args) -> int:
                 "max_schedule_minutes": cfg.plugin_config.max_schedule_minutes,
                 "enabled_points": sorted(cfg.enabled_points),
                 "controller_workers": cfg.plugin_config.controller_workers,
+                "min_batch_interval_seconds": (
+                    cfg.plugin_config.min_batch_interval_seconds
+                ),
+                "oracle_background_refresh": (
+                    cfg.plugin_config.oracle_background_refresh
+                ),
             }
         )
     )
@@ -270,6 +276,10 @@ def cmd_sim(args) -> int:
     scorer = cfg.plugin_config.scorer
     oracle_client = None
     remote_scorer = None
+    want_bg_refresh = (
+        args.oracle_background_refresh
+        or cfg.plugin_config.oracle_background_refresh
+    )
     if args.oracle_addr:
         from ..service.client import OracleClient, RemoteScorer
 
@@ -278,7 +288,7 @@ def cmd_sim(args) -> int:
         # background refresh needs a second connection so row reads on the
         # current batch never contend with the in-flight background batch
         bg_client = None
-        if args.oracle_background_refresh:
+        if want_bg_refresh:
             try:
                 bg_client = OracleClient(host or "127.0.0.1", int(port))
             except OSError:
@@ -291,7 +301,8 @@ def cmd_sim(args) -> int:
         scorer=scorer,
         max_schedule_minutes=cfg.plugin_config.max_schedule_minutes,
         enabled_points=cfg.enabled_points,
-        oracle_background_refresh=args.oracle_background_refresh,
+        min_batch_interval=cfg.plugin_config.min_batch_interval_seconds,
+        oracle_background_refresh=want_bg_refresh,
     )
 
     nodes: List[Node] = []

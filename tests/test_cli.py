@@ -130,6 +130,42 @@ def test_load_reference_parity_config():
     assert cfg.kubeconfig  # clientConnection surfaced
 
 
+def test_config_scorer_batching_args():
+    """pluginConfig.args carries the scorer batching knobs (the config-file
+    analog of --oracle-background-refresh / batch coalescing)."""
+    cfg = SchedulerConfiguration.from_dict(
+        {
+            "pluginConfig": [
+                {
+                    "name": "batch-scheduler",
+                    "args": {
+                        "min_batch_interval_seconds": 0.5,
+                        "oracle_background_refresh": True,
+                    },
+                }
+            ]
+        }
+    )
+    assert cfg.plugin_config.min_batch_interval_seconds == 0.5
+    assert cfg.plugin_config.oracle_background_refresh is True
+    # defaults stay off
+    dflt = load_scheduler_config(None)
+    assert dflt.plugin_config.min_batch_interval_seconds == 0.0
+    assert dflt.plugin_config.oracle_background_refresh is False
+    # a string "false" must fail loudly, not silently mean True
+    with pytest.raises(ValueError, match="JSON boolean"):
+        SchedulerConfiguration.from_dict(
+            {
+                "pluginConfig": [
+                    {
+                        "name": "batch-scheduler",
+                        "args": {"oracle_background_refresh": "false"},
+                    }
+                ]
+            }
+        )
+
+
 def test_default_config_and_bad_kind():
     assert load_scheduler_config(None).enabled_points == DEFAULT_ENABLED
     with pytest.raises(ValueError):
