@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# One-shot TPU artifact capture: run the whole hardware evidence suite the
+# moment the accelerator tunnel answers, writing the round's artifact files
+# at the repo root. Exits non-zero if the backend is not a real TPU (no
+# artifact is overwritten with CPU numbers).
+#
+# Usage: benchmarks/capture_tpu_artifacts.sh [round_tag]   (default r03)
+set -u
+cd "$(dirname "$0")/.."
+TAG="${1:-r03}"
+
+echo "== probing backend =="
+if ! timeout 90 python -c "
+import subprocess, sys
+r = subprocess.run([sys.executable, '-c', 'import jax; print(jax.default_backend())'],
+                   timeout=75, capture_output=True, text=True)
+sys.exit(0 if (r.returncode == 0 and 'tpu' in r.stdout) else 1)
+"; then
+    echo "backend not reachable / not tpu — aborting without touching artifacts"
+    exit 1
+fi
+
+fail=0
+
+echo "== bench (headline batch) =="
+if timeout 900 python bench.py > "/tmp/BENCH_${TAG}.json" 2>/tmp/bench.err; then
+    grep -q '"platform": "tpu"' "/tmp/BENCH_${TAG}.json" \
+        && cp "/tmp/BENCH_${TAG}.json" "BENCH_${TAG}_late.json" \
+        || { echo "bench degraded (not tpu) — keeping prior artifact"; fail=1; }
+else
+    echo "bench failed:"; tail -3 /tmp/bench.err; fail=1
+fi
+
+echo "== pallas hardware smoke (incl. selector-mask variant) =="
+if timeout 900 python benchmarks/tpu_smoke.py > "/tmp/SMOKE_${TAG}.json" 2>/dev/null; then
+    cp "/tmp/SMOKE_${TAG}.json" "TPU_SMOKE_${TAG}.json"
+else
+    echo "smoke failed"; cat "/tmp/SMOKE_${TAG}.json" 2>/dev/null; fail=1
+fi
+
+echo "== measurement ladder (all configs) =="
+if timeout 2400 python benchmarks/ladder.py > "/tmp/LADDER_${TAG}.json" 2>/tmp/ladder.err; then
+    cp "/tmp/LADDER_${TAG}.json" "LADDER_${TAG}_tpu.json"
+else
+    echo "ladder had failures (kept partial output):"; tail -3 /tmp/ladder.err
+    cp "/tmp/LADDER_${TAG}.json" "LADDER_${TAG}_tpu.json" 2>/dev/null
+    fail=1
+fi
+
+echo "== scale headroom probe =="
+timeout 1200 python benchmarks/scale_probe.py > "SCALE_${TAG}.json" 2>/dev/null \
+    || { echo "scale probe failed"; rm -f "SCALE_${TAG}.json"; fail=1; }
+
+echo "== done (fail=${fail}) =="
+ls -la BENCH_${TAG}*.json TPU_SMOKE_${TAG}.json LADDER_${TAG}_tpu.json SCALE_${TAG}.json 2>/dev/null
+exit $fail
