@@ -1,0 +1,138 @@
+"""Host<->device link diagnostic for the tunneled TPU.
+
+Answers the questions the pipelined churn loop's budget depends on:
+
+1. does a jit DISPATCH with numpy args block on the link (per-arg h2d
+   round trips), and how does that scale with argument count?
+2. does the async D2H copy actually pre-stage results (collect ~free)?
+3. what is the floor: dispatch with all-device-resident args?
+
+Prints one JSON line. Run on the TPU host: python benchmarks/link_diag.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _med(f, n=7):
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        f()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def main() -> int:
+    from batch_scheduler_tpu.utils.backend import resolve_platform
+
+    platform, err = resolve_platform()
+    out = {"metric": "link_diag", "platform": platform}
+    if platform != "tpu":
+        out["skipped"] = err or "not tpu"
+        print(json.dumps(out))
+        return 1
+
+    import jax
+    import jax.numpy as jnp
+
+    n, r = 8192, 8
+
+    # --- 1. dispatch cost vs numpy-arg count -----------------------------
+    big_np = np.ones((n, r), np.int32)
+    smalls_np = [np.full((64,), i, np.int32) for i in range(12)]
+    big_dev = jax.device_put(big_np)
+    smalls_dev = [jax.device_put(s) for s in smalls_np]
+
+    @jax.jit
+    def many_args(big, *smalls):
+        acc = big.sum()
+        for s in smalls:
+            acc = acc + s.sum()
+        return jnp.atleast_1d(acc)
+
+    @jax.jit
+    def one_arg(big):
+        return jnp.atleast_1d(big.sum())
+
+    # warm all signatures
+    jax.block_until_ready(many_args(big_dev, *smalls_dev))
+    jax.block_until_ready(one_arg(big_dev))
+
+    out["dispatch_all_device_ms"] = round(
+        _med(lambda: many_args(big_dev, *smalls_dev)) * 1000, 2
+    )
+    out["dispatch_big_np_ms"] = round(_med(lambda: one_arg(big_np)) * 1000, 2)
+    out["dispatch_12_small_np_ms"] = round(
+        _med(lambda: many_args(big_dev, *smalls_np)) * 1000, 2
+    )
+    out["dispatch_big_plus_12_small_np_ms"] = round(
+        _med(lambda: many_args(big_np, *smalls_np)) * 1000, 2
+    )
+
+    # --- 2. D2H: async copy pre-staging vs cold get ----------------------
+    y = jax.block_until_ready(one_arg(big_dev))
+
+    def cold_get():
+        z = one_arg(big_dev)
+        return np.asarray(jax.device_get(z))
+
+    def staged_get():
+        z = one_arg(big_dev)
+        try:
+            z.copy_to_host_async()
+        except Exception:
+            pass
+        time.sleep(0.15)
+        t0 = time.perf_counter()
+        np.asarray(jax.device_get(z))
+        return time.perf_counter() - t0
+
+    out["get_cold_ms"] = round(_med(cold_get) * 1000, 2)
+    out["get_after_async_copy_ms"] = round(
+        float(np.median([staged_get() for _ in range(5)])) * 1000, 2
+    )
+
+    # --- 3. the actual churn tick, split ---------------------------------
+    from batch_scheduler_tpu.ops.rescore import ChurnRescorer
+    from batch_scheduler_tpu.ops.snapshot import GroupDemand
+    from batch_scheduler_tpu.sim.scenarios import make_sim_node
+
+    nodes = [
+        make_sim_node(f"n{i:05d}", {"cpu": "64", "memory": "256Gi", "pods": "110"})
+        for i in range(5000)
+    ]
+    rsc = ChurnRescorer(nodes)
+    rsc.warm([8])
+    gangs = [
+        GroupDemand(f"default/g{i}", 10, member_request={"cpu": 4000},
+                    creation_ts=float(i), has_pod=True)
+        for i in range(4)
+    ]
+    for _ in range(5):
+        pend = rsc.tick_dispatch(None, gangs)
+        time.sleep(0.1)
+        rsc.tick_collect(pend)
+    s = rsc.summary()
+    out["tick_p50_pack_ms"] = round(s["p50_pack_s"] * 1000, 2)
+    out["tick_p50_dispatch_ms"] = round(s["p50_dispatch_s"] * 1000, 2)
+    out["tick_p50_collect_ms"] = round(s["p50_collect_s"] * 1000, 2)
+
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception as e:  # noqa: BLE001 — one JSON line, always
+        print(json.dumps({"metric": "link_diag", "error": repr(e)[:400]}))
+        sys.exit(1)
