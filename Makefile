@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all build vet test test-cpu bench native ladder dryrun clean version
+.PHONY: all build vet test test-cpu bench native ladder dryrun clean version tpu-artifacts
 
 all: vet native test
 
@@ -37,6 +37,11 @@ ladder:
 # pallas-kernel-on-hardware proof (skips with rc=1 off-TPU)
 smoke-tpu:
 	$(PY) benchmarks/tpu_smoke.py
+
+# capture the full hardware-evidence suite (bench, smoke, ladder, scale)
+# into the round's artifact files — aborts untouched if the TPU is away
+tpu-artifacts:
+	bash benchmarks/capture_tpu_artifacts.sh
 
 # GSPMD layout measurement on the 8-device virtual CPU mesh (collective
 # counts per layout; see README "Measured layout choice")
