@@ -12,8 +12,11 @@ TAG="${1:-r03}"
 echo "== probing backend =="
 if ! timeout 90 python -c "
 import subprocess, sys
-r = subprocess.run([sys.executable, '-c', 'import jax; print(jax.default_backend())'],
-                   timeout=75, capture_output=True, text=True)
+try:
+    r = subprocess.run([sys.executable, '-c', 'import jax; print(jax.default_backend())'],
+                       timeout=75, capture_output=True, text=True)
+except subprocess.TimeoutExpired:
+    sys.exit(1)
 sys.exit(0 if (r.returncode == 0 and 'tpu' in r.stdout) else 1)
 "; then
     echo "backend not reachable / not tpu — aborting without touching artifacts"
