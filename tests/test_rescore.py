@@ -221,3 +221,30 @@ def test_device_occupancy_resyncs_after_failure():
     placed = out2.placed_groups()
     assert "default/big" not in placed  # 16 cpus no longer free (4 admitted)
     assert "default/small" in placed
+
+
+def test_sticky_buckets_pin_shapes_across_boundaries():
+    """sticky_buckets=True: once a bucket is visited, smaller ticks keep the
+    pinned (larger) padded shape — oscillating across a boundary compiles
+    once, and results are unaffected by the extra padding."""
+    r_sticky = ChurnRescorer(_nodes(4), sticky_buckets=True)
+    r_plain = ChurnRescorer(_nodes(4))
+
+    big = [_gang(f"b{i}", 1, ts=float(i)) for i in range(9)]  # bucket 16
+    small = [_gang("s0", 2, ts=100.0)]  # bucket 8 unpinned
+
+    for r in (r_sticky, r_plain):
+        r.tick(None, list(big))
+        r.tick(None, list(small))
+        r.tick(None, list(big))
+
+    # plain: 8-bucket and 16-bucket are distinct signatures
+    assert r_plain.recompiles == 2
+    # sticky: the small tick reuses the pinned 16-bucket shape
+    assert r_sticky.recompiles == 1
+    shapes = {s[0] for s in r_sticky._shapes_seen}
+    assert shapes == {16}
+    # same scheduling outcome regardless of padding mode
+    out_sticky = r_sticky.tick(None, list(small))
+    out_plain = r_plain.tick(None, list(small))
+    assert out_sticky.placed_groups() == out_plain.placed_groups()
