@@ -282,6 +282,43 @@ def test_cli_sim_race_manifest(scorer, capsys):
     assert loser[3] == "0"
 
 
+def test_cli_sim_zones_manifest_pins_gang_to_selected_zone(capsys, monkeypatch):
+    """examples/zones.yaml: the nodeSelector-pinned gang lands entirely in
+    its zone (the per-group [G,N] fit-mask path at the user surface) even
+    though the other zone has more room; the free gang also runs."""
+    from batch_scheduler_tpu.sim import harness
+
+    placements = {}
+    orig_stop = harness.SimCluster.stop
+
+    def capturing_stop(self):
+        if not placements:
+            for p in self.clientset.pods().list():
+                if p.spec.node_name:
+                    placements[p.metadata.name] = p.spec.node_name
+        orig_stop(self)
+
+    monkeypatch.setattr(harness.SimCluster, "stop", capturing_stop)
+    rc = main(
+        [
+            "sim",
+            "-f",
+            os.path.join(REPO, "examples", "zones.yaml"),
+            "--timeout",
+            "30",
+            "--settle",
+            "2",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    lines = {l.split()[0]: l.split() for l in out.splitlines() if l.startswith("default/")}
+    assert lines["default/pinned-east"][1] == "Running"
+    assert lines["default/free-roam"][1] == "Running"
+    pinned = {v for k, v in placements.items() if k.startswith("pinned-")}
+    assert pinned == {"east-1"}  # never lands in the roomier west
+
+
 def test_cli_sim_requires_nodes_and_groups(capsys):
     assert main(["sim", "--timeout", "1"]) == 2
 
