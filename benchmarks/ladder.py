@@ -279,53 +279,80 @@ def config5_churn(ticks: int = 30, interval: float = 0.1):
     # tunnel) is hidden behind the interval; decisions lag exactly one tick,
     # which is safe here because capacity only grows between dispatch and
     # admit (releases/arrivals add slack — see tick_dispatch's staleness
-    # contract).
+    # contract). The dispatch itself runs on a helper thread: if the
+    # tunnel's PJRT client blocks the dispatching thread on per-argument
+    # h2d RPCs, that block rides the interval too instead of the loop
+    # (exactly one dispatch is ever in flight, and the loop never touches
+    # the rescorer between submit and result, so there is no sharing).
+    from concurrent.futures import ThreadPoolExecutor
+
     deadline_misses = 0
+    loop_times = []  # the SLO series: wall time the LOOP spends per tick
     inflight_groups = list(pending)
-    pend = r.tick_dispatch(None, inflight_groups)
-    time.sleep(interval)  # pipeline fill: give batch 0 its interval in flight
-    for _ in range(ticks):
-        t0 = time.perf_counter()
-        out = r.tick_collect(pend)
+    # context-managed: a mid-loop failure must not leave the interpreter
+    # joining an in-flight dispatch against a possibly-hung backend
+    with ThreadPoolExecutor(
+        max_workers=1, thread_name_prefix="tick-dispatch"
+    ) as pool:
+        pend_f = pool.submit(r.tick_dispatch, None, inflight_groups)
+        time.sleep(interval)  # pipeline fill: batch 0 gets its interval
+        for _ in range(ticks):
+            t0 = time.perf_counter()
+            out = r.tick_collect(pend_f.result())
 
-        # admit: committed gangs charge their assignments (dense bookkeeping)
-        placed = set(out.placed_groups())
-        for g in inflight_groups:
-            if g.full_name in placed:
-                r.admit(out, g.full_name)
-        pending = [g for g in pending if g.full_name not in placed]
+            # admit: committed gangs charge their assignments
+            placed = set(out.placed_groups())
+            for g in inflight_groups:
+                if g.full_name in placed:
+                    r.admit(out, g.full_name)
+            pending = [g for g in pending if g.full_name not in placed]
 
-        # churn: ~2% of running gangs finish, their capacity frees
-        running = r.running
-        for _ in range(max(1, len(running) // 50) if running else 0):
-            r.release(running.pop(int(rng.integers(len(running)))))
-        # arrivals: a few new gangs join the pending set
-        for _ in range(2):
-            g = next(arrivals, None)
-            if g is not None:
-                pending.append(g)
+            # churn: ~2% of running gangs finish, their capacity frees
+            running = r.running
+            for _ in range(max(1, len(running) // 50) if running else 0):
+                r.release(running.pop(int(rng.integers(len(running)))))
+            # arrivals: a few new gangs join the pending set
+            for _ in range(2):
+                g = next(arrivals, None)
+                if g is not None:
+                    pending.append(g)
 
-        inflight_groups = list(pending)
-        pend = r.tick_dispatch(None, inflight_groups)
+            inflight_groups = list(pending)
+            pend_f = pool.submit(r.tick_dispatch, None, inflight_groups)
 
-        elapsed = time.perf_counter() - t0
-        if elapsed > interval:
-            deadline_misses += 1
-        else:
-            time.sleep(interval - elapsed)
-    r.tick_collect(pend)  # drain the last in-flight batch (unmeasured)
-    r.drop_last_stats()
+            elapsed = time.perf_counter() - t0
+            loop_times.append(elapsed)
+            if elapsed > interval:
+                deadline_misses += 1
+            else:
+                time.sleep(interval - elapsed)
+        r.tick_collect(pend_f.result())  # drain the last in-flight batch
+        r.drop_last_stats()  # (unmeasured)
 
     s = r.summary()
     platform = jax.devices()[0].platform
     steady_recompiles = s["recompiles"] - warmed
+    loop_arr = np.array(loop_times)
+    loop_p95 = float(np.percentile(loop_arr, 95))
     _emit(
         5,
         "churn_rescore_100ms_10kpod_5knode",
-        s["p95_s"],
-        "s_p95_tick",
-        p50_s=s["p50_s"],
-        max_s=s["max_s"],
+        round(loop_p95, 5),
+        # unit renamed from s_p95_tick when the headline series changed
+        # from the rescorer's component sum to the LOOP's wall time per
+        # tick (the SLO a pipelined loop actually owes) — recorded
+        # artifacts with the old unit are not directly comparable
+        "s_p95_loop_tick",
+        # THE SLO series: wall time the loop itself spends per tick
+        # (collect + admit + churn + dispatch submit); overlapped device /
+        # link time rides the interval by design and is reported below
+        loop_p50_s=round(float(np.median(loop_arr)), 5),
+        loop_max_s=round(float(loop_arr.max()), 5),
+        # per-batch component costs as recorded by the rescorer (in
+        # pipelined mode pack+dispatch run on the helper thread and
+        # OVERLAP the interval — they are not loop-blocking time)
+        rescorer_p50_s=s["p50_s"],
+        rescorer_max_s=s["max_s"],
         p50_pack_s=s["p50_pack_s"],
         p50_device_s=s["p50_device_s"],
         p50_dispatch_s=s["p50_dispatch_s"],
@@ -347,8 +374,9 @@ def config5_churn(ticks: int = 30, interval: float = 0.1):
         f"churn loop recompiled {steady_recompiles}x in steady state"
     )
     if platform == "tpu":
-        assert s["p95_s"] <= interval, (
-            f"p95 tick {s['p95_s']:.3f}s exceeds the {interval}s budget on TPU"
+        assert loop_p95 <= interval, (
+            f"p95 loop tick {loop_p95:.3f}s exceeds the {interval}s budget "
+            "on TPU"
         )
         assert deadline_misses == 0, (
             f"{deadline_misses} steady churn ticks missed the {interval}s "
