@@ -1,0 +1,177 @@
+"""Direct unit tests for BatchSchedulingPlugin's gang release choreography —
+the retry dance the reference performs between the permit signal and the
+framework's waiting-pod cache (reference batchscheduler.go:219-344). The
+e2e sims cover the happy path; these pin the edge semantics."""
+
+from batch_scheduler_tpu.cache import PGStatusCache
+from batch_scheduler_tpu.core import ScheduleOperation
+from batch_scheduler_tpu.framework.types import StatusCode
+from batch_scheduler_tpu.plugin.batch_plugin import BatchSchedulingPlugin
+
+from helpers import FakeCluster, make_group, make_node, make_pod, status_for
+
+
+class _StubWaiting:
+    """Framework-handle stand-in: a dict of uid -> waiting pod."""
+
+    def __init__(self):
+        self.pods = {}
+
+    def get_waiting_pod(self, uid):
+        return self.pods.get(uid)
+
+    def iterate_over_waiting_pods(self, fn):
+        for wp in list(self.pods.values()):
+            fn(wp)
+
+
+class _StubWaitingPod:
+    def __init__(self, pod, node_name="n1"):
+        self.pod = pod
+        self.node_name = node_name
+        self.allowed = 0
+        self.rejected = []
+
+    def get_pod(self):
+        return self.pod
+
+    def allow(self, name):
+        self.allowed += 1
+        return True
+
+    def reject(self, reason):
+        self.rejected.append(reason)
+        return True
+
+
+def _build(members=2):
+    node = make_node("n1", {"cpu": "32", "memory": "64Gi", "pods": "110"})
+    cluster = FakeCluster([node])
+    cache = PGStatusCache()
+    pg = make_group("gang", members, creation_ts=1.0)
+    pods = [
+        make_pod(f"gang-{i}", group="gang", requests={"cpu": "1"})
+        for i in range(members)
+    ]
+    status_for(pg, cache, rep_pod=pods[0])
+    op = ScheduleOperation(cache, cluster, scorer="oracle")
+    handle = _StubWaiting()
+    plugin = BatchSchedulingPlugin(handle, op, pg_client=None)
+    return plugin, handle, op, cache, pods
+
+
+def _permit_all(plugin, op, pods):
+    for p in pods:
+        op.pre_filter(p)
+        plugin.permit(p, "n1")
+
+
+def test_release_allows_every_matched_waiting_pod():
+    plugin, handle, op, cache, pods = _build()
+    _permit_all(plugin, op, pods)
+    wps = {}
+    for p in pods:
+        wps[p.metadata.uid] = _StubWaitingPod(p)
+    handle.pods = wps
+
+    plugin.start_batch_schedule("default/gang")
+    assert all(wp.allowed == 1 for wp in wps.values())
+    # pairs are consumed: a second release has nothing left to allow
+    plugin.start_batch_schedule("default/gang")
+    assert all(wp.allowed == 1 for wp in wps.values())
+
+
+def test_release_drops_stale_pair_when_waiting_pod_never_appears():
+    """The permit signal racing ahead of the framework cache: after the
+    retries exhaust, the stale (uid, pair) is dropped instead of blocking
+    the release loop forever (reference batchscheduler.go:316-323)."""
+    plugin, handle, op, cache, pods = _build()
+    _permit_all(plugin, op, pods)
+    # only pod 1 is in the framework's waiting cache; pod 0 never shows
+    wp1 = _StubWaitingPod(pods[1])
+    handle.pods = {pods[1].metadata.uid: wp1}
+
+    plugin.start_batch_schedule("default/gang")
+    pairs = op.get_pod_node_pairs("default/gang")
+    assert pairs.get(pods[0].metadata.uid) is None  # stale pair dropped
+
+
+def test_update_batch_cache_evicts_replaced_uid():
+    """A pod deleted and recreated under the same name carries a new uid;
+    the old uid's matched entry must go (reference UpdateBatchCache,
+    batchscheduler.go:219-251)."""
+    plugin, handle, op, cache, pods = _build()
+    _permit_all(plugin, op, pods)
+    pairs = op.get_pod_node_pairs("default/gang")
+    assert pairs.get(pods[0].metadata.uid) is not None
+
+    reborn = make_pod("gang-0", group="gang", requests={"cpu": "1"})
+    assert reborn.metadata.uid != pods[0].metadata.uid
+    handle.pods = {reborn.metadata.uid: _StubWaitingPod(reborn)}
+    plugin.update_batch_cache()
+    assert pairs.get(pods[0].metadata.uid) is None  # old uid evicted
+
+
+def test_permit_outcome_mapping():
+    """Permit statuses map exactly: non-gang pod -> SUCCESS, gang member ->
+    WAIT with the TTL+1s timeout, unknown group -> UNSCHEDULABLE."""
+    plugin, handle, op, cache, pods = _build()
+    loose = make_pod("loose", requests={"cpu": "1"})
+    loose.metadata.labels = {}
+    code, _ = plugin.permit(loose, "n1")
+    assert code == StatusCode.SUCCESS
+
+    op.pre_filter(pods[0])
+    code, timeout = plugin.permit(pods[0], "n1")
+    assert code == StatusCode.WAIT
+    assert timeout > 1.0  # gang TTL + 1s margin
+
+    stranger = make_pod("ghost-0", group="ghost", requests={"cpu": "1"})
+    code, _ = plugin.permit(stranger, "n1")
+    assert code == StatusCode.UNSCHEDULABLE
+
+
+def test_reject_pod_is_noop_for_unknown_uid():
+    plugin, handle, op, cache, pods = _build()
+    plugin.reject_pod("no-such-uid")  # must not raise
+    wp = _StubWaitingPod(pods[0])
+    handle.pods = {pods[0].metadata.uid: wp}
+    plugin.reject_pod(pods[0].metadata.uid)
+    assert wp.rejected == ["Group failed"]
+
+
+# -- serde round trips (api/serde.py: every API-server read rehydrates
+# through these; a lossy field would corrupt silently) -----------------------
+
+
+def test_serde_round_trips_preserve_all_fields():
+    from batch_scheduler_tpu.api.serde import (
+        node_from_dict,
+        pod_from_dict,
+        pod_group_from_dict,
+    )
+    from batch_scheduler_tpu.api.types import to_dict
+
+    pg = make_group("rt", 5, creation_ts=12.5)
+    pg.spec.min_resources = {"cpu": 2000, "nvidia.com/gpu": 1}
+    pg.spec.max_schedule_time = 90.0
+    pg.spec.priority_class_name = "high"
+    pg.status.phase = pg.status.phase.__class__("Scheduling")
+    pg.status.scheduled = 3
+    pg.status.occupied_by = "default/owner"
+    d = to_dict(pg)
+    back = pod_group_from_dict(d)
+    assert to_dict(back) == d
+
+    pod = make_pod("rt-0", group="rt", requests={"cpu": "2", "memory": "1Gi"})
+    pod.spec.node_selector = {"zone": "east"}
+    pod.spec.priority = 7
+    pod.spec.node_name = "n9"
+    d = to_dict(pod)
+    assert to_dict(pod_from_dict(d)) == d
+
+    node = make_node("rt-n", {"cpu": "8", "memory": "16Gi", "pods": "110"},
+                     labels={"zone": "east"})
+    node.spec.unschedulable = True
+    d = to_dict(node)
+    assert to_dict(node_from_dict(d)) == d
