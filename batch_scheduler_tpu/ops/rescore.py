@@ -14,7 +14,13 @@ batch. Three properties make the tick budget:
   appearing mid-loop can't change array shapes;
 - **O(G) host fetch** (ops.oracle.execute_batch_host): each tick pulls only
   the per-group vectors + compact top-K assignment; (G,N) tensors stay on
-  device.
+  device;
+- **link-latency hiding** (tick_dispatch/tick_collect): a one-tick-deep
+  pipeline overlaps the host<->device round-trip with the tick interval
+  (one-tick staleness contract documented on tick_dispatch);
+- **device-resident state**: the padded alloc and occupancy arrays stay on
+  device across ticks; admit/release ship fixed-width scatter deltas, with
+  the numpy mirror as ground truth and automatic resync on failure.
 """
 
 from __future__ import annotations
