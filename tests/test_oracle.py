@@ -462,3 +462,54 @@ def test_assign_gangs_fuzz_vs_python_mirror():
         np.testing.assert_array_equal(placed_d, placed_p, err_msg=f"trial {trial}")
         np.testing.assert_array_equal(takes_d, takes_p, err_msg=f"trial {trial}")
         np.testing.assert_array_equal(left_d, left_p, err_msg=f"trial {trial}")
+
+
+def test_assign_gangs_invariants_hypothesis():
+    """Property-based structural safety of the assignment scan, on fixed
+    shapes (jit cache shared across examples) with hypothesis-driven
+    values: takes respect the mask, placed gangs take exactly their need,
+    unplaced gangs take nothing, and no node lane is ever driven below
+    zero by a take (capacity can only be consumed where it exists)."""
+    hypothesis = pytest.importorskip("hypothesis")
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+    from hypothesis.extra import numpy as hnp
+
+    N, G, R = 8, 4, 3
+
+    @settings(max_examples=60, deadline=None)
+    @given(
+        # negative starting lanes included: an over-committed node must
+        # contribute zero capacity on that lane, not go MORE negative
+        left0=hnp.arrays(np.int32, (N, R), elements=st.integers(-20, 60)),
+        group_req=hnp.arrays(np.int32, (G, R), elements=st.integers(0, 7)),
+        remaining=hnp.arrays(np.int32, (G,), elements=st.integers(0, 25)),
+        order_seed=st.integers(0, 23),
+        mask_bits=hnp.arrays(np.bool_, (G, N)),
+        broadcast=st.booleans(),
+    )
+    def check(left0, group_req, remaining, order_seed, mask_bits, broadcast):
+        import itertools
+
+        orders = list(itertools.permutations(range(G)))
+        order = np.array(orders[order_seed % len(orders)], dtype=np.int32)
+        mask = mask_bits[:1] if broadcast else mask_bits
+
+        takes, placed, left_after = (
+            np.asarray(x)
+            for x in assign_gangs(left0, group_req, remaining, mask, order)
+        )
+        full_mask = np.broadcast_to(mask, (G, N))
+        # mask respected
+        assert (takes[~full_mask] == 0).all()
+        # placed gangs take exactly their need; unplaced take nothing
+        sums = takes.sum(axis=1)
+        assert (sums[placed] == remaining[placed]).all()
+        assert (sums[~placed] == 0).all()
+        # conservation: leftover = start - consumption
+        consumed = (takes[:, :, None] * group_req[:, None, :]).sum(axis=0)
+        np.testing.assert_array_equal(left_after, left0 - consumed)
+        # no lane driven below zero by takes (started-nonnegative lanes)
+        assert (left_after[left0 >= 0] >= 0).all()
+
+    check()
