@@ -58,7 +58,9 @@ serial-baseline:
 # the jax_platforms config — env vars alone don't switch to CPU; the config
 # update below is what makes the virtual 8-device CPU mesh take effect.
 dryrun:
-	$(PY) -c "import __graft_entry__ as g; fn, args = g.entry(); fn(*args); print('entry OK')"
+	$(PY) -c "from batch_scheduler_tpu.utils.backend import resolve_platform; \
+		print('platform:', resolve_platform()); \
+		import __graft_entry__ as g; fn, args = g.entry(); fn(*args); print('entry OK')"
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PY) -c "import jax; jax.config.update('jax_platforms', 'cpu'); \
 		import __graft_entry__ as g; g.dryrun_multichip(8)"

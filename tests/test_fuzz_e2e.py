@@ -226,6 +226,7 @@ def test_fuzz_churn_backfill_capacity_cycles(sim):
         kubelet_run_duration=1.0,  # gangs finish ~1s after starting
         backoff_base=0.1,
         backoff_cap=0.5,
+        bind_workers=16,  # ladder config 6's concurrency level
     )
     cluster.add_nodes(nodes)
 
