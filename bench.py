@@ -46,10 +46,21 @@ def resolve_platform():
     """Pick a JAX platform, surviving TPU-backend failures AND hangs — the
     shared subprocess-probe helper (batch_scheduler_tpu.utils.backend; the
     CLI's sim/serve use the same guard). Returns (platform, error_or_None).
+
+    The bench run is NOT latency-sensitive (it is the driver's number of
+    record), so the probe gets a many-minute wall-clock budget with backoff
+    instead of the CLI's fast 2-attempt default: a transiently hung
+    accelerator tunnel must not demote the round's headline to CPU
+    (round-3 postmortem). Override with BSP_BENCH_PROBE_DEADLINE_S.
     """
     from batch_scheduler_tpu.utils.backend import resolve_platform as _resolve
 
-    return _resolve()
+    try:
+        deadline = float(os.environ.get("BSP_BENCH_PROBE_DEADLINE_S", "1500"))
+    except ValueError:
+        print("ignoring malformed BSP_BENCH_PROBE_DEADLINE_S", file=sys.stderr)
+        deadline = 1500.0
+    return _resolve(deadline_s=deadline)
 
 
 def build_inputs():

@@ -4,10 +4,10 @@
 # at the repo root. Exits non-zero if the backend is not a real TPU (no
 # artifact is overwritten with CPU numbers).
 #
-# Usage: benchmarks/capture_tpu_artifacts.sh [round_tag]   (default r03)
+# Usage: benchmarks/capture_tpu_artifacts.sh [round_tag]   (default r04)
 set -u
 cd "$(dirname "$0")/.."
-TAG="${1:-r03}"
+TAG="${1:-r04}"
 
 echo "== probing backend =="
 if ! timeout 90 python -c "
@@ -24,6 +24,11 @@ sys.exit(0 if (r.returncode == 0 and 'tpu' in r.stdout) else 1)
 fi
 
 fail=0
+
+# the tunnel just answered the probe above — a short probe budget for
+# EVERY step (bench, ladder, smoke all resolve the platform) keeps a
+# mid-capture drop from eating a step's whole timeout window
+export BSP_BENCH_PROBE_DEADLINE_S=150
 
 echo "== bench (headline batch) =="
 if timeout 900 python bench.py > "/tmp/BENCH_${TAG}.json" 2>/tmp/bench.err; then
