@@ -83,6 +83,14 @@ class PGStatusCache:
     def __init__(self):
         self._lock = threading.RLock()
         self._map: Dict[str, PodGroupMatchStatus] = {}
+        self._on_delete: list = []
+
+    def on_delete(self, fn: Callable[[str], None]) -> None:
+        """Register a callback fired (outside the lock) with the full name
+        of every entry removed — lets per-group derived caches (e.g. the
+        queue sort key's creation-timestamp cache) die with the group, so
+        a name reused by a recreated group never serves stale values."""
+        self._on_delete.append(fn)
 
     def get(self, full_name: str) -> Optional[PodGroupMatchStatus]:
         with self._lock:
@@ -97,6 +105,8 @@ class PGStatusCache:
             status = self._map.pop(full_name, None)
         if status is not None:
             status.close()
+        for fn in self._on_delete:
+            fn(full_name)
 
     def snapshot(self) -> Dict[str, PodGroupMatchStatus]:
         """Consistent point-in-time view for batch scoring."""

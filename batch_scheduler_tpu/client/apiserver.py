@@ -144,6 +144,95 @@ class APIServer:
             self._notify(kind, WatchEvent(WatchEvent.ADDED, kind, d))
             return json_deepcopy(d)
 
+    def create_many(
+        self, kind: str, objs: List[dict], assume_fresh: bool = False
+    ) -> int:
+        """Bulk create: one lock pass, one ADDED event per object, and no
+        per-object response copies (callers ingesting load — the sim
+        harness feeding 10k pods — never read the responses; the per-call
+        ``create`` pays two deep copies per object). Name conflicts
+        present at call time raise before anything commits; the lock is
+        then released between commit chunks (a 10k-object ingest must not
+        block every concurrent patch/bind for its whole duration), so an
+        object racing a concurrent ``create`` of the same name is skipped
+        — the returned count is the number ACTUALLY created.
+
+        ``assume_fresh``: skip the defensive deep copy when every dict was
+        freshly built for this call and never retained by the caller (the
+        sim harness's to_dict output) — the store takes ownership."""
+        docs = []
+        for obj in objs:
+            d = self._as_dict(obj)
+            if not assume_fresh:
+                d = json_deepcopy(d)
+            d.setdefault("metadata", {})
+            docs.append(d)
+        keys = [
+            (
+                d["metadata"].get("namespace", "default"),
+                d["metadata"].get("name", ""),
+            )
+            for d in docs
+        ]
+        if len(set(keys)) != len(keys):
+            raise AlreadyExistsError("duplicate names in create_many batch")
+        with self._lock:
+            store = self._kind_store(kind)
+            for key in keys:
+                if key in store:
+                    raise AlreadyExistsError(f"{kind} {key[0]}/{key[1]} exists")
+        chunk = 256
+        created = 0
+        for start in range(0, len(docs), chunk):
+            with self._lock:
+                store = self._kind_store(kind)
+                for d, key in zip(
+                    docs[start : start + chunk], keys[start : start + chunk]
+                ):
+                    if key in store:  # raced a concurrent create: skip
+                        continue
+                    meta = d["metadata"]
+                    self._rv += 1
+                    meta["resource_version"] = self._rv
+                    if not meta.get("creation_timestamp"):
+                        meta["creation_timestamp"] = self._clock()
+                    if not meta.get("uid"):
+                        meta["uid"] = new_uid(kind.lower())
+                    store[key] = d
+                    self._index_add(kind, key, d)
+                    self._notify(kind, WatchEvent(WatchEvent.ADDED, kind, d))
+                    created += 1
+        return created
+
+    def patch_many(
+        self, kind: str, namespace: str, patches: List[Tuple[str, dict]]
+    ) -> List[str]:
+        """Bulk merge patch: one lock pass, one patch + MODIFIED event per
+        object, no response copies. Missing objects are skipped. Returns
+        the names patched. (The sim kubelet drives thousands of
+        Pending->Running transitions per run; per-call ``patch`` pays a
+        response deep copy and a lock round trip each.)"""
+        patched: List[str] = []
+        with self._lock:
+            store = self._kind_store(kind)
+            for name, patch in patches:
+                key = (namespace, name)
+                old = store.get(key)
+                if old is None:
+                    continue
+                merged = apply_merge_patch(old, patch)
+                self._rv += 1
+                merged["metadata"] = dict(merged.get("metadata") or {})
+                merged["metadata"]["resource_version"] = self._rv
+                self._index_remove(kind, key, old)
+                store[key] = merged
+                self._index_add(kind, key, merged)
+                self._notify(
+                    kind, WatchEvent(WatchEvent.MODIFIED, kind, merged)
+                )
+                patched.append(name)
+        return patched
+
     def get(self, kind: str, namespace: str, name: str) -> dict:
         with self._lock:
             obj = self._kind_store(kind).get((namespace, name))
@@ -233,6 +322,36 @@ class APIServer:
             self._index_add(kind, key, merged)
             self._notify(kind, WatchEvent(WatchEvent.MODIFIED, kind, merged))
             return json_deepcopy(merged)
+
+    def bind_pods(self, namespace: str, pairs: List[Tuple[str, str]]) -> List[str]:
+        """Batched bind subresource: one lock pass, one merge patch + one
+        MODIFIED event per pod. The whole-gang choreography binds a
+        released gang as a unit (reference StartBatchSchedule releases a
+        complete gang in one sweep, batchscheduler.go:254-344; here the
+        bind itself is batched too). Missing pods are skipped — the caller
+        forgets their assumed capacity. A bind patch touches only
+        ``spec.node_name``, so the label index needs no maintenance.
+        Returns the names actually bound."""
+        bound: List[str] = []
+        with self._lock:
+            store = self._kind_store("Pod")
+            for name, node_name in pairs:
+                key = (namespace, name)
+                old = store.get(key)
+                if old is None:
+                    continue
+                merged = apply_merge_patch(
+                    old, {"spec": {"node_name": node_name}}
+                )
+                self._rv += 1
+                merged["metadata"] = dict(merged.get("metadata") or {})
+                merged["metadata"]["resource_version"] = self._rv
+                store[key] = merged
+                self._notify(
+                    "Pod", WatchEvent(WatchEvent.MODIFIED, "Pod", merged)
+                )
+                bound.append(name)
+        return bound
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         with self._lock:

@@ -9,11 +9,11 @@ DeleteCollection/Patch) plus the core/v1 slices the controller consumes
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..api.serde import node_from_dict, pod_from_dict, pod_group_from_dict
 from ..api.types import Node, Pod, PodGroup, to_dict
-from .apiserver import APIServer
+from .apiserver import APIServer, NotFoundError
 
 __all__ = ["Clientset", "PodGroupInterface", "PodInterface", "NodeInterface"]
 
@@ -85,6 +85,25 @@ class PodInterface(_TypedInterface):
     def bind(self, name: str, node_name: str) -> Pod:
         """The bind subresource: commit a pod to a node."""
         return self.patch(name, {"spec": {"node_name": node_name}})
+
+    def bind_many(self, pairs: List[Tuple[str, str]]) -> List[str]:
+        """Batched bind: one API round trip for a whole released gang
+        (gang-granular choreography; reference precedent for whole-gang
+        release sweeps is StartBatchSchedule, batchscheduler.go:254-344).
+        Falls back to per-pod binds when the backing API lacks the batched
+        verb (e.g. the HTTP gateway). Returns the names actually bound;
+        missing pods are skipped."""
+        bind_pods = getattr(self._api, "bind_pods", None)
+        if bind_pods is not None:
+            return bind_pods(self._ns, pairs)
+        bound = []
+        for name, node_name in pairs:
+            try:
+                self.patch(name, {"spec": {"node_name": node_name}})
+            except NotFoundError:
+                continue
+            bound.append(name)
+        return bound
 
 
 class NodeInterface(_TypedInterface):

@@ -69,32 +69,48 @@ class PodGroupController:
         self.queue = RateLimitingQueue(*self._limiter_args)
         self._informer = pg_informer
         pg_informer.add_event_handler(
-            on_add=self._pg_added,
-            on_update=self._pg_updated,
-            on_delete=self._pg_deleted,
+            on_add=self._pg_added_raw,
+            on_update=self._pg_updated_raw,
+            on_delete=self._pg_deleted_raw,
+            raw=True,
         )
         self._stop = threading.Event()
         self._workers: List[threading.Thread] = []
 
     # -- informer handlers (reference controller.go:111-145) ---------------
 
-    def _pg_added(self, pg: PodGroup) -> None:
-        if pg.status.phase in (PodGroupPhase.FINISHED, PodGroupPhase.FAILED):
+    # raw-dict handlers: the watch stream delivers a handful of events per
+    # gang (create + every status patch); the enqueue decision needs five
+    # scalar fields, not a typed rehydration per event. The restart path
+    # (run) feeds the same predicate from the informer's raw store, so the
+    # GC/phase-skip rule exists exactly once.
+    def _pg_added_raw(self, d: dict) -> None:
+        status = d.get("status") or {}
+        phase = status.get("phase") or ""
+        if phase in (PodGroupPhase.FINISHED.value, PodGroupPhase.FAILED.value):
             return
+        meta = d.get("metadata") or {}
+        spec = d.get("spec") or {}
         if (
-            pg.status.scheduled == pg.spec.min_member
-            and pg.status.running == 0
-            and pg.status.schedule_start_time - pg.metadata.creation_timestamp
+            status.get("scheduled", 0) == spec.get("min_member", 0)
+            and status.get("running", 0) == 0
+            and (status.get("schedule_start_time") or 0.0)
+            - (meta.get("creation_timestamp") or 0.0)
             > GC_HORIZON_SECONDS
         ):
             return
-        self.queue.add(pg.full_name())
+        self.queue.add(
+            f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
+        )
 
-    def _pg_updated(self, old: PodGroup, new: PodGroup) -> None:
-        self._pg_added(new)
+    def _pg_updated_raw(self, old: Optional[dict], new: dict) -> None:
+        self._pg_added_raw(new)
 
-    def _pg_deleted(self, pg: PodGroup) -> None:
-        self.pg_cache.delete(pg.full_name())
+    def _pg_deleted_raw(self, d: dict) -> None:
+        meta = d.get("metadata") or {}
+        self.pg_cache.delete(
+            f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
+        )
 
     # -- run loop (reference controller.go:93-108) -------------------------
 
@@ -104,8 +120,8 @@ class PodGroupController:
             # restart after a lease loss: the old queue is dead; re-enqueue
             # every known group so reconciliation resumes cleanly
             self.queue = RateLimitingQueue(*self._limiter_args)
-            for pg in self._informer.list():
-                self._pg_added(pg)
+            for d in self._informer.list_raw():
+                self._pg_added_raw(d)
         self._informer.wait_for_sync()
         for i in range(workers):
             t = threading.Thread(
@@ -135,7 +151,12 @@ class PodGroupController:
 
     def _sync(self, key: str) -> None:
         namespace, _, name = key.partition("/")
-        pg = self._informer.get(namespace, name)
+        # shared read-only typed view (one materialisation per store
+        # update, not one per sync — the deep-copying ``get`` was the
+        # controller workers' top cost at 10k-pod scale). _sync_handler
+        # never mutates it: every write goes through replace() copies, and
+        # the cache entry takes a private copy at init.
+        pg = self._informer.get_typed(namespace, name)
         if pg is None:
             try:
                 pg = self.client.podgroups(namespace).get(name)
@@ -317,6 +338,10 @@ class PodGroupController:
         (reference initPodGroupMatchStatus + OnEvicted,
         controller.go:314-335)."""
         ttl = get_wait_seconds(pg, self.max_schedule_seconds)
+        # private copy: the cache entry's group is mutated in place by
+        # Permit/PostBind/_fill_occupied (status fields, spec.min_resources)
+        # and must never alias the informer's shared typed view
+        pg = replace(pg, spec=replace(pg.spec), status=replace(pg.status))
         pgs = PodGroupMatchStatus(pg, match_ttl=ttl, clock=self._clock)
 
         def on_evicted(_key: str, _value) -> None:

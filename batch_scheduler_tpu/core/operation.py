@@ -39,6 +39,23 @@ PERMITTED_TTL = 2.0
 PERMITTED_CACHE_DEFAULT_TTL = 3.0
 
 
+class _RevStr:
+    """String wrapper ordering REVERSE-lexicographically — the Compare
+    chain's ``name1 > name2`` tiebreak (reference core.go:404) embedded in
+    a sort-key tuple."""
+
+    __slots__ = ("s",)
+
+    def __init__(self, s: str):
+        self.s = s
+
+    def __lt__(self, other: "_RevStr") -> bool:
+        return self.s > other.s
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, _RevStr) and self.s == other.s
+
+
 class ClusterStateProvider(Protocol):
     """The slice of cluster state the scorers need (the reference reads this
     from the framework's SnapshotSharedLister, core.go:437,567)."""
@@ -116,6 +133,13 @@ class ScheduleOperation:
         self.last_denied_pg = TTLCache(DENY_CACHE_DEFAULT_TTL, DENY_CACHE_JANITOR, clock=clock)
         self.last_permitted_pod = TTLCache(PERMITTED_CACHE_DEFAULT_TTL, DENY_CACHE_JANITOR, clock=clock)
         self._lock = threading.RLock()
+        # sort_key's per-group creation-timestamp cache. A value is
+        # immutable for a group's lifetime; entries die with the group via
+        # the status-cache delete hook, so a recreated group under a
+        # reused name re-reads its (new) creation stamp and the cache
+        # stays bounded by the live group count.
+        self._creation_cache: Dict[Tuple[str, str], float] = {}
+        status_cache.on_delete(self._forget_creation)
         # Cross-call max-progress group state used by the serial Filter path
         # (reference core.go:58-59,118-127).
         self.max_finished_pg: str = ""
@@ -269,6 +293,115 @@ class ScheduleOperation:
             if planned > current.get(node, 0) - base.get(node, 0):
                 return node
         return None
+
+    def gang_plan(self, pod: Pod):
+        """Whole-gang fast-lane eligibility (gang-granular release+bind;
+        reference precedent for whole-gang choreography is
+        StartBatchSchedule releasing a complete gang in one sweep,
+        batchscheduler.go:254-344 — here admission, permit and bind are
+        gang-granular too).
+
+        Returns ``(slots, needed)`` — the current batch's placement plan
+        ``{node: member_count}`` and the member quorum — when this pod's
+        gang can be admitted as ONE transaction: oracle mode, a plan
+        stamped by the live batch, and a completely fresh gang (nothing
+        matched or waiting, nothing scheduled, not released). Anything
+        else returns None and the caller takes the per-pod path."""
+        if self.scorer_kind != "oracle" or self.oracle is None:
+            return None
+        pg_name, ok = pod_group_name(pod)
+        if not ok:
+            return None
+        full_name = f"{pod.metadata.namespace}/{pg_name}"
+        pgs = self.status_cache.get(full_name)
+        if (
+            pgs is None
+            or pgs.scheduled
+            or not pgs.placement_plan
+            or pgs.plan_batch_seq != self.oracle.batches_run
+            or pgs.pod_group.status.scheduled
+            or pgs.matched_pod_nodes.items()
+        ):
+            return None
+        needed = pgs.pod_group.spec.min_member
+        if sum(pgs.placement_plan.values()) < needed:
+            return None
+        return dict(pgs.placement_plan), needed
+
+    def permit_gang(self, full_name: str, members) -> bool:
+        """Bulk Permit for a whole-gang transaction: one phase transition
+        and one released-flag flip instead of per-member TTL bookkeeping.
+        No waiting-pod entries are created — the caller binds
+        synchronously, so the gang never parks and the TTL-eviction abort
+        path has nothing to guard (the reference accumulates waiting pods
+        only because its binds are asynchronous, core.go:268-309).
+
+        ``members`` are (pod, node_name) pairs the caller already assumed.
+        May raise OccupiedError (owner-reference fencing, per member like
+        the per-pod path); returns False when the gang vanished mid-flight.
+        Either way the caller rolls back its assumes."""
+        pgs = self.status_cache.get(full_name)
+        if pgs is None:
+            return False
+        for pod, _ in members:
+            self._fill_occupied(pgs, pod)
+        pg = pgs.pod_group
+        if pg.status.phase == PodGroupPhase.PENDING:
+            pg.status.phase = PodGroupPhase.PRE_SCHEDULING
+        pgs.scheduled = True
+        # every one of these assumes is capacity the batch pre-accounted
+        # through the gang's plan (the bulk form of on_assume's credit)
+        if self.oracle is not None:
+            self.oracle.credit_expected_change(len(members))
+        return True
+
+    def post_bind_gang(self, full_name: str, bound: int) -> None:
+        """One status transition for ``bound`` members bound as a unit:
+        the per-gang equivalent of ``bound`` post_bind calls — one lock
+        pass and ONE merge patch instead of up to two patches plus
+        ``bound`` lock acquisitions (reference PostBind runs per pod,
+        core.go:312-362; at 10k pods the per-pod form was the single
+        largest control-plane cost)."""
+        if bound <= 0:
+            return
+        with self._lock:
+            pgs = self.status_cache.get(full_name)
+            if pgs is None:
+                return
+            pg = pgs.pod_group
+            new_scheduled = pg.status.scheduled + bound
+            completed = new_scheduled >= pg.spec.min_member
+            new_phase = (
+                PodGroupPhase.SCHEDULED
+                if completed
+                else PodGroupPhase.SCHEDULING
+            )
+            new_start = pg.status.schedule_start_time or time.time()
+            if new_phase != pg.status.phase and self.pg_client is not None:
+                try:
+                    updated = self.pg_client.podgroups(
+                        pg.metadata.namespace
+                    ).patch(
+                        pg.metadata.name,
+                        {
+                            "status": {
+                                "phase": new_phase.value,
+                                "scheduled": new_scheduled,
+                                "schedule_start_time": new_start,
+                            }
+                        },
+                    )
+                    pg.status.phase = updated.status.phase
+                except Exception:
+                    return
+            else:
+                pg.status.phase = new_phase
+            pg.status.schedule_start_time = new_start
+            pg.status.scheduled = new_scheduled
+            # the plan is consumed; members beyond the quorum scan-place
+            pgs.placement_plan = None
+        if completed:
+            self.mark_dirty()
 
     def on_assume(
         self, pod: Pod, node_name: str, from_plan: bool = False
@@ -569,6 +702,46 @@ class ScheduleOperation:
         if prio1 == prio2 and c1 == c2 and name1 > name2:
             return True
         return prio1 == prio2 and c1 == c2 and name1 == name2 and ts1 < ts2
+
+    def _forget_creation(self, full_name: str) -> None:
+        ns, _, name = full_name.partition("/")
+        self._creation_cache.pop((ns, name), None)
+
+    def sort_key(self, info) -> tuple:
+        """Total-order queue key equivalent to :meth:`compare` (reference
+        Compare, core.go:368-411): priority desc → non-gang before gang →
+        group creation asc → group name REVERSE-lex → queue timestamp asc.
+        Computed once per push from the entry's scalar fields, so heap
+        operations are tuple compares instead of O(log n) Less() chains.
+
+        One documented deviation: a gang pod whose PodGroup the lister has
+        not yet observed gets creation=+inf (sorts after known gangs of
+        equal priority); the comparator form answers "incomparable" there
+        (reference returns false both ways) and falls to insertion order.
+        Both resolve the same way once the group is observed — such pods
+        fail PreFilter with PodGroupNotFound until then."""
+        if not info.gang:
+            return (-info.priority, 0, 0.0, _RevStr(""), info.timestamp)
+        # creation timestamps are immutable: cache per group so a push
+        # costs a dict hit, not a lister lookup contending with the
+        # watch-dispatch thread's informer lock
+        cache_key = (info.namespace, info.gang)
+        created = self._creation_cache.get(cache_key)
+        if created is None:
+            created = float("inf")
+            if self.pg_lister is not None:
+                pg = self.pg_lister(info.namespace, info.gang)
+                if pg is not None:
+                    created = pg.metadata.creation_timestamp
+            if created != float("inf"):
+                self._creation_cache[cache_key] = created
+        return (
+            -info.priority,
+            1,
+            created,
+            _RevStr(info.gang),
+            info.timestamp,
+        )
 
     # ------------------------------------------------------------------
     # helpers

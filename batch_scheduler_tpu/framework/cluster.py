@@ -131,9 +131,47 @@ class ClusterState:
             if not unchanged:
                 self._version += 1
 
-    def remove_pod(self, pod: Pod) -> None:
+    def observe_pod_raw(self, d: dict) -> None:
+        """Raw-dict fast path for pod watch events (the informer's ``raw``
+        handler form): terminal phases release by uid, and a pod already
+        charged to the same node is a no-op WITHOUT parsing its resource
+        quantities — k8s pod requests are immutable, so same (uid, node)
+        implies same charge. Only a placement this state has never charged
+        (an external/bound-elsewhere pod) pays typed rehydration and
+        delegates to :meth:`observe_pod`."""
+        spec = d.get("spec") or {}
+        node = spec.get("node_name")
+        if not node:
+            return
+        meta = d.get("metadata") or {}
+        uid = meta.get("uid", "")
+        phase = (d.get("status") or {}).get("phase") or "Pending"
         with self._lock:
-            uid = pod.metadata.uid
+            if phase in ("Succeeded", "Failed"):
+                charged = self._requested.get(node, {}).pop(uid, None)
+                known = self._pod_nodes.pop(uid, None)
+                self._assumed.pop(uid, None)
+                self._pod_objs.pop(uid, None)
+                if charged is not None or known is not None:
+                    self._version += 1
+                return
+            if self._pod_nodes.get(uid) == node:
+                self._assumed.pop(uid, None)  # bind commit observed
+                return
+        from ..api.serde import pod_from_dict
+
+        # no defensive deepcopy: pod_from_dict copies every nested
+        # container it keeps (same contract PodInfo.pod relies on)
+        self.observe_pod(pod_from_dict(d))
+
+    def remove_pod(self, pod: Pod) -> None:
+        self._remove_uid(pod.metadata.uid)
+
+    def remove_pod_raw(self, d: dict) -> None:
+        self._remove_uid(((d.get("metadata") or {}).get("uid", "")))
+
+    def _remove_uid(self, uid: str) -> None:
+        with self._lock:
             node = self._pod_nodes.pop(uid, None)
             self._assumed.pop(uid, None)
             self._pod_objs.pop(uid, None)

@@ -21,15 +21,26 @@ LessFn = Callable[[PodInfo, PodInfo], bool]
 
 
 class _Entry:
-    __slots__ = ("info", "less", "dead", "group")
+    __slots__ = ("info", "less", "dead", "group", "key")
 
-    def __init__(self, info: PodInfo, less: LessFn, group: Optional[str] = None):
+    def __init__(
+        self,
+        info: PodInfo,
+        less: LessFn,
+        group: Optional[str] = None,
+        key=None,
+    ):
         self.info = info
         self.less = less
         self.dead = False  # lazily-deleted (drained as part of its gang)
         self.group = group
+        # precomputed total-order key (plugin sort_key): heap comparisons
+        # become tuple compares instead of two Less() attribute walks
+        self.key = key
 
     def __lt__(self, other: "_Entry") -> bool:
+        if self.key is not None and other.key is not None:
+            return self.key < other.key
         if self.less(self.info, other.info):
             return True
         if self.less(other.info, self.info):
@@ -45,12 +56,17 @@ class SchedulingQueue:
         backoff_cap: float = 10.0,
         clock: Callable[[], float] = time.monotonic,
         group_key_fn: Optional[Callable[[PodInfo], Optional[str]]] = None,
+        sort_key_fn: Optional[Callable[[PodInfo], tuple]] = None,
     ):
         self._less = less_fn or (lambda a, b: a.timestamp < b.timestamp)
         self._backoff_base = backoff_base
         self._backoff_cap = backoff_cap
         self._clock = clock
         self._group_key = group_key_fn
+        # when provided, entries carry a precomputed total-order key (one
+        # plugin call per push) instead of paying O(log n) Less() chains
+        # per heap operation
+        self._sort_key = sort_key_fn
         self._cond = threading.Condition()
         self._active: list = []
         self._active_dead = 0
@@ -67,7 +83,10 @@ class SchedulingQueue:
 
     def _push_active_locked(self, info: PodInfo) -> None:
         group = self._group_key(info) if self._group_key else None
-        entry = _Entry(info, self._less, group)
+        key = None
+        if self._sort_key is not None:
+            key = (*self._sort_key(info), info.seq)  # seq: stable tiebreak
+        entry = _Entry(info, self._less, group, key)
         heapq.heappush(self._active, entry)
         if group is not None:
             self._groups.setdefault(group, set()).add(entry)
@@ -86,6 +105,13 @@ class SchedulingQueue:
         with self._cond:
             self._push_active_locked(info)
             self._cond.notify()
+
+    def group_size(self, group: str) -> int:
+        """Live queued members of ``group`` — the gang-transaction quorum
+        check (popped entries leave their bucket, so len is exact)."""
+        with self._cond:
+            bucket = self._groups.get(group)
+            return len(bucket) if bucket else 0
 
     def pop_group(self, group: str) -> list:
         """Remove and return every queued member of ``group`` (arbitrary

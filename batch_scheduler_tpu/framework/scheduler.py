@@ -32,11 +32,11 @@ __all__ = ["Scheduler", "FrameworkHandle"]
 
 def _gang_key(info: PodInfo) -> Optional[str]:
     """namespace/group queue-index key for gang-unit admission (None for
-    non-gang pods)."""
-    name, ok = pod_group_name(info.pod)
-    if not ok:
+    non-gang pods) — served from the entry's scalar fields, no typed
+    materialisation."""
+    if not info.gang:
         return None
-    return f"{info.pod.metadata.namespace}/{name}"
+    return f"{info.namespace}/{info.gang}"
 
 
 class FrameworkHandle:
@@ -88,6 +88,7 @@ class Scheduler:
             backoff_cap,
             clock,
             group_key_fn=_gang_key,
+            sort_key_fn=getattr(self.plugin, "sort_key", None),
         )
         self._bind_workers = bind_workers
         self._threads: List[threading.Thread] = []
@@ -138,6 +139,16 @@ class Scheduler:
             return
         self.queue.push(PodInfo(pod=pod, timestamp=self._clock()))
 
+    def enqueue_raw(self, d: dict) -> None:
+        """Raw-dict enqueue (the informer's ``raw`` handler form): the
+        entry's typed pod materialises lazily on the scheduling thread,
+        keeping the watch-dispatch thread to scalar parsing."""
+        if (d.get("spec") or {}).get("node_name"):
+            return
+        if ((d.get("status") or {}).get("phase") or "Pending") != "Pending":
+            return
+        self.queue.push(PodInfo(raw=d, timestamp=self._clock()))
+
     # -- main cycle --------------------------------------------------------
 
     def _loop(self) -> None:
@@ -155,6 +166,138 @@ class Scheduler:
                 for sibling in self.queue.pop_group(gang):
                     self._run_cycle(sibling)
 
+    # -- whole-gang fast lane ---------------------------------------------
+
+    def _gang_transaction(self, info: PodInfo, pod: Pod, gang: str) -> bool:
+        """Whole-gang transaction (gang-granular release+bind): when the
+        popped pod's entire gang is queued and its batch plan covers the
+        quorum, admit the gang as ONE unit — direct seat assignment from
+        the plan, one bulk permit, one batched bind API call, one status
+        patch — instead of ``min_member`` independent pod cycles with
+        permit parking and release choreography. Reference precedent for
+        gang-unit choreography: StartBatchSchedule
+        (batchscheduler.go:254-344).
+
+        Called from _schedule_one AFTER pre_filter passed (which is what
+        stamps a fresh gang's plan). Returns True when the gang was
+        admitted (the popped pod and every queued sibling consumed);
+        False falls through to the per-pod path with nothing repeated."""
+        plugin = self.plugin
+        plan = plugin.gang_plan(pod)
+        if plan is None:
+            return False  # no whole-gang plan: per-pod path
+        slots, needed = plan
+        if 1 + self.queue.group_size(gang) < needed:
+            return False  # partial arrival: members park via Permit waits
+        members = [(info, pod)]
+        for sib in self.queue.pop_group(gang):
+            p = self._live_pod(sib)
+            if p is not None:
+                members.append((sib, p))
+
+        def hand_back() -> bool:
+            # everything except the popped pod returns to the queue; the
+            # caller continues with the per-pod path for ``info``
+            for m, _ in members[1:]:
+                self.queue.push(m)
+            return False
+
+        if len(members) < needed:
+            return hand_back()  # stale siblings thinned the quorum
+        seat, extras = members[:needed], members[needed:]
+        assigned = []
+
+        def rollback() -> None:
+            # forget releases only still-ASSUMED capacity (bound pods are
+            # untouched), so this is safe at every failure point; re-pushed
+            # bound entries are dropped by the next pop's liveness check
+            for _, p, _ in assigned:
+                self.cluster.forget(p.metadata.uid)
+
+        try:
+            assigned, shortfall = self._seat_plan(seat, slots)
+            if shortfall or len(assigned) < needed:
+                rollback()
+                return hand_back()
+            try:
+                ok = plugin.permit_gang(
+                    gang, [(p, n) for _, p, n in assigned]
+                )
+            except SchedulingError as e:
+                rollback()
+                hand_back()
+                self._unschedulable(info, str(e))
+                return True
+            if not ok:
+                rollback()
+                return hand_back()
+
+            ns = pod.metadata.namespace
+            bound_names = set(
+                self.clientset.pods(ns).bind_many(
+                    [(p.metadata.name, n) for _, p, n in assigned]
+                )
+            )
+            bound = 0
+            for _, p, n in assigned:
+                if p.metadata.name in bound_names:
+                    self.cluster.finish_binding(p.metadata.uid)
+                    p.spec.node_name = n
+                    bound += 1
+                else:
+                    self.cluster.forget(p.metadata.uid)
+            self.stats["binds"] += bound
+            self.stats["scheduled"] += bound
+            self._binds_total.inc(bound)
+            plugin.post_bind_gang(gang, bound)
+        except Exception:
+            # unexpected failure (transport, bug): release what was only
+            # assumed, hand the gang back, and let the outer handler run
+            # the popped pod through the per-pod path
+            rollback()
+            hand_back()
+            raise
+        for m, _ in extras:
+            # members beyond the quorum: ordinary per-pod scan placement
+            self.queue.push(m)
+        return True
+
+    def _seat_plan(self, seat, slots):
+        """Assign each (info, pod) in ``seat`` to a plan slot, verifying
+        node capacity live and assuming as it goes. Returns
+        ``(assigned, shortfall)`` where assigned holds
+        (info, pod, node_name) triples; on shortfall the caller rolls the
+        assumes back."""
+        assigned = []
+        idx = 0
+        for node_name, count in slots.items():
+            if idx >= len(seat):
+                break
+            node = self.cluster.get_node(node_name)
+            if node is None or node.spec.unschedulable:
+                continue
+            left = rmath.single_node_left(
+                node, self.cluster.node_requested(node_name), None
+            )
+            remaining = count
+            while remaining > 0 and idx < len(seat):
+                m, p = seat[idx]
+                require = dict(p.resource_require())
+                require["pods"] = require.get("pods", 0) + 1
+                if not (
+                    rmath.check_fit(p, node)
+                    and rmath.resource_satisfied(left, require)
+                ):
+                    break  # slot stale for this member: try the next node
+                self.cluster.assume(p, node_name)
+                assigned.append((m, p, node_name))
+                left = rmath.add_resources(
+                    left, {k: -v for k, v in require.items()}
+                )
+                idx += 1
+                remaining -= 1
+        return assigned, idx < len(seat)
+
     def _run_cycle(self, info: PodInfo) -> Optional[str]:
         try:
             with self._cycle_seconds.time():
@@ -162,42 +305,41 @@ class Scheduler:
         except Exception:
             # a broken cycle must not kill the loop; release any
             # capacity assumed mid-cycle, then retry the pod
-            self.cluster.forget(info.pod.metadata.uid)
+            self.cluster.forget(info.uid)
             if self.plugin is not None:
                 self.plugin.mark_dirty()
             self.queue.push_backoff(info)
             return None
 
-    def _schedule_one(self, info: PodInfo) -> Optional[str]:
-        self.stats["cycles"] += 1
-        # liveness check: the queued copy may be stale (deleted, replaced,
-        # already bound). Prefer the informer's raw store — same signal as
-        # an API GET without the deep copy + rehydration.
+    def _live_pod(self, info: PodInfo) -> Optional[Pod]:
+        """Liveness check: the queued copy may be stale (deleted, replaced,
+        already bound). Prefer the informer's raw store — same signal as
+        an API GET without the deep copy + rehydration. Returns the pod to
+        schedule, or None when the entry is stale (consume silently)."""
         if self._pod_informer is not None:
-            d = self._pod_informer.peek_raw(
-                info.pod.metadata.namespace, info.pod.metadata.name
-            )
+            d = self._pod_informer.peek_raw(info.namespace, info.name)
             if d is None:
-                return
+                return None
             meta = d.get("metadata") or {}
-            if meta.get("uid") != info.pod.metadata.uid or (
+            if meta.get("uid") != info.uid or (
                 (d.get("spec") or {}).get("node_name")
             ):
-                return
-            pod = info.pod
-        else:
-            try:
-                pod = self.clientset.pods(info.pod.metadata.namespace).get(
-                    info.pod.metadata.name
-                )
-            except NotFoundError:
-                return
-            if (
-                pod.spec.node_name
-                or pod.metadata.uid != info.pod.metadata.uid
-            ):
-                return
-            info.pod = pod
+                return None
+            return info.pod  # lazy: typed materialises only past liveness
+        try:
+            pod = self.clientset.pods(info.namespace).get(info.name)
+        except NotFoundError:
+            return None
+        if pod.spec.node_name or pod.metadata.uid != info.uid:
+            return None
+        info.pod = pod
+        return pod
+
+    def _schedule_one(self, info: PodInfo) -> Optional[str]:
+        self.stats["cycles"] += 1
+        pod = self._live_pod(info)
+        if pod is None:
+            return
 
         if self.plugin is not None:
             try:
@@ -205,6 +347,12 @@ class Scheduler:
             except SchedulingError as e:
                 self._unschedulable(info, str(e))
                 return
+            # whole-gang fast lane: pre_filter just ran (stamping a fresh
+            # gang's plan); a plan covering the quorum admits the gang as
+            # one transaction and consumes its queued siblings
+            if info.gang and hasattr(self.plugin, "gang_plan"):
+                if self._gang_transaction(info, pod, _gang_key(info)):
+                    return
 
         node_name, from_plan = self._select_node(pod)
         if node_name is None:

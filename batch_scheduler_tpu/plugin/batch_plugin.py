@@ -73,6 +73,11 @@ class BatchSchedulingPlugin:
             info1.pod, info1.timestamp, info2.pod, info2.timestamp
         )
 
+    def sort_key(self, info) -> tuple:
+        """Precomputed queue key equivalent to ``less`` — see
+        ScheduleOperation.sort_key."""
+        return self.operation.sort_key(info)
+
     def pre_filter(self, pod: Pod) -> None:
         with self._ext_seconds.time(point="preFilter"):
             self.operation.pre_filter(pod)
@@ -129,6 +134,23 @@ class BatchSchedulingPlugin:
 
     def mark_dirty(self) -> None:
         self.operation.mark_dirty()
+
+    # Whole-gang fast lane (gang-granular release+bind; reference
+    # precedent: StartBatchSchedule's one-sweep gang release,
+    # batchscheduler.go:254-344)
+    def gang_plan(self, pod: Pod):
+        return self.operation.gang_plan(pod)
+
+    def permit_gang(self, full_name: str, members) -> bool:
+        with self._ext_seconds.time(point="permit"):
+            ok = self.operation.permit_gang(full_name, members)
+        if ok:
+            self._gang_releases.inc()
+        return ok
+
+    def post_bind_gang(self, full_name: str, bound: int) -> None:
+        with self._ext_seconds.time(point="postBind"):
+            self.operation.post_bind_gang(full_name, bound)
 
     def suggested_node(self, pod: Pod) -> Optional[str]:
         """Gang-granular admission: the batch plan's next open slot for this

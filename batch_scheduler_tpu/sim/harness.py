@@ -11,7 +11,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, List, Optional
 
-from ..api.types import Node, Pod, PodGroup, PodGroupPhase, PodPhase
+from ..api.types import Node, Pod, PodGroup, PodGroupPhase, PodPhase, to_dict
 from ..client.apiserver import APIServer
 from ..client.clientset import Clientset
 from ..client.informers import SharedInformerFactory
@@ -90,18 +90,23 @@ class SimCluster:
             on_update=lambda old, new: self.cluster.update_node(new),
             on_delete=lambda n: self.cluster.remove_node(n.metadata.name),
         )
+        # all Pod events ride the raw fast path: ADDED seeds the queue with
+        # a lazy entry (typed pod materialises on the scheduling thread),
+        # bind commits and kubelet phase flips are ~3 MODIFIED events per
+        # pod and never need typed rehydration (observe_pod_raw)
         self._fwk_informers.informer("Pod").add_event_handler(
-            on_add=self._pod_added,
-            on_update=lambda old, new: self.cluster.observe_pod(new),
-            on_delete=self.cluster.remove_pod,
+            on_add=self._pod_added_raw,
+            on_update=lambda old, new: self.cluster.observe_pod_raw(new),
+            on_delete=self.cluster.remove_pod_raw,
+            raw=True,
         )
         self._started = False
 
-    def _pod_added(self, pod: Pod) -> None:
-        if pod.spec.node_name:
-            self.cluster.observe_pod(pod)
+    def _pod_added_raw(self, d: dict) -> None:
+        if (d.get("spec") or {}).get("node_name"):
+            self.cluster.observe_pod_raw(d)
         else:
-            self.scheduler.enqueue(pod)
+            self.scheduler.enqueue_raw(d)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -145,6 +150,15 @@ class SimCluster:
     # -- populate ----------------------------------------------------------
 
     def add_nodes(self, nodes: List[Node]) -> None:
+        create_many = getattr(self.api, "create_many", None)
+        if create_many is not None:
+            docs = []
+            for node in nodes:
+                d = to_dict(node)
+                d.setdefault("metadata", {})["namespace"] = ""  # cluster-scoped
+                docs.append(d)
+            create_many("Node", docs, assume_fresh=True)
+            return
         for node in nodes:
             self.clientset.nodes().create(node)
 
@@ -152,6 +166,14 @@ class SimCluster:
         return self.clientset.podgroups(pg.metadata.namespace).create(pg)
 
     def create_pods(self, pods: List[Pod]) -> None:
+        # bulk ingest when the API supports it: load generation must not
+        # serialize on per-pod response copies it never reads
+        create_many = getattr(self.api, "create_many", None)
+        if create_many is not None:
+            create_many(
+                "Pod", [to_dict(pod) for pod in pods], assume_fresh=True
+            )
+            return
         for pod in pods:
             self.clientset.pods(pod.metadata.namespace).create(pod)
 

@@ -103,14 +103,31 @@ class SimKubelet:
             with self._lock:
                 while self._pending and self._pending[0][0] <= now:
                     due.append(heapq.heappop(self._pending))
-            for _, _, ns, name, phase in due:
-                try:
-                    self.clientset.pods(ns).patch(
-                        name, {"status": {"phase": phase.value}}
+            if not due:
+                continue
+            patch_many = getattr(self.api, "patch_many", None)
+            if patch_many is not None:
+                # batched phase transitions: one lock pass per (tick, ns)
+                # instead of a patch round trip per pod — at 10k pods the
+                # per-pod form was measurable GIL load beside the scheduler
+                by_ns: Dict[str, list] = {}
+                for _, _, ns, name, phase in due:
+                    by_ns.setdefault(ns, []).append(
+                        (name, {"status": {"phase": phase.value}})
                     )
-                except NotFoundError:
-                    continue
-                if phase == PodPhase.RUNNING and self.run_duration is not None:
-                    self._schedule_transition(
-                        ns, name, PodPhase.SUCCEEDED, self.run_duration
-                    )
+                for ns, patches in by_ns.items():
+                    patch_many("Pod", ns, patches)
+            else:
+                for _, _, ns, name, phase in due:
+                    try:
+                        self.clientset.pods(ns).patch(
+                            name, {"status": {"phase": phase.value}}
+                        )
+                    except NotFoundError:
+                        continue
+            if self.run_duration is not None:
+                for _, _, ns, name, phase in due:
+                    if phase == PodPhase.RUNNING:
+                        self._schedule_transition(
+                            ns, name, PodPhase.SUCCEEDED, self.run_duration
+                        )

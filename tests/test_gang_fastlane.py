@@ -1,0 +1,219 @@
+"""Whole-gang fast lane (gang-granular release+bind): a gang whose batch
+plan covers the quorum and whose members are all queued is admitted as ONE
+transaction — no permit parking, one batched bind, one status patch.
+Reference precedent for gang-unit choreography: StartBatchSchedule
+(reference pkg/scheduler/batch/batchscheduler.go:254-344)."""
+
+import pytest
+
+from batch_scheduler_tpu.api import PodGroupPhase
+from batch_scheduler_tpu.client.apiserver import APIServer, AlreadyExistsError
+from batch_scheduler_tpu.client.clientset import Clientset
+from batch_scheduler_tpu.framework.types import PodInfo
+from batch_scheduler_tpu.sim import (
+    SimCluster,
+    make_member_pods,
+    make_sim_group,
+    make_sim_node,
+)
+
+from helpers import make_pod
+
+
+@pytest.fixture
+def sim(request):
+    clusters = []
+
+    def build(**kwargs):
+        c = SimCluster(**kwargs)
+        clusters.append(c)
+        return c
+
+    yield build
+    for c in clusters:
+        c.stop()
+
+
+def test_whole_gang_admitted_without_permit_waits(sim):
+    """A fully-queued gang rides the fast lane: all members bind, the gang
+    reaches Scheduled, and NOTHING parks in a Permit wait."""
+    cluster = sim(scorer="oracle")
+    cluster.add_nodes(
+        [make_sim_node(f"n{i}", {"cpu": "16", "pods": "64"}) for i in range(3)]
+    )
+    pg = make_sim_group("fast", 6)
+    pg.spec.min_resources = {"cpu": 1000}
+    cluster.create_group(pg)
+    cluster.start()
+    cluster.create_pods(make_member_pods("fast", 6, {"cpu": "1"}))
+    assert cluster.wait_for_bound("fast", 6, timeout=20.0), (
+        cluster.scheduler.stats
+    )
+    assert cluster.wait_for_group_phase(
+        "fast", (PodGroupPhase.SCHEDULED, PodGroupPhase.RUNNING), timeout=10.0
+    )
+    stats = cluster.scheduler.stats
+    assert stats["permit_waits"] == 0, stats
+    assert stats["binds"] == 6
+
+
+def test_partial_arrival_falls_back_to_permit_waits(sim):
+    """Members arriving over time park via Permit waits (per-pod path) and
+    the gang still completes when the quorum lands — fast-lane eligibility
+    must not break incremental arrival."""
+    cluster = sim(scorer="oracle")
+    cluster.add_nodes([make_sim_node("n1", {"cpu": "16", "pods": "64"})])
+    pg = make_sim_group("slow", 4)
+    pg.spec.min_resources = {"cpu": 1000}
+    cluster.create_group(pg)
+    cluster.start()
+    first = make_member_pods("slow", 4, {"cpu": "1"})
+    cluster.create_pods(first[:2])
+    # the two early members must park (gang incomplete)
+    assert cluster.wait_for(
+        lambda: cluster.scheduler.stats["permit_waits"] >= 2, timeout=10.0
+    ), cluster.scheduler.stats
+    cluster.create_pods(first[2:])
+    assert cluster.wait_for_bound("slow", 4, timeout=20.0), (
+        cluster.scheduler.stats
+    )
+
+
+def test_gang_plan_eligibility_gating(sim):
+    """gang_plan is None for: serial mode, unknown groups, released gangs,
+    and gangs with matched members (waiting pods) — each falls back to the
+    per-pod path."""
+    cluster = sim(scorer="oracle")
+    cluster.add_nodes([make_sim_node("n1", {"cpu": "16", "pods": "64"})])
+    pg = make_sim_group("gate", 2)
+    pg.spec.min_resources = {"cpu": 1000}
+    cluster.create_group(pg)
+    cluster.start()
+    op = cluster.runtime.operation
+    pod = make_member_pods("gate", 2, {"cpu": "1"})[0]
+
+    # no plan stamped yet
+    assert op.gang_plan(pod) is None
+    # stamp a plan via pre_filter
+    op.pre_filter(pod)
+    plan = op.gang_plan(pod)
+    assert plan is not None
+    slots, needed = plan
+    assert needed == 2 and sum(slots.values()) >= 2
+    # a matched (waiting) member disqualifies the whole-gang transaction
+    pgs = op.status_cache.get("default/gate")
+    outcome = op.permit(pod, "n1")
+    assert not outcome.ready
+    assert op.gang_plan(pod) is None
+    # released gangs are ineligible too
+    pgs.matched_pod_nodes.flush()
+    pgs.scheduled = True
+    assert op.gang_plan(pod) is None
+
+    # non-group pods never have a plan
+    assert op.gang_plan(make_pod("solo")) is None
+
+
+def test_post_bind_gang_single_patch_transitions_to_scheduled(sim):
+    """post_bind_gang applies ONE status transition for the whole gang:
+    scheduled count jumps by the quorum and the phase lands on Scheduled
+    (partial counts land on Scheduling)."""
+    cluster = sim(scorer="oracle")
+    cluster.add_nodes([make_sim_node("n1", {"cpu": "16", "pods": "64"})])
+    pg = make_sim_group("unit", 4)
+    pg.spec.min_resources = {"cpu": 1000}
+    cluster.create_group(pg)
+    cluster.start()
+    op = cluster.runtime.operation
+    op.post_bind_gang("default/unit", 3)
+    live = cluster.group("unit")
+    assert live.status.scheduled == 3
+    assert live.status.phase == PodGroupPhase.SCHEDULING
+    op.post_bind_gang("default/unit", 1)
+    live = cluster.group("unit")
+    assert live.status.scheduled == 4
+    assert live.status.phase == PodGroupPhase.SCHEDULED
+    assert live.status.schedule_start_time > 0
+
+
+def test_bind_many_skips_missing_and_binds_rest():
+    api = APIServer()
+    cs = Clientset(api)
+    for name in ("a", "b"):
+        cs.pods().create(make_pod(name))
+    bound = cs.pods().bind_many([("a", "n1"), ("ghost", "n1"), ("b", "n2")])
+    assert bound == ["a", "b"]
+    assert cs.pods().get("a").spec.node_name == "n1"
+    assert cs.pods().get("b").spec.node_name == "n2"
+
+
+def test_create_many_all_or_nothing_on_existing_names():
+    api = APIServer()
+    cs = Clientset(api)
+    cs.pods().create(make_pod("dup"))
+    import batch_scheduler_tpu.api.types as t
+
+    with pytest.raises(AlreadyExistsError):
+        api.create_many(
+            "Pod", [t.to_dict(make_pod("fresh")), t.to_dict(make_pod("dup"))]
+        )
+    # nothing from the failed batch committed
+    import batch_scheduler_tpu.client.apiserver as a
+
+    with pytest.raises(a.NotFoundError):
+        api.get("Pod", "default", "fresh")
+    assert api.create_many("Pod", [t.to_dict(make_pod("fresh"))]) == 1
+
+
+def test_sort_key_orders_like_compare(sim):
+    """The precomputed queue key must rank pods exactly as the Compare
+    chain (reference core.go:368-411): priority desc, non-gang first,
+    group creation asc, group name REVERSE-lex, timestamp asc."""
+    cluster = sim(scorer="oracle")
+    cluster.add_nodes([make_sim_node("n1", {"cpu": "16", "pods": "64"})])
+    for name, ts in (("alpha", 5.0), ("beta", 5.0), ("gamma", 1.0)):
+        pg = make_sim_group(name, 1, creation_ts=ts)
+        pg.spec.min_resources = {"cpu": 1000}
+        cluster.create_group(pg)
+    cluster.start()
+    op = cluster.runtime.operation
+
+    def info_for(pod, ts):
+        return PodInfo(pod=pod, timestamp=ts)
+
+    hi = info_for(make_pod("hi", group="alpha", priority=9), 4.0)
+    solo = info_for(make_pod("solo", priority=0), 3.0)
+    early = info_for(make_pod("e", group="gamma"), 2.0)
+    a_pod = info_for(make_pod("a", group="alpha"), 2.0)
+    b_pod = info_for(make_pod("b", group="beta"), 1.0)
+    a_late = info_for(make_pod("a2", group="alpha"), 9.0)
+
+    # expected: hi (prio) < solo (non-gang) < early (created 1.0)
+    #           < b (reverse-lex beta>alpha) < a < a_late (timestamp)
+    expected = [hi, solo, early, b_pod, a_pod, a_late]
+    keyed = sorted(expected[::-1], key=op.sort_key)
+    assert [i.name for i in keyed] == [i.name for i in expected]
+    # spot-check agreement with the comparator form on every ordered pair
+    for x in expected:
+        for y in expected:
+            if x is y:
+                continue
+            lt = op.compare(x.pod, x.timestamp, y.pod, y.timestamp)
+            if lt:
+                assert op.sort_key(x) < op.sort_key(y), (x.name, y.name)
+
+
+def test_creation_cache_invalidated_on_group_delete(sim):
+    """A group deleted and recreated under the same name must sort by its
+    NEW creation timestamp (the sort-key cache dies with the group)."""
+    cluster = sim(scorer="oracle")
+    cluster.add_nodes([make_sim_node("n1", {"cpu": "16", "pods": "64"})])
+    pg = make_sim_group("reborn", 1, creation_ts=100.0)
+    pg.spec.min_resources = {"cpu": 1000}
+    cluster.create_group(pg)
+    cluster.start()
+    op = cluster.runtime.operation
+    info = PodInfo(pod=make_pod("p1", group="reborn"), timestamp=0.0)
+    assert op.sort_key(info)[2] == 100.0
+    op.status_cache.delete("default/reborn")
+    assert ("default", "reborn") not in op._creation_cache
