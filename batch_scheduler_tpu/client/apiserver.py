@@ -213,24 +213,26 @@ class APIServer:
         Pending->Running transitions per run; per-call ``patch`` pays a
         response deep copy and a lock round trip each.)"""
         patched: List[str] = []
-        with self._lock:
-            store = self._kind_store(kind)
-            for name, patch in patches:
-                key = (namespace, name)
-                old = store.get(key)
-                if old is None:
-                    continue
-                merged = apply_merge_patch(old, patch)
-                self._rv += 1
-                merged["metadata"] = dict(merged.get("metadata") or {})
-                merged["metadata"]["resource_version"] = self._rv
-                self._index_remove(kind, key, old)
-                store[key] = merged
-                self._index_add(kind, key, merged)
-                self._notify(
-                    kind, WatchEvent(WatchEvent.MODIFIED, kind, merged)
-                )
-                patched.append(name)
+        chunk = 64  # bounded lock hold, like bind_pods
+        for start in range(0, len(patches), chunk):
+            with self._lock:
+                store = self._kind_store(kind)
+                for name, patch in patches[start : start + chunk]:
+                    key = (namespace, name)
+                    old = store.get(key)
+                    if old is None:
+                        continue
+                    merged = apply_merge_patch(old, patch)
+                    self._rv += 1
+                    merged["metadata"] = dict(merged.get("metadata") or {})
+                    merged["metadata"]["resource_version"] = self._rv
+                    self._index_remove(kind, key, old)
+                    store[key] = merged
+                    self._index_add(kind, key, merged)
+                    self._notify(
+                        kind, WatchEvent(WatchEvent.MODIFIED, kind, merged)
+                    )
+                    patched.append(name)
         return patched
 
     def get(self, kind: str, namespace: str, name: str) -> dict:
@@ -333,24 +335,26 @@ class APIServer:
         ``spec.node_name``, so the label index needs no maintenance.
         Returns the names actually bound."""
         bound: List[str] = []
-        with self._lock:
-            store = self._kind_store("Pod")
-            for name, node_name in pairs:
-                key = (namespace, name)
-                old = store.get(key)
-                if old is None:
-                    continue
-                merged = apply_merge_patch(
-                    old, {"spec": {"node_name": node_name}}
-                )
-                self._rv += 1
-                merged["metadata"] = dict(merged.get("metadata") or {})
-                merged["metadata"]["resource_version"] = self._rv
-                store[key] = merged
-                self._notify(
-                    "Pod", WatchEvent(WatchEvent.MODIFIED, "Pod", merged)
-                )
-                bound.append(name)
+        chunk = 64  # bounded lock hold: a whole-flush bind (10s of pods)
+        for start in range(0, len(pairs), chunk):
+            with self._lock:
+                store = self._kind_store("Pod")
+                for name, node_name in pairs[start : start + chunk]:
+                    key = (namespace, name)
+                    old = store.get(key)
+                    if old is None:
+                        continue
+                    merged = apply_merge_patch(
+                        old, {"spec": {"node_name": node_name}}
+                    )
+                    self._rv += 1
+                    merged["metadata"] = dict(merged.get("metadata") or {})
+                    merged["metadata"]["resource_version"] = self._rv
+                    store[key] = merged
+                    self._notify(
+                        "Pod", WatchEvent(WatchEvent.MODIFIED, "Pod", merged)
+                    )
+                    bound.append(name)
         return bound
 
     def delete(self, kind: str, namespace: str, name: str) -> None:

@@ -59,6 +59,23 @@ class _TypedInterface:
     def patch(self, name: str, patch: dict):
         return self._decode(self._api.patch(self.KIND, self._ns, name, patch))
 
+    def patch_many(self, pairs) -> List[str]:
+        """Bulk merge patch: one API pass where the backend supports it,
+        per-object patches otherwise. Missing objects are skipped; returns
+        the names actually patched (no response decode — callers that
+        need the updated objects patch individually)."""
+        api_patch_many = getattr(self._api, "patch_many", None)
+        if api_patch_many is not None:
+            return api_patch_many(self.KIND, self._ns, pairs)
+        patched = []
+        for name, patch in pairs:
+            try:
+                self._api.patch(self.KIND, self._ns, name, patch)
+            except NotFoundError:
+                continue
+            patched.append(name)
+        return patched
+
     def delete(self, name: str) -> None:
         self._api.delete(self.KIND, self._ns, name)
 

@@ -14,6 +14,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..api.serde import object_from_dict
+from ..utils.drain import drain_queue
 from .apiserver import APIServer, WatchEvent
 
 __all__ = ["SharedInformer", "SharedInformerFactory", "PodGroupLister"]
@@ -99,13 +100,14 @@ class SharedInformer:
 
     def _run(self) -> None:
         # Drain the replayed ADDED events, then mark synced on first idle.
+        # Bursts drain in micro-batches (utils.drain.drain_queue).
         while not self._stop.is_set():
-            try:
-                event = self._events.get(timeout=_POLL_SECONDS)
-            except queue.Empty:
+            batch = drain_queue(self._events, timeout=_POLL_SECONDS)
+            if batch is None:
                 self._synced.set()
                 continue
-            self._dispatch(event)
+            for event in batch:
+                self._dispatch(event)
 
     def _dispatch(self, event: WatchEvent) -> None:
         meta = event.obj.get("metadata") or {}
@@ -113,8 +115,21 @@ class SharedInformer:
         typed = None  # materialised lazily: only if a non-raw handler fires
         with self._lock:
             old = self._store.get(key)
-            if old is not None:
-                for item in ((old.get("metadata") or {}).get("labels") or {}).items():
+            # label-index maintenance only when the label set changed:
+            # status/spec patches (binds, phase flips — most MODIFIED
+            # traffic) leave labels identical, and this critical section is
+            # what the scheduling thread's peeks contend with
+            old_labels = (
+                ((old.get("metadata") or {}).get("labels") or {})
+                if old is not None
+                else None
+            )
+            new_labels = meta.get("labels") or {}
+            labels_changed = (
+                event.type == WatchEvent.DELETED or old_labels != new_labels
+            )
+            if old is not None and labels_changed:
+                for item in (old_labels or {}).items():
                     bucket = self._label_index.get(item)
                     if bucket is not None:
                         bucket.discard(key)
@@ -127,8 +142,9 @@ class SharedInformer:
                 self._typed_cache.pop(key, None)
             else:
                 self._store[key] = event.obj
-                for item in (meta.get("labels") or {}).items():
-                    self._label_index.setdefault(item, set()).add(key)
+                if old is None or labels_changed:
+                    for item in new_labels.items():
+                        self._label_index.setdefault(item, set()).add(key)
         old_typed = (
             object_from_dict(self.kind, old)
             if old
@@ -171,6 +187,14 @@ class SharedInformer:
         GET was our addition and cost ~100µs/cycle at 10k-pod scale)."""
         with self._lock:
             return self._store.get((namespace, name))
+
+    def peek_raw_many(self, namespace: str, names) -> list:
+        """One lock pass over many keys — the gang transaction's batch
+        liveness check (per-member ``peek_raw`` calls contend this lock
+        against the watch-dispatch thread ~10x per gang). Same read-only
+        contract as ``peek_raw``; missing keys yield None."""
+        with self._lock:
+            return [self._store.get((namespace, n)) for n in names]
 
     def list_raw_by_label(
         self, namespace: Optional[str], selector: Dict[str, str]

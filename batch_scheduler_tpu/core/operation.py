@@ -403,6 +403,65 @@ class ScheduleOperation:
         if completed:
             self.mark_dirty()
 
+    def post_bind_gangs(self, items) -> None:
+        """Flush form of :meth:`post_bind_gang` for a batch of gangs bound
+        together (the scheduler's cross-gang commit buffer): ONE lock
+        pass, ONE bulk status patch per namespace, ONE batch invalidation
+        — instead of a lock + patch + re-batch per gang. ``items``:
+        (full_name, bound_count) pairs.
+
+        Unlike the per-gang form (which leaves local state unadvanced when
+        its patch fails, so the next bind retries the transition), the
+        flush commits local state first and patches best-effort: the binds
+        are already durable at this point, and the controller re-derives
+        any missed phase from live member pods (reference
+        controller.go:201-222 crash recovery)."""
+        patches_by_ns: Dict[str, list] = {}
+        completed_any = False
+        with self._lock:
+            for full_name, bound in items:
+                if bound <= 0:
+                    continue
+                pgs = self.status_cache.get(full_name)
+                if pgs is None:
+                    continue
+                pg = pgs.pod_group
+                new_scheduled = pg.status.scheduled + bound
+                completed = new_scheduled >= pg.spec.min_member
+                new_phase = (
+                    PodGroupPhase.SCHEDULED
+                    if completed
+                    else PodGroupPhase.SCHEDULING
+                )
+                new_start = pg.status.schedule_start_time or time.time()
+                if self.pg_client is not None and new_phase != pg.status.phase:
+                    patches_by_ns.setdefault(
+                        pg.metadata.namespace, []
+                    ).append(
+                        (
+                            pg.metadata.name,
+                            {
+                                "status": {
+                                    "phase": new_phase.value,
+                                    "scheduled": new_scheduled,
+                                    "schedule_start_time": new_start,
+                                }
+                            },
+                        )
+                    )
+                pg.status.phase = new_phase
+                pg.status.schedule_start_time = new_start
+                pg.status.scheduled = new_scheduled
+                pgs.placement_plan = None
+                completed_any = completed_any or completed
+        for ns, patches in patches_by_ns.items():
+            try:
+                self.pg_client.podgroups(ns).patch_many(patches)
+            except Exception:
+                pass  # controller reconciliation recovers the phase
+        if completed_any:
+            self.mark_dirty()
+
     def on_assume(
         self, pod: Pod, node_name: str, from_plan: bool = False
     ) -> None:

@@ -80,6 +80,24 @@ class ClusterState:
             self._pod_objs[uid] = pod
             self._version += 1
 
+    def assume_many(self, pairs) -> None:
+        """Batch form of :meth:`assume` — one lock pass for a whole gang's
+        seat assignment (per-member acquisitions contend with the watch
+        handlers ~quorum times per gang). ``pairs``: (pod, node_name)."""
+        with self._lock:
+            for pod, node_name in pairs:
+                uid = pod.metadata.uid
+                prev = self._pod_nodes.get(uid)
+                if prev is not None and prev != node_name:
+                    self._requested.get(prev, {}).pop(uid, None)
+                self._requested.setdefault(node_name, {})[uid] = self._require(
+                    pod
+                )
+                self._assumed[uid] = node_name
+                self._pod_nodes[uid] = node_name
+                self._pod_objs[uid] = pod
+            self._version += len(pairs)
+
     def forget(self, pod_uid: str) -> None:
         """Drop an assumed pod whose permit/bind failed."""
         with self._lock:
@@ -94,6 +112,11 @@ class ClusterState:
     def finish_binding(self, pod_uid: str) -> None:
         with self._lock:
             self._assumed.pop(pod_uid, None)
+
+    def finish_binding_many(self, pod_uids) -> None:
+        with self._lock:
+            for uid in pod_uids:
+                self._assumed.pop(uid, None)
 
     def observe_pod(self, pod: Pod) -> None:
         """Apply an informer event for a pod: bound pods charge their node,

@@ -11,6 +11,7 @@ from __future__ import annotations
 import heapq
 import threading
 import time
+from collections import deque
 from typing import Callable, Optional
 
 from .types import PodInfo
@@ -21,7 +22,7 @@ LessFn = Callable[[PodInfo, PodInfo], bool]
 
 
 class _Entry:
-    __slots__ = ("info", "less", "dead", "group", "key")
+    __slots__ = ("info", "less", "dead", "group", "key", "in_heap")
 
     def __init__(
         self,
@@ -37,6 +38,7 @@ class _Entry:
         # precomputed total-order key (plugin sort_key): heap comparisons
         # become tuple compares instead of two Less() attribute walks
         self.key = key
+        self.in_heap = False  # heap-resident vs parked in a gang FIFO
 
     def __lt__(self, other: "_Entry") -> bool:
         if self.key is not None and other.key is not None:
@@ -70,10 +72,23 @@ class SchedulingQueue:
         self._cond = threading.Condition()
         self._active: list = []
         self._active_dead = 0
+        self._live_active = 0
         # gang-unit admission index: group key -> live active entries, so a
         # batch-planned gang's queued members drain in one cycle instead of
         # one heap pop + full comparator churn each (pop_group)
         self._groups: dict = {}
+        # Two-level gang queueing: the heap holds ONE resident entry per
+        # (group, priority) bucket; later same-bucket arrivals park in a
+        # FIFO and are promoted when the resident pops. Same-bucket pods
+        # are mutually adjacent under the Compare chain (identical
+        # priority/creation/name — only the queue timestamp differs), so
+        # bucket-FIFO order matches the heap order they would have had,
+        # and the ~quorum-1 members per gang skip the heap entirely (at
+        # 10k pods that was most of the push cost). One deviation: a
+        # backoff RE-entry re-parks at its bucket's FIFO tail even though
+        # its original timestamp may precede a queued sibling's.
+        self._fifos: dict = {}
+        self._heads: dict = {}
         self._backoff: list = []  # heap of (ready_at, seq, PodInfo)
         self._closed = False
         self._flusher = threading.Thread(
@@ -83,13 +98,40 @@ class SchedulingQueue:
 
     def _push_active_locked(self, info: PodInfo) -> None:
         group = self._group_key(info) if self._group_key else None
-        key = None
-        if self._sort_key is not None:
-            key = (*self._sort_key(info), info.seq)  # seq: stable tiebreak
-        entry = _Entry(info, self._less, group, key)
-        heapq.heappush(self._active, entry)
+        entry = _Entry(info, self._less, group)
+        self._live_active += 1
         if group is not None:
             self._groups.setdefault(group, set()).add(entry)
+            bucket = (group, info.priority)
+            if bucket in self._heads:
+                # a sibling is heap-resident: park (no heap op, no key)
+                self._fifos.setdefault(bucket, deque()).append(entry)
+                return
+            self._heads[bucket] = entry
+        self._heap_insert_locked(entry)
+
+    def _heap_insert_locked(self, entry: _Entry) -> None:
+        if self._sort_key is not None:
+            # seq appended for a stable total order
+            entry.key = (*self._sort_key(entry.info), entry.info.seq)
+        entry.in_heap = True
+        heapq.heappush(self._active, entry)
+
+    def _promote_bucket_locked(self, entry: _Entry) -> None:
+        """A gang bucket's heap-resident entry was popped (live or dead):
+        promote its next live FIFO member into the heap."""
+        bucket = (entry.group, entry.info.priority)
+        if self._heads.get(bucket) is not entry:
+            return
+        fifo = self._fifos.get(bucket)
+        while fifo:
+            nxt = fifo.popleft()
+            if not nxt.dead:
+                self._heads[bucket] = nxt
+                self._heap_insert_locked(nxt)
+                return
+        self._heads.pop(bucket, None)
+        self._fifos.pop(bucket, None)
 
     def _drop_from_group_locked(self, entry: "_Entry") -> None:
         if entry.group is not None:
@@ -116,7 +158,8 @@ class SchedulingQueue:
     def pop_group(self, group: str) -> list:
         """Remove and return every queued member of ``group`` (arbitrary
         order — the caller admits them against an already-priority-ordered
-        batch plan). Their heap entries are lazily deleted."""
+        batch plan). Heap-resident entries are lazily deleted; FIFO-parked
+        entries never touch the heap at all."""
         with self._cond:
             bucket = self._groups.pop(group, None)
             if not bucket:
@@ -125,7 +168,9 @@ class SchedulingQueue:
             for entry in bucket:
                 if not entry.dead:
                     entry.dead = True
-                    self._active_dead += 1
+                    self._live_active -= 1
+                    if entry.in_heap:
+                        self._active_dead += 1
                     out.append(entry.info)
             return out
 
@@ -161,10 +206,16 @@ class SchedulingQueue:
                     self._cond.wait(wait if wait is None else max(wait, 0.01))
                     self._promote_locked()
                 entry = heapq.heappop(self._active)
+                entry.in_heap = False
+                if entry.group is not None:
+                    # live or dead, the popped resident hands its bucket's
+                    # heap slot to the next parked sibling
+                    self._promote_bucket_locked(entry)
                 if entry.dead:
                     self._active_dead -= 1
                     continue  # lazily-deleted (drained via pop_group)
                 self._drop_from_group_locked(entry)
+                self._live_active -= 1
                 return entry.info
 
     def _promote_locked(self) -> None:
@@ -185,9 +236,7 @@ class SchedulingQueue:
 
     def __len__(self) -> int:
         with self._cond:
-            return (
-                len(self._active) - self._active_dead + len(self._backoff)
-            )
+            return self._live_active + len(self._backoff)
 
     def close(self) -> None:
         with self._cond:

@@ -93,6 +93,11 @@ class Scheduler:
         self._bind_workers = bind_workers
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
+        # cross-gang commit buffer: (gang, namespace, assigned) awaiting
+        # the batched bind + post-bind flush (scheduling thread only,
+        # except stop() after joining it); _buffer_since bounds deferral
+        self._gang_buffer: List[tuple] = []
+        self._buffer_since = 0.0
         # counters for observability (SURVEY.md §5 build note)
         self.stats = {
             "scheduled": 0,
@@ -131,6 +136,14 @@ class Scheduler:
         self._stop.set()
         self.queue.close()
         self.waiting.close()
+        # the cycle thread's exit path flushes the gang commit buffer; wait
+        # for it so no permitted gang stays assumed-but-unbound, and flush
+        # here if the thread could not (single-threaded buffer contract is
+        # preserved: a joined or dead thread no longer touches it)
+        for t in self._threads:
+            if t.name == "sched-cycle" and t is not threading.current_thread():
+                t.join(timeout=5.0)
+        self._flush_gangs()
 
     # -- enqueue (wired to pod informer events) ---------------------------
 
@@ -151,10 +164,23 @@ class Scheduler:
 
     # -- main cycle --------------------------------------------------------
 
+    # gangs per cross-gang commit flush: big enough to amortize the bind/
+    # patch API passes, small enough that binds trail their permits by
+    # only a few transactions
+    FLUSH_GANGS = 16
+    # wall-clock bound on commit deferral: sustained per-pod traffic must
+    # not hold already-permitted gangs unbound until the queue idles
+    FLUSH_SECONDS = 0.05
+
     def _loop(self) -> None:
         while not self._stop.is_set():
-            info = self.queue.pop(timeout=0.2)
+            # with commits buffered, drain fast and flush the moment the
+            # queue goes momentarily idle; otherwise wait normally
+            info = self.queue.pop(
+                timeout=0.005 if self._gang_buffer else 0.2
+            )
             if info is None:
+                self._flush_gangs()
                 continue
             gang = self._run_cycle(info)
             if gang is not None:
@@ -165,6 +191,12 @@ class Scheduler:
                 # seat fall through to the scan/backoff path as usual.
                 for sibling in self.queue.pop_group(gang):
                     self._run_cycle(sibling)
+            if self._gang_buffer and (
+                len(self._gang_buffer) >= self.FLUSH_GANGS
+                or self._clock() - self._buffer_since > self.FLUSH_SECONDS
+            ):
+                self._flush_gangs()
+        self._flush_gangs()  # nothing may stay assumed-but-unbound
 
     # -- whole-gang fast lane ---------------------------------------------
 
@@ -190,10 +222,26 @@ class Scheduler:
         if 1 + self.queue.group_size(gang) < needed:
             return False  # partial arrival: members park via Permit waits
         members = [(info, pod)]
-        for sib in self.queue.pop_group(gang):
-            p = self._live_pod(sib)
-            if p is not None:
-                members.append((sib, p))
+        sibs = self.queue.pop_group(gang)
+        if self._pod_informer is not None and sibs:
+            # batch liveness: one informer lock pass for the whole gang
+            docs = self._pod_informer.peek_raw_many(
+                info.namespace, [s.name for s in sibs]
+            )
+            for sib, d in zip(sibs, docs):
+                if d is None:
+                    continue
+                dmeta = d.get("metadata") or {}
+                if dmeta.get("uid") != sib.uid or (
+                    (d.get("spec") or {}).get("node_name")
+                ):
+                    continue
+                members.append((sib, sib.pod))
+        else:
+            for sib in sibs:
+                p = self._live_pod(sib)
+                if p is not None:
+                    members.append((sib, p))
 
         def hand_back() -> bool:
             # everything except the popped pod returns to the queue; the
@@ -232,24 +280,14 @@ class Scheduler:
                 rollback()
                 return hand_back()
 
-            ns = pod.metadata.namespace
-            bound_names = set(
-                self.clientset.pods(ns).bind_many(
-                    [(p.metadata.name, n) for _, p, n in assigned]
-                )
+            # commit is DEFERRED into the cross-gang flush buffer: binds
+            # and the post-bind status patch batch across up to
+            # FLUSH_GANGS gangs (one API pass each, one re-batch total)
+            if not self._gang_buffer:
+                self._buffer_since = self._clock()
+            self._gang_buffer.append(
+                (gang, pod.metadata.namespace, assigned)
             )
-            bound = 0
-            for _, p, n in assigned:
-                if p.metadata.name in bound_names:
-                    self.cluster.finish_binding(p.metadata.uid)
-                    p.spec.node_name = n
-                    bound += 1
-                else:
-                    self.cluster.forget(p.metadata.uid)
-            self.stats["binds"] += bound
-            self.stats["scheduled"] += bound
-            self._binds_total.inc(bound)
-            plugin.post_bind_gang(gang, bound)
         except Exception:
             # unexpected failure (transport, bug): release what was only
             # assumed, hand the gang back, and let the outer handler run
@@ -262,12 +300,69 @@ class Scheduler:
             self.queue.push(m)
         return True
 
+    def _flush_gangs(self) -> None:
+        """Commit the buffered gang transactions: ONE batched bind call
+        per namespace, one finish-binding lock pass, one post-bind status
+        sweep (bulk patch + single batch invalidation). Runs on the
+        scheduling thread only. On a bind transport failure every member
+        of the failed flush is rolled back to the queue with backoff —
+        their capacity was only assumed."""
+        buf = self._gang_buffer
+        if not buf:
+            return
+        self._gang_buffer = []
+        try:
+            by_ns = {}
+            for _, ns, assigned in buf:
+                by_ns.setdefault(ns, []).extend(
+                    (p.metadata.name, n) for _, p, n in assigned
+                )
+            bound_keys = set()
+            for ns, pairs in by_ns.items():
+                for name in self.clientset.pods(ns).bind_many(pairs):
+                    bound_keys.add((ns, name))
+        except Exception:
+            for _, _, assigned in buf:
+                for m, p, _ in assigned:
+                    self.cluster.forget(p.metadata.uid)
+                    self.queue.push_backoff(m)
+            if self.plugin is not None:
+                self.plugin.mark_dirty()
+            return
+        finished = []
+        items = []
+        for gang, ns, assigned in buf:
+            bound = 0
+            for _, p, n in assigned:
+                if (ns, p.metadata.name) in bound_keys:
+                    finished.append(p.metadata.uid)
+                    p.spec.node_name = n
+                    bound += 1
+                else:
+                    self.cluster.forget(p.metadata.uid)
+            items.append((gang, bound))
+            self.stats["binds"] += bound
+            self.stats["scheduled"] += bound
+            self._binds_total.inc(bound)
+        self.cluster.finish_binding_many(finished)
+        post_many = getattr(self.plugin, "post_bind_gangs", None)
+        if post_many is not None:
+            post_many(items)
+        else:
+            for gang, bound in items:
+                self.plugin.post_bind_gang(gang, bound)
+
     def _seat_plan(self, seat, slots):
         """Assign each (info, pod) in ``seat`` to a plan slot, verifying
-        node capacity live and assuming as it goes. Returns
+        node capacity against a local running balance, then assume the
+        whole seating in ONE cluster-lock pass. Returns
         ``(assigned, shortfall)`` where assigned holds
         (info, pod, node_name) triples; on shortfall the caller rolls the
-        assumes back."""
+        assumes back. Safe to defer the assumes to the end: the scheduling
+        cycle is single-threaded, concurrent mutators only RELEASE
+        capacity (bind-failure forgets, terminal-pod observes), and the
+        local ``left`` balance accounts this gang's own seats — the same
+        check-then-assume window the per-pod path has."""
         assigned = []
         idx = 0
         for node_name, count in slots.items():
@@ -279,24 +374,26 @@ class Scheduler:
             left = rmath.single_node_left(
                 node, self.cluster.node_requested(node_name), None
             )
+            left = dict(left)  # private running balance, mutated in place
             remaining = count
             while remaining > 0 and idx < len(seat):
                 m, p = seat[idx]
-                require = dict(p.resource_require())
+                require = p.resource_require()  # fresh dict per call
                 require["pods"] = require.get("pods", 0) + 1
                 if not (
                     rmath.check_fit(p, node)
                     and rmath.resource_satisfied(left, require)
                 ):
                     break  # slot stale for this member: try the next node
-                self.cluster.assume(p, node_name)
                 assigned.append((m, p, node_name))
-                left = rmath.add_resources(
-                    left, {k: -v for k, v in require.items()}
-                )
+                for k, v in require.items():
+                    left[k] = left.get(k, 0) - v
                 idx += 1
                 remaining -= 1
-        return assigned, idx < len(seat)
+        shortfall = idx < len(seat)
+        if not shortfall:
+            self.cluster.assume_many([(p, n) for _, p, n in assigned])
+        return assigned, shortfall
 
     def _run_cycle(self, info: PodInfo) -> Optional[str]:
         try:

@@ -152,6 +152,10 @@ class BatchSchedulingPlugin:
         with self._ext_seconds.time(point="postBind"):
             self.operation.post_bind_gang(full_name, bound)
 
+    def post_bind_gangs(self, items) -> None:
+        with self._ext_seconds.time(point="postBind"):
+            self.operation.post_bind_gangs(items)
+
     def suggested_node(self, pod: Pod) -> Optional[str]:
         """Gang-granular admission: the batch plan's next open slot for this
         pod, letting the framework skip the full node scan."""

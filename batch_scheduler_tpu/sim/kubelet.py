@@ -17,6 +17,7 @@ from typing import Callable, Dict, Optional
 from ..api.types import PodPhase
 from ..client.apiserver import APIServer, NotFoundError, WatchEvent
 from ..client.clientset import Clientset
+from ..utils.drain import drain_queue
 
 __all__ = ["SimKubelet"]
 
@@ -69,31 +70,32 @@ class SimKubelet:
             )
 
     def _watch_loop(self) -> None:
-        import queue as _q
-
         while not self._stop.is_set():
-            try:
-                event = self._events.get(timeout=0.1)
-            except _q.Empty:
+            batch = drain_queue(self._events, timeout=0.1)
+            if batch is None:
                 continue
-            if event.type == WatchEvent.DELETED:
-                continue
-            obj = event.obj
-            spec = obj.get("spec") or {}
-            status = obj.get("status") or {}
-            if not spec.get("node_name"):
-                continue
-            if status.get("phase", "Pending") != "Pending":
-                continue
-            meta = obj.get("metadata") or {}
-            ns, name = meta.get("namespace", "default"), meta.get("name", "")
-            key = f"{ns}/{name}"
-            next_phase = (
-                PodPhase.FAILED
-                if self.fail_pod is not None and self.fail_pod(key)
-                else PodPhase.RUNNING
-            )
-            self._schedule_transition(ns, name, next_phase, self.start_delay)
+            for event in batch:
+                self._handle_event(event)
+
+    def _handle_event(self, event) -> None:
+        if event.type == WatchEvent.DELETED:
+            return
+        obj = event.obj
+        spec = obj.get("spec") or {}
+        status = obj.get("status") or {}
+        if not spec.get("node_name"):
+            return
+        if status.get("phase", "Pending") != "Pending":
+            return
+        meta = obj.get("metadata") or {}
+        ns, name = meta.get("namespace", "default"), meta.get("name", "")
+        key = f"{ns}/{name}"
+        next_phase = (
+            PodPhase.FAILED
+            if self.fail_pod is not None and self.fail_pod(key)
+            else PodPhase.RUNNING
+        )
+        self._schedule_transition(ns, name, next_phase, self.start_delay)
 
     def _tick_loop(self) -> None:
         while not self._stop.is_set():

@@ -1,0 +1,36 @@
+"""Micro-batch queue draining shared by watch-stream consumers.
+
+At tens of thousands of events per run the per-``get`` timeout machinery
+is measurable; consumers take one blocking get, then drain
+opportunistically up to a batch bound (which also caps how long a burst
+keeps a consumer away from its stop-flag check).
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+from typing import List, Optional
+
+__all__ = ["drain_queue"]
+
+DEFAULT_MAX_BATCH = 512
+
+
+def drain_queue(
+    q: "_queue.Queue",
+    timeout: float,
+    max_batch: int = DEFAULT_MAX_BATCH,
+) -> Optional[List]:
+    """One blocking get (``timeout`` seconds), then up to ``max_batch - 1``
+    non-blocking gets. Returns None when the blocking get times out."""
+    try:
+        first = q.get(timeout=timeout)
+    except _queue.Empty:
+        return None
+    batch = [first]
+    for _ in range(max_batch - 1):
+        try:
+            batch.append(q.get_nowait())
+        except _queue.Empty:
+            break
+    return batch
