@@ -112,7 +112,10 @@ class ScheduleOperation:
             # a scorer instance (e.g. RemoteScorer backed by the sidecar);
             # apply requested batching behavior rather than silently
             # dropping it — but only when asked, so an instance configured
-            # directly keeps its own settings
+            # directly keeps its own settings. NOTE (ADVICE r3): when
+            # min_batch_interval/background_refresh are passed here, the
+            # caller-supplied instance IS mutated — do not share one scorer
+            # across operations with conflicting batching settings.
             self.scorer_kind = "oracle"
             self.oracle = scorer
             if min_batch_interval:

@@ -323,18 +323,34 @@ class OracleScorer:
                 self._bg_error = None
                 self.refresh(cluster, status_cache)
 
-    def drain_background(self, timeout: float = 10.0) -> None:
+    def drain_background(self, timeout: float = 60.0) -> bool:
         """Wait out any in-flight background batch. MUST be called before
         process teardown when background_refresh is on: a daemon thread dying
         inside an XLA call while the runtime is being destroyed aborts the
         process. The flag flip and the thread read share _bg_lock with the
         kick path (which rechecks the flag under it), so no new thread can
-        start after this returns."""
+        start after this returns.
+
+        Returns True when no background batch remains in flight. The
+        default timeout is sized to the known first-compile worst case
+        (~20-40s on the accelerator); a False return means the join timed
+        out and teardown would still race the XLA call — callers should
+        treat it as "do not destroy the runtime yet" (ADVICE r3)."""
         with self._bg_lock:
             self.background_refresh = False  # no new kicks after drain
             t = self._bg_thread
         if t is not None and t.is_alive():
             t.join(timeout)
+            if t.is_alive():
+                import sys
+
+                print(
+                    "drain_background: background batch still in flight "
+                    f"after {timeout}s; teardown would race an XLA call",
+                    file=sys.stderr,
+                )
+                return False
+        return True
 
     def _kick_background_refresh(self, cluster, status_cache: PGStatusCache) -> None:
         with self._bg_lock:

@@ -74,6 +74,10 @@ _BINS = 128
 # Pallas enablement is PER MASK MODE: a lowering/runtime failure on one
 # kernel variant (e.g. the per-group [G,N] mask path) disables only that
 # variant — it must not poison the other, independently proven one.
+# Read/written from multiple threads (background refresh + scheduling
+# cycles) without a lock: a benign race — the worst interleaving runs one
+# extra fallback batch and prints a duplicate warning (ADVICE r3); do not
+# add invariants here that assume single-threaded access.
 _pallas_enabled = {
     mode: os.environ.get("BST_DISABLE_PALLAS", "") != "1"
     for mode in ("broadcast", "per_group")

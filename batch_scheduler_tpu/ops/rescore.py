@@ -52,8 +52,13 @@ def _scatter_add_rows(req, rows, updates):
 
 # Fixed delta width: every scatter shares ONE jit signature per [N,R]
 # shape (warmed at first upload), so no steady tick can hit a mid-loop
-# compile. Bigger bursts fall back to a full mirror re-upload.
-_DELTA_BUCKET = 64
+# compile. Bigger bursts fall back to a full mirror re-upload (counted in
+# summary() as reupload_fallbacks — the path is ~100x costlier and an
+# undersized bucket silently turns every burst tick into it, VERDICT r3
+# item 5 postmortem). Sized for the worst admission-window tick: 32
+# admits x up to 10 assignment rows each (one per member at maximal
+# fragmentation) plus a releases margin.
+_DELTA_BUCKET = 512
 
 
 @dataclass
@@ -141,6 +146,7 @@ class ChurnRescorer:
         self.collect_times: List[float] = []
         self._shapes_seen: set = set()
         self.recompiles = 0
+        self.reupload_fallbacks = 0
         # Sticky buckets pin the padded shape to the largest seen — ZERO
         # recompiles ever, at the cost of scanning the max gang count every
         # tick. Off by default: the jit cache already holds every bucket
@@ -283,6 +289,10 @@ class ChurnRescorer:
                 or self._req_dev.shape != padded_requested.shape
                 or rows_total > _DELTA_BUCKET  # burst: re-upload is cheaper
             ):
+                if self._req_dev is not None:
+                    # an established mirror falling back is the perf cliff
+                    # the bucket sizing exists to avoid — count it
+                    self.reupload_fallbacks += 1
                 deltas.clear()
                 self._req_dev = jax.device_put(padded_requested)
                 # compile the (sole) scatter signature now, outside any
@@ -399,6 +409,20 @@ class ChurnRescorer:
         vec = self._member_lane_vec(group)
         update = (cnt[:, None] * vec[None, :]).astype(np.int32)
         self.requested_lanes[idx] += update
+        # Staleness guard (ADVICE r3): charging a one-tick-stale assignment
+        # is safe only under this class's contract that capacity never
+        # SHRINKS between dispatch and admit (releases/arrivals only add
+        # slack). A caller that interleaved node removal or external
+        # placements would oversubscribe silently — fail loudly instead.
+        over = self.requested_lanes[idx] > self._alloc_lanes[idx]
+        if over.any():
+            self.requested_lanes[idx] -= update
+            raise RuntimeError(
+                f"admit({full_name}): assignment oversubscribes "
+                f"{int(over.any(axis=1).sum())} node(s) — the tick's "
+                "snapshot is staler than the capacity-only-grows contract "
+                "allows (node removed or externally placed load?)"
+            )
         if self._req_dev is not None:
             # only queue while a device copy exists to drain into — the
             # upload path rebuilds from the mirror and discards the queue
@@ -458,4 +482,5 @@ class ChurnRescorer:
             "p50_collect_s": round(float(np.median(self.collect_times)), 5) if self.collect_times else 0.0,
             "bucket_shapes": sorted(self._shapes_seen),
             "recompiles": self.recompiles,
+            "reupload_fallbacks": self.reupload_fallbacks,
         }

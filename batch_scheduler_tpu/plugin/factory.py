@@ -119,8 +119,14 @@ class PluginRuntime:
         oracle = getattr(self.operation, "oracle", None)
         if oracle is not None:
             # let any in-flight background batch finish before the process
-            # (and with it the XLA runtime) can go away
-            oracle.drain_background()
+            # (and with it the XLA runtime) can go away; a timed-out join
+            # means teardown would still race the XLA call, so keep
+            # waiting with escalating patience before giving up loudly
+            drain = getattr(oracle, "drain_background", None)
+            if drain is not None:
+                for timeout in (60.0, 120.0, 120.0):
+                    if drain(timeout) is not False:
+                        break
 
 
 def new_plugin_runtime(

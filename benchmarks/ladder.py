@@ -13,11 +13,12 @@ Run from the repo root: ``python benchmarks/ladder.py [--configs 1,2,5]``.
   4  10k pods / 5k nodes, extended-resources (nvidia.com/gpu) bin-packing —
      the bench.py headline batch.
   5  config 4 under churn: every 100ms tick, ~2% of running gangs finish
-     (freeing capacity) and new gangs arrive. The initial backlog drains
-     as a separately-reported admission burst; the measured loop is
-     software-pipelined one tick deep (dispatch on a helper thread,
-     collect at the next boundary) and must hold the tick budget with
-     zero steady-state recompiles.
+     (freeing capacity) and new gangs arrive. The initial 600-gang
+     backlog is admitted INSIDE the measured window through a bounded
+     per-tick admission slot (ADMIT_WINDOW); the loop is software-
+     pipelined one tick deep (dispatch on a helper thread, collect at
+     the next boundary) and must hold the tick budget with zero misses
+     — admission included — and zero steady-state recompiles.
   6  north-star FULL-FRAMEWORK e2e: 10k pods / 5k nodes through the whole
      stack (queue -> prefilter -> whole-gang fast lane -> batched bind ->
      cross-gang commit flush), entered in steady state (standing oracle
@@ -242,8 +243,15 @@ def config4_headline():
     )
 
 
-def config5_churn(ticks: int = 30, interval: float = 0.1):
-    """Sustained 100ms churn re-score at the 10k-pod/5k-node scale."""
+def config5_churn(ticks: int = 50, interval: float = 0.1):
+    """Sustained 100ms churn re-score at the 10k-pod/5k-node scale.
+
+    The initial 600-gang backlog is admitted INSIDE the measured window
+    (VERDICT r3 item 5): each tick dispatches at most ADMIT_WINDOW pending
+    gangs, bounding both the device batch width and the admit-scatter cost
+    per tick, so the arrival burst amortises across ticks under the same
+    100ms SLO as the steady churn — zero deadline misses, admission
+    included."""
     import jax
 
     from batch_scheduler_tpu.ops.rescore import ChurnRescorer
@@ -254,39 +262,30 @@ def config5_churn(ticks: int = 30, interval: float = 0.1):
     pending = all_gangs[:600]
     arrivals = iter(all_gangs[600:])
 
-    r = ChurnRescorer(nodes, extra_resources=[GPU])
-    # precompile every bucket the loop can visit: the initial 600-gang burst
-    # plus the steady-state pending sizes
-    r.warm([8, 16, 32, 64, 1024])
-    warmed = r.recompiles
+    # Per-tick admission slot: caps the dispatched batch width AND the
+    # admit scatter count, reserving headroom inside the tick budget.
+    # Sized so a full placing batch stays well under the interval (the
+    # assignment scan's cost scales with gangs actually placed: ~35ms at
+    # 16, ~62ms at 32, ~113ms at 64 on the CPU host — 64 would overrun
+    # the interval and cascade the pipelined collect into the loop).
+    ADMIT_WINDOW = 32
 
-    # ADMISSION BURST — flushed before the measured window and reported
-    # separately. The initial 600-gang backlog is config-4-class arrival
-    # flood (one full-width oracle batch), not churn; the 100ms SLO governs
-    # the steady backfill re-score, which is what the loop below measures.
-    burst_t0 = time.perf_counter()
-    burst_ticks = 0
-    while pending and burst_ticks < 10:
-        out = r.tick(None, pending)
-        placed = set(out.placed_groups())
-        if not placed:
-            break
-        for g in pending:
-            if g.full_name in placed:
-                r.admit(out, g.full_name)
-        pending = [g for g in pending if g.full_name not in placed]
-        burst_ticks += 1
-    burst_s = time.perf_counter() - burst_t0
+    r = ChurnRescorer(nodes, extra_resources=[GPU])
+    # precompile every bucket the loop can visit (width <= ADMIT_WINDOW)
+    r.warm([8, 16, 32, 64])
+    warmed = r.recompiles
     r.clear_stats()
 
-    # STEADY CHURN LOOP — software-pipelined one tick deep: each boundary
+    # CHURN LOOP — software-pipelined one tick deep: each boundary
     # collects the previous dispatch (whose D2H copy rode the sleep), admits
     # it, applies churn, and dispatches against the now-current occupancy.
     # The host<->device link round-trip (~6x the device compute on the axon
     # tunnel) is hidden behind the interval; decisions lag exactly one tick,
     # which is safe here because capacity only grows between dispatch and
     # admit (releases/arrivals add slack — see tick_dispatch's staleness
-    # contract). The dispatch itself runs on a helper thread: if the
+    # contract; every placed gang of a collected tick is admitted before
+    # the next dispatch, so charges never lag a dispatch that could
+    # re-place them). The dispatch itself runs on a helper thread: if the
     # tunnel's PJRT client blocks the dispatching thread on per-argument
     # h2d RPCs, that block rides the interval too instead of the loop
     # (exactly one dispatch is ever in flight, and the loop never touches
@@ -295,7 +294,8 @@ def config5_churn(ticks: int = 30, interval: float = 0.1):
 
     deadline_misses = 0
     loop_times = []  # the SLO series: wall time the LOOP spends per tick
-    inflight_groups = list(pending)
+    backlog_drained_tick = None
+    inflight_groups = pending[:ADMIT_WINDOW]
     # context-managed: a mid-loop failure must not leave the interpreter
     # joining an in-flight dispatch against a possibly-hung backend
     with ThreadPoolExecutor(
@@ -303,16 +303,19 @@ def config5_churn(ticks: int = 30, interval: float = 0.1):
     ) as pool:
         pend_f = pool.submit(r.tick_dispatch, None, inflight_groups)
         time.sleep(interval)  # pipeline fill: batch 0 gets its interval
-        for _ in range(ticks):
+        for tick_i in range(ticks):
             t0 = time.perf_counter()
             out = r.tick_collect(pend_f.result())
 
-            # admit: committed gangs charge their assignments
+            # admit: every gang the collected batch placed charges its
+            # assignment (bounded by ADMIT_WINDOW by construction)
             placed = set(out.placed_groups())
             for g in inflight_groups:
                 if g.full_name in placed:
                     r.admit(out, g.full_name)
             pending = [g for g in pending if g.full_name not in placed]
+            if backlog_drained_tick is None and len(pending) < ADMIT_WINDOW:
+                backlog_drained_tick = tick_i
 
             # churn: ~2% of running gangs finish, their capacity frees
             running = r.running
@@ -324,7 +327,7 @@ def config5_churn(ticks: int = 30, interval: float = 0.1):
                 if g is not None:
                     pending.append(g)
 
-            inflight_groups = list(pending)
+            inflight_groups = pending[:ADMIT_WINDOW]
             pend_f = pool.submit(r.tick_dispatch, None, inflight_groups)
 
             elapsed = time.perf_counter() - t0
@@ -352,7 +355,9 @@ def config5_churn(ticks: int = 30, interval: float = 0.1):
         "s_p95_loop_tick",
         # THE SLO series: wall time the loop itself spends per tick
         # (collect + admit + churn + dispatch submit); overlapped device /
-        # link time rides the interval by design and is reported below
+        # link time rides the interval by design and is reported below.
+        # The admission burst is INSIDE this series (no carve-out):
+        # deadline_misses_incl_admission is the whole story.
         loop_p50_s=round(float(np.median(loop_arr)), 5),
         loop_max_s=round(float(loop_arr.max()), 5),
         # per-batch component costs as recorded by the rescorer (in
@@ -366,12 +371,14 @@ def config5_churn(ticks: int = 30, interval: float = 0.1):
         p50_collect_s=s["p50_collect_s"],
         ticks=s["ticks"],
         steady_state_recompiles=steady_recompiles,
-        deadline_misses=deadline_misses,
-        burst_admission_s=round(burst_s, 5),
-        burst_ticks=burst_ticks,
+        deadline_misses_incl_admission=deadline_misses,
+        admit_window=ADMIT_WINDOW,
+        backlog_drained_tick=backlog_drained_tick,
         mode="pipelined",
         staleness_ticks=1,
         running_gangs_final=len(r.running),
+        pending_final=len(pending),
+        reupload_fallbacks=s["reupload_fallbacks"],
         platform=platform,
     )
     # REGRESSION ASSERTIONS (BASELINE config 5): the jit cache must absorb
@@ -380,15 +387,23 @@ def config5_churn(ticks: int = 30, interval: float = 0.1):
     assert steady_recompiles == 0, (
         f"churn loop recompiled {steady_recompiles}x in steady state"
     )
+    # the admission burst must actually drain AND STAY drained: a
+    # transient dip below the window must not mask a stalled or growing
+    # backlog at run end
+    assert backlog_drained_tick is not None and len(pending) <= ADMIT_WINDOW, (
+        f"600-gang backlog not drained: {len(pending)} still pending "
+        f"(first dip below window at tick {backlog_drained_tick})"
+    )
     if platform == "tpu":
         assert loop_p95 <= interval, (
             f"p95 loop tick {loop_p95:.3f}s exceeds the {interval}s budget "
             "on TPU"
         )
+        # deadline_misses counts every tick over the interval — max_s over
+        # budget is the same condition, so this is THE whole-series assert
         assert deadline_misses == 0, (
-            f"{deadline_misses} steady churn ticks missed the {interval}s "
-            "deadline on TPU (admission burst is excluded and reported "
-            "separately)"
+            f"{deadline_misses} churn ticks missed the {interval}s "
+            "deadline on TPU (admission burst INCLUDED in the series)"
         )
 
 
