@@ -33,6 +33,7 @@ __all__ = [
     "make_sim_group",
     "make_member_pods",
     "race_scenario",
+    "readback_tail_scenarios",
     "synthetic_cluster",
 ]
 
@@ -41,13 +42,14 @@ def make_sim_node(
     name: str,
     allocatable: Optional[Dict] = None,
     labels: Optional[Dict] = None,
+    taints: Optional[List] = None,
 ) -> Node:
     alloc = parse_resource_list(
         allocatable or {"cpu": "32", "memory": "128Gi", "pods": 110}, floor=True
     )
     return Node(
         metadata=ObjectMeta(name=name, uid=new_uid("node"), labels=labels or {}),
-        spec=NodeSpec(),
+        spec=NodeSpec(taints=list(taints or [])),
         status=NodeStatus(allocatable=alloc, capacity=dict(alloc)),
     )
 
@@ -78,6 +80,8 @@ def make_member_pods(
     requests: Optional[Dict] = None,
     namespace: str = "default",
     priority: int = 0,
+    node_selector: Optional[Dict] = None,
+    tolerations: Optional[List] = None,
 ) -> List[Pod]:
     return [
         Pod(
@@ -92,6 +96,8 @@ def make_member_pods(
                     Container.from_raw(requests=requests or {"cpu": "1"})
                 ],
                 priority=priority,
+                node_selector=dict(node_selector or {}),
+                tolerations=list(tolerations or []),
             ),
         )
         for i in range(count)
@@ -114,6 +120,46 @@ def race_scenario() -> Tuple[List[Node], List[PodGroup], Dict[str, List[Pod]]]:
         for g in groups
     }
     return [node], groups, pods
+
+
+def readback_tail_scenarios():
+    """Shared builders for the compact-readback tail checks (used by BOTH
+    benchmarks/tpu_smoke.py on hardware and tests/test_oracle.py on CPU —
+    one definition, two execution contexts): a gang spanning more distinct
+    nodes than ASSIGNMENT_TOP_K with remaining near the packed halfword,
+    and a single node whose per-member count exceeds it.
+
+    Returns ((wide_nodes, wide_groups), (big_nodes, big_groups))."""
+    from ..ops.snapshot import GroupDemand
+
+    wide_nodes = [
+        make_sim_node(
+            f"w{i:03d}", {"cpu": "64", "memory": "256Gi", "pods": "200"}
+        )
+        for i in range(512)
+    ]
+    wide_groups = [
+        GroupDemand(
+            full_name="default/wide",
+            min_member=60000,
+            member_request={"cpu": 100},
+            creation_ts=0.0,
+        )
+    ]
+    big_nodes = [
+        make_sim_node(
+            "big", {"cpu": "100000", "memory": "1024Gi", "pods": "70000"}
+        )
+    ]
+    big_groups = [
+        GroupDemand(
+            full_name="default/huge",
+            min_member=66000,
+            member_request={"cpu": 1},
+            creation_ts=0.0,
+        )
+    ]
+    return (wide_nodes, wide_groups), (big_nodes, big_groups)
 
 
 @dataclass

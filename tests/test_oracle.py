@@ -513,3 +513,46 @@ def test_assign_gangs_invariants_hypothesis():
         assert (left_after[left0 >= 0] >= 0).all()
 
     check()
+
+
+def test_compact_readback_tails_wide_gang_and_saturation():
+    """The smoke's readback-tail checks (benchmarks/tpu_smoke.py), CPU form
+    over the SAME shared scenarios (sim.scenarios.readback_tail_scenarios):
+    a gang spanning more distinct nodes than ASSIGNMENT_TOP_K truncates to
+    the K largest (node,count) pairs that agree with the dense assignment;
+    a per-node count above the packed halfword saturates ONLY the packed
+    form (dense + unpacked counts stay exact)."""
+    import jax
+    import numpy as np
+
+    from batch_scheduler_tpu.ops.oracle import ASSIGNMENT_TOP_K, schedule_batch
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot
+    from batch_scheduler_tpu.sim.scenarios import readback_tail_scenarios
+
+    (wide_nodes, wide_groups), (big_nodes, big_groups) = (
+        readback_tail_scenarios()
+    )
+    out = schedule_batch(
+        *ClusterSnapshot(wide_nodes, {}, wide_groups).device_args(),
+        use_pallas=False,
+    )
+    dense = np.asarray(jax.device_get(out["assignment"]))[0]
+    an = np.asarray(out["assignment_nodes"])[0]
+    ac = np.asarray(out["assignment_counts"])[0]
+    assert bool(np.asarray(out["placed"])[0])
+    assert int((dense > 0).sum()) > ASSIGNMENT_TOP_K  # truncation engaged
+    assert all(dense[n] == c for n, c in zip(an, ac) if c > 0)
+    assert ac.min() >= np.sort(dense)[-len(an)]  # the K largest
+    ap = np.asarray(out["assignment_packed"])[0]
+    assert np.array_equal(ap >> 16, an)
+    assert np.array_equal(ap & 0xFFFF, np.minimum(ac, 2**16 - 1))
+
+    out2 = schedule_batch(
+        *ClusterSnapshot(big_nodes, {}, big_groups).device_args(),
+        use_pallas=False,
+    )
+    dense2 = np.asarray(jax.device_get(out2["assignment"]))[0]
+    ac2 = np.asarray(out2["assignment_counts"])[0]
+    ap2 = np.asarray(out2["assignment_packed"])[0]
+    assert dense2.max() == 66000 and ac2.max() == 66000  # exact above 2^16-1
+    assert int(ap2[int(ac2.argmax())]) & 0xFFFF == 2**16 - 1  # packed saturates
