@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all build vet test test-cpu bench native ladder dryrun clean version tpu-artifacts
+.PHONY: all build vet test test-cpu bench native ladder dryrun clean version tpu-artifacts http-e2e
 
 all: vet native test
 
@@ -37,6 +37,11 @@ ladder:
 # pallas-kernel-on-hardware proof (skips with rc=1 off-TPU)
 smoke-tpu:
 	$(PY) benchmarks/tpu_smoke.py
+
+# config-2-scale e2e over the HTTP control plane with a forced gateway
+# restart mid-run (CPU-only: measures the wire, not the oracle)
+http-e2e:
+	$(PY) benchmarks/http_e2e.py
 
 # capture the full hardware-evidence suite (bench, smoke, ladder, scale)
 # into the round's artifact files — aborts untouched if the TPU is away

@@ -45,6 +45,8 @@ class HTTPAPIServer:
         timeout: float = 10.0,
         qps: float = 10.0,
         burst: int = 20,
+        pg_qps: Optional[float] = None,
+        pg_burst: int = 20,
     ):
         self.host = host
         self.port = port
@@ -54,15 +56,35 @@ class HTTPAPIServer:
         # token first, so the controller's resync across all groups cannot
         # stampede a real API server. Watch streams pace themselves via
         # the reflector's reconnect backoff instead. qps<=0 disables.
+        #
+        # ``pg_qps``/``pg_burst`` carve out a SEPARATE bucket for PodGroup
+        # verbs, mirroring the reference deployment where the PG clientset
+        # has its own rest.Config throttle (10/20) while the embedding
+        # kube-scheduler's client runs at its own limits (50/100 defaults)
+        # — one shared bucket would let pod traffic starve gang status
+        # writes and vice versa.
         self._limiter = TokenBucket(qps, burst)
+        # pg_burst applies only when pg_qps enables the separate bucket
+        self._pg_limiter = (
+            TokenBucket(pg_qps, pg_burst) if pg_qps is not None else None
+        )
         # id(queue) -> {"conn", "resp", "thread", "stop"} (see watch())
         self._watches: Dict[int, dict] = {}
         self._lock = threading.Lock()
 
     # -- request plumbing --------------------------------------------------
 
-    def _request(self, method: str, path: str, body: Optional[dict] = None) -> dict:
-        self._limiter.acquire()
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[dict] = None,
+        kind: Optional[str] = None,
+    ) -> dict:
+        limiter = self._limiter
+        if kind == "PodGroup" and self._pg_limiter is not None:
+            limiter = self._pg_limiter
+        limiter.acquire()
         conn = http.client.HTTPConnection(self.host, self.port, timeout=self.timeout)
         try:
             payload = None if body is None else json.dumps(body)
@@ -113,10 +135,12 @@ class HTTPAPIServer:
     def create(self, kind: str, obj) -> dict:
         d = self._as_dict(obj)
         ns = (d.get("metadata") or {}).get("namespace", "default")
-        return self._request("POST", self._collection_path(kind, ns), d)
+        return self._request("POST", self._collection_path(kind, ns), d, kind=kind)
 
     def get(self, kind: str, namespace: str, name: str) -> dict:
-        return self._request("GET", self._object_path(kind, namespace, name))
+        return self._request(
+            "GET", self._object_path(kind, namespace, name), kind=kind
+        )
 
     def list(
         self,
@@ -128,7 +152,7 @@ class HTTPAPIServer:
         if label_selector:
             sel = ",".join(f"{k}={v}" for k, v in label_selector.items())
             path += f"?labelSelector={quote(sel)}"
-        return self._request("GET", path)["items"]
+        return self._request("GET", path, kind=kind)["items"]
 
     def update(self, kind: str, obj) -> dict:
         d = self._as_dict(obj)
@@ -136,19 +160,21 @@ class HTTPAPIServer:
         path = self._object_path(
             kind, meta.get("namespace", "default"), meta.get("name", "")
         )
-        return self._request("PUT", path, d)
+        return self._request("PUT", path, d, kind=kind)
 
     def patch(self, kind: str, namespace: str, name: str, patch: dict) -> dict:
         return self._request(
-            "PATCH", self._object_path(kind, namespace, name), patch
+            "PATCH", self._object_path(kind, namespace, name), patch, kind=kind
         )
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
-        self._request("DELETE", self._object_path(kind, namespace, name))
+        self._request(
+            "DELETE", self._object_path(kind, namespace, name), kind=kind
+        )
 
     def delete_collection(self, kind: str, namespace: Optional[str] = None) -> int:
         return self._request(
-            "DELETE", self._collection_path(kind, namespace)
+            "DELETE", self._collection_path(kind, namespace), kind=kind
         ).get("deleted", 0)
 
     # -- watch -------------------------------------------------------------

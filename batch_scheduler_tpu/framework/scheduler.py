@@ -679,14 +679,32 @@ class Scheduler:
                 continue
             pod = wp.pod
             if outcome == ALLOW:
-                self._bind(pod, wp.node_name)
+                try:
+                    self._bind(pod, wp.node_name)
+                except Exception:
+                    # a worker must NEVER die: a transport error mid-bind
+                    # (API server outage) requeues the pod — found by the
+                    # gateway-restart e2e, where every in-flight bind
+                    # killed its worker and the resolved queue went
+                    # unconsumed forever. The assumed capacity is KEPT:
+                    # the request may have applied server-side with only
+                    # the response lost (forgetting would transiently
+                    # overcommit the node), and the retry cycle either
+                    # drops the entry on the bound-pod liveness check or
+                    # re-assumes, both of which square the charge.
+                    if self.plugin is not None:
+                        self.plugin.mark_dirty()
+                    self._requeue_waiting(wp, pod)
             else:
                 self.stats["permit_rejects"] += 1
                 self.cluster.forget(pod.metadata.uid)
                 if self.plugin is not None:
                     self.plugin.mark_dirty()
-                info = getattr(wp, "_info", None) or PodInfo(pod=pod)
-                self.queue.push_backoff(info)
+                self._requeue_waiting(wp, pod)
+
+    def _requeue_waiting(self, wp, pod: Pod) -> None:
+        info = getattr(wp, "_info", None) or PodInfo(pod=pod)
+        self.queue.push_backoff(info)
 
     def _bind(self, pod: Pod, node_name: str) -> None:
         try:

@@ -262,17 +262,40 @@ class BatchSchedulingPlugin:
     def send_start_schedule_signal(self, full_name: str) -> None:
         self.start_chan.put(full_name)
 
+    # release-signal retry bound: ~10s of 0.5s-spaced attempts rides out
+    # an API-server outage; a persistently-failing signal is then dropped
+    # and the gang recovers via its TTL abort (reference behavior drops
+    # immediately, batchscheduler.go:263-288 returns on patch error)
+    RELEASE_RETRIES = 20
+
     def reconcile_status(self) -> None:
         while not self._stop.is_set():
             try:
-                full_name = self.start_chan.get(timeout=0.2)
+                item = self.start_chan.get(timeout=0.2)
             except queue.Empty:
                 continue
+            full_name, attempt = (
+                item if isinstance(item, tuple) else (item, 0)
+            )
             try:
                 self.update_batch_cache()
                 self.start_batch_schedule(full_name)
             except Exception:
-                pass  # the reconcile loop must survive any single release
+                # the reconcile loop must survive any single release — and
+                # the SIGNAL must survive a transient failure too (an API
+                # outage during the ScheduleStartTime stamp would strand a
+                # complete gang in Permit waits until its TTL abort). The
+                # re-enqueue is DELAYED on a timer, never blocking this
+                # consumer thread, and bounded so a poisoned signal cannot
+                # starve other gangs' releases forever.
+                if attempt < self.RELEASE_RETRIES:
+                    timer = threading.Timer(
+                        0.5,
+                        self.start_chan.put,
+                        args=((full_name, attempt + 1),),
+                    )
+                    timer.daemon = True
+                    timer.start()
 
     def start(self) -> None:
         self._reconcile_thread = threading.Thread(

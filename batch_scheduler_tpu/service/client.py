@@ -102,8 +102,9 @@ class RemoteScorer(OracleScorer):
             c.close()
 
     def _execute(self, snap: ClusterSnapshot):
-        # fit_mask may be the [1,N] broadcast fast path; the wire encoder
-        # (protocol.pack_schedule_request) expands it to the [G,N] format.
+        # fit_mask may be the [1,N] broadcast fast path; the wire carries
+        # it as ONE row (protocol mask_rows header — at 5k nodes the
+        # expanded [G,N] form was 96% of the request bytes).
         req = proto.ScheduleRequest(
             alloc=snap.alloc,
             requested=snap.requested,

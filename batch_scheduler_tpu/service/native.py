@@ -55,7 +55,13 @@ def _load():
     i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
     u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
     lib.bsp_schedule.argtypes = (
-        [ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32]
+        [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,  # mask_rows
+        ]
         + [i32p] * 4
         + [u8p, u8p]
         + [i32p] * 4
@@ -119,10 +125,11 @@ class NativeOracleClient:
         k_out = ctypes.c_int32(0)
         batch_seq = ctypes.c_uint32(0)
 
+        mask = u8(req.fit_mask)
         rc = self._lib.bsp_schedule(
-            self._handle, n, g, r,
+            self._handle, n, g, r, mask.shape[0],
             i32(req.alloc), i32(req.requested), i32(req.group_req),
-            i32(req.remaining), u8(req.fit_mask), u8(req.group_valid),
+            i32(req.remaining), mask, u8(req.group_valid),
             i32(req.order), i32(req.min_member), i32(req.scheduled),
             i32(req.matched), u8(req.ineligible), i32(req.creation_rank),
             gang_feasible, placed, progress,

@@ -29,9 +29,10 @@ def _pad_request(req: proto.ScheduleRequest):
     in-process snapshot packer (ops.bucketing.pad_oracle_batch) so the wire
     path can never drift from the local path.
 
-    The wire format always carries a full [G,N] mask (native C++ client
-    compatibility); re-collapse a uniform one to the broadcast [1,N] row so
-    sidecar batches reach the same fast paths as in-process batches (smaller
+    The wire carries ``mask_rows`` rows (1 = broadcast fast path, G =
+    per-group masks); a client that shipped a uniform [G,N] mask anyway is
+    re-collapsed to the broadcast [1,N] row here so its batches still
+    reach the same fast paths as in-process batches (smaller device
     transfer + the fused pallas assignment kernel)."""
     n = req.alloc.shape[0]
     g = req.group_req.shape[0]

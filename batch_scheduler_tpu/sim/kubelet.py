@@ -45,6 +45,7 @@ class SimKubelet:
         self._lock = threading.Lock()
         self._pending: list = []  # heap of (due, seq, ns, name, next_phase)
         self._seq = 0
+        self._batch_failures = 0  # consecutive _apply_due failures
         self._threads = []
         self._events = None
 
@@ -107,29 +108,52 @@ class SimKubelet:
                     due.append(heapq.heappop(self._pending))
             if not due:
                 continue
-            patch_many = getattr(self.api, "patch_many", None)
-            if patch_many is not None:
-                # batched phase transitions: one lock pass per (tick, ns)
-                # instead of a patch round trip per pod — at 10k pods the
-                # per-pod form was measurable GIL load beside the scheduler
-                by_ns: Dict[str, list] = {}
-                for _, _, ns, name, phase in due:
-                    by_ns.setdefault(ns, []).append(
-                        (name, {"status": {"phase": phase.value}})
+            try:
+                self._apply_due(due)
+                self._batch_failures = 0
+            except Exception:
+                # the tick thread must survive a transport outage (HTTP
+                # API): push the batch back and retry next tick — but
+                # BOUNDED, then per-item with failures dropped, so one
+                # poisoned pod cannot starve every co-due transition
+                self._batch_failures += 1
+                if self._batch_failures <= 25:  # ~5s outage budget
+                    with self._lock:
+                        for item in due:
+                            heapq.heappush(self._pending, item)
+                    self._stop.wait(0.2)
+                else:
+                    for item in due:
+                        try:
+                            self._apply_due([item])
+                        except Exception:
+                            pass  # poisoned item: dropped
+                    self._batch_failures = 0
+
+    def _apply_due(self, due) -> None:
+        patch_many = getattr(self.api, "patch_many", None)
+        if patch_many is not None:
+            # batched phase transitions: one lock pass per (tick, ns)
+            # instead of a patch round trip per pod — at 10k pods the
+            # per-pod form was measurable GIL load beside the scheduler
+            by_ns: Dict[str, list] = {}
+            for _, _, ns, name, phase in due:
+                by_ns.setdefault(ns, []).append(
+                    (name, {"status": {"phase": phase.value}})
+                )
+            for ns, patches in by_ns.items():
+                patch_many("Pod", ns, patches)
+        else:
+            for _, _, ns, name, phase in due:
+                try:
+                    self.clientset.pods(ns).patch(
+                        name, {"status": {"phase": phase.value}}
                     )
-                for ns, patches in by_ns.items():
-                    patch_many("Pod", ns, patches)
-            else:
-                for _, _, ns, name, phase in due:
-                    try:
-                        self.clientset.pods(ns).patch(
-                            name, {"status": {"phase": phase.value}}
-                        )
-                    except NotFoundError:
-                        continue
-            if self.run_duration is not None:
-                for _, _, ns, name, phase in due:
-                    if phase == PodPhase.RUNNING:
-                        self._schedule_transition(
-                            ns, name, PodPhase.SUCCEEDED, self.run_duration
-                        )
+                except NotFoundError:
+                    continue
+        if self.run_duration is not None:
+            for _, _, ns, name, phase in due:
+                if phase == PodPhase.RUNNING:
+                    self._schedule_transition(
+                        ns, name, PodPhase.SUCCEEDED, self.run_duration
+                    )

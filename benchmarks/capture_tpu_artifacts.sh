@@ -55,6 +55,10 @@ else
     fail=1
 fi
 
+echo "== scan-vs-scoring split (multi-chip honesty) =="
+timeout 900 python benchmarks/scan_split.py > "SCAN_SPLIT_${TAG}.json" 2>/dev/null \
+    || { echo "scan split failed"; rm -f "SCAN_SPLIT_${TAG}.json"; fail=1; }
+
 echo "== scale headroom probe =="
 timeout 1200 python benchmarks/scale_probe.py > "SCALE_${TAG}.json" 2>/dev/null \
     || { echo "scale probe failed"; rm -f "SCALE_${TAG}.json"; fail=1; }

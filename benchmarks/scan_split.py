@@ -1,0 +1,167 @@
+"""Scan-vs-scoring split at the north-star shape (VERDICT r3 item 3).
+
+The multi-chip layout (ops.oracle.schedule_batch's ``scan_mesh``) shards
+only the O(G*N*R) scoring term (leftover -> capacity -> feasibility ->
+scores); the sequential gang-assignment scan runs REPLICATED on every
+chip. Whether "multi-chip by sharding" is an honest scaling claim
+therefore hangs on what fraction of the batch the scan is: this
+benchmark times the two terms separately (each as its own jit, hot,
+device-resident inputs, median of passes) and reports the Amdahl
+ceiling for sharded scoring at 4 and 8 chips.
+
+Run from the repo root: ``python benchmarks/scan_split.py`` — one JSON
+line (artifact: SCAN_SPLIT_r04.json when captured on TPU).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import bench
+
+    platform, err = bench.resolve_platform()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from batch_scheduler_tpu.ops import oracle as O
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot
+
+    nodes, groups = bench.build_inputs()
+    snap = ClusterSnapshot(nodes, {}, groups)
+    (alloc, requested, group_req, remaining, fit_mask, group_valid, order) = (
+        snap.device_args()
+    )
+
+    @jax.jit
+    def scoring_only(alloc, requested, group_req, remaining, fit_mask, group_valid):
+        left = O.left_resources(alloc, requested)
+        cap = O.group_capacity(left, group_req, fit_mask)
+        feasible = O.gang_feasible(cap, remaining, group_valid)
+        scores = O.score_nodes(cap)
+        # scalar reductions force the full computation without a (G,N) D2H
+        return (
+            jnp.sum(scores),
+            jnp.sum(cap),
+            jnp.sum(feasible),
+            left,
+        )
+
+    @jax.jit
+    def scan_only(left, group_req, remaining, fit_mask, order):
+        assignment, placed, left_after = O.assign_gangs(
+            left, group_req, remaining, fit_mask, order
+        )
+        return jnp.sum(assignment), jnp.sum(placed), jnp.sum(left_after)
+
+    use_pallas = platform == "tpu"
+
+    @jax.jit
+    def scan_only_pallas(left, group_req, remaining, fit_mask, order):
+        from batch_scheduler_tpu.ops.pallas_assign import assign_gangs_pallas
+
+        assignment, placed, left_after = assign_gangs_pallas(
+            left, group_req, remaining, fit_mask, order
+        )
+        return jnp.sum(assignment), jnp.sum(placed), jnp.sum(left_after)
+
+    # device-resident inputs: we are measuring compute, not the host link
+    dev = jax.device_put(
+        (alloc, requested, group_req, remaining, fit_mask, group_valid, order)
+    )
+    jax.block_until_ready(dev)
+    alloc, requested, group_req, remaining, fit_mask, group_valid, order = dev
+
+    def timed(fn, args, passes=7):
+        jax.block_until_ready(fn(*args))  # warm/compile
+        ts = []
+        for _ in range(passes):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts))
+
+    score_args = (alloc, requested, group_req, remaining, fit_mask, group_valid)
+    t_score = timed(scoring_only, score_args)
+    left = jax.block_until_ready(scoring_only(*score_args))[3]
+
+    scan_args = (left, group_req, remaining, fit_mask, order)
+    t_scan = timed(scan_only, scan_args)
+    t_scan_pallas = None
+    if use_pallas:
+        try:
+            t_scan_pallas = timed(scan_only_pallas, scan_args)
+        except Exception as e:
+            print(f"pallas scan timing failed: {e!r}", file=sys.stderr)
+
+    @jax.jit
+    def full(*args):
+        out = O.schedule_batch(*args, use_pallas=False)
+        return out["placed"]
+
+    t_full = timed(full, (alloc, requested, group_req, remaining, fit_mask, group_valid, order))
+
+    scan_t = t_scan_pallas if t_scan_pallas is not None else t_scan
+    total = t_score + scan_t
+    scan_frac = scan_t / total
+
+    def amdahl(n):
+        return round(1.0 / (scan_frac + (1 - scan_frac) / n), 2)
+
+    print(
+        json.dumps(
+            {
+                "metric": "oracle_scan_vs_scoring_split_10kpod_5knode",
+                "value": round(scan_frac, 4),
+                "unit": "scan_fraction_of_batch_compute",
+                "detail": {
+                    "platform": platform,
+                    "scoring_s": round(t_score, 5),
+                    "scan_s": round(t_scan, 5),
+                    "scan_pallas_s": (
+                        round(t_scan_pallas, 5)
+                        if t_scan_pallas is not None
+                        else None
+                    ),
+                    "fused_full_batch_s": round(t_full, 5),
+                    "sharded_scoring_amdahl_ceiling": {
+                        "4_chips": amdahl(4),
+                        "8_chips": amdahl(8),
+                    },
+                    "layout": (
+                        "scoring sharded over the mesh; scan replicated "
+                        "(ops.oracle.schedule_batch scan_mesh; measured "
+                        "partitioned-scan alternative 6x slower, "
+                        "SHARDING_r03.json)"
+                    ),
+                    "backend_init_error": err,
+                },
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception as e:  # noqa: BLE001 — one JSON line, always
+        print(
+            json.dumps(
+                {
+                    "metric": "oracle_scan_vs_scoring_split_10kpod_5knode",
+                    "value": -1.0,
+                    "unit": "scan_fraction_of_batch_compute",
+                    "detail": {"error": repr(e)[:400]},
+                }
+            )
+        )
+        sys.exit(1)

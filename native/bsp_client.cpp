@@ -194,8 +194,11 @@ static void append(std::vector<uint8_t>* buf, const void* data, size_t len) {
 // One oracle batch. All arrays row-major little-endian; outputs sized by the
 // caller: gang_feasible/placed/progress are [g]; assignment_* are
 // [g * k_capacity] with the actual K written to k_out (K <= k_capacity
-// required, server K is min(128, padded nodes)).
+// required, server K is min(128, padded nodes)). fit_mask carries
+// mask_rows rows of n (1 = broadcast row, the no-selector fast path that
+// keeps the frame small; g = per-group selector masks).
 int bsp_schedule(BspClient* c, int32_t n, int32_t g, int32_t r,
+                 int32_t mask_rows,
                  const int32_t* alloc, const int32_t* requested,
                  const int32_t* group_req, const int32_t* remaining,
                  const uint8_t* fit_mask, const uint8_t* group_valid,
@@ -206,17 +209,23 @@ int bsp_schedule(BspClient* c, int32_t n, int32_t g, int32_t r,
                  int32_t* best, uint8_t* best_exists,
                  int32_t* assignment_nodes, int32_t* assignment_counts,
                  int32_t* k_out, int32_t k_capacity, uint32_t* batch_seq) {
+  if (mask_rows != 1 && mask_rows != g) {
+    c->last_error = "mask_rows must be 1 or g";
+    return -1;
+  }
   std::vector<uint8_t> payload;
-  payload.reserve(12 + static_cast<size_t>(n) * r * 8 +
-                  static_cast<size_t>(g) * (r * 4 + n + 22));
-  uint32_t counts[3] = {static_cast<uint32_t>(n), static_cast<uint32_t>(g),
-                        static_cast<uint32_t>(r)};
+  payload.reserve(16 + static_cast<size_t>(n) * r * 8 +
+                  static_cast<size_t>(g) * (r * 4 + 22) +
+                  static_cast<size_t>(mask_rows) * n);
+  uint32_t counts[4] = {static_cast<uint32_t>(n), static_cast<uint32_t>(g),
+                        static_cast<uint32_t>(r),
+                        static_cast<uint32_t>(mask_rows)};
   append(&payload, counts, sizeof(counts));
   append(&payload, alloc, static_cast<size_t>(n) * r * 4);
   append(&payload, requested, static_cast<size_t>(n) * r * 4);
   append(&payload, group_req, static_cast<size_t>(g) * r * 4);
   append(&payload, remaining, static_cast<size_t>(g) * 4);
-  append(&payload, fit_mask, static_cast<size_t>(g) * n);
+  append(&payload, fit_mask, static_cast<size_t>(mask_rows) * n);
   append(&payload, group_valid, static_cast<size_t>(g));
   append(&payload, order, static_cast<size_t>(g) * 4);
   append(&payload, min_member, static_cast<size_t>(g) * 4);

@@ -159,6 +159,36 @@ def test_native_client_wire_compat(server):
     native.close()
     py_client.close()
 
+def test_native_client_broadcast_mask_row(server):
+    """The mask_rows=1 wire form through the NATIVE client: a broadcast
+    [1,N] fit-mask row must produce the same schedule as the expanded
+    [G,N] mask (the frame-size win lives or dies on this C++ encode
+    path)."""
+    from batch_scheduler_tpu.service.native import NativeOracleClient, ensure_built
+
+    if ensure_built() is None:
+        pytest.skip("no C++ toolchain available")
+    host, port = server.address
+    req_full = _request()
+    g, n = req_full.fit_mask.shape
+    import dataclasses
+
+    req_bcast = dataclasses.replace(
+        req_full, fit_mask=np.ones((1, n), bool)
+    )
+    native = NativeOracleClient(host, port)
+    resp_bcast = native.schedule(req_bcast)
+    resp_full = native.schedule(req_full)
+    np.testing.assert_array_equal(resp_bcast.placed, resp_full.placed)
+    np.testing.assert_array_equal(
+        resp_bcast.assignment_counts, resp_full.assignment_counts
+    )
+    np.testing.assert_array_equal(
+        resp_bcast.gang_feasible, resp_full.gang_feasible
+    )
+    native.close()
+
+
 def test_native_client_protocol_constants_in_sync():
     """Drift check between the Python wire protocol and the native C++
     client — the analog of the reference's codegen drift gate
