@@ -344,9 +344,13 @@ class APIServer:
                     old = store.get(key)
                     if old is None:
                         continue
-                    merged = apply_merge_patch(
-                        old, {"spec": {"node_name": node_name}}
-                    )
+                    # hand-rolled single-field merge: same copy-on-write
+                    # shape apply_merge_patch produces for this patch,
+                    # without the generic merge walk (the bind storm is
+                    # the hottest write path in the system)
+                    merged = dict(old)
+                    merged["spec"] = dict(old.get("spec") or {})
+                    merged["spec"]["node_name"] = node_name
                     self._rv += 1
                     merged["metadata"] = dict(merged.get("metadata") or {})
                     merged["metadata"]["resource_version"] = self._rv
