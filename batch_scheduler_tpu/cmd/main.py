@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from typing import Dict, List, Optional
@@ -427,4 +428,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         logging.WARNING if args.v <= 0 else logging.INFO if args.v <= 2 else logging.DEBUG
     )
     logging.basicConfig(level=level)
+    # Runtime tuning (the knob the Go reference reaches via GOMAXPROCS):
+    # the scheduler is one compute-bound cycle thread beside ~25 mostly-
+    # idle service threads; CPython's default 5ms GIL switch interval
+    # costs measurable handoff time under a 10k-pod drain (ladder config
+    # 6: cycle_total 0.77s -> ~0.4-0.6s at 20ms). BST_GIL_SWITCH_INTERVAL
+    # overrides; 0 keeps the interpreter default.
+    try:
+        interval = float(os.environ.get("BST_GIL_SWITCH_INTERVAL", "0.02"))
+    except ValueError:
+        logging.warning(
+            "ignoring malformed BST_GIL_SWITCH_INTERVAL=%r; using 0.02",
+            os.environ.get("BST_GIL_SWITCH_INTERVAL"),
+        )
+        interval = 0.02
+    if interval > 0:
+        sys.setswitchinterval(interval)
     return COMMANDS[args.command](args)
