@@ -5,7 +5,7 @@ vectors from the control plane to a JAX sidecar (BASELINE.json north_star;
 SURVEY.md §7 notes "packed arrays, not protobuf-per-pod" is required for the
 <1s budget). The protocol is deliberately dumb and fast:
 
-    frame  := magic "BSO1" | u32 msg_type | u64 payload_len | payload
+    frame  := magic "BSO2" | u32 msg_type | u64 payload_len | payload
     arrays := raw little-endian buffers in fixed order, counts up front
 
 No per-pod messages, no schema negotiation, no string tables in the hot
@@ -45,7 +45,9 @@ __all__ = [
     "unpack_row_request",
 ]
 
-MAGIC = b"BSO1"
+# bumped BSO1 -> BSO2 when the request header grew mask_rows: the layout
+# change would otherwise misparse silently between mismatched peers
+MAGIC = b"BSO2"
 _HEADER = struct.Struct("<4sIQ")
 
 # A realistic max batch (8k-node/2k-group buckets) is tens of MB; anything

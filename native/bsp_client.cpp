@@ -3,7 +3,7 @@
 // Speaks the framed packed-array protocol of
 // batch_scheduler_tpu/service/protocol.py:
 //
-//   frame := "BSO1" | u32 msg_type | u64 payload_len | payload  (LE)
+//   frame := "BSO2" | u32 msg_type | u64 payload_len | payload  (LE)
 //
 // Exposed as a C API so it embeds anywhere the control plane lives: Go via
 // cgo, C++ directly, Python via ctypes (service/native.py). This is the
@@ -26,7 +26,7 @@
 
 namespace {
 
-constexpr char kMagic[4] = {'B', 'S', 'O', '1'};
+constexpr char kMagic[4] = {'B', 'S', 'O', '2'};
 
 enum MsgType : uint32_t {
   kScheduleReq = 1,
