@@ -1,0 +1,165 @@
+"""Direct unit tests for the two-level gang scheduling queue: one heap
+resident per (gang, priority) bucket with FIFO parking, lazy deletion via
+pop_group, and promotion on resident pop (framework/queue.py)."""
+
+import pytest
+
+from batch_scheduler_tpu.framework.queue import SchedulingQueue
+from batch_scheduler_tpu.framework.types import PodInfo
+
+from helpers import make_pod
+
+
+def _info(name, group="", priority=0, ts=0.0):
+    return PodInfo(pod=make_pod(name, group=group, priority=priority), timestamp=ts)
+
+
+def _gang_key(info):
+    return f"{info.namespace}/{info.gang}" if info.gang else None
+
+
+def _sort_key(info):
+    # same shape as the production key (operation.sort_key): priority,
+    # non-gang first, then a gang-level component BEFORE the timestamp —
+    # what makes same-gang members mutually adjacent, the property the
+    # bucket FIFO relies on
+    return (
+        -info.priority,
+        0 if not info.gang else 1,
+        info.gang,
+        info.timestamp,
+    )
+
+
+@pytest.fixture
+def queue_factory():
+    queues = []
+
+    def build(**kw):
+        q = SchedulingQueue(group_key_fn=_gang_key, sort_key_fn=_sort_key, **kw)
+        queues.append(q)
+        return q
+
+    yield build
+    for q in queues:
+        q.close()
+
+
+def test_same_gang_members_park_in_fifo_and_pop_in_arrival_order(queue_factory):
+    q = queue_factory()
+    for i in range(4):
+        q.push(_info(f"m{i}", group="g1", ts=float(i + 1)))
+    assert q.group_size("default/g1") == 4
+    assert len(q) == 4
+    # only ONE heap entry exists; pops promote the FIFO in arrival order
+    names = [q.pop(timeout=0.1).name for _ in range(4)]
+    assert names == ["m0", "m1", "m2", "m3"]
+    assert q.group_size("default/g1") == 0
+    assert len(q) == 0
+
+
+def test_pop_group_drains_fifo_members_without_heap_traffic(queue_factory):
+    q = queue_factory()
+    q.push(_info("lead", group="g1", ts=1.0))
+    for i in range(3):
+        q.push(_info(f"sib{i}", group="g1", ts=float(i + 2)))
+    lead = q.pop(timeout=0.1)
+    assert lead.name == "lead"
+    drained = {i.name for i in q.pop_group("default/g1")}
+    assert drained == {"sib0", "sib1", "sib2"}
+    assert len(q) == 0
+    # the promoted-but-dead residents are skipped transparently
+    assert q.pop(timeout=0.05) is None
+
+
+def test_dead_head_still_promotes_parked_straggler(queue_factory):
+    """pop_group kills the whole bucket while one entry is heap-resident;
+    a STRAGGLER pushed afterwards parks behind the dead head and must
+    still surface once the dead head cycles through the heap."""
+    q = queue_factory()
+    q.push(_info("a", group="g1", ts=1.0))
+    q.push(_info("b", group="g1", ts=2.0))
+    assert {i.name for i in q.pop_group("default/g1")} == {"a", "b"}
+    # straggler arrives while the dead resident is still in the heap
+    q.push(_info("late", group="g1", ts=3.0))
+    assert q.pop(timeout=0.2).name == "late"
+
+
+def test_priority_splits_buckets_within_one_gang(queue_factory):
+    """Members of one gang at different priorities occupy separate
+    buckets, so a high-priority member is never hidden behind a
+    low-priority resident."""
+    q = queue_factory()
+    q.push(_info("low", group="g1", priority=0, ts=1.0))
+    q.push(_info("high", group="g1", priority=5, ts=2.0))
+    assert q.pop(timeout=0.1).name == "high"
+    assert q.pop(timeout=0.1).name == "low"
+    # both were still indexed under the gang for pop_group
+    q.push(_info("low2", group="g1", priority=0))
+    q.push(_info("high2", group="g1", priority=5))
+    assert {i.name for i in q.pop_group("default/g1")} == {"low2", "high2"}
+
+
+def test_interleaved_gangs_order_by_sort_key(queue_factory):
+    q = queue_factory()
+    q.push(_info("b1", group="beta", ts=2.0))
+    q.push(_info("a1", group="alpha", ts=1.0))
+    q.push(_info("solo", ts=5.0))  # non-gang sorts first at equal priority
+    q.push(_info("a2", group="alpha", ts=3.0))
+    names = [q.pop(timeout=0.1).name for _ in range(4)]
+    assert names == ["solo", "a1", "a2", "b1"]
+
+
+def test_backoff_reentry_returns_to_bucket(queue_factory):
+    q = queue_factory(backoff_base=0.01, backoff_cap=0.02)
+    info = _info("retry", group="g1", ts=1.0)
+    q.push(info)
+    assert q.pop(timeout=0.1).name == "retry"
+    q.push_backoff(info)
+    assert len(q) == 1
+    # promoted from backoff into the gang bucket and poppable again
+    got = q.pop(timeout=2.0)
+    assert got is not None and got.name == "retry"
+    assert got.attempts == 1
+
+
+def test_group_size_tracks_live_members_only(queue_factory):
+    q = queue_factory()
+    for i in range(3):
+        q.push(_info(f"m{i}", group="g1", ts=float(i + 1)))
+    assert q.group_size("default/g1") == 3
+    q.pop(timeout=0.1)
+    assert q.group_size("default/g1") == 2
+    q.pop_group("default/g1")
+    assert q.group_size("default/g1") == 0
+    assert q.group_size("default/ghost") == 0
+
+
+def test_len_counts_fifo_parked_and_backoff(queue_factory):
+    q = queue_factory(backoff_base=5.0, backoff_cap=5.0)
+    for i in range(5):
+        q.push(_info(f"m{i}", group="g1", ts=float(i + 1)))
+    q.push_backoff(_info("delayed", group="g1"))
+    assert len(q) == 6  # 1 resident + 4 FIFO + 1 backoff
+
+
+def test_raw_podinfo_scalars_and_lazy_pod():
+    from batch_scheduler_tpu.api.types import to_dict
+
+    pod = make_pod("rawpod", group="g9", priority=3)
+    d = to_dict(pod)
+    info = PodInfo(raw=d, timestamp=1.5)
+    assert (info.namespace, info.name, info.gang, info.priority) == (
+        "default",
+        "rawpod",
+        "g9",
+        3,
+    )
+    assert info._pod is None  # not materialised yet
+    typed = info.pod
+    assert typed.metadata.name == "rawpod"
+    assert typed.spec.priority == 3
+    assert info.pod is typed  # cached
+
+    with pytest.raises(ValueError):
+        PodInfo()
