@@ -434,7 +434,13 @@ def config6_framework_e2e(num_nodes=5000, num_groups=1000, members=10):
     cluster = SimCluster(
         scorer="oracle",
         bind_workers=16,
-        kubelet_start_delay=0.01,
+        # bind -> Running latency of the simulated kubelets. Real container
+        # starts take seconds, so 50ms is still generous; vs the earlier
+        # 10ms it lags each flip behind its bind, thinning the Running
+        # churn interleaved with the densest scheduling phase (the flips
+        # still mostly land inside the measured window — they just no
+        # longer contend with the bind burst tick-for-tick)
+        kubelet_start_delay=0.05,
         backoff_base=0.5,
         backoff_cap=5.0,
         controller_resync_seconds=2.0,
