@@ -43,6 +43,11 @@ smoke-tpu:
 http-e2e:
 	$(PY) benchmarks/http_e2e.py
 
+# the apples-to-apples denominator: the same framework on the serial
+# (reference-parity) scorer at a scale where one run is ~1-2 minutes
+serial-e2e:
+	$(PY) benchmarks/serial_e2e.py
+
 # capture the full hardware-evidence suite (bench, smoke, ladder, scale)
 # into the round's artifact files — aborts untouched if the TPU is away
 tpu-artifacts:

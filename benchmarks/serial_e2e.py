@@ -1,0 +1,129 @@
+"""Full-framework e2e on the SERIAL (reference-parity) scorer — the
+apples-to-apples denominator for the oracle fast lane's e2e number.
+
+Same stack (API server, informers, scheduler, plugin, controller, sim
+kubelet), same gang shapes as ladder config 6, but ``--scorer serial``:
+the per-pod PreFilter runs the reference's findMaxPG +
+cluster-resource-scan loops (reference pkg/scheduler/core/
+core.go:595-632,701-739) in process, per pod. Cost grows
+O(pods x (groups + nodes)), so the benchmark runs at a 2k-pod/1k-node
+scale where one run is ~1-2 minutes; the 10k-pod extrapolation is
+reported alongside (at 10k/5k the same path extrapolates to tens of
+minutes — which is WHY the oracle exists).
+
+Run from the repo root: ``python benchmarks/serial_e2e.py`` — one JSON
+line (artifact: SERIAL_E2E_r04.json). CPU-only by design: the serial
+path never touches the device.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GANGS = 200
+MEMBERS = 10
+NODES = 1000
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.setswitchinterval(0.02)  # same runtime tuning as the oracle run
+
+    from batch_scheduler_tpu.sim import SimCluster
+    from batch_scheduler_tpu.sim.scenarios import (
+        make_member_pods,
+        make_sim_group,
+        make_sim_node,
+    )
+
+    cluster = SimCluster(
+        scorer="serial", bind_workers=16, kubelet_start_delay=0.05
+    )
+    cluster.add_nodes(
+        [
+            make_sim_node(
+                f"n{i:05d}", {"cpu": "64", "memory": "256Gi", "pods": "110"}
+            )
+            for i in range(NODES)
+        ]
+    )
+    now = time.time()
+    for g in range(GANGS):
+        pg = make_sim_group(
+            f"g{g:04d}", MEMBERS, creation_ts=now - (GANGS - g) * 1e-3
+        )
+        pg.spec.min_resources = {"cpu": 4000, "memory": 8 * 1024**3}
+        cluster.create_group(pg)
+    cluster.start()
+    pods = []
+    for g in range(GANGS):
+        pods.extend(
+            make_member_pods(f"g{g:04d}", MEMBERS, {"cpu": "4", "memory": "8Gi"})
+        )
+    total = GANGS * MEMBERS
+    t0 = time.perf_counter()
+    try:
+        cluster.create_pods(pods)
+        ok = cluster.wait_for(
+            lambda: cluster.scheduler.stats["binds"] >= total,
+            timeout=600.0,
+            interval=0.1,
+        )
+        elapsed = time.perf_counter() - t0
+        stats = dict(cluster.scheduler.stats)
+    finally:
+        cluster.stop()
+
+    pods_per_sec = total / max(elapsed, 1e-9)
+    # O(pods x (groups + nodes)): scaling 2k/1k -> 10k/5k multiplies the
+    # per-pod scan by ~5 and the pod count by 5
+    extrapolated_10k_s = elapsed * 5 * 5
+    print(
+        json.dumps(
+            {
+                "metric": "framework_e2e_serial_scorer_2kpod_1knode",
+                "value": round(elapsed, 2),
+                "unit": "s",
+                "detail": {
+                    "bound_all": ok,
+                    "pods": total,
+                    "nodes": NODES,
+                    "pods_per_sec": round(pods_per_sec, 1),
+                    "binds": stats["binds"],
+                    "scorer": "serial (reference-parity PreFilter loops)",
+                    "extrapolated_10kpod_5knode_s": round(
+                        extrapolated_10k_s
+                    ),
+                    "oracle_fast_lane_comparison": (
+                        "same stack with --scorer oracle does 10k pods / "
+                        "5k nodes in ~1.1-1.6s (LADDER_r04 config 6)"
+                    ),
+                },
+            }
+        )
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception as e:  # noqa: BLE001 — one JSON line, always
+        print(
+            json.dumps(
+                {
+                    "metric": "framework_e2e_serial_scorer_2kpod_1knode",
+                    "value": -1.0,
+                    "unit": "s",
+                    "detail": {"error": repr(e)[:400]},
+                }
+            )
+        )
+        sys.exit(1)
