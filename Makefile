@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all build vet test test-cpu bench native ladder dryrun clean version tpu-artifacts http-e2e
+.PHONY: all build vet test test-cpu bench native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e
 
 all: vet native test
 

@@ -28,6 +28,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 GANGS = 200
 MEMBERS = 10
 NODES = 1000
+GPU = "nvidia.com/gpu"
 
 
 def main() -> int:
@@ -49,7 +50,8 @@ def main() -> int:
     cluster.add_nodes(
         [
             make_sim_node(
-                f"n{i:05d}", {"cpu": "64", "memory": "256Gi", "pods": "110"}
+                f"n{i:05d}",
+                {"cpu": "64", "memory": "256Gi", "pods": "110", GPU: "8"}
             )
             for i in range(NODES)
         ]
@@ -59,13 +61,16 @@ def main() -> int:
         pg = make_sim_group(
             f"g{g:04d}", MEMBERS, creation_ts=now - (GANGS - g) * 1e-3
         )
-        pg.spec.min_resources = {"cpu": 4000, "memory": 8 * 1024**3}
+        pg.spec.min_resources = {"cpu": 4000, "memory": 8 * 1024**3, GPU: 1}
         cluster.create_group(pg)
     cluster.start()
     pods = []
     for g in range(GANGS):
         pods.extend(
-            make_member_pods(f"g{g:04d}", MEMBERS, {"cpu": "4", "memory": "8Gi"})
+            make_member_pods(
+                f"g{g:04d}", MEMBERS,
+                {"cpu": "4", "memory": "8Gi", GPU: "1"},
+            )
         )
     total = GANGS * MEMBERS
     t0 = time.perf_counter()
