@@ -94,3 +94,77 @@ def test_hang_degrades_to_cpu(monkeypatch):
     assert platform == "cpu"
     assert "hang" in err
     assert ("jax_platforms", "cpu") in updates
+
+
+def test_deadline_mode_retries_until_budget(monkeypatch):
+    """deadline_s switches to a wall-clock budget: hang attempts repeat
+    with backoff until the remaining budget cannot fit another probe."""
+    import jax
+
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(
+        type(jax.config), "jax_platforms", property(lambda self: "axon"),
+        raising=False,
+    )
+    calls = []
+
+    def fake_run(*a, **kw):
+        calls.append(kw.get("timeout"))
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=kw.get("timeout"))
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(jax.config, "update", lambda k, v: None)
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+
+    import time as _time
+
+    sleeps = []
+    monkeypatch.setattr(_time, "sleep", lambda s: sleeps.append(s))
+    # deterministic clock: the budget must not race the real wall clock
+    fake_now = [0.0]
+    monkeypatch.setattr(_time, "monotonic", lambda: fake_now[0])
+
+    platform, err = backend.resolve_platform(
+        probe_timeout_s=0.0, retry_delay_s=0.01, deadline_s=0.05
+    )
+    assert platform == "cpu" and "hang" in err
+    # multiple attempts under the budget, backoff doubling between them
+    assert len(calls) >= 2
+    assert sleeps and sleeps[0] == 0.01 and sleeps[1] == 0.02
+
+
+def test_deadline_mode_deterministic_failure_exits_early(monkeypatch):
+    """A fast, identically-repeating probe failure (broken plugin, not a
+    hung tunnel) must NOT burn the whole deadline budget: three identical
+    errors degrade immediately."""
+    import jax
+
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(
+        type(jax.config), "jax_platforms", property(lambda self: "axon"),
+        raising=False,
+    )
+    calls = []
+
+    class R:
+        returncode = 1
+        stdout = ""
+        stderr = "RuntimeError: plugin exploded"
+
+    def fake_run(*a, **kw):
+        calls.append(1)
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(jax.config, "update", lambda k, v: None)
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    import time as _time
+
+    monkeypatch.setattr(_time, "sleep", lambda s: None)
+
+    platform, err = backend.resolve_platform(
+        probe_timeout_s=0.0, retry_delay_s=0.0, deadline_s=3600.0
+    )
+    assert platform == "cpu"
+    assert "plugin exploded" in err
+    assert len(calls) == 3  # bounded, despite the huge budget

@@ -104,3 +104,45 @@ def test_cluster_state_assume_then_observe_no_double_count():
     pod.spec.node_name = "n1"
     cs.observe_pod(pod)  # informer catches up with the bind
     assert cs.node_requested("n1") == {"cpu": 2000, "pods": 1}
+
+
+def test_cluster_state_raw_paths_match_typed():
+    """observe_pod_raw's three branches (terminal release, same-placement
+    no-op without quantity parsing, unseen-placement fallback) must leave
+    ClusterState identical to the typed observe_pod path."""
+    from batch_scheduler_tpu.api.types import to_dict
+
+    cs = ClusterState()
+    cs.add_node(make_node("n1"))
+    pod = make_pod("p1", requests={"cpu": "2"})
+
+    # unseen bound pod arrives raw -> full charge via the fallback
+    d = to_dict(pod)
+    d["spec"]["node_name"] = "n1"
+    cs.observe_pod_raw(d)
+    assert cs.node_requested("n1").get("cpu") == 2000
+    assert not cs.is_assumed(pod.metadata.uid)
+
+    # same placement again: no-op (version unchanged)
+    v = cs.version()
+    cs.observe_pod_raw(d)
+    assert cs.version() == v
+
+    # assumed pod's bind commit observed raw: assumed flag clears only
+    p2 = make_pod("p2", requests={"cpu": "1"})
+    cs.assume(p2, "n1")
+    assert cs.is_assumed(p2.metadata.uid)
+    d2 = to_dict(p2)
+    d2["spec"]["node_name"] = "n1"
+    cs.observe_pod_raw(d2)
+    assert not cs.is_assumed(p2.metadata.uid)
+    assert cs.node_requested("n1").get("cpu") == 3000
+
+    # terminal phase releases by uid
+    d["status"]["phase"] = PodPhase.SUCCEEDED.value
+    cs.observe_pod_raw(d)
+    assert cs.node_requested("n1").get("cpu", 0) == 1000
+
+    # raw removal drops the remaining charge
+    cs.remove_pod_raw(d2)
+    assert cs.node_requested("n1").get("cpu", 0) == 0
