@@ -83,3 +83,19 @@ def test_pallas_rejects_mismatched_mask_rows():
             np.ones((2, 2), bool),  # neither 1 nor G rows
             np.arange(3, dtype=np.int32),
         )
+
+
+def test_pallas_matches_scan_readback_tail_scenarios():
+    """Interpret-mode equivalence at the compact-readback tail shapes
+    (sim.scenarios.readback_tail_scenarios, the same scenarios the TPU
+    smoke drives on hardware): a gang spanning hundreds of distinct nodes
+    with remaining near 2^16, and a 66k-member single-node take."""
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot
+    from batch_scheduler_tpu.sim.scenarios import readback_tail_scenarios
+
+    for nodes, groups in readback_tail_scenarios():
+        snap = ClusterSnapshot(nodes, {}, groups)
+        left = snap.alloc - snap.requested
+        _run_both(
+            left, snap.group_req, snap.remaining, snap.fit_mask, snap.order
+        )
