@@ -59,6 +59,15 @@ class PodGroupMatchStatus:
         self.pod: Optional[Pod] = None
         # True once the gang has been released to bind at least once.
         self.scheduled = False
+        # Binds THIS scheduler committed (PostBind-side counter). The
+        # status.scheduled field has two monotone lower-bound sources —
+        # this counter and the controller's live member count — and
+        # PostBind takes max(status.scheduled, binds_committed) instead of
+        # blind addition, so the two writers commute: a controller count
+        # that already includes a bind this counter later accounts cannot
+        # double it (and vice versa for binds whose API responses were
+        # lost, which only the controller ever sees).
+        self.binds_committed = 0
         # Gang-granular admission plan (no reference equivalent — it admits
         # gangs pod by pod against a TTL cache, core.go:268-309): the oracle
         # batch that places this gang stamps its node->member-count plan

@@ -238,6 +238,17 @@ class PodGroupController:
             PodGroupPhase.SCHEDULED,
             PodGroupPhase.RUNNING,
             PodGroupPhase.SCHEDULING,
+            # PRE_SCHEDULING is beyond the reference's gate
+            # (controller.go:235: Scheduling+), but bound members CAN
+            # exist here — a bind whose API response was lost, or a
+            # scheduler crash between bind and PostBind, leaves the gang
+            # pre-scheduling with live non-Pending members and an
+            # undercounted Status.Scheduled. Without this row the permit
+            # quorum (minMember - scheduled) stays unreachable and the
+            # gang loops park -> TTL abort -> park forever (found by the
+            # gateway-restart soak at seed run 4: 7 members parked
+            # needing 9, with 3 bound-but-uncounted siblings).
+            PodGroupPhase.PRE_SCHEDULING,
         ):
             members = self._member_phases(pg_copy)
             with pgs.count_lock:

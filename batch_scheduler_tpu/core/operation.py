@@ -372,7 +372,8 @@ class ScheduleOperation:
             if pgs is None:
                 return
             pg = pgs.pod_group
-            new_scheduled = pg.status.scheduled + bound
+            pgs.binds_committed += bound
+            new_scheduled = max(pg.status.scheduled, pgs.binds_committed)
             completed = new_scheduled >= pg.spec.min_member
             new_phase = (
                 PodGroupPhase.SCHEDULED
@@ -429,7 +430,8 @@ class ScheduleOperation:
                 if pgs is None:
                     continue
                 pg = pgs.pod_group
-                new_scheduled = pg.status.scheduled + bound
+                pgs.binds_committed += bound
+                new_scheduled = max(pg.status.scheduled, pgs.binds_committed)
                 completed = new_scheduled >= pg.spec.min_member
                 new_phase = (
                     PodGroupPhase.SCHEDULED
@@ -683,7 +685,10 @@ class ScheduleOperation:
             if pgs is None:
                 return
             pg = pgs.pod_group
-            new_scheduled = pg.status.scheduled + 1
+            # max-of-lower-bounds, not addition: commutes with the
+            # controller's live member count (see pg_cache.binds_committed)
+            pgs.binds_committed += 1
+            new_scheduled = max(pg.status.scheduled, pgs.binds_committed)
             if new_scheduled >= pg.spec.min_member:
                 new_phase = PodGroupPhase.SCHEDULED
                 new_start = pg.status.schedule_start_time

@@ -140,3 +140,4 @@ def test_ttl_eviction_aborts_gang():
     assert h.rejected == ["uid-1"]
     assert h.backoffs == ["default/g"]
     assert pgs.matched_pod_nodes.items() == {}
+

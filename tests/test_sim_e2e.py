@@ -393,3 +393,61 @@ def test_new_extended_resource_after_first_batch(sim):
     assert cluster.wait_for(
         lambda: cluster.scheduler.stats["binds"] >= 4, timeout=30.0
     ), cluster.scheduler.stats
+
+
+def test_prescheduling_gang_with_lost_bind_responses_recovers(sim):
+    """A gang whose early binds committed but whose responses were lost
+    (API outage) sits PreScheduling with live non-Pending members and an
+    undercounted Status.Scheduled; the permit quorum is then unreachable
+    for the remaining members. The controller must count members in
+    PRE_SCHEDULING too (beyond the reference's Scheduling+ gate) so the
+    quorum becomes reachable and the gang completes via the TTL abort
+    retry. Found by the gateway-restart soak."""
+    import time
+
+    cluster = sim(
+        scorer="oracle",
+        max_schedule_minutes=0.05,  # 3s gang TTL: fast abort-retry cycles
+        backoff_base=0.1,
+        backoff_cap=0.5,
+        kubelet_start_delay=0.01,
+    )
+    cluster.add_nodes([make_sim_node("n1", {"cpu": "16", "pods": "64"})])
+    pg = make_sim_group("lost", 4, creation_ts=time.time())
+    pg.spec.min_resources = {"cpu": 1000}
+    cluster.create_group(pg)
+    cluster.start()
+
+    pods = make_member_pods("lost", 4, {"cpu": "1"})
+    # two members "bind with lost responses": committed in the store
+    # (and will go Running via the kubelet) but the scheduler never
+    # saw success — no post_bind, no scheduled bump
+    for p in pods[:2]:
+        cluster.clientset.pods().create(p)
+        cluster.clientset.pods().bind(p.metadata.name, "n1")
+    # the gang is mid-admission from the scheduler's perspective
+    op = cluster.runtime.operation
+    assert cluster.wait_for(
+        lambda: op.status_cache.get("default/lost") is not None,
+        timeout=10.0,
+    )
+    pgs = op.status_cache.get("default/lost")
+    from batch_scheduler_tpu.api import PodGroupPhase
+
+    pgs.pod_group.status.phase = PodGroupPhase.PRE_SCHEDULING
+    pgs.scheduled = True  # released
+
+    # remaining two members arrive normally; quorum needs
+    # min_member - scheduled = 4 - 0 = 4 while only 2 remain ->
+    # unreachable until the controller corrects scheduled to 2
+    cluster.create_pods(pods[2:])
+    assert cluster.wait_for(
+        lambda: all(
+            cluster.clientset.pods().get(p.metadata.name).spec.node_name
+            for p in pods
+        ),
+        timeout=30.0,
+    ), (
+        cluster.scheduler.stats,
+        cluster.group("lost").status,
+    )
