@@ -142,6 +142,8 @@ class ScheduleOperation:
         # reused name re-reads its (new) creation stamp and the cache
         # stays bounded by the live group count.
         self._creation_cache: Dict[Tuple[str, str], float] = {}
+        self._creation_tombstones: Dict[Tuple[str, str], float] = {}
+        self._clock = clock
         status_cache.on_delete(self._forget_creation)
         # Cross-call max-progress group state used by the serial Filter path
         # (reference core.go:58-59,118-127).
@@ -348,10 +350,13 @@ class ScheduleOperation:
             return False
         for pod, _ in members:
             self._fill_occupied(pgs, pod)
-        pg = pgs.pod_group
-        if pg.status.phase == PodGroupPhase.PENDING:
-            pg.status.phase = PodGroupPhase.PRE_SCHEDULING
-        pgs.scheduled = True
+        # under the operation lock like post_bind: the phase flip must not
+        # race a controller worker swapping pgs.pod_group.status
+        with self._lock:
+            pg = pgs.pod_group
+            if pg.status.phase == PodGroupPhase.PENDING:
+                pg.status.phase = PodGroupPhase.PRE_SCHEDULING
+            pgs.scheduled = True
         # every one of these assumes is capacity the batch pre-accounted
         # through the gang's plan (the bulk form of on_assume's credit)
         if self.oracle is not None:
@@ -360,52 +365,14 @@ class ScheduleOperation:
 
     def post_bind_gang(self, full_name: str, bound: int) -> None:
         """One status transition for ``bound`` members bound as a unit:
-        the per-gang equivalent of ``bound`` post_bind calls — one lock
-        pass and ONE merge patch instead of up to two patches plus
-        ``bound`` lock acquisitions (reference PostBind runs per pod,
-        core.go:312-362; at 10k pods the per-pod form was the single
-        largest control-plane cost)."""
-        if bound <= 0:
-            return
-        with self._lock:
-            pgs = self.status_cache.get(full_name)
-            if pgs is None:
-                return
-            pg = pgs.pod_group
-            pgs.binds_committed += bound
-            new_scheduled = max(pg.status.scheduled, pgs.binds_committed)
-            completed = new_scheduled >= pg.spec.min_member
-            new_phase = (
-                PodGroupPhase.SCHEDULED
-                if completed
-                else PodGroupPhase.SCHEDULING
-            )
-            new_start = pg.status.schedule_start_time or time.time()
-            if new_phase != pg.status.phase and self.pg_client is not None:
-                try:
-                    updated = self.pg_client.podgroups(
-                        pg.metadata.namespace
-                    ).patch(
-                        pg.metadata.name,
-                        {
-                            "status": {
-                                "phase": new_phase.value,
-                                "scheduled": new_scheduled,
-                                "schedule_start_time": new_start,
-                            }
-                        },
-                    )
-                    pg.status.phase = updated.status.phase
-                except Exception:
-                    return
-            else:
-                pg.status.phase = new_phase
-            pg.status.schedule_start_time = new_start
-            pg.status.scheduled = new_scheduled
-            # the plan is consumed; members beyond the quorum scan-place
-            pgs.placement_plan = None
-        if completed:
-            self.mark_dirty()
+        the per-gang equivalent of ``bound`` post_bind calls (reference
+        PostBind runs per pod, core.go:312-362; at 10k pods the per-pod
+        form was the single largest control-plane cost). Thin wrapper
+        over :meth:`post_bind_gangs` so the transition state machine
+        exists exactly once — including its commit-local-first patch
+        semantics (the binds are already durable; the controller
+        reconciles any missed patch from live member pods)."""
+        self.post_bind_gangs([(full_name, bound)])
 
     def post_bind_gangs(self, items) -> None:
         """Flush form of :meth:`post_bind_gang` for a batch of gangs bound
@@ -645,6 +612,18 @@ class ScheduleOperation:
                 False, pg_name, errs.PodGroupNotFoundError(full_name)
             )
         pg = pgs.pod_group
+        if (
+            pgs.scheduled
+            and pg.status.scheduled >= pg.spec.min_member
+        ):
+            # Quorum met AND released: members beyond the minimum schedule
+            # like ordinary pods. The reference instead parks them in a
+            # Permit wait whose release signal StartBatchSchedule ignores
+            # for SCHEDULED gangs (batchscheduler.go:258-262), stranding
+            # every late/extra member in a park -> TTL-abort loop forever
+            # — a wart fixed, not copied (found by review repro: a
+            # min_member=3 gang with 4 members never binds the 4th).
+            return PermitOutcome(True, pg_name, errs.NotMatchedError())
         if pg.status.phase == PodGroupPhase.PENDING:
             pg.status.phase = PodGroupPhase.PRE_SCHEDULING
 
@@ -770,9 +749,18 @@ class ScheduleOperation:
             return True
         return prio1 == prio2 and c1 == c2 and name1 == name2 and ts1 < ts2
 
+    # After a group's cache entry dies, its name is TOMBSTONED for this
+    # long: sort_key keeps answering from the (possibly lagging) lister
+    # but does NOT re-cache, so a recreated group cannot get pinned to its
+    # predecessor's creation timestamp read off a stale informer doc.
+    CREATION_TOMBSTONE_S = 5.0
+
     def _forget_creation(self, full_name: str) -> None:
         ns, _, name = full_name.partition("/")
         self._creation_cache.pop((ns, name), None)
+        self._creation_tombstones[(ns, name)] = (
+            self._clock() + self.CREATION_TOMBSTONE_S
+        )
 
     def sort_key(self, info) -> tuple:
         """Total-order queue key equivalent to :meth:`compare` (reference
@@ -800,7 +788,12 @@ class ScheduleOperation:
                 pg = self.pg_lister(info.namespace, info.gang)
                 if pg is not None:
                     created = pg.metadata.creation_timestamp
-            if created != float("inf"):
+            tomb = self._creation_tombstones.get(cache_key)
+            if tomb is not None and self._clock() < tomb:
+                pass  # recently deleted: the lister may still be stale
+            elif created != float("inf"):
+                if tomb is not None:
+                    del self._creation_tombstones[cache_key]
                 self._creation_cache[cache_key] = created
         return (
             -info.priority,

@@ -94,10 +94,15 @@ class Scheduler:
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
         # cross-gang commit buffer: (gang, namespace, assigned) awaiting
-        # the batched bind + post-bind flush (scheduling thread only,
-        # except stop() after joining it); _buffer_since bounds deferral
+        # the batched bind + post-bind flush. Appended only by the
+        # scheduling thread; the buffer SWAP in _flush_gangs is guarded by
+        # _flush_lock so stop()'s safety-net flush (after a join that may
+        # time out mid-outage) can never double-commit a batch the cycle
+        # thread is still flushing — concurrent flushes take disjoint
+        # buffers. _buffer_since bounds deferral.
         self._gang_buffer: List[tuple] = []
         self._buffer_since = 0.0
+        self._flush_lock = threading.Lock()
         # counters for observability (SURVEY.md §5 build note)
         self.stats = {
             "scheduled": 0,
@@ -295,6 +300,12 @@ class Scheduler:
             rollback()
             hand_back()
             raise
+        if extras:
+            # flush BEFORE handing extras to the per-pod path: their
+            # permit reads status.scheduled, and a deferred commit would
+            # park them against a stale quorum (one TTL-abort + 20s deny
+            # detour per extra)
+            self._flush_gangs()
         for m, _ in extras:
             # members beyond the quorum: ordinary per-pod scan placement
             self.queue.push(m)
@@ -307,10 +318,11 @@ class Scheduler:
         scheduling thread only. On a bind transport failure every member
         of the failed flush is rolled back to the queue with backoff —
         their capacity was only assumed."""
-        buf = self._gang_buffer
-        if not buf:
-            return
-        self._gang_buffer = []
+        with self._flush_lock:
+            buf = self._gang_buffer
+            if not buf:
+                return
+            self._gang_buffer = []
         try:
             by_ns = {}
             for _, ns, assigned in buf:

@@ -91,7 +91,13 @@ def main() -> int:
 
     cluster.start()
     total = NUM_GANGS * MEMBERS
-    restart_at = total * 2 // 5  # kill the gateway at ~40% bound
+    # kill point as a fraction of binds (default ~40%); soak runs sweep
+    # this to exercise early/late outage windows
+    try:
+        frac = float(os.environ.get("BSP_HTTP_RESTART_FRACTION", "0.4"))
+    except ValueError:
+        frac = 0.4
+    restart_at = max(1, int(total * frac))
 
     t0 = time.perf_counter()
     for g in range(NUM_GANGS):
@@ -166,18 +172,29 @@ def main() -> int:
         for gname in sorted(
             {d["metadata"]["name"].rsplit("-", 1)[0] for d in unbound}
         ):
-            pgs = op.status_cache.get(f"default/{gname}")
-            live = backing.get("PodGroup", "default", gname)
-            print(
-                f"# {gname}: live phase={live['status']['phase']} "
-                f"sched={live['status']['scheduled']} | cache "
-                f"phase={pgs.pod_group.status.phase.value} "
-                f"sched={pgs.pod_group.status.scheduled} "
-                f"matched={len(pgs.matched_pod_nodes.items())} "
-                f"released={pgs.scheduled} "
-                f"denied={op.last_denied_pg.contains(f'default/{gname}')}",
-                file=sys.stderr,
-            )
+            # best-effort diagnostics: a vanished group (GC'd, terminal)
+            # must not crash the dump or the JSON-line contract
+            try:
+                pgs = op.status_cache.get(f"default/{gname}")
+                live = backing.get("PodGroup", "default", gname)
+                cache_desc = (
+                    "cache-entry-missing"
+                    if pgs is None
+                    else (
+                        f"cache phase={pgs.pod_group.status.phase.value} "
+                        f"sched={pgs.pod_group.status.scheduled} "
+                        f"matched={len(pgs.matched_pod_nodes.items())} "
+                        f"released={pgs.scheduled}"
+                    )
+                )
+                print(
+                    f"# {gname}: live phase={live['status']['phase']} "
+                    f"sched={live['status']['scheduled']} | {cache_desc} "
+                    f"denied={op.last_denied_pg.contains(f'default/{gname}')}",
+                    file=sys.stderr,
+                )
+            except Exception as e:  # noqa: BLE001 — diagnostics only
+                print(f"# {gname}: dump failed: {e!r}", file=sys.stderr)
         print(
             f"# queue={len(cluster.scheduler.queue)} "
             f"waiting={len(cluster.scheduler.waiting)} "

@@ -585,16 +585,20 @@ def config6_framework_e2e(num_nodes=5000, num_groups=1000, members=10):
     )
     # WALL-CLOCK BUDGET (VERDICT r3 item 1: a config that passes at any
     # speed asserts nothing). With the whole-gang fast lane + standing
-    # batch the e2e runs ~1.1-1.5s / ~7k pods/s on the bench host
+    # batch the e2e runs ~1.1-1.5s / ~7-9k pods/s on the bench host
     # (was 4.5s / 2.2k); the asserted budget leaves headroom for host
     # noise while failing any regression toward the per-pod era.
-    assert elapsed < 2.0, (
+    # BST_E2E_BUDGET_S rescales for a foreign/slower host (the budget is
+    # calibrated to the bench machine, not a universal constant).
+    budget_s = float(os.environ.get("BST_E2E_BUDGET_S", "2.0"))
+    assert elapsed < budget_s, (
         f"framework e2e took {elapsed:.2f}s for {total} pods "
-        "(budget 2.0s; steady ~1.3s)"
+        f"(budget {budget_s}s; steady ~1.3s on the bench host)"
     )
     pods_per_sec = total / max(elapsed, 1e-9)
-    assert pods_per_sec > 4500, (
-        f"{pods_per_sec:.0f} pods/s below the 4500 regression floor"
+    floor = total / budget_s * 0.9
+    assert pods_per_sec > floor, (
+        f"{pods_per_sec:.0f} pods/s below the {floor:.0f} regression floor"
     )
 
 

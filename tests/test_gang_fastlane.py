@@ -295,3 +295,22 @@ def test_gang_transaction_partial_bind_missing_pod(sim):
     assert cluster.wait_for_bound("gappy", 3, timeout=20.0), (
         cluster.scheduler.stats
     )
+
+
+def test_members_beyond_min_member_bind_after_fast_lane(sim):
+    """A gang with MORE queued members than min_member: the fast lane
+    seats the quorum and the extras must still bind (beyond-quorum
+    members schedule like ordinary pods once the gang is released — the
+    reference strands them in a park/TTL-abort loop instead,
+    batchscheduler.go:258-262; fixed, not copied)."""
+    cluster = sim(scorer="oracle", max_schedule_minutes=0.05)
+    cluster.add_nodes([make_sim_node("n1", {"cpu": "16", "pods": "64"})])
+    pg = make_sim_group("plus", 3)
+    pg.spec.min_resources = {"cpu": 1000}
+    cluster.create_group(pg)
+    cluster.start()
+    cluster.create_pods(make_member_pods("plus", 4, {"cpu": "1"}))
+    assert cluster.wait_for_bound("plus", 4, timeout=20.0), (
+        cluster.scheduler.stats,
+        cluster.member_phase_counts("plus"),
+    )
