@@ -10,7 +10,7 @@ device-resident inputs, median of passes) and reports the Amdahl
 ceiling for sharded scoring at 4 and 8 chips.
 
 Run from the repo root: ``python benchmarks/scan_split.py`` — one JSON
-line (artifact: SCAN_SPLIT_r04.json when captured on TPU).
+line (artifact: SCAN_SPLIT_r05.json when captured on TPU).
 """
 
 from __future__ import annotations
