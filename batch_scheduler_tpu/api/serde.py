@@ -106,8 +106,10 @@ def pod_from_dict(d: dict) -> Pod:
             containers=[_container(c) for c in spec.get("containers") or []],
             node_selector=dict(spec.get("node_selector") or {}),
             tolerations=[_toleration(t) for t in spec.get("tolerations") or []],
-            priority=spec.get("priority", 0),
-            node_name=spec.get("node_name", ""),
+            # "priority": null is legal external JSON; normalize here so
+            # every typed consumer (compare, preemption sorts) sees an int
+            priority=spec.get("priority") or 0,
+            node_name=spec.get("node_name") or "",
         ),
         status=PodStatus(phase=PodPhase(status.get("phase", "Pending"))),
     )

@@ -406,7 +406,16 @@ class ScheduleOperation:
                     else PodGroupPhase.SCHEDULING
                 )
                 new_start = pg.status.schedule_start_time or time.time()
-                if self.pg_client is not None and new_phase != pg.status.phase:
+                # patch on scheduled-count advance too, not just phase
+                # change: two partial flushes both landing in SCHEDULING
+                # must still move the API server's count, or a crash in
+                # that window loses more progress than the per-pod path
+                # (whose bound-but-Pending members the controller cannot
+                # see until kubelets start them)
+                if self.pg_client is not None and (
+                    new_phase != pg.status.phase
+                    or new_scheduled > pg.status.scheduled
+                ):
                     patches_by_ns.setdefault(
                         pg.metadata.namespace, []
                     ).append(

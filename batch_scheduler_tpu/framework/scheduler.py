@@ -103,6 +103,15 @@ class Scheduler:
         self._gang_buffer: List[tuple] = []
         self._buffer_since = 0.0
         self._flush_lock = threading.Lock()
+        # uids whose bind failed AMBIGUOUSLY (transport error: the request
+        # may have applied with only the response lost) and whose capacity
+        # was therefore kept. Consulted at pop time to release the ghost
+        # once a fresh liveness read proves the bind never applied —
+        # WITHOUT this marker, a duplicate queue entry (HTTP watch replay
+        # re-enqueues every Pending pod) could release a permit-parked or
+        # flush-buffered pod's LIVE reservation. GIL-atomic set ops; add
+        # on the bind-worker/flush failure paths, discard at pop.
+        self._kept_assumes: set = set()
         # counters for observability (SURVEY.md §5 build note)
         self.stats = {
             "scheduled": 0,
@@ -248,6 +257,29 @@ class Scheduler:
                 if p is not None:
                     members.append((sib, p))
 
+        # consumed siblings bypass _schedule_one's marker discard, so
+        # handle their kept assumes HERE: a sib still in members just
+        # passed an unbound liveness read — the same evidence the pop
+        # path uses — so release its ghost; either way discard the
+        # marker, or it outlives this consumption and lets a duplicate
+        # queue entry forget the re-assumed LIVE reservation later.
+        # (guarded: _kept_assumes is empty except during outage recovery)
+        if self._kept_assumes:
+            stale = False
+            member_uids = {m.uid for m, _ in members}
+            for sib in sibs:
+                if sib.uid in self._kept_assumes:
+                    self._kept_assumes.discard(sib.uid)
+                    if (
+                        sib.uid in member_uids
+                        and self.cluster.is_assumed(sib.uid)
+                        and not self._assume_owned(sib.uid)
+                    ):
+                        self.cluster.forget(sib.uid)
+                        stale = True
+            if stale:
+                plugin.mark_dirty()
+
         def hand_back() -> bool:
             # everything except the popped pod returns to the queue; the
             # caller continues with the per-pod path for ``info``
@@ -287,12 +319,17 @@ class Scheduler:
 
             # commit is DEFERRED into the cross-gang flush buffer: binds
             # and the post-bind status patch batch across up to
-            # FLUSH_GANGS gangs (one API pass each, one re-batch total)
-            if not self._gang_buffer:
-                self._buffer_since = self._clock()
-            self._gang_buffer.append(
-                (gang, pod.metadata.namespace, assigned)
-            )
+            # FLUSH_GANGS gangs (one API pass each, one re-batch total).
+            # Appended under _flush_lock (uncontended in the normal
+            # single-threaded cycle) so stop()'s safety-net flush cannot
+            # swap the buffer out from under a still-running cycle thread
+            # and strand a permitted gang assumed-but-unbound.
+            with self._flush_lock:
+                if not self._gang_buffer:
+                    self._buffer_since = self._clock()
+                self._gang_buffer.append(
+                    (gang, pod.metadata.namespace, assigned)
+                )
         except Exception:
             # unexpected failure (transport, bug): release what was only
             # assumed, hand the gang back, and let the outer handler run
@@ -315,35 +352,57 @@ class Scheduler:
         """Commit the buffered gang transactions: ONE batched bind call
         per namespace, one finish-binding lock pass, one post-bind status
         sweep (bulk patch + single batch invalidation). Runs on the
-        scheduling thread only. On a bind transport failure every member
-        of the failed flush is rolled back to the queue with backoff —
-        their capacity was only assumed."""
+        scheduling thread only. Bind-failure policy mirrors the per-pod
+        worker (_bind_worker): a bind_many exception is AMBIGUOUS — the
+        request may have applied server-side with only the response lost
+        — so the failed namespace's members KEEP their assumed capacity
+        and requeue (the retry either drops them on the bound-pod
+        liveness check or re-assumes, squaring the charge). Only
+        namespaces never attempted are rolled back, and namespaces whose
+        bind_many already returned still go through the normal finish +
+        post_bind path."""
         with self._flush_lock:
             buf = self._gang_buffer
             if not buf:
                 return
             self._gang_buffer = []
-        try:
-            by_ns = {}
-            for _, ns, assigned in buf:
-                by_ns.setdefault(ns, []).extend(
-                    (p.metadata.name, n) for _, p, n in assigned
-                )
-            bound_keys = set()
-            for ns, pairs in by_ns.items():
+        by_ns = {}
+        for _, ns, assigned in buf:
+            by_ns.setdefault(ns, []).extend(
+                (p.metadata.name, n) for _, p, n in assigned
+            )
+        bound_keys = set()
+        done_ns = set()
+        failed_ns = None
+        unattempted_ns = set()
+        ns_order = list(by_ns.items())
+        for i, (ns, pairs) in enumerate(ns_order):
+            try:
                 for name in self.clientset.pods(ns).bind_many(pairs):
                     bound_keys.add((ns, name))
-        except Exception:
-            for _, _, assigned in buf:
-                for m, p, _ in assigned:
-                    self.cluster.forget(p.metadata.uid)
-                    self.queue.push_backoff(m)
-            if self.plugin is not None:
-                self.plugin.mark_dirty()
-            return
+                done_ns.add(ns)
+            except Exception:
+                failed_ns = ns
+                unattempted_ns = {n2 for n2, _ in ns_order[i + 1:]}
+                break
+        if failed_ns is not None and self.plugin is not None:
+            self.plugin.mark_dirty()
         finished = []
         items = []
         for gang, ns, assigned in buf:
+            if ns not in done_ns:
+                for m, p, _ in assigned:
+                    if ns in unattempted_ns:
+                        # never reached the API: the assume is pure local
+                        # state — release it
+                        self.cluster.forget(p.metadata.uid)
+                    else:
+                        # failed_ns: keep the assume (ambiguous outcome)
+                        # and mark it so the next pop can release the
+                        # ghost once a fresh read proves it never bound
+                        self._kept_assumes.add(p.metadata.uid)
+                    self.queue.push_backoff(m)
+                continue
             bound = 0
             for _, p, n in assigned:
                 if (ns, p.metadata.name) in bound_keys:
@@ -356,6 +415,8 @@ class Scheduler:
             self.stats["binds"] += bound
             self.stats["scheduled"] += bound
             self._binds_total.inc(bound)
+        if not items:
+            return
         self.cluster.finish_binding_many(finished)
         post_many = getattr(self.plugin, "post_bind_gangs", None)
         if post_many is not None:
@@ -446,9 +507,35 @@ class Scheduler:
 
     def _schedule_one(self, info: PodInfo) -> Optional[str]:
         self.stats["cycles"] += 1
+        kept = info.uid in self._kept_assumes
+        if kept:
+            self._kept_assumes.discard(info.uid)
         pod = self._live_pod(info)
         if pod is None:
             return
+
+        if (
+            kept
+            and self.cluster.is_assumed(info.uid)
+            and not self._assume_owned(info.uid)
+        ):
+            # a kept assume from an ambiguous bind failure (the worker/
+            # flush keep-capacity policy): the liveness read above just
+            # showed the pod UNBOUND, which resolves the ambiguity — the
+            # lost request never applied — so release the ghost
+            # reservation before planning. Without this the pod competes
+            # against its own charge and a gang that exactly fills a node
+            # livelocks on it forever. Two gates protect LIVE reservations
+            # from this forget: the _kept_assumes marker (only the
+            # ambiguous-failure paths set it, so an ordinary duplicate
+            # queue entry never triggers it) and _assume_owned (a marker
+            # raced by a duplicate-entry re-assume that re-parked the pod
+            # must not release the new owner's charge). (A stale informer
+            # view self-heals: a late bound event re-charges the node via
+            # observe_pod.)
+            self.cluster.forget(info.uid)
+            if self.plugin is not None:
+                self.plugin.mark_dirty()
 
         if self.plugin is not None:
             try:
@@ -704,6 +791,7 @@ class Scheduler:
                     # overcommit the node), and the retry cycle either
                     # drops the entry on the bound-pod liveness check or
                     # re-assumes, both of which square the charge.
+                    self._kept_assumes.add(pod.metadata.uid)
                     if self.plugin is not None:
                         self.plugin.mark_dirty()
                     self._requeue_waiting(wp, pod)
@@ -713,6 +801,20 @@ class Scheduler:
                 if self.plugin is not None:
                     self.plugin.mark_dirty()
                 self._requeue_waiting(wp, pod)
+
+    def _assume_owned(self, uid: str) -> bool:
+        """True when a live owner currently holds this uid's assume — a
+        permit-parked WaitingPod or a flush-buffered gang seat — in which
+        case the ghost-release at pop time must not touch the charge (the
+        marker it is acting on was raced by a re-admission)."""
+        if self.waiting.get(uid) is not None:
+            return True
+        with self._flush_lock:
+            for _, _, assigned in self._gang_buffer:
+                for _, p, _ in assigned:
+                    if p.metadata.uid == uid:
+                        return True
+        return False
 
     def _requeue_waiting(self, wp, pod: Pod) -> None:
         info = getattr(wp, "_info", None) or PodInfo(pod=pod)

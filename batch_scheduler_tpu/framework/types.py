@@ -66,14 +66,16 @@ class PodInfo:
             self.namespace = pod.metadata.namespace
             self.name = pod.metadata.name
             self.uid = pod.metadata.uid
-            self.priority = pod.spec.priority
+            # nullable in external JSON: an explicit null must not poison
+            # the queue's -priority sort key on the watch-dispatch thread
+            self.priority = pod.spec.priority or 0
             self.gang = (pod.metadata.labels or {}).get(POD_GROUP_LABEL, "")
         else:
             meta = raw.get("metadata") or {}
             self.namespace = meta.get("namespace", "default")
             self.name = meta.get("name", "")
             self.uid = meta.get("uid", "")
-            self.priority = (raw.get("spec") or {}).get("priority", 0)
+            self.priority = (raw.get("spec") or {}).get("priority") or 0
             self.gang = (meta.get("labels") or {}).get(POD_GROUP_LABEL, "")
 
     @property

@@ -131,6 +131,7 @@ class SimKubelet:
                     self._batch_failures = 0
 
     def _apply_due(self, due) -> None:
+        applied = set()
         patch_many = getattr(self.api, "patch_many", None)
         if patch_many is not None:
             # batched phase transitions: one lock pass per (tick, ns)
@@ -142,7 +143,8 @@ class SimKubelet:
                     (name, {"status": {"phase": phase.value}})
                 )
             for ns, patches in by_ns.items():
-                patch_many("Pod", ns, patches)
+                for name in patch_many("Pod", ns, patches):
+                    applied.add((ns, name))
         else:
             for _, _, ns, name, phase in due:
                 try:
@@ -151,9 +153,12 @@ class SimKubelet:
                     )
                 except NotFoundError:
                     continue
+                applied.add((ns, name))
         if self.run_duration is not None:
+            # deleted pods (patch skipped) must not get phantom SUCCEEDED
+            # transitions queued against their name
             for _, _, ns, name, phase in due:
-                if phase == PodPhase.RUNNING:
+                if phase == PodPhase.RUNNING and (ns, name) in applied:
                     self._schedule_transition(
                         ns, name, PodPhase.SUCCEEDED, self.run_duration
                     )

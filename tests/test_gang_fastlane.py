@@ -256,6 +256,184 @@ def test_flush_rolls_back_to_queue_on_bind_transport_failure(sim):
     assert req.get("pods", 0) == 3, req
 
 
+def test_flush_partial_namespace_failure_policy():
+    """ADVICE r4 (medium), unit form: a bind_many exception mid-flush is
+    AMBIGUOUS (the request may have applied with only the response lost),
+    so the failed namespace's members KEEP their assumed capacity —
+    mirroring the per-pod bind worker — while namespaces never attempted
+    roll back fully, and namespaces whose bind_many already returned go
+    through the normal finish + post_bind_gangs path."""
+    from batch_scheduler_tpu.framework.cluster import ClusterState
+    from batch_scheduler_tpu.framework.scheduler import Scheduler
+    from helpers import make_node
+
+    api = APIServer()
+    cs = Clientset(api)
+    cluster = ClusterState()
+    cluster.add_node(make_node("n1", {"cpu": "64", "pods": "64"}))
+
+    class Plugin:
+        def __init__(self):
+            self.posted = []
+            self.dirty = 0
+
+        less = None
+
+        def mark_dirty(self):
+            self.dirty += 1
+
+        def post_bind_gangs(self, items):
+            self.posted.extend(items)
+
+    plugin = Plugin()
+    sched = Scheduler(cs, cluster, plugin=plugin)
+
+    buf_entries = []
+    for ns, gang in (("nsa", "ga"), ("nsb", "gb"), ("nsc", "gc")):
+        assigned = []
+        for i in range(2):
+            p = make_pod(f"{gang}-{i}", group=gang, namespace=ns,
+                         requests={"cpu": "1"})
+            cs.pods(ns).create(p)
+            cluster.assume(p, "n1")
+            assigned.append((PodInfo(pod=p), p, "n1"))
+        buf_entries.append((f"{ns}/{gang}", ns, assigned))
+    sched._gang_buffer = list(buf_entries)
+
+    orig = api.bind_pods
+
+    def broken(ns, pairs):
+        if ns == "nsb":
+            raise ConnectionError("simulated outage")
+        return orig(ns, pairs)
+
+    api.bind_pods = broken
+    sched._flush_gangs()
+
+    # nsa (bound before the failure): members finished binding, capacity
+    # charged as bound, gang went through post_bind_gangs
+    assert ("nsa/ga", 2) in plugin.posted
+    assert all(g != "nsb/gb" and g != "nsc/gc" for g, _ in plugin.posted)
+    for _, p, _ in buf_entries[0][2]:
+        assert not cluster.is_assumed(p.metadata.uid)  # promoted to bound
+    assert cs.pods("nsa").get("ga-0").spec.node_name == "n1"
+
+    # nsb (the ambiguous failure): assumes KEPT, members requeued
+    for _, p, _ in buf_entries[1][2]:
+        assert cluster.is_assumed(p.metadata.uid)
+    # nsc (never attempted): assumes released, members requeued
+    for _, p, _ in buf_entries[2][2]:
+        assert not cluster.is_assumed(p.metadata.uid)
+    assert plugin.dirty >= 1
+    # all four non-bound members are back in the queue (backoff)
+    assert len(sched.queue) == 4
+    # total capacity charge: nsa bound (2 pods) + nsb kept assumes (2 pods)
+    assert cluster.node_requested("n1").get("pods", 0) == 4
+
+
+def test_kept_assume_does_not_livelock_tight_node(sim):
+    """The ambiguous-failure keep-capacity policy must not let a gang
+    livelock against its OWN ghost reservations: a gang that exactly
+    fills a node fails its first flush (kept assumes saturate the node),
+    and the retry must still bind — the fresh liveness read resolves the
+    ambiguity and releases the stale assume before planning."""
+    cluster = sim(scorer="oracle")
+    # node sized EXACTLY for the gang: 3 cpu, 3 pod slots
+    cluster.add_nodes([make_sim_node("n1", {"cpu": "3", "pods": "3"})])
+    pg = make_sim_group("tight", 3)
+    pg.spec.min_resources = {"cpu": 1000}
+    cluster.create_group(pg)
+    cluster.start()
+
+    orig = cluster.api.bind_pods
+    calls = {"n": 0}
+
+    def broken(ns, pairs):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ConnectionError("simulated outage")
+        return orig(ns, pairs)
+
+    cluster.api.bind_pods = broken
+    cluster.create_pods(make_member_pods("tight", 3, {"cpu": "1"}))
+    assert cluster.wait_for_bound("tight", 3, timeout=30.0), (
+        cluster.scheduler.stats,
+        calls,
+        cluster.cluster.node_requested("n1"),
+    )
+    # accounting squared: exactly one gang's worth charged
+    req = cluster.cluster.node_requested("n1")
+    assert req.get("pods", 0) == 3, req
+
+
+def test_duplicate_queue_entry_keeps_parked_pod_reservation(sim):
+    """A watch-replay duplicate queue entry for a permit-PARKED pod (which
+    is assumed — its reservation is live) must not release that charge:
+    the ghost-release at pop time is gated on the ambiguous-failure
+    marker, not on is_assumed alone."""
+    import time as _time
+
+    cluster = sim(scorer="oracle")
+    cluster.add_nodes([make_sim_node("n1", {"cpu": "16", "pods": "64"})])
+    pg = make_sim_group("parked", 4)
+    pg.spec.min_resources = {"cpu": 1000}
+    cluster.create_group(pg)
+    cluster.start()
+    pods = make_member_pods("parked", 4, {"cpu": "1"})
+    cluster.create_pods(pods[:2])
+    assert cluster.wait_for(
+        lambda: cluster.scheduler.stats["permit_waits"] >= 2, timeout=10.0
+    ), cluster.scheduler.stats
+    parked_uid = pods[0].metadata.uid
+    assert cluster.cluster.is_assumed(parked_uid)
+    # replayed ADDED event: duplicate entry for the parked (unbound) pod
+    cluster.scheduler.queue.push(PodInfo(pod=pods[0]))
+    _time.sleep(0.5)  # let the duplicate pop and run a cycle
+    assert cluster.cluster.is_assumed(parked_uid), (
+        "duplicate entry released a parked pod's live reservation"
+    )
+    # the gang still completes when the rest arrive
+    cluster.create_pods(pods[2:])
+    assert cluster.wait_for_bound("parked", 4, timeout=20.0), (
+        cluster.scheduler.stats
+    )
+
+
+def test_raced_kept_marker_spares_parked_owner(sim):
+    """A _kept_assumes marker that lands AFTER a duplicate entry re-parked
+    the pod (bind-worker failure racing a watch replay) must not release
+    the new owner's live reservation: the forget is gated on
+    _assume_owned, not the marker alone."""
+    import time as _time
+
+    cluster = sim(scorer="oracle")
+    cluster.add_nodes([make_sim_node("n1", {"cpu": "16", "pods": "64"})])
+    pg = make_sim_group("raced", 4)
+    pg.spec.min_resources = {"cpu": 1000}
+    cluster.create_group(pg)
+    cluster.start()
+    pods = make_member_pods("raced", 4, {"cpu": "1"})
+    cluster.create_pods(pods[:2])
+    assert cluster.wait_for(
+        lambda: cluster.scheduler.stats["permit_waits"] >= 2, timeout=10.0
+    ), cluster.scheduler.stats
+    uid = pods[0].metadata.uid
+    assert cluster.cluster.is_assumed(uid)
+    assert cluster.scheduler.waiting.get(uid) is not None
+    # simulate the race: a stale ambiguous-failure marker exists for a
+    # pod whose assume is now owned by a parked WaitingPod
+    cluster.scheduler._kept_assumes.add(uid)
+    cluster.scheduler.queue.push(PodInfo(pod=pods[0]))
+    _time.sleep(0.5)
+    assert cluster.cluster.is_assumed(uid), (
+        "raced marker released a parked owner's reservation"
+    )
+    cluster.create_pods(pods[2:])
+    assert cluster.wait_for_bound("raced", 4, timeout=20.0), (
+        cluster.scheduler.stats
+    )
+
+
 def test_gang_transaction_partial_bind_missing_pod(sim):
     """A member deleted between seat and flush: bind_many skips it, the
     gang lands partially (Scheduling), and the recreated member completes

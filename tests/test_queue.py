@@ -123,6 +123,36 @@ def test_backoff_reentry_returns_to_bucket(queue_factory):
     assert got.attempts == 1
 
 
+def test_backoff_reentry_deviation_bounded_to_same_bucket(queue_factory):
+    """Pins the two-level queue's ONE ordering deviation (queue.py FIFO
+    parking note): a backoff RE-entry parks at its bucket's FIFO tail,
+    so it pops AFTER same-bucket siblings whose timestamps it precedes —
+    and pins the deviation's BOUND: cross-bucket Compare order (priority
+    first, then the gang component, reference core.go:368-411 semantics)
+    is never inverted, because the sort key orders buckets before the
+    timestamp ever matters. A queue refactor that widens the deviation
+    beyond same-(gang, priority) buckets fails this test."""
+    import time as _time
+
+    q = queue_factory(backoff_base=0.01, backoff_cap=0.02)
+    a1 = _info("a1", group="alpha", ts=1.0)
+    q.push(a1)
+    q.push(_info("a2", group="alpha", ts=2.0))
+    q.push(_info("a3", group="alpha", ts=3.0))
+    assert q.pop(timeout=0.1).name == "a1"
+    # re-entry: a1's ts=1.0 precedes a2/a3, but it re-parks at the tail
+    q.push_backoff(a1)
+    # cross-bucket competitors pushed AFTER the re-entry
+    q.push(_info("b-hi", group="beta", priority=5, ts=9.0))
+    q.push(_info("b1", group="beta", ts=0.5))
+    _time.sleep(0.3)  # backoff flusher (≤0.02s) re-admits a1
+    names = [q.pop(timeout=2.0).name for _ in range(5)]
+    # priority bucket first (never inverted by the parking), then the
+    # alpha bucket ahead of beta (gang component precedes timestamp in
+    # the key), with the deviation visible ONLY inside alpha: a1 last
+    assert names == ["b-hi", "a2", "a3", "a1", "b1"], names
+
+
 def test_group_size_tracks_live_members_only(queue_factory):
     q = queue_factory()
     for i in range(3):
