@@ -174,33 +174,43 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.flush()
             while True:
                 try:
-                    ev = events.get(timeout=0.2)
+                    item = events.get(timeout=0.2)
                 except queue.Empty:
                     # heartbeat keeps half-open disconnects detectable
                     self.wfile.write(b"\n")
                     self.wfile.flush()
                     continue
-                key = key_of(ev.obj)
-                etype = ev.type
-                if in_scope(ev.obj):
-                    if etype == "DELETED":
+                # the API server's bulk verbs fan out one LIST per chunk
+                out = []
+                for ev in item if isinstance(item, list) else (item,):
+                    key = key_of(ev.obj)
+                    etype = ev.type
+                    if in_scope(ev.obj):
+                        if etype == "DELETED":
+                            sent.discard(key)
+                        else:
+                            # scope ENTRY (e.g. relabeled into the
+                            # selector) must read as ADDED to a scoped
+                            # watcher
+                            if key not in sent:
+                                etype = "ADDED"
+                            sent.add(key)
+                    elif key in sent:
+                        # scope EXIT: to this watcher the object is gone —
+                        # k8s scoped watches emit DELETED here, not
+                        # silence
                         sent.discard(key)
+                        etype = "DELETED"
                     else:
-                        # scope ENTRY (e.g. relabeled into the selector)
-                        # must read as ADDED to a scoped watcher
-                        if key not in sent:
-                            etype = "ADDED"
-                        sent.add(key)
-                elif key in sent:
-                    # scope EXIT: to this watcher the object is gone —
-                    # k8s scoped watches emit DELETED here, not silence
-                    sent.discard(key)
-                    etype = "DELETED"
-                else:
-                    continue  # never in scope for this stream
-                line = json.dumps({"type": etype, "object": ev.obj}) + "\n"
-                self.wfile.write(line.encode())
-                self.wfile.flush()
+                        continue  # never in scope for this stream
+                    out.append(
+                        json.dumps({"type": etype, "object": ev.obj}) + "\n"
+                    )
+                if out:
+                    # one write + flush per batch: fewer syscalls under
+                    # the bind storm
+                    self.wfile.write("".join(out).encode())
+                    self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:

@@ -235,6 +235,9 @@ def cmd_serve(args) -> int:
 
     if args.warmup:
         print(f"warmup compile done in {warm_oracle():.1f}s", flush=True)
+    from ..utils.runtime_tuning import freeze_startup
+
+    freeze_startup()
 
     _maybe_serve_metrics(args)
 
@@ -358,6 +361,9 @@ def cmd_sim(args) -> int:
             remote_scorer=scorer if oracle_client is not None else None,
         )
         print(f"oracle warmup compile: {elapsed:.1f}s", flush=True)
+    from ..utils.runtime_tuning import freeze_startup
+
+    freeze_startup()
 
     cluster.add_nodes(nodes)
     for pg in groups:
@@ -444,4 +450,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         interval = 0.02
     if interval > 0:
         sys.setswitchinterval(interval)
+    # GC thresholds are runtime tuning of the same kind (see
+    # utils.runtime_tuning); freeze_startup runs after each command's
+    # warmup so jit caches land in the frozen set too
+    from ..utils.runtime_tuning import apply_gc_tuning
+
+    apply_gc_tuning()
     return COMMANDS[args.command](args)

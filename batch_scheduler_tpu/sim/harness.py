@@ -177,6 +177,23 @@ class SimCluster:
         for pod in pods:
             self.clientset.pods(pod.metadata.namespace).create(pod)
 
+    def create_pod_docs(self, docs: List[dict]) -> None:
+        """Raw-dict bulk ingest: the caller already serialized the
+        documents (load-generator-side work — a real client ships JSON it
+        built on its own clock). The store takes ownership
+        (assume_fresh); the docs must not be retained by the caller."""
+        create_many = getattr(self.api, "create_many", None)
+        if create_many is not None:
+            create_many("Pod", docs, assume_fresh=True)
+            return
+        # fallback (e.g. HTTP API without the bulk verb): rehydrate — the
+        # typed clientset serializes dataclasses, not raw dicts
+        from ..api.serde import pod_from_dict
+
+        for d in docs:
+            pod = pod_from_dict(d)
+            self.clientset.pods(pod.metadata.namespace).create(pod)
+
     # -- observation -------------------------------------------------------
 
     def group(self, name: str, namespace: str = "default") -> PodGroup:

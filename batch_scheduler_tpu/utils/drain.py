@@ -22,15 +22,26 @@ def drain_queue(
     max_batch: int = DEFAULT_MAX_BATCH,
 ) -> Optional[List]:
     """One blocking get (``timeout`` seconds), then up to ``max_batch - 1``
-    non-blocking gets. Returns None when the blocking get times out."""
+    non-blocking gets. Returns None when the blocking get times out.
+
+    A queue item that is itself a list (the API server's batched
+    ``_notify_many`` fanout) is flattened transparently — consumers
+    always see a flat event list. ``max_batch`` bounds the FLATTENED
+    size: draining stops once the batch reaches it (the final item may
+    overshoot by one producer chunk, ≤256 events), so a consumer's
+    per-batch lock hold stays bounded under a 10k-event flood."""
     try:
         first = q.get(timeout=timeout)
     except _queue.Empty:
         return None
-    batch = [first]
-    for _ in range(max_batch - 1):
+    batch = list(first) if isinstance(first, list) else [first]
+    while len(batch) < max_batch:
         try:
-            batch.append(q.get_nowait())
+            item = q.get_nowait()
         except _queue.Empty:
             break
+        if isinstance(item, list):
+            batch.extend(item)
+        else:
+            batch.append(item)
     return batch
