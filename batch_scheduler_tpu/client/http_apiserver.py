@@ -17,6 +17,7 @@ from __future__ import annotations
 import http.client
 import json
 import queue
+import socket
 import threading
 from typing import Dict, List, Optional
 from urllib.parse import quote
@@ -35,8 +36,9 @@ __all__ = ["HTTPAPIServer"]
 
 
 class HTTPAPIServer:
-    """APIServer-interface client over HTTP (one connection per request;
-    watches hold a streaming connection + reader thread per subscription)."""
+    """APIServer-interface client over HTTP (persistent per-thread
+    request connections, client-go style; watches hold a streaming
+    connection + reader thread per subscription)."""
 
     def __init__(
         self,
@@ -47,6 +49,7 @@ class HTTPAPIServer:
         burst: int = 20,
         pg_qps: Optional[float] = None,
         pg_burst: int = 20,
+        batch_bind: bool = True,
     ):
         self.host = host
         self.port = port
@@ -68,11 +71,43 @@ class HTTPAPIServer:
         self._pg_limiter = (
             TokenBucket(pg_qps, pg_burst) if pg_qps is not None else None
         )
+        # ``batch_bind=False`` forces per-pod PATCH binds (measurement
+        # control: quantifies what the pods:bindmany verb buys at a fixed
+        # client QPS — benchmarks/http_e2e.py)
+        self._batch_bind = batch_bind
         # id(queue) -> {"conn", "resp", "thread", "stop"} (see watch())
         self._watches: Dict[int, dict] = {}
         self._lock = threading.Lock()
+        # per-thread persistent connection for request/response verbs
+        # (client-go keeps connections alive the same way); watches use
+        # their own streaming connections
+        self._local = threading.local()
 
     # -- request plumbing --------------------------------------------------
+
+    def _conn(self) -> http.client.HTTPConnection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout
+            )
+            conn.connect()
+            # small request/response exchanges on a kept-alive connection
+            # hit the Nagle/delayed-ACK stall (~40ms each) without this
+            conn.sock.setsockopt(
+                socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+            )
+            self._local.conn = conn
+        return conn
+
+    def _drop_conn(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            self._local.conn = None
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _request(
         self,
@@ -85,24 +120,42 @@ class HTTPAPIServer:
         if kind == "PodGroup" and self._pg_limiter is not None:
             limiter = self._pg_limiter
         limiter.acquire()
-        conn = http.client.HTTPConnection(self.host, self.port, timeout=self.timeout)
-        try:
-            payload = None if body is None else json.dumps(body)
-            headers = {"Content-Type": "application/json"} if payload else {}
-            conn.request(method, path, body=payload, headers=headers)
-            resp = conn.getresponse()
-            data = json.loads(resp.read() or b"{}")
-            if resp.status == 404:
-                raise NotFoundError(data.get("message", path))
-            if resp.status == 409:
-                if data.get("reason") == "Conflict":
-                    raise ConflictError(data.get("message", path))
-                raise AlreadyExistsError(data.get("message", path))
-            if resp.status >= 400:
-                raise RuntimeError(f"{method} {path}: {resp.status} {data}")
-            return data
-        finally:
-            conn.close()
+        payload = None if body is None else json.dumps(body)
+        headers = {"Content-Type": "application/json"} if payload else {}
+        # one reconnect retry: a kept-alive connection the server closed
+        # between requests (restart, idle timeout) surfaces as a
+        # connection-level error; a fresh connection disambiguates a real
+        # outage from a stale socket. A failure AFTER the request bytes
+        # went out may mean the server applied it with only the response
+        # lost, so post-send retries are limited to verbs safe to
+        # double-apply — a re-sent POST could turn a lost create response
+        # into a spurious AlreadyExists. PATCH qualifies ONLY because
+        # every patch through this client is an RFC 7386 merge patch
+        # (absolute field values, idempotent); a future read-modify-write
+        # or JSON-patch verb must come off this list.
+        idempotent = method in ("GET", "PUT", "PATCH", "DELETE")
+        for attempt in (0, 1):
+            conn = self._conn()
+            sent = False
+            try:
+                conn.request(method, path, body=payload, headers=headers)
+                sent = True
+                resp = conn.getresponse()
+                data = json.loads(resp.read() or b"{}")
+                break
+            except (OSError, http.client.HTTPException, ValueError):
+                self._drop_conn()
+                if attempt or (sent and not idempotent):
+                    raise
+        if resp.status == 404:
+            raise NotFoundError(data.get("message", path))
+        if resp.status == 409:
+            if data.get("reason") == "Conflict":
+                raise ConflictError(data.get("message", path))
+            raise AlreadyExistsError(data.get("message", path))
+        if resp.status >= 400:
+            raise RuntimeError(f"{method} {path}: {resp.status} {data}")
+        return data
 
     @staticmethod
     def _collection_path(kind: str, namespace: Optional[str]) -> str:
@@ -166,6 +219,36 @@ class HTTPAPIServer:
         return self._request(
             "PATCH", self._object_path(kind, namespace, name), patch, kind=kind
         )
+
+    def bind_pods(self, namespace: str, pairs) -> List[str]:
+        """Batched bind over the wire: ONE request (one throttle token)
+        for a whole released gang, via the gateway's ``pods:bindmany``
+        custom verb — the cross-gang commit flush's per-gang API-pass
+        amortization carried over HTTP (Clientset.bind_many dispatches
+        here via the ``bind_pods`` duck type). Falls back to per-pod
+        PATCH binds against a gateway without the route (404), keeping
+        the bind_many contract: returns names bound, skips missing."""
+        if self._batch_bind:
+            path = self._collection_path("Pod", namespace) + ":bindmany"
+            try:
+                return self._request(
+                    "POST", path, {"binds": [[n, node] for n, node in pairs]}
+                )["bound"]
+            except NotFoundError:
+                # gateway without the batch verb: remember (capability
+                # discovered once, client-go style) so later flushes skip
+                # the deterministic 404 round trip + throttle token
+                self._batch_bind = False
+        bound = []
+        for name, node in pairs:
+            try:
+                self.patch(
+                    "Pod", namespace, name, {"spec": {"node_name": node}}
+                )
+            except NotFoundError:
+                continue
+            bound.append(name)
+        return bound
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         self._request(
@@ -319,3 +402,7 @@ class HTTPAPIServer:
             self._watches.clear()
         for entry in entries:
             self._close_entry(entry)
+        # persistent request connections are per-thread; only the calling
+        # thread's can be closed here (the others close when their threads
+        # exit), but that covers the common single-threaded-client case
+        self._drop_conn()

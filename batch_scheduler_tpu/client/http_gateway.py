@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import queue
+import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
@@ -66,12 +67,26 @@ def _parse_resource(path: str) -> Optional[Tuple[str, Optional[str], Optional[st
 
 
 class _Handler(BaseHTTPRequestHandler):
-    # Streaming watch needs per-request flushing, not buffered responses.
-    protocol_version = "HTTP/1.0"
+    # HTTP/1.1: persistent connections for the request/response verbs
+    # (client-go parity — one TCP handshake per client, not per request;
+    # per-request connections flooded the kernel with TIME_WAIT sockets
+    # at e2e scale). Responses carry Content-Length, so keep-alive works;
+    # the watch stream opts out with Connection: close below.
+    protocol_version = "HTTP/1.1"
+    # persistent connections make the Nagle/delayed-ACK interaction
+    # visible (~40ms per small request/response exchange): disable Nagle
+    # like every production HTTP server does
+    disable_nagle_algorithm = True
     api: APIServer = None  # set by serve_gateway subclass
 
     def log_message(self, *args) -> None:  # quiet
         pass
+
+    def parse_request(self) -> bool:
+        # per-request state on a persistent connection: the handler
+        # instance is reused across keep-alive requests
+        self._body_read = False
+        return super().parse_request()
 
     # -- helpers -----------------------------------------------------------
 
@@ -86,6 +101,15 @@ class _Handler(BaseHTTPRequestHandler):
     def _send_error_json(
         self, code: int, message: str, reason: str = ""
     ) -> None:
+        # keep-alive hygiene: an error response sent before the request
+        # body was read leaves the body bytes in the stream, and the next
+        # request on this persistent connection would parse them as its
+        # request line — drain them first
+        if not getattr(self, "_body_read", False):
+            length = int(self.headers.get("Content-Length") or 0)
+            if length > 0:
+                self.rfile.read(length)
+                self._body_read = True
         self._send_json(
             code,
             {"kind": "Status", "code": code, "message": message, "reason": reason},
@@ -93,6 +117,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _read_body(self) -> dict:
         length = int(self.headers.get("Content-Length") or 0)
+        self._body_read = True
         return json.loads(self.rfile.read(length) or b"{}")
 
     def _selector(self, qs) -> Optional[dict]:
@@ -162,6 +187,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "identity")
+        # a watch stream has no length and ends only when a side closes:
+        # it cannot ride a keep-alive connection
+        self.send_header("Connection", "close")
+        self.close_connection = True
         self.end_headers()
         sent: set = set()  # keys this stream has delivered as in-scope
         try:
@@ -218,6 +247,26 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self) -> None:
         url = urlparse(self.path)
+        if url.path.endswith("/pods:bindmany"):
+            # batched bind subresource: one request binds a whole released
+            # gang (the k8s custom-verb path shape). Body
+            # {"binds": [[name, node], ...]} -> {"bound": [names]}; missing
+            # pods are skipped, matching APIServer.bind_pods. Without this
+            # route the cross-gang commit flush's one-API-pass amortization
+            # evaporates over the wire into per-pod PATCHes.
+            parsed = _parse_resource(url.path[: -len(":bindmany")])
+            if parsed is None or parsed[0] != "Pod":
+                self._send_error_json(404, f"unknown path {url.path}")
+                return
+            ns = parsed[1] or "default"
+            body = self._read_body()
+            pairs = [(b[0], b[1]) for b in body.get("binds", [])]
+            bind_pods = getattr(self.api, "bind_pods", None)
+            if bind_pods is None:
+                self._send_error_json(404, "bind batch unsupported")
+                return
+            self._send_json(200, {"bound": bind_pods(ns, pairs)})
+            return
         if url.path == CRD_PATH:
             body = self._read_body()
             created = self.api.ensure_crd(
@@ -284,6 +333,41 @@ class _Handler(BaseHTTPRequestHandler):
 class GatewayServer(ThreadingHTTPServer):
     daemon_threads = True
     allow_reuse_address = True
+
+    # With HTTP/1.1 keep-alive, shutdown()+server_close() only stop the
+    # ACCEPT loop — daemon handler threads would keep serving persistent
+    # connections straight through a "restart", silently defeating outage
+    # tests (and leaking zombie handlers). Track live connections and
+    # sever them at close, like a real server death would.
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._live_conns: set = set()
+        self._conn_lock = threading.Lock()
+
+    def process_request(self, request, client_address):
+        with self._conn_lock:
+            self._live_conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conn_lock:
+            self._live_conns.discard(request)
+        super().shutdown_request(request)
+
+    def server_close(self):
+        super().server_close()
+        with self._conn_lock:
+            conns = list(self._live_conns)
+            self._live_conns.clear()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
 
 def serve_gateway(

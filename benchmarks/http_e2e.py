@@ -15,8 +15,10 @@ client, as the workload controllers that create pods are separate actors
 with their own flow control.
 
 Run from the repo root: ``python benchmarks/http_e2e.py`` — prints one
-JSON line (artifact: HTTP_E2E_r04.json). CPU-only: this measures the
-control plane over the wire, not the oracle.
+JSON line (artifact: HTTP_E2E_r05.json). The headline run uses the
+batched ``pods:bindmany`` verb; two extra no-restart passes report
+pods/s with and without batching at the same client throttle. CPU-only:
+this measures the control plane over the wire, not the oracle.
 """
 
 from __future__ import annotations
@@ -33,11 +35,12 @@ NUM_GANGS = 100
 MEMBERS = 10
 
 
-def main() -> int:
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-
+def run_once(restart: bool = True, batch_bind: bool = True):
+    """One full pass: schedule 100 gangs x 10 pods over the gateway.
+    ``restart`` forces the mid-run gateway kill; ``batch_bind`` toggles
+    the client's pods:bindmany verb (False = per-pod PATCH binds at the
+    SAME client QPS, the measurement control). Returns
+    (ok, elapsed_s, detail)."""
     from batch_scheduler_tpu.client.apiserver import APIServer
     from batch_scheduler_tpu.client.http_apiserver import HTTPAPIServer
     from batch_scheduler_tpu.client.http_gateway import serve_gateway
@@ -55,7 +58,13 @@ def main() -> int:
     # the scheduler's client: kube-scheduler-default flow control for the
     # core kinds, the reference's 10/20 throttle for PodGroup verbs
     api = HTTPAPIServer(
-        host, port, qps=50.0, burst=100, pg_qps=10.0, pg_burst=20
+        host,
+        port,
+        qps=50.0,
+        burst=100,
+        pg_qps=10.0,
+        pg_burst=20,
+        batch_bind=batch_bind,
     )
     # load generation is a separate actor with its own client
     loadgen = HTTPAPIServer(host, port, qps=500.0, burst=500)
@@ -66,6 +75,11 @@ def main() -> int:
         oracle_background_refresh=True,
         backoff_base=0.2,
         backoff_cap=2.0,
+        # same re-batch pacing the ladder's framework e2e deploys with:
+        # without it, reflector event churn dirties the batch per burst
+        # and the refresh daemon re-computes ~900 batches/run (measured),
+        # GIL time that shows up as ±40s run variance
+        min_batch_interval=1.0,
     )
     nodes = [
         make_sim_node(f"h{i:03d}", {"cpu": "64", "memory": "256Gi", "pods": "110"})
@@ -105,19 +119,27 @@ def main() -> int:
             loadgen.create("Pod", to_dict(pod))
 
     # -- forced gateway restart mid-run ---------------------------------
-    cluster.wait_for(
-        lambda: cluster.scheduler.stats["binds"] >= restart_at,
-        timeout=120.0,
-        interval=0.05,
-    )
-    binds_before_restart = cluster.scheduler.stats["binds"]
-    t_kill = time.perf_counter()
-    server.shutdown()
-    server.server_close()
-    outage_s = 0.5  # the control plane is dark for this long
-    time.sleep(outage_s)
-    server = serve_gateway(backing, host, port)  # same port, same store
-    t_restored = time.perf_counter()
+    restart_detail = None
+    if restart:
+        cluster.wait_for(
+            lambda: cluster.scheduler.stats["binds"] >= restart_at,
+            timeout=120.0,
+            interval=0.05,
+        )
+        binds_before_restart = cluster.scheduler.stats["binds"]
+        t_kill = time.perf_counter()
+        server.shutdown()
+        server.server_close()
+        outage_s = 0.5  # the control plane is dark for this long
+        time.sleep(outage_s)
+        server = serve_gateway(backing, host, port)  # same port, same store
+        t_restored = time.perf_counter()
+        restart_detail = {
+            "binds_before": binds_before_restart,
+            "outage_s": outage_s,
+            "at_s": round(t_kill - t0, 3),
+            "restored_at_s": round(t_restored - t0, 3),
+        }
 
     # completion judged from the BACKING STORE, not the scheduler's own
     # counters: a bind whose request applied but whose response was lost
@@ -149,12 +171,8 @@ def main() -> int:
         "nodes": NUM_NODES,
         "client_qps_burst": [50.0, 100],
         "pg_client_qps_burst": [10.0, 20],
-        "gateway_restart": {
-            "binds_before": binds_before_restart,
-            "outage_s": outage_s,
-            "at_s": round(t_kill - t0, 3),
-            "restored_at_s": round(t_restored - t0, 3),
-        },
+        "bind_batching": batch_bind,
+        "gateway_restart": restart_detail,
         "oracle_batches": oracle.batches_run,
         "permit_rejects": stats["permit_rejects"],
         "unschedulable_retries": stats["unschedulable"],
@@ -214,6 +232,82 @@ def main() -> int:
     loadgen.close()
     server.shutdown()
     server.server_close()
+    return ok and bound_in_store == total, elapsed, detail
+
+
+def _run_subprocess(mode: str) -> dict:
+    """One pass in a FRESH interpreter: repeated passes in one process
+    measure each other's residue (accumulated heap, lingering gateway
+    handler threads), not the framework — comparison runs must each see
+    clean-process conditions."""
+    import subprocess
+
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--mode", mode],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    if r.returncode != 0:
+        return {"ok": False, "error": (r.stderr or "")[-400:]}
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--mode",
+        choices=["headline", "batched", "per_pod"],
+        default=None,
+        help="run ONE pass and print its JSON (used by the orchestrator)",
+    )
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    if args.mode is not None:
+        ok, elapsed, detail = run_once(
+            restart=args.mode == "headline",
+            batch_bind=args.mode != "per_pod",
+        )
+        print(
+            json.dumps(
+                {
+                    "ok": ok,
+                    "elapsed_s": round(elapsed, 3),
+                    "pods_per_sec": detail["pods_per_sec"],
+                    "detail": detail,
+                }
+            )
+        )
+        return 0 if ok else 1
+
+    # headline: batched binds + the forced mid-run gateway restart
+    ok, elapsed, detail = run_once(restart=True, batch_bind=True)
+    # batching comparison at the SAME client QPS/burst, no restart so the
+    # outage window doesn't confound the delta, each in a fresh process:
+    # the batch verb spends one throttle token per gang flush instead of
+    # one per pod
+    res_b = _run_subprocess("batched")
+    res_p = _run_subprocess("per_pod")
+    detail["bind_batching_comparison"] = {
+        "batched": {
+            k: res_b.get(k) for k in ("ok", "elapsed_s", "pods_per_sec")
+        },
+        "per_pod": {
+            k: res_p.get(k) for k in ("ok", "elapsed_s", "pods_per_sec")
+        },
+        "note": (
+            "same client throttle both ways (50 QPS/100 burst core, "
+            "10/20 PodGroup), no restart, each pass in a fresh process; "
+            "headline run is batched"
+        ),
+    }
 
     print(
         json.dumps(
@@ -225,8 +319,9 @@ def main() -> int:
             }
         )
     )
-    assert ok and bound_in_store == total, (
-        f"store shows {bound_in_store}/{total} bound: {stats}"
+    assert ok, f"headline run incomplete: {detail}"
+    assert res_b.get("ok") and res_p.get("ok"), (
+        f"batching comparison runs incomplete: {res_b} {res_p}"
     )
     return 0
 

@@ -86,6 +86,33 @@ def test_crud_and_crd_over_http(remote):
         cs.podgroups().get("web")
 
 
+def test_bind_many_batched_over_http(remote):
+    """The pods:bindmany custom verb: one request binds many pods,
+    missing pods are skipped, and Clientset.bind_many dispatches to it
+    via the bind_pods duck type. The per-pod fallback path
+    (batch_bind=False) must agree bit-for-bit."""
+    api, _ = remote
+    cs = Clientset(api)
+    for name in ("bm-0", "bm-1", "bm-2"):
+        cs.pods().create(make_pod(name))
+    bound = cs.pods().bind_many(
+        [("bm-0", "n1"), ("ghost", "n1"), ("bm-1", "n2")]
+    )
+    assert bound == ["bm-0", "bm-1"]
+    assert cs.pods().get("bm-0").spec.node_name == "n1"
+    assert cs.pods().get("bm-1").spec.node_name == "n2"
+    assert not cs.pods().get("bm-2").spec.node_name
+    # measurement-control path: same contract without the batch verb
+    api._batch_bind = False
+    try:
+        assert api.bind_pods("default", [("bm-2", "n3"), ("ghost", "n3")]) == [
+            "bm-2"
+        ]
+    finally:
+        api._batch_bind = True
+    assert cs.pods().get("bm-2").spec.node_name == "n3"
+
+
 def test_watch_streams_over_http(remote):
     api, _ = remote
     cs = Clientset(api)
