@@ -1,5 +1,10 @@
 from .distributed import global_mesh, init_distributed
-from .mesh import make_mesh, shard_snapshot_args, sharded_schedule_batch
+from .mesh import (
+    make_mesh,
+    shard_snapshot_args,
+    sharded_collective_counts,
+    sharded_schedule_batch,
+)
 
 __all__ = [
     "global_mesh",
@@ -7,4 +12,5 @@ __all__ = [
     "make_mesh",
     "shard_snapshot_args",
     "sharded_schedule_batch",
+    "sharded_collective_counts",
 ]

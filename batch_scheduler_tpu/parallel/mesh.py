@@ -24,7 +24,39 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import oracle as okern
 
-__all__ = ["make_mesh", "shard_snapshot_args", "sharded_schedule_batch"]
+__all__ = [
+    "make_mesh",
+    "shard_snapshot_args",
+    "sharded_schedule_batch",
+    "sharded_collective_counts",
+    "count_collective_instructions",
+    "COLLECTIVES",
+]
+
+# collective op mnemonics as they appear in compiled HLO instruction lines
+# (single shared tuple — benchmarks/sharding_scaling.py counts with the
+# same heuristic through count_collective_instructions below)
+COLLECTIVES = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+
+def count_collective_instructions(hlo_text: str) -> dict:
+    """Per-op counts of collective INSTRUCTION sites in compiled HLO.
+    Line-based on instruction forms (``%x = ... all-gather(...)`` and the
+    async ``-start`` variant), not incidental metadata mentions."""
+    counts = {}
+    for op in COLLECTIVES:
+        counts[op] = sum(
+            1
+            for line in hlo_text.splitlines()
+            if f" {op}(" in line or f"{op}-start(" in line
+        )
+    return counts
 
 
 def _factor_devices(n: int) -> tuple:
@@ -120,3 +152,24 @@ def sharded_schedule_batch(mesh: Mesh, args: tuple, replicated_scan: bool = True
     return okern.schedule_batch(
         *sharded, scan_mesh=mesh if replicated_scan else None
     )
+
+
+def sharded_collective_counts(
+    mesh: Mesh, args: tuple, replicated_scan: bool = True
+) -> dict:
+    """Collective INSTRUCTIONS in the compiled sharded module, by op.
+
+    The replicated-scan layout's contract is a one-time handful of
+    collectives for the whole batch (scoring all-gathers + the scan-input
+    replication), not per-scan-step traffic — GSPMD partitioning bugs at
+    large/uneven shard shapes typically show up as op-count explosions
+    here before they show up as wrong numbers."""
+    sharded = shard_snapshot_args(mesh, args)
+    hlo = (
+        okern.schedule_batch.lower(
+            *sharded, scan_mesh=mesh if replicated_scan else None
+        )
+        .compile()
+        .as_text()
+    )
+    return count_collective_instructions(hlo)

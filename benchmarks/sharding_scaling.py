@@ -46,13 +46,10 @@ import time
 
 import numpy as np
 
-COLLECTIVES = (
-    "all-reduce",
-    "all-gather",
-    "reduce-scatter",
-    "collective-permute",
-    "all-to-all",
+from batch_scheduler_tpu.parallel.mesh import (  # noqa: E402
+    count_collective_instructions,
 )
+
 ITERS = 5
 
 
@@ -80,17 +77,10 @@ def time_batch(args, **kw) -> float:
 def collective_counts(args, **kw) -> dict:
     from batch_scheduler_tpu.ops.oracle import schedule_batch
 
+    # single shared heuristic (parallel.mesh): args arrive pre-sharded
+    # by the variant under measurement
     hlo = schedule_batch.lower(*args, **kw).compile().as_text()
-    counts = {}
-    for op in COLLECTIVES:
-        # count op *instructions* (lines like "%x = ... all-gather(...)"),
-        # not incidental mentions in metadata
-        counts[op] = sum(
-            1
-            for line in hlo.splitlines()
-            if f" {op}(" in line or f"{op}-start(" in line
-        )
-    return counts
+    return count_collective_instructions(hlo)
 
 
 def main() -> int:

@@ -4,6 +4,7 @@ single-device result."""
 
 import jax
 import numpy as np
+import pytest
 
 from batch_scheduler_tpu.ops import ClusterSnapshot, GroupDemand, schedule_batch
 from batch_scheduler_tpu.parallel import make_mesh, sharded_schedule_batch
@@ -40,6 +41,72 @@ def test_sharded_batch_matches_single_device():
     mesh = make_mesh(8)
     sharded = jax.device_get(sharded_schedule_batch(mesh, snap.device_args()))
 
+    for key in ("gang_feasible", "placed", "capacity", "assignment"):
+        np.testing.assert_array_equal(
+            np.asarray(single[key]), np.asarray(sharded[key]), err_msg=key
+        )
+
+
+@pytest.mark.parametrize(
+    "num_nodes,num_groups",
+    [
+        (64, 32),  # even tiny shards
+        (100, 24),  # uneven node shards (100 pads to 128, splits 4-way)
+    ],
+)
+def test_sharded_equivalence_across_shapes(num_nodes, num_groups):
+    """GSPMD partitioning bugs are notoriously shape-dependent (tile
+    boundaries, uneven shards): the sharded batch must match the
+    single-device batch bit-for-bit across shard layouts. (The padded
+    north-star production bucket gets its own combined test below.)"""
+    snap = _snapshot(num_nodes=num_nodes, num_groups=num_groups)
+    args = snap.device_args()
+    single = jax.device_get(schedule_batch(*args))
+    mesh = make_mesh(8)
+    sharded = jax.device_get(sharded_schedule_batch(mesh, args))
+    for key in ("gang_feasible", "placed", "capacity", "assignment"):
+        np.testing.assert_array_equal(
+            np.asarray(single[key]), np.asarray(sharded[key]), err_msg=key
+        )
+
+
+def test_north_star_bucket_equivalence_and_collectives():
+    """The padded north-star production bucket (5k nodes / 1k groups ->
+    [G=1024, N=8192]) compiled ONCE, then both checks against that one
+    compiled object (VERDICT r4 item 4):
+
+    - placements match the single-device batch bit-for-bit (GSPMD
+      partitioning bugs are shape-dependent — tile boundaries, uneven
+      shards — so the toy-shape equivalence above proves nothing here);
+    - the compiled module carries only the one-time handful of
+      collectives (scoring all-gathers + scan-input replication),
+      nothing per scan step — a partitioning regression shows up as an
+      op-count explosion (the fully-partitioned scan variant measures
+      ~50 collective sites) before it shows up as wrong placements.
+
+    Slow on the 8-way virtual CPU mesh (~1 min: eight replicas share one
+    host) — correctness at the production shape is the point."""
+    from batch_scheduler_tpu.ops import oracle as okern
+    from batch_scheduler_tpu.parallel import shard_snapshot_args
+    from batch_scheduler_tpu.parallel.mesh import (
+        count_collective_instructions,
+    )
+
+    snap = _snapshot(num_nodes=5000, num_groups=1000)
+    args = snap.device_args()
+    single = jax.device_get(schedule_batch(*args))
+
+    mesh = make_mesh(8)
+    sharded_args = shard_snapshot_args(mesh, args)
+    compiled = okern.schedule_batch.lower(
+        *sharded_args, scan_mesh=mesh
+    ).compile()
+
+    counts = count_collective_instructions(compiled.as_text())
+    total = sum(counts.values())
+    assert 0 < total <= 16, counts
+
+    sharded = jax.device_get(compiled(*sharded_args))
     for key in ("gang_feasible", "placed", "capacity", "assignment"):
         np.testing.assert_array_equal(
             np.asarray(single[key]), np.asarray(sharded[key]), err_msg=key
