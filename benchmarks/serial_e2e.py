@@ -108,7 +108,7 @@ def main() -> int:
                     ),
                     "oracle_fast_lane_comparison": (
                         "same stack with --scorer oracle does 10k pods / "
-                        "5k nodes in ~1.1-1.6s (LADDER_r04 config 6)"
+                        "5k nodes in ~0.6-0.9s (LADDER_r05 config 6)"
                     ),
                 },
             }
