@@ -84,16 +84,30 @@ def test_release_allows_every_matched_waiting_pod():
 def test_release_drops_stale_pair_when_waiting_pod_never_appears():
     """The permit signal racing ahead of the framework cache: after the
     retries exhaust, the stale (uid, pair) is dropped instead of blocking
-    the release loop forever (reference batchscheduler.go:316-323)."""
-    plugin, handle, op, cache, pods = _build()
+    the release loop forever (reference batchscheduler.go:316-323) — and
+    the sweep CONTINUES past it. The reference returns, stranding every
+    not-yet-allowed member in its Permit wait until the full timeout
+    with no further release signal coming (the ~100s stragglers in the
+    gateway-restart e2e); the pairs are independent, so the raced one is
+    dropped and the rest are still allowed. Deviation, not copied."""
+    plugin, handle, op, cache, pods = _build(members=3)
     _permit_all(plugin, op, pods)
-    # only pod 1 is in the framework's waiting cache; pod 0 never shows
+    # pods 1 and 2 are parked in the framework's waiting cache; pod 0
+    # never shows (its wait resolved before the sweep saw it)
     wp1 = _StubWaitingPod(pods[1])
-    handle.pods = {pods[1].metadata.uid: wp1}
+    wp2 = _StubWaitingPod(pods[2])
+    handle.pods = {
+        pods[1].metadata.uid: wp1,
+        pods[2].metadata.uid: wp2,
+    }
 
     plugin.start_batch_schedule("default/gang")
     pairs = op.get_pod_node_pairs("default/gang")
     assert pairs.get(pods[0].metadata.uid) is None  # stale pair dropped
+    # the remaining parked members were NOT abandoned
+    assert wp1.allowed == 1 and wp2.allowed == 1, (wp1.allowed, wp2.allowed)
+    assert pairs.get(pods[1].metadata.uid) is None
+    assert pairs.get(pods[2].metadata.uid) is None
 
 
 def test_update_batch_cache_evicts_replaced_uid():
