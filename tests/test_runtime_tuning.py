@@ -1,0 +1,54 @@
+"""Interpreter-tuning knobs (utils/runtime_tuning.py): env parsing and
+restore discipline — the measured framework is the deployed framework,
+so the knobs must apply and fail-safe exactly as documented."""
+
+import gc
+
+import pytest
+
+from batch_scheduler_tpu.utils.runtime_tuning import (
+    _DEFAULT,
+    apply_gc_tuning,
+    freeze_startup,
+)
+
+
+@pytest.fixture
+def restore_gc():
+    prev = gc.get_threshold()
+    yield
+    gc.set_threshold(*prev)
+    gc.unfreeze()
+
+
+def test_default_thresholds_applied(restore_gc, monkeypatch):
+    monkeypatch.delenv("BST_GC_THRESHOLD", raising=False)
+    apply_gc_tuning()
+    assert gc.get_threshold() == _DEFAULT
+
+
+def test_env_override_and_zero_disables(restore_gc, monkeypatch):
+    monkeypatch.setenv("BST_GC_THRESHOLD", "1234,56,78")
+    apply_gc_tuning()
+    assert gc.get_threshold() == (1234, 56, 78)
+
+    prev = gc.get_threshold()
+    monkeypatch.setenv("BST_GC_THRESHOLD", "0")
+    apply_gc_tuning()  # "0" keeps whatever is set — no change
+    assert gc.get_threshold() == prev
+
+
+@pytest.mark.parametrize("bad", ["nope", "1,2", "1,2,3,4", "-5,1,1", "0,0,0"])
+def test_malformed_env_falls_back_to_default(restore_gc, monkeypatch, bad):
+    monkeypatch.setenv("BST_GC_THRESHOLD", bad)
+    apply_gc_tuning()
+    assert gc.get_threshold() == _DEFAULT
+
+
+def test_freeze_startup_moves_objects_out_of_gc(restore_gc):
+    freeze_startup()
+    try:
+        assert gc.get_freeze_count() > 0
+    finally:
+        gc.unfreeze()
+    assert gc.get_freeze_count() == 0
