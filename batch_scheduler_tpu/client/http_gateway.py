@@ -11,6 +11,7 @@ simulated clusters serve exactly these paths).
 
 Routes:
   /api/v1/namespaces/{ns}/pods[/{name}]
+  /api/v1/namespaces/{ns}/pods:bindmany  (POST: batched bind custom verb)
   /api/v1/nodes[/{name}]
   /apis/batch.scheduler.tpu/v1/namespaces/{ns}/podgroups[/{name}]
   /apis/apiextensions.k8s.io/v1/customresourcedefinitions
