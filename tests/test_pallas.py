@@ -61,6 +61,27 @@ def test_pallas_matches_scan_per_group_mask_fuzz():
         _run_both(left, group_req, remaining, mask, order)
 
 
+def test_pallas_matches_scan_bucketed_shapes_and_edge_values():
+    """Equivalence at BUCKETED shapes (the sizes production actually
+    compiles — shape-dependent bugs are the class that bit GSPMD) with
+    adversarial value patterns: saturated nodes, zero-remaining rows,
+    values near the LANE_MAX domain bound. Fixed shape set keeps the
+    interpret-mode compile count bounded."""
+    rng = np.random.default_rng(23)
+    for n, g, r in ((64, 16, 3), (128, 32, 5)):
+        left = rng.integers(0, 40, size=(n, r)).astype(np.int32)
+        left[: n // 4] = 0  # saturated nodes
+        left[n // 4] = 2**29  # near the lane domain bound
+        group_req = rng.integers(0, 6, size=(g, r)).astype(np.int32)
+        group_req[0] = 0  # zero-demand gang
+        remaining = rng.integers(0, 10, size=g).astype(np.int32)
+        remaining[1] = 0  # nothing left to place
+        order = rng.permutation(g).astype(np.int32)
+        mask = rng.random((g, n)) < 0.7
+        mask[2, :] = False  # fully masked-out gang
+        _run_both(left, group_req, remaining, mask, order)
+
+
 def test_pallas_per_group_mask_selector_semantics():
     """A gang selecting one zone places only on its nodes even when the
     other zone has more room (the fit-mask contract the [G,N] path owns)."""
