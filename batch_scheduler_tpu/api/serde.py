@@ -111,7 +111,9 @@ def pod_from_dict(d: dict) -> Pod:
             priority=spec.get("priority") or 0,
             node_name=spec.get("node_name") or "",
         ),
-        status=PodStatus(phase=PodPhase(status.get("phase", "Pending"))),
+        # explicit null is as legal as a missing field here (same contract
+        # as priority above; the raw-path consumers normalize identically)
+        status=PodStatus(phase=PodPhase(status.get("phase") or "Pending")),
     )
 
 

@@ -227,39 +227,51 @@ class BatchSchedulingPlugin:
         if len(pending_map) < needed:
             return
 
-        # the retry-sleep grace (framework cache catching up to the permit
-        # signal) is paid at most ONCE per sweep: after one pair has had
-        # the full grace and stayed missing, further missing pairs drop
-        # immediately — a mostly-stale big gang must not serially stall
-        # the single reconcile thread ~20ms per member
-        grace_spent = False
+        # Two-pass sweep. Pass 1 allows every pair whose waiting pod is
+        # already visible (no sleeping). Pass 2 gives ALL the misses one
+        # shared retry grace — each pair's WaitingPod materialises
+        # independently in the permit-signal/park gap, so every pair gets
+        # the full grace, while the sweep's total sleep stays one grace
+        # period regardless of gang size (a mostly-stale big gang must
+        # not serially stall the single reconcile thread per member).
+        # Pairs still missing after the grace are dropped — but the sweep
+        # CONTINUES. The reference RETURNS on the first miss
+        # (batchscheduler.go:316-323), abandoning every not-yet-allowed
+        # member to its full Permit timeout with no further release
+        # signal coming (the quorum event already happened — found as the
+        # ~100s stragglers in the gateway-restart e2e). The pairs are
+        # independent TTL entries; one raced pod says nothing about the
+        # rest. Deviation, not copied.
+        def consume(uid, pair, waiting_pod) -> None:
+            # allow() returning False means the wait already resolved
+            # (timeout/reject) — that is permanent, so never retry;
+            # either way the pair is consumed
+            waiting_pod.allow(self.name)
+            pending.delete(uid)
+            pending_ids.delete(pair.pod_name)
+
+        missing = []
         for uid, pair in pending_map.items():
             waiting_pod = self.handle.get_waiting_pod(uid)
-            if waiting_pod is None and not grace_spent:
-                for _ in range(GET_WAIT_POD_RETRIES - 1):
-                    time.sleep(GET_WAIT_POD_SLEEP)
-                    waiting_pod = self.handle.get_waiting_pod(uid)
-                    if waiting_pod is not None:
-                        break
-                else:
-                    grace_spent = True
             if waiting_pod is None:
-                # signal raced ahead of the framework cache: drop the
-                # stale pair (reference batchscheduler.go:316-323) — but
-                # keep sweeping. The reference RETURNS here, abandoning
-                # every not-yet-allowed member to its full Permit timeout
-                # (no further release signal fires: the quorum event
-                # already happened — found as the ~100s stragglers in the
-                # gateway-restart e2e). The pairs are independent TTL
-                # entries; one raced pod says nothing about the rest.
-                # Deviation, not copied.
-                pending.delete(uid)
-                pending_ids.delete(pair.pod_name)
-                continue
-            # allow() returning False means the wait already resolved
-            # (timeout/reject) — that is permanent, so never retry; either
-            # way this pair is consumed
-            waiting_pod.allow(self.name)
+                missing.append((uid, pair))
+            else:
+                consume(uid, pair, waiting_pod)
+        for attempt in range(GET_WAIT_POD_RETRIES - 1):
+            if not missing:
+                break
+            time.sleep(GET_WAIT_POD_SLEEP)
+            still = []
+            for uid, pair in missing:
+                waiting_pod = self.handle.get_waiting_pod(uid)
+                if waiting_pod is None:
+                    still.append((uid, pair))
+                else:
+                    consume(uid, pair, waiting_pod)
+            missing = still
+        for uid, pair in missing:
+            # raced ahead of the framework cache for the whole grace:
+            # drop the stale pair (reference batchscheduler.go:316-323)
             pending.delete(uid)
             pending_ids.delete(pair.pod_name)
 

@@ -63,15 +63,15 @@ def resolve_platform():
     platform, err = _resolve(deadline_s=deadline)
     if platform != "tpu" and err is not None:
         # err None means no probe ran (deliberate JAX_PLATFORMS pin) —
-        # only a genuinely exhausted/failed probe warrants the reminder.
-        # the capture strategy depends on a human/agent having started the
-        # detached tunnel watcher; when the probe exhausts its budget, say
-        # so where the round log will surface it
+        # only a genuinely failed probe warrants the reminder. Include the
+        # failure itself: a plugin/import error needs different diagnosis
+        # than a hung tunnel, and the capture strategy depends on reading
+        # this signal correctly.
         print(
-            "bench: TPU probe exhausted its budget — ensure the tunnel "
-            "watcher is running (nohup benchmarks/capture_tpu_artifacts.sh "
-            "via a probe loop) so hardware artifacts land when the tunnel "
-            "answers",
+            f"bench: TPU probe did not yield a TPU ({err}) — if this is "
+            "the hung tunnel, ensure the watcher is running (nohup probe "
+            "loop firing benchmarks/capture_tpu_artifacts.sh) so hardware "
+            "artifacts land when it answers",
             file=sys.stderr,
         )
     return platform, err

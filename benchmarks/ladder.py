@@ -487,20 +487,6 @@ def config6_framework_e2e(num_nodes=5000, num_groups=1000, members=10):
     # bucket shapes outside the clock. The measured wall below is the
     # steady-state framework, not XLA's first compile.
     warm_s = warm_oracle(nodes=nodes_typed, groups=groups_typed, pods=pods)
-    # the deployed runtime's interpreter tuning (cmd.main applies the same
-    # two knobs): scheduler-shaped GC thresholds + startup freeze. Without
-    # them the default gen0 trigger fires ~1.3k collections across the
-    # flood — ~0.25s of pauses and THE run-to-run variance source.
-    import gc as _gc
-
-    from batch_scheduler_tpu.utils.runtime_tuning import (
-        apply_gc_tuning,
-        freeze_startup,
-    )
-
-    prev_gc_threshold = _gc.get_threshold()
-    apply_gc_tuning()
-    freeze_startup()
     # Steady-state entry: the cluster (nodes + PodGroup specs with member
     # shapes) predates the arrival flood, so the oracle's standing batch
     # does too — materialise it before the clock starts, the state any
@@ -547,6 +533,23 @@ def config6_framework_e2e(num_nodes=5000, num_groups=1000, members=10):
     from batch_scheduler_tpu.api.types import to_dict as _to_dict
 
     pod_docs = [_to_dict(p) for p in pods]
+    # the deployed runtime's interpreter tuning (cmd.main applies the same
+    # two knobs): scheduler-shaped GC thresholds + startup freeze. Without
+    # them the default gen0 trigger fires ~1.3k collections across the
+    # flood — ~0.25s of pauses and THE run-to-run variance source.
+    # Applied HERE, adjacent to the switch-interval set and inside the
+    # same restore discipline: everything between warmup and this point
+    # can raise, and a leak would skew other configs' measurements.
+    import gc as _gc
+
+    from batch_scheduler_tpu.utils.runtime_tuning import (
+        apply_gc_tuning,
+        freeze_startup,
+    )
+
+    prev_gc_threshold = _gc.get_threshold()
+    apply_gc_tuning()
+    freeze_startup()
     sys.setswitchinterval(switch_interval)
     t0 = time.perf_counter()
     try:

@@ -110,6 +110,45 @@ def test_release_drops_stale_pair_when_waiting_pod_never_appears():
     assert pairs.get(pods[2].metadata.uid) is None
 
 
+def test_release_grace_covers_late_waiting_pod_despite_dead_pair():
+    """The retry grace is shared, not first-come-first-served: a pair
+    whose WaitingPod materialises DURING the grace is allowed even when
+    another pair is permanently dead — the dead pair must not exhaust
+    the grace on the others' behalf (the hole a single-payment
+    implementation had)."""
+    plugin, handle, op, cache, pods = _build(members=3)
+    _permit_all(plugin, op, pods)
+    wp2 = _StubWaitingPod(pods[2])
+    late = {"wp": None}
+
+    class _LateHandle:
+        def get_waiting_pod(self, uid):
+            if uid == pods[2].metadata.uid:
+                return wp2
+            if uid == pods[1].metadata.uid:
+                return late["wp"]  # materialises mid-grace
+            return None  # pod 0: permanently dead
+
+        def iterate_over_waiting_pods(self, fn):
+            pass
+
+    plugin.handle = _LateHandle()
+    # wp1 appears only after the sweep's first pass has already missed it
+    import threading
+
+    wp1 = _StubWaitingPod(pods[1])
+    # fires inside the first grace sleep (grace = 2 x 10ms), well before
+    # the sweep's final re-check — immune to scheduler jitter
+    t = threading.Timer(0.005, lambda: late.__setitem__("wp", wp1))
+    t.start()
+    plugin.start_batch_schedule("default/gang")
+    t.cancel()
+    assert wp2.allowed == 1
+    assert wp1.allowed == 1, "late-materialising pod lost its grace"
+    pairs = op.get_pod_node_pairs("default/gang")
+    assert pairs.get(pods[0].metadata.uid) is None  # dead pair dropped
+
+
 def test_update_batch_cache_evicts_replaced_uid():
     """A pod deleted and recreated under the same name carries a new uid;
     the old uid's matched entry must go (reference UpdateBatchCache,
