@@ -25,6 +25,7 @@ batch. Three properties make the tick budget:
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
@@ -55,10 +56,12 @@ def _scatter_add_rows(req, rows, updates):
 # compile. Bigger bursts fall back to a full mirror re-upload (counted in
 # summary() as reupload_fallbacks — the path is ~100x costlier and an
 # undersized bucket silently turns every burst tick into it, VERDICT r3
-# item 5 postmortem). Sized for the worst admission-window tick: 32
-# admits x up to 10 assignment rows each (one per member at maximal
-# fragmentation) plus a releases margin.
-_DELTA_BUCKET = 512
+# item 5 postmortem). Sized for the worst admission tick at the deepest
+# link-RTT pipeline (depth 4 x 32-gang window, whole-batch atomic admit):
+# 128 admits x up to 10 assignment rows each (one per member at maximal
+# fragmentation) plus a releases margin. The padded scatter payload at
+# this width is ~2048 x R x 4B ≈ 64KB per drain — noise on any link.
+_DELTA_BUCKET = 2048
 
 
 @dataclass
@@ -163,6 +166,19 @@ class ChurnRescorer:
         # re-uploads the numpy mirror (the ground truth) and clears deltas.
         self._req_dev = None
         self._req_deltas: List[tuple] = []  # (row_idx[int32], update[?,R])
+        # True while a resync upload is in flight outside the lock: admits
+        # in that window must still queue their deltas (the upload snapshot
+        # predates them), even though _req_dev may read as None
+        self._req_uploading = False
+        # Serializes admit/release (occupancy charge + delta enqueue)
+        # against tick_dispatch's snapshot pack + delta drain. A pipeline
+        # deeper than one tick runs dispatches on a helper thread that can
+        # overlap the loop's admits — without this lock a delta appended
+        # between _requested_device's concatenate and clear() is silently
+        # dropped and the device occupancy understates committed load
+        # forever after. The lock covers only host-side packing (~ms), not
+        # the dispatch RPC, so admits never stall on a slow link.
+        self._state_lock = threading.Lock()
 
     def tick(
         self,
@@ -201,7 +217,11 @@ class ChurnRescorer:
         DISPATCH. Admitting it later is safe exactly when capacity has not
         shrunk in between — releases and arrivals only add slack, so the
         churn loop qualifies; node removal or external placements would
-        need a host-side re-verify before admit."""
+        need a host-side re-verify before admit (``admit_verified``).
+
+        Thread-safety: a pipelined loop may run this on a helper thread
+        while admit/release run on the loop thread; the internal state
+        lock makes the snapshot pack + delta drain atomic against them."""
         if nodes is not None and node_requested is None:
             # the dense occupancy state is indexed by the constructor's node
             # list; scoring a different node set against it would silently
@@ -212,24 +232,32 @@ class ChurnRescorer:
                 "constructor's node list"
             )
         use_nodes = self.nodes if nodes is None else list(nodes)
-        t0 = time.perf_counter()
-        dense = self.requested_lanes if node_requested is None else None
-        snap = ClusterSnapshot(
-            use_nodes,
-            node_requested or {},
-            groups,
-            schema=self.schema,
-            requested_lanes=dense,
-            alloc_lanes=self._alloc_lanes if nodes is None else None,
-            min_buckets=self._sticky_buckets,
-        )
-        t_pack = time.perf_counter() - t0
+        # state lock: the pack reads the occupancy mirror, which must be
+        # atomic vs a concurrent admit/release on another thread (depth-k
+        # pipelines). Device RPCs stay OUTSIDE the lock (the alloc upload
+        # below reads only constructor-immutable state; _requested_device
+        # takes the lock internally for exactly the queue-drain part). t0
+        # starts inside the lock so pack_seconds stays a pure pack
+        # measurement — lock waits land in the loop's wall series, not here.
+        with self._state_lock:
+            t0 = time.perf_counter()
+            dense = self.requested_lanes if node_requested is None else None
+            snap = ClusterSnapshot(
+                use_nodes,
+                node_requested or {},
+                groups,
+                schema=self.schema,
+                requested_lanes=dense,
+                alloc_lanes=self._alloc_lanes if nodes is None else None,
+                min_buckets=self._sticky_buckets,
+            )
+            t_pack = time.perf_counter() - t0
 
         args = snap.device_args()
         if nodes is None:
             # the alloc side never changes tick-to-tick: keep the padded
-            # array resident on device so steady ticks skip its host->device
-            # transfer (the largest per-tick input)
+            # array resident on device so steady ticks skip its
+            # host->device transfer (the largest per-tick input)
             if (
                 self._alloc_dev is None
                 or self._alloc_dev.shape != args[0].shape
@@ -280,46 +308,70 @@ class ChurnRescorer:
         mirror whole and drops queued deltas; steady ticks scatter-add only
         the queued admit/release rows (bucketed so the jit signature is
         stable). On any failure the device copy is dropped — the next tick
-        re-uploads ground truth."""
+        re-uploads ground truth.
+
+        Locking: only the queue drain (and the resync's mirror re-read)
+        holds the state lock; the device RPCs run outside it so a
+        concurrent admit/release never stalls behind an h2d transfer on a
+        slow link. ``_req_dev`` itself is helper-thread-owned. The resync
+        path re-pads from the LIVE mirror under the lock rather than using
+        the caller's (possibly pre-admit) pack: admit updates the mirror
+        and queues its delta atomically, so dropping the queue is only
+        consistent with an upload of the mirror as of the same instant."""
         try:
-            deltas = self._req_deltas
-            rows_total = sum(len(d[0]) for d in deltas)
-            if (
-                self._req_dev is None
-                or self._req_dev.shape != padded_requested.shape
-                or rows_total > _DELTA_BUCKET  # burst: re-upload is cheaper
-            ):
-                if self._req_dev is not None:
-                    # an established mirror falling back is the perf cliff
-                    # the bucket sizing exists to avoid — count it
-                    self.reupload_fallbacks += 1
-                deltas.clear()
-                self._req_dev = jax.device_put(padded_requested)
+            with self._state_lock:
+                deltas = self._req_deltas
+                rows_total = sum(len(d[0]) for d in deltas)
+                resync = (
+                    self._req_dev is None
+                    or self._req_dev.shape != padded_requested.shape
+                    or rows_total > _DELTA_BUCKET  # burst: re-upload wins
+                )
+                drained = None
+                if resync:
+                    if self._req_dev is not None:
+                        # an established mirror falling back is the perf
+                        # cliff the bucket sizing exists to avoid — count it
+                        self.reupload_fallbacks += 1
+                    deltas.clear()
+                    upload = np.zeros_like(padded_requested)
+                    upload[: len(self.requested_lanes)] = self.requested_lanes
+                    self._req_uploading = True
+                elif deltas:
+                    rows = np.concatenate([d[0] for d in deltas])
+                    ups = np.concatenate([d[1] for d in deltas])
+                    deltas.clear()
+                    pad = _DELTA_BUCKET - len(rows)
+                    rows = np.concatenate(
+                        [rows, np.zeros(pad, dtype=np.int32)]
+                    )
+                    ups = np.concatenate(
+                        [ups, np.zeros((pad, ups.shape[1]), dtype=np.int32)]
+                    )
+                    drained = (rows, ups)
+            if resync:
+                dev = jax.device_put(upload)
                 # compile the (sole) scatter signature now, outside any
                 # tick budget — a zero delta is a numeric no-op
-                self._req_dev = _scatter_add_rows(
-                    self._req_dev,
+                dev = _scatter_add_rows(
+                    dev,
                     np.zeros(_DELTA_BUCKET, dtype=np.int32),
                     np.zeros(
                         (_DELTA_BUCKET, padded_requested.shape[1]),
                         dtype=np.int32,
                     ),
                 )
-                return self._req_dev
-            if deltas:
-                rows = np.concatenate([d[0] for d in deltas])
-                ups = np.concatenate([d[1] for d in deltas])
-                deltas.clear()
-                pad = _DELTA_BUCKET - len(rows)
-                rows = np.concatenate([rows, np.zeros(pad, dtype=np.int32)])
-                ups = np.concatenate(
-                    [ups, np.zeros((pad, ups.shape[1]), dtype=np.int32)]
-                )
-                self._req_dev = _scatter_add_rows(self._req_dev, rows, ups)
+                with self._state_lock:
+                    self._req_dev = dev
+                    self._req_uploading = False
+            elif drained is not None:
+                self._req_dev = _scatter_add_rows(self._req_dev, *drained)
             return self._req_dev
         except Exception:
-            self._req_dev = None
-            self._req_deltas.clear()
+            with self._state_lock:
+                self._req_dev = None
+                self._req_uploading = False
+                self._req_deltas.clear()
             raise
 
     def tick_collect(self, pend: "PendingTick") -> TickResult:
@@ -408,34 +460,70 @@ class ChurnRescorer:
         idx, cnt = nodes_idx[mask], counts[mask].astype(np.int64)
         vec = self._member_lane_vec(group)
         update = (cnt[:, None] * vec[None, :]).astype(np.int32)
-        self.requested_lanes[idx] += update
-        # Staleness guard (ADVICE r3): charging a one-tick-stale assignment
-        # is safe only under this class's contract that capacity never
-        # SHRINKS between dispatch and admit (releases/arrivals only add
-        # slack). A caller that interleaved node removal or external
-        # placements would oversubscribe silently — fail loudly instead.
-        over = self.requested_lanes[idx] > self._alloc_lanes[idx]
-        if over.any():
-            self.requested_lanes[idx] -= update
-            raise RuntimeError(
-                f"admit({full_name}): assignment oversubscribes "
-                f"{int(over.any(axis=1).sum())} node(s) — the tick's "
-                "snapshot is staler than the capacity-only-grows contract "
-                "allows (node removed or externally placed load?)"
-            )
-        if self._req_dev is not None:
-            # only queue while a device copy exists to drain into — the
-            # upload path rebuilds from the mirror and discards the queue
-            self._req_deltas.append((idx.astype(np.int32), update))
-        self._running[full_name] = (idx, update)
+        with self._state_lock:  # vs a concurrent dispatch's pack/drain
+            self.requested_lanes[idx] += update
+            # Staleness guard (ADVICE r3): charging a one-tick-stale
+            # assignment is safe only under this class's contract that
+            # capacity never SHRINKS between dispatch and admit (releases/
+            # arrivals only add slack). A caller that interleaved node
+            # removal or external placements would oversubscribe silently —
+            # fail loudly instead.
+            over = self.requested_lanes[idx] > self._alloc_lanes[idx]
+            if over.any():
+                self.requested_lanes[idx] -= update
+                raise RuntimeError(
+                    f"admit({full_name}): assignment oversubscribes "
+                    f"{int(over.any(axis=1).sum())} node(s) — the tick's "
+                    "snapshot is staler than the capacity-only-grows "
+                    "contract allows (node removed or externally placed "
+                    "load?)"
+                )
+            if self._req_dev is not None or self._req_uploading:
+                # only queue while a device copy exists (or an upload that
+                # predates this charge is in flight) to drain into — the
+                # upload path rebuilds from the mirror and discards the queue
+                self._req_deltas.append((idx.astype(np.int32), update))
+            self._running[full_name] = (idx, update)
+
+    def admit_verified(self, tick: TickResult, full_name: str) -> bool:
+        """``admit`` for pipelines deeper than one tick: re-verify the
+        stale assignment against CURRENT occupancy and skip instead of
+        raising when it no longer fits.
+
+        A depth-k software pipeline (k dispatches in flight) breaks the
+        capacity-only-grows contract ``admit`` is allowed to assume: ticks
+        N-1..N-k+1 admit their placements AFTER tick N was dispatched, so
+        tick N's plan may seat gangs on capacity those admissions consumed,
+        and the same still-pending gang may ride several in-flight batches
+        at once. This host-side re-verify restores safety for any depth:
+
+        - already admitted (an earlier in-flight batch won): skip, False;
+        - charge would oversubscribe any node (plan staler than current
+          occupancy): roll back cleanly (``admit``'s guard) and skip,
+          False — the gang stays pending and re-rides the next dispatch;
+        - otherwise charge and commit exactly like ``admit``: True.
+
+        The caller must not re-offer a name it has released (a finished
+        gang is indistinguishable from a fresh incarnation here) — track
+        completion on the caller side, as benchmarks/ladder.py config 5
+        does with its placed-ever set.
+        """
+        if full_name in self._running:
+            return False
+        try:
+            self.admit(tick, full_name)
+        except RuntimeError:
+            return False
+        return True
 
     def release(self, full_name: str) -> None:
         """A running gang finished: free its occupancy (the exact negation
         of the admit-time update, by construction)."""
         idx, update = self._running.pop(full_name)
-        self.requested_lanes[idx] -= update
-        if self._req_dev is not None:
-            self._req_deltas.append((idx.astype(np.int32), -update))
+        with self._state_lock:  # vs a concurrent dispatch's pack/drain
+            self.requested_lanes[idx] -= update
+            if self._req_dev is not None or self._req_uploading:
+                self._req_deltas.append((idx.astype(np.int32), -update))
 
     @property
     def running(self) -> List[str]:

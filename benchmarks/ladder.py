@@ -16,9 +16,11 @@ Run from the repo root: ``python benchmarks/ladder.py [--configs 1,2,5]``.
      (freeing capacity) and new gangs arrive. The initial 600-gang
      backlog is admitted INSIDE the measured window through a bounded
      per-tick admission slot (ADMIT_WINDOW); the loop is software-
-     pipelined one tick deep (dispatch on a helper thread, collect at
-     the next boundary) and must hold the tick budget with zero misses
-     — admission included — and zero steady-state recompiles.
+     pipelined as deep as a measured link-RTT probe requires (dispatch
+     on a helper thread, collect ``depth`` boundaries later, stale
+     placements re-verified host-side at admit) and must hold the tick
+     budget with zero misses — admission included — and zero
+     steady-state recompiles.
   6  north-star FULL-FRAMEWORK e2e: 10k pods / 5k nodes through the whole
      stack (queue -> prefilter -> whole-gang fast lane -> batched bind ->
      cross-gang commit flush), entered in steady state (standing oracle
@@ -35,6 +37,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 import time
@@ -255,6 +258,7 @@ def config5_churn(ticks: int = 50, interval: float = 0.1):
     import jax
 
     from batch_scheduler_tpu.ops.rescore import ChurnRescorer
+    from batch_scheduler_tpu.ops.snapshot import GroupDemand as RescoreGroup
 
     rng = np.random.default_rng(0)
     nodes = _sim_nodes(5000, {"cpu": "64", "memory": "256Gi", "pods": "110", GPU: "8"})
@@ -264,56 +268,140 @@ def config5_churn(ticks: int = 50, interval: float = 0.1):
 
     # Per-tick admission slot: caps the dispatched batch width AND the
     # admit scatter count, reserving headroom inside the tick budget.
-    # Sized so a full placing batch stays well under the interval (the
-    # assignment scan's cost scales with gangs actually placed: ~35ms at
-    # 16, ~62ms at 32, ~113ms at 64 on the CPU host — 64 would overrun
-    # the interval and cascade the pipelined collect into the loop).
+    # Sized so a full placing batch stays well under the interval in the
+    # depth-1/CPU regime, where the assignment scan runs on the HOST
+    # inside collect and its cost scales with gangs actually placed:
+    # ~35ms at 16, ~62ms at 32, ~113ms at 64 — 64 would overrun the
+    # interval and cascade the pipelined collect into the loop. At
+    # depth >= 2 (a slow link, i.e. a real accelerator behind a tunnel)
+    # the window widens to depth x ADMIT_WINDOW: there the scan runs
+    # on the DEVICE (~ms at these widths) and the host pays only admit
+    # bookkeeping (~tens of µs per gang; 32-admit drain ticks measure
+    # ~1.5ms of loop time). Forcing depth >= 2 on the CPU backend keeps
+    # the host-scan cost AND the widened window — expect tail misses;
+    # that is a debug mode, not the SLO configuration.
     ADMIT_WINDOW = 32
 
     r = ChurnRescorer(nodes, extra_resources=[GPU])
-    # precompile every bucket the loop can visit (width <= ADMIT_WINDOW)
-    r.warm([8, 16, 32, 64])
+    # warm the probe's own bucket first so the RTT probe measures the
+    # steady link, not a first compile; the full warm (which needs the
+    # probed depth to know the widest window bucket) follows the probe
+    r.warm([8])
+
+    # LINK PROBE — the pipeline depth is a property of the link, not the
+    # code: round 3's tunnel answered in ~65ms (one tick of headroom),
+    # round 5's in ~200ms (two). Measure the warmed small-bucket tick RTT
+    # synchronously and size the pipeline so the collect of a batch
+    # dispatched k intervals ago blocks well under the interval:
+    #   k >= RTT/interval - 0.6   (0.4-interval headroom for admit + jitter)
+    # BST_CHURN_PIPELINE_DEPTH overrides (integer; "auto" = probe).
+    probe_dummies = [
+        RescoreGroup(
+            full_name=f"__rtt__/{i}",
+            min_member=1,
+            member_request={"cpu": 1},
+            has_pod=True,
+        )
+        for i in range(8)
+    ]
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        r.tick(None, probe_dummies)
+        rtts.append(time.perf_counter() - t0)
+    link_rtt = float(np.median(rtts))
+    depth_env = os.environ.get("BST_CHURN_PIPELINE_DEPTH", "auto")
+    if depth_env == "auto":
+        depth = max(1, min(4, math.ceil(link_rtt / interval - 0.6)))
+    else:
+        # clamped like auto mode: _DELTA_BUCKET and the window sizing are
+        # rated for depth <= 4 (deeper would push catch-up drains into
+        # the re-upload fallback the bucket exists to avoid)
+        depth = max(1, min(4, int(depth_env)))
+    # the dispatch window widens with depth so the oldest-batch stream
+    # still drains ~ADMIT_WINDOW fresh gangs per tick (see loop comment);
+    # precompile every bucket the loop can visit, INCLUDING the widened
+    # window's (96 gangs -> bucket 128 at depth 3 — unwarmed, it would
+    # recompile mid-loop and fail the steady-state assert)
+    window = ADMIT_WINDOW * depth
+    r.warm(sorted({8, 16, 32, 64, window}))
     warmed = r.recompiles
     r.clear_stats()
 
-    # CHURN LOOP — software-pipelined one tick deep: each boundary
-    # collects the previous dispatch (whose D2H copy rode the sleep), admits
-    # it, applies churn, and dispatches against the now-current occupancy.
-    # The host<->device link round-trip (~6x the device compute on the axon
-    # tunnel) is hidden behind the interval; decisions lag exactly one tick,
-    # which is safe here because capacity only grows between dispatch and
-    # admit (releases/arrivals add slack — see tick_dispatch's staleness
-    # contract; every placed gang of a collected tick is admitted before
-    # the next dispatch, so charges never lag a dispatch that could
-    # re-place them). The dispatch itself runs on a helper thread: if the
+    # CHURN LOOP — software-pipelined ``depth`` ticks deep: each boundary
+    # collects the OLDEST in-flight dispatch (whose D2H copy rode the
+    # sleeps), admits it, applies churn, and dispatches against the
+    # now-current occupancy. The host<->device link round-trip (~6-20x the
+    # device compute on the axon tunnel) is hidden behind ``depth``
+    # intervals; decisions lag exactly ``depth`` ticks. Beyond one tick the
+    # capacity-only-grows contract admit() assumes no longer holds (newer
+    # in-flight batches predate the older ones' admissions, and a
+    # still-pending gang rides every in-flight batch at once), so
+    # placements commit through admit_verified(): already-admitted and
+    # no-longer-fitting placements are skipped — skipped gangs stay
+    # pending and re-ride the next dispatch; a placed-ever set keeps a
+    # released gang's stale placement from re-seating it. Each dispatch
+    # carries the same pending PREFIX, widened to depth x ADMIT_WINDOW:
+    # the oracle plans a batch sequentially in priority order, so a
+    # follower batch — planned before its predecessor's admissions were
+    # charged but CONTAINING the predecessor's gangs at the same ranks —
+    # reproduces those placements and plans its fresh tail consistently
+    # around them (the admitted prefix dup-skips via placed_ever, the
+    # tail admits cleanly; only churn-induced cascades need the
+    # admit_verified skip). Disjoint windows are the tempting wrong
+    # answer: siblings planned on pre-charge state collide with the
+    # predecessor's best-fit seats almost every time (measured: ~800
+    # skips vs ~7, and a SLOWER drain). The dispatch itself runs on a
+    # helper thread: if the
     # tunnel's PJRT client blocks the dispatching thread on per-argument
-    # h2d RPCs, that block rides the interval too instead of the loop
-    # (exactly one dispatch is ever in flight, and the loop never touches
-    # the rescorer between submit and result, so there is no sharing).
+    # h2d RPCs, that block rides the interval too instead of the loop; at
+    # depth >= 2 that thread can be packing a later dispatch WHILE the
+    # loop admits an earlier batch — the rescorer's internal state lock
+    # serializes admit/release against the dispatch-side pack and delta
+    # drain, so charges are never lost.
+    from collections import deque
     from concurrent.futures import ThreadPoolExecutor
 
     deadline_misses = 0
     loop_times = []  # the SLO series: wall time the LOOP spends per tick
     backlog_drained_tick = None
-    inflight_groups = pending[:ADMIT_WINDOW]
+    admit_skips = 0  # stale placements rejected by host-side re-verify
+    placed_ever: set = set()
+    inflight: deque = deque()  # (future, groups) oldest-first, len==depth
     # context-managed: a mid-loop failure must not leave the interpreter
     # joining an in-flight dispatch against a possibly-hung backend
     with ThreadPoolExecutor(
         max_workers=1, thread_name_prefix="tick-dispatch"
     ) as pool:
-        pend_f = pool.submit(r.tick_dispatch, None, inflight_groups)
-        time.sleep(interval)  # pipeline fill: batch 0 gets its interval
+        for _ in range(depth):  # pipeline fill: each batch gets an interval
+            groups = pending[:window]
+            inflight.append(
+                (pool.submit(r.tick_dispatch, None, groups), groups)
+            )
+            time.sleep(interval)
         for tick_i in range(ticks):
             t0 = time.perf_counter()
+            pend_f, tick_groups = inflight.popleft()
             out = r.tick_collect(pend_f.result())
 
             # admit: every gang the collected batch placed charges its
-            # assignment (bounded by ADMIT_WINDOW by construction)
+            # assignment, re-verified against current occupancy (see loop
+            # comment). The whole batch admits ATOMICALLY from its one
+            # internally-consistent plan — partial admission (a per-tick
+            # fresh cap) or cross-batch mixing reintroduces exactly the
+            # collisions admit_verified exists to catch (measured: a
+            # capped/staggered variant skipped ~10x more). The per-tick
+            # admit bound is therefore the window (depth x ADMIT_WINDOW,
+            # tens of µs of host numpy per gang; dup re-carries skip for
+            # free), reached only on post-burst catch-up ticks.
             placed = set(out.placed_groups())
-            for g in inflight_groups:
-                if g.full_name in placed:
-                    r.admit(out, g.full_name)
-            pending = [g for g in pending if g.full_name not in placed]
+            for g in tick_groups:
+                if g.full_name in placed and g.full_name not in placed_ever:
+                    if r.admit_verified(out, g.full_name):
+                        placed_ever.add(g.full_name)
+                    else:
+                        admit_skips += 1
+            pending = [g for g in pending if g.full_name not in placed_ever]
             if backlog_drained_tick is None and len(pending) < ADMIT_WINDOW:
                 backlog_drained_tick = tick_i
 
@@ -327,8 +415,10 @@ def config5_churn(ticks: int = 50, interval: float = 0.1):
                 if g is not None:
                     pending.append(g)
 
-            inflight_groups = pending[:ADMIT_WINDOW]
-            pend_f = pool.submit(r.tick_dispatch, None, inflight_groups)
+            groups = pending[:window]
+            inflight.append(
+                (pool.submit(r.tick_dispatch, None, groups), groups)
+            )
 
             elapsed = time.perf_counter() - t0
             loop_times.append(elapsed)
@@ -336,8 +426,10 @@ def config5_churn(ticks: int = 50, interval: float = 0.1):
                 deadline_misses += 1
             else:
                 time.sleep(interval - elapsed)
-        r.tick_collect(pend_f.result())  # drain the last in-flight batch
-        r.drop_last_stats()  # (unmeasured)
+        while inflight:  # drain the in-flight batches (unmeasured)
+            pend_f, _ = inflight.popleft()
+            r.tick_collect(pend_f.result())
+            r.drop_last_stats()
 
     s = r.summary()
     platform = jax.devices()[0].platform
@@ -375,7 +467,9 @@ def config5_churn(ticks: int = 50, interval: float = 0.1):
         admit_window=ADMIT_WINDOW,
         backlog_drained_tick=backlog_drained_tick,
         mode="pipelined",
-        staleness_ticks=1,
+        staleness_ticks=depth,
+        link_rtt_probe_s=round(link_rtt, 5),
+        admit_skips_stale=admit_skips,
         running_gangs_final=len(r.running),
         pending_final=len(pending),
         reupload_fallbacks=s["reupload_fallbacks"],
@@ -431,6 +525,19 @@ def config6_framework_e2e(num_nodes=5000, num_groups=1000, members=10):
     # in the finally below; reported in the detail.
     switch_interval = 0.02
     prev_switch = sys.getswitchinterval()
+
+    # stage marks on stderr: a run killed by an outer timeout (a tunnel
+    # dying mid-compile looks exactly like a hang) still shows WHERE the
+    # time went — the r05 capture window lost config 6 with no trace
+    t_setup0 = time.perf_counter()
+
+    def _mark(stage: str) -> None:
+        print(
+            f"# config6 {stage} t+{time.perf_counter() - t_setup0:.1f}s",
+            file=sys.stderr,
+            flush=True,
+        )
+
     cluster = SimCluster(
         scorer="oracle",
         bind_workers=16,
@@ -473,6 +580,7 @@ def config6_framework_e2e(num_nodes=5000, num_groups=1000, members=10):
         groups_typed.append(pg)
         cluster.create_group(pg)
     cluster.start()
+    _mark("cluster started (5k nodes, 1k groups)")
 
     pods = []
     for g in range(num_groups):
@@ -487,6 +595,7 @@ def config6_framework_e2e(num_nodes=5000, num_groups=1000, members=10):
     # bucket shapes outside the clock. The measured wall below is the
     # steady-state framework, not XLA's first compile.
     warm_s = warm_oracle(nodes=nodes_typed, groups=groups_typed, pods=pods)
+    _mark(f"oracle warm compile done ({warm_s:.1f}s)")
     # Steady-state entry: the cluster (nodes + PodGroup specs with member
     # shapes) predates the arrival flood, so the oracle's standing batch
     # does too — materialise it before the clock starts, the state any
@@ -505,8 +614,12 @@ def config6_framework_e2e(num_nodes=5000, num_groups=1000, members=10):
         timeout=30.0,
         interval=0.05,
     )
+    _mark("controller phase sweep done")
     op = cluster.runtime.operation
+    t_standing = time.perf_counter()
     op.oracle.ensure_fresh(cluster.cluster, op.status_cache)
+    standing_batch_s = time.perf_counter() - t_standing
+    _mark(f"standing batch materialised ({standing_batch_s:.1f}s)")
     batches_prewarm = op.oracle.batches_run
     # the registry is process-global (earlier configs observe into the same
     # series): snapshot here and report window deltas only
@@ -551,6 +664,7 @@ def config6_framework_e2e(num_nodes=5000, num_groups=1000, members=10):
     apply_gc_tuning()
     freeze_startup()
     sys.setswitchinterval(switch_interval)
+    _mark("entering measured window")
     t0 = time.perf_counter()
     try:
         cluster.create_pod_docs(pod_docs)
@@ -596,6 +710,7 @@ def config6_framework_e2e(num_nodes=5000, num_groups=1000, members=10):
         "s",
         bound_all=ok,
         warmup_compile_s=round(warm_s, 2),
+        standing_batch_s=round(standing_batch_s, 2),
         binds=stats["binds"],
         pods=total,
         pods_per_sec=round(total / max(elapsed, 1e-9), 1),

@@ -248,3 +248,95 @@ def test_sticky_buckets_pin_shapes_across_boundaries():
     out_sticky = r_sticky.tick(None, list(small))
     out_plain = r_plain.tick(None, list(small))
     assert out_sticky.placed_groups() == out_plain.placed_groups()
+
+
+def test_admit_verified_depth2_contention():
+    """Pipelines deeper than one tick break admit()'s capacity-only-grows
+    contract: a newer in-flight batch predates the older one's admissions,
+    so its plan can seat a gang on capacity that is now taken.
+    admit_verified() is the host-side re-verify that restores safety:
+    the stale overlapping placement is skipped with a clean rollback, a
+    double-offered gang commits exactly once, and the skipped gang places
+    on a fresh dispatch once capacity frees."""
+    nodes = _nodes(4, cpu="4")  # 16 cpus
+    r = ChurnRescorer(nodes)
+    x, y = _gang("x", 10, ts=0.0), _gang("y", 10, ts=1.0)
+
+    # two dispatches in flight against the SAME empty-cluster occupancy
+    p1 = r.tick_dispatch(None, [x])
+    p2 = r.tick_dispatch(None, [y])
+
+    out1 = r.tick_collect(p1)
+    assert r.admit_verified(out1, "default/x") is True
+    assert r.admit_verified(out1, "default/x") is False  # dup offer: no-op
+
+    out2 = r.tick_collect(p2)
+    # the stale plan DID place y (10 free cpus at dispatch)...
+    assert "default/y" in out2.placed_groups()
+    # ...but only 6 remain now: any 10-cpu seating must oversubscribe
+    before = r.requested_lanes.copy()
+    assert r.admit_verified(out2, "default/y") is False
+    assert (r.requested_lanes == before).all()  # rollback left no charge
+    assert r.running == ["default/x"]
+
+    # skipped gangs stay pending and place on a CURRENT-state dispatch
+    r.release("default/x")
+    out3 = r.tick(None, [y])
+    assert r.admit_verified(out3, "default/y") is True
+    assert r.running == ["default/y"]
+
+
+def test_concurrent_dispatch_admit_consistency():
+    """Pins the depth-k race the state lock closes: dispatches running on a
+    helper thread while the loop thread admits/releases must never lose a
+    queued occupancy delta (a delta appended between the drain's
+    concatenate and clear() used to vanish, silently understating device
+    occupancy forever after). Invariant checked: after every round, the
+    occupancy mirror equals the sum of running gangs' charges, and a
+    final fresh tick places against exactly that state."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    nodes = _nodes(8, cpu="8")  # 64 cpus
+    r = ChurnRescorer(nodes)
+    rng = np.random.default_rng(7)
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        out = r.tick(None, [_gang("seed", 2)])
+        r.admit(out, "default/seed")
+        for round_i in range(20):
+            gangs = [
+                _gang(f"r{round_i}-{j}", int(rng.integers(1, 4)), ts=float(j))
+                for j in range(4)
+            ]
+            fut = pool.submit(r.tick_dispatch, None, gangs)
+            # interleave with the in-flight dispatch's pack/drain window
+            for g in list(r.running):
+                if rng.random() < 0.3 and g != "default/seed":
+                    r.release(g)
+            out = r.tick_collect(fut.result())
+            for g in gangs:
+                if g.full_name in out.placed_groups():
+                    r.admit_verified(out, g.full_name)
+            expect = np.zeros_like(r.requested_lanes)
+            for idx, update in r._running.values():
+                np.add.at(expect, idx, update)
+            assert (r.requested_lanes == expect).all(), (
+                f"occupancy mirror diverged from running charges at "
+                f"round {round_i}"
+            )
+    # the device-resident copy saw every delta too: a fresh tick scored
+    # against it must agree with a from-scratch pack of the mirror
+    probe = _gang("probe", 60, ts=999.0)  # needs most of the cluster
+    out_dev = r.tick(None, [probe])
+    r2 = ChurnRescorer(nodes)
+    out_ref = r2.tick(
+        {
+            n.metadata.name: {
+                res: int(v)
+                for res, v in zip(r.schema.names, r.requested_lanes[i])
+                if v
+            }
+            for i, n in enumerate(nodes)
+        },
+        [probe],
+    )
+    assert out_dev.placed_groups() == out_ref.placed_groups()
