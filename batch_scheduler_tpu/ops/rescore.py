@@ -15,9 +15,10 @@ batch. Three properties make the tick budget:
 - **O(G) host fetch** (ops.oracle.execute_batch_host): each tick pulls only
   the per-group vectors + compact top-K assignment; (G,N) tensors stay on
   device;
-- **link-latency hiding** (tick_dispatch/tick_collect): a one-tick-deep
-  pipeline overlaps the host<->device round-trip with the tick interval
-  (one-tick staleness contract documented on tick_dispatch);
+- **link-latency hiding** (tick_dispatch/tick_collect): a software
+  pipeline overlaps the host<->device round-trip with one or more tick
+  intervals (staleness contract on tick_dispatch; pipelines deeper than
+  one tick commit through admit_verified's host-side re-check);
 - **device-resident state**: the padded alloc and occupancy arrays stay on
   device across ticks; admit/release ship fixed-width scatter deltas, with
   the numpy mirror as ground truth and automatic resync on failure.
