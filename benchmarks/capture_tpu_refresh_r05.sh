@@ -89,6 +89,12 @@ else
     fail=1
 fi
 
+echo "== link diagnostic (explains the per-window RTT) =="
+timeout 600 python benchmarks/link_diag.py > /tmp/link_diag.json 2>/dev/null \
+    && grep -q '"platform": "tpu"' /tmp/link_diag.json \
+    && cp /tmp/link_diag.json LINK_DIAG_r05.json \
+    || echo "link diag failed (optional)"
+
 echo "== scale headroom probe =="
 timeout 900 python benchmarks/scale_probe.py > /tmp/scale.json 2>/dev/null \
     && cp /tmp/scale.json SCALE_r05.json \
