@@ -53,6 +53,12 @@ serial-e2e:
 tpu-artifacts:
 	bash benchmarks/capture_tpu_artifacts.sh
 
+# focused round-5 re-capture, ordered by missing evidence (ladder config
+# 6 and 5, scan split, link diag, scale probe); merges per-config into
+# LADDER_r05_tpu.json
+tpu-refresh:
+	bash benchmarks/capture_tpu_refresh_r05.sh
+
 # GSPMD layout measurement on the 8-device virtual CPU mesh (collective
 # counts per layout; see README "Measured layout choice")
 sharding:
