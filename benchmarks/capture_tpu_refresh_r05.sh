@@ -100,5 +100,13 @@ timeout 900 python benchmarks/scale_probe.py > /tmp/scale.json 2>/dev/null \
     && cp /tmp/scale.json SCALE_r05.json \
     || echo "scale probe failed (optional)"
 
+echo "== headline bench (second draw, optional) =="
+if timeout 900 python bench.py > /tmp/bench2.json 2>/dev/null \
+        && grep -q '"platform": "tpu"' /tmp/bench2.json; then
+    cp /tmp/bench2.json BENCH_r05_late.json
+else
+    echo "second bench draw failed/degraded (optional) — keeping prior"
+fi
+
 echo "== done (fail=${fail}) =="
 exit $fail
