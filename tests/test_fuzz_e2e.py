@@ -104,8 +104,10 @@ def _fuzz_scenario(sim, seed, **cluster_kwargs):
     total_cpu = int(node_cpus.sum())
     max_node_cpu = int(node_cpus.max())
 
+    # oracle by default; kwargs may select the serial (reference-parity)
+    # scorer so the same invariants hammer both paths
+    cluster_kwargs.setdefault("scorer", "oracle")
     cluster = sim(
-        scorer="oracle",
         max_schedule_minutes=0.05,  # 3s gang TTL: abort paths exercised
         backoff_base=0.1,
         backoff_cap=0.5,
@@ -160,6 +162,11 @@ def _fuzz_scenario(sim, seed, **cluster_kwargs):
         (101, {}),
         (202, {"oracle_background_refresh": True}),
         (303, {"min_batch_interval": 0.2}),
+        # the serial (reference-parity) scorer under the same invariants:
+        # its PreFilter may optimistically admit what Filter then rejects
+        # per node, so infeasible gangs die by TTL abort instead of
+        # up-front denial — the binding-level invariants must hold anyway
+        (404, {"scorer": "serial"}),
     ],
 )
 def test_fuzz_full_framework_invariants(sim, seed, kwargs):
