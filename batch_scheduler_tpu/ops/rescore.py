@@ -26,6 +26,7 @@ batch. Three properties make the tick budget:
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from dataclasses import dataclass
@@ -39,7 +40,13 @@ from .lanes import LaneSchema
 from .oracle import batch_top_k, collect_batch, dispatch_batch
 from .snapshot import ClusterSnapshot, GroupDemand
 
-__all__ = ["ChurnRescorer", "TickResult", "PendingTick"]
+__all__ = [
+    "ChurnRescorer",
+    "TickResult",
+    "PendingTick",
+    "TickPipeline",
+    "probe_link_depth",
+]
 
 
 @jax.jit
@@ -573,3 +580,168 @@ class ChurnRescorer:
             "recompiles": self.recompiles,
             "reupload_fallbacks": self.reupload_fallbacks,
         }
+
+
+def probe_link_depth(
+    rescorer: "ChurnRescorer",
+    interval: float,
+    probe_width: int = 8,
+    samples: int = 5,
+    cap: int = 4,
+) -> tuple:
+    """Measure the steady synchronous tick round-trip on ``rescorer``'s
+    backend and return ``(depth, rtt_seconds)``: the software-pipeline
+    depth a churn loop with the given tick ``interval`` needs so the
+    collect of a batch dispatched ``depth`` intervals ago blocks well
+    under the interval::
+
+        depth >= RTT/interval - 0.6   (0.4-interval headroom for
+                                       admit bookkeeping + jitter)
+
+    The pipeline depth is a property of the LINK, not the code — the
+    same loop needs depth 1 on a ~65 ms tunnel and depth 2 on a ~200 ms
+    one (LADDER_r03_tpu vs LADDER_r05_tpu config 5). Call after warming
+    ``probe_width``'s bucket (``rescorer.warm([probe_width])``) so the
+    probe measures the link, not a first compile; the probe's own ticks
+    are un-recorded from the stats series (previously recorded ticks are
+    untouched, so a mid-run re-probe is safe). ``cap`` bounds the depth
+    the delta-bucket sizing is rated for (see ``_DELTA_BUCKET``).
+    """
+    dummies = [
+        GroupDemand(
+            full_name=f"__rtt__/{i}",
+            min_member=1,
+            member_request={"cpu": 1},
+            has_pod=True,
+        )
+        for i in range(probe_width)
+    ]
+    rtts = []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        rescorer.tick(None, dummies)
+        rtts.append(time.perf_counter() - t0)
+        rescorer.drop_last_stats()
+    rtt = float(np.median(rtts))
+    return max(1, min(cap, math.ceil(rtt / interval - 0.6))), rtt
+
+
+class TickPipeline:
+    """Depth-k software pipeline around a :class:`ChurnRescorer`.
+
+    Encapsulates the choreography a slow link demands (measured and
+    asserted by benchmarks/ladder.py config 5): dispatches run on a
+    helper thread (per-argument h2d blocking rides the tick interval,
+    not the caller's loop), ``collect`` returns the OLDEST in-flight
+    batch ``depth`` intervals after its dispatch, and whole batches
+    admit atomically through ``admit_verified`` — stale placements are
+    skipped with clean rollback and simply re-ride a later dispatch,
+    duplicates (the same still-pending gang rides every in-flight
+    batch) skip for free via the ``placed_ever`` set.
+
+    Usage::
+
+        with TickPipeline(rescorer, depth) as pipe:
+            for groups in fill_windows:      # depth windows, one per tick
+                pipe.submit(groups)
+                time.sleep(interval)
+            while churning:
+                out, tick_groups = pipe.collect()
+                admitted, skips = pipe.admit_all(out, tick_groups)
+                ... release/arrive, build next window ...
+                pipe.submit(next_window)
+                ... sleep out the interval remainder ...
+        # __exit__ drains remaining in-flight batches (unrecorded)
+
+    The dispatch window should be ``depth x`` the single-tick admission
+    budget and carry the same pending PREFIX every tick: the oracle
+    plans batches sequentially in priority order, so a follower batch
+    containing its predecessor's gangs at the same ranks reproduces
+    those placements and plans its fresh tail consistently around them.
+    Disjoint or partially-admitted windows collide with the
+    predecessor's best-fit seats almost every time (measured ~10x the
+    skips, benchmarks/ladder.py loop comment).
+    """
+
+    def __init__(self, rescorer: "ChurnRescorer", depth: int):
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.rescorer = rescorer
+        self.depth = max(1, int(depth))
+        self.placed_ever: set = set()
+        self.admit_skips = 0
+        self._inflight = deque()  # (future, groups) oldest-first
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tick-dispatch"
+        )
+
+    # -- pipeline ----------------------------------------------------------
+
+    def submit(self, groups: Sequence[GroupDemand]) -> None:
+        """Dispatch a batch for ``groups`` on the helper thread."""
+        groups = list(groups)
+        self._inflight.append(
+            (self._pool.submit(self.rescorer.tick_dispatch, None, groups),
+             groups)
+        )
+
+    def collect(self) -> tuple:
+        """Block until the OLDEST in-flight batch's result is ready and
+        return ``(TickResult, groups)`` for it. In a loop that sleeps
+        out its interval between submits, the D2H copy rode the sleeps
+        and this returns ~immediately once depth matches the link."""
+        fut, groups = self._inflight.popleft()
+        return self.rescorer.tick_collect(fut.result()), groups
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._inflight)
+
+    def admit_all(self, out: "TickResult", groups: Sequence[GroupDemand]):
+        """Atomically admit every placement of one collected batch that
+        is not already committed; returns ``(admitted_names, skips)``.
+        Skipped gangs (stale placements rejected by the re-verify)
+        stay the caller's to re-offer — they re-ride the next window."""
+        placed = set(out.placed_groups())
+        admitted, skips = [], 0
+        for g in groups:
+            name = g.full_name
+            if name in placed and name not in self.placed_ever:
+                if self.rescorer.admit_verified(out, name):
+                    self.placed_ever.add(name)
+                    admitted.append(name)
+                else:
+                    skips += 1
+        self.admit_skips += skips
+        return admitted, skips
+
+    def drain(self, record_stats: bool = False) -> None:
+        """Collect and discard every remaining in-flight batch (e.g. at
+        loop shutdown); by default their timings are un-recorded so a
+        benchmark's steady-state series stays clean."""
+        while self._inflight:
+            fut, _ = self._inflight.popleft()
+            self.rescorer.tick_collect(fut.result())
+            if not record_stats:
+                self.rescorer.drop_last_stats()
+
+    # -- context manager ---------------------------------------------------
+
+    def __enter__(self) -> "TickPipeline":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # a mid-loop failure must not leave the interpreter joining an
+        # in-flight dispatch against a possibly-hung backend forever:
+        # drain only on the clean path; on the failure path cancel the
+        # queued not-yet-started dispatches too (without cancel_futures
+        # they would still execute against the possibly-hung backend,
+        # and concurrent.futures' atexit hook would join the worker)
+        try:
+            if exc_type is None:
+                self.drain()
+        finally:
+            self._pool.shutdown(
+                wait=exc_type is None, cancel_futures=exc_type is not None
+            )

@@ -37,7 +37,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import math
 import os
 import sys
 import time
@@ -257,8 +256,11 @@ def config5_churn(ticks: int = 50, interval: float = 0.1):
     the steady churn — zero deadline misses, admission included."""
     import jax
 
-    from batch_scheduler_tpu.ops.rescore import ChurnRescorer
-    from batch_scheduler_tpu.ops.snapshot import GroupDemand as RescoreGroup
+    from batch_scheduler_tpu.ops.rescore import (
+        ChurnRescorer,
+        TickPipeline,
+        probe_link_depth,
+    )
 
     rng = np.random.default_rng(0)
     nodes = _sim_nodes(5000, {"cpu": "64", "memory": "256Gi", "pods": "110", GPU: "8"})
@@ -290,30 +292,13 @@ def config5_churn(ticks: int = 50, interval: float = 0.1):
 
     # LINK PROBE — the pipeline depth is a property of the link, not the
     # code: round 3's tunnel answered in ~65ms (one tick of headroom),
-    # round 5's in ~200ms (two). Measure the warmed small-bucket tick RTT
-    # synchronously and size the pipeline so the collect of a batch
-    # dispatched k intervals ago blocks well under the interval:
+    # round 5's in ~200ms (two). ops.rescore.probe_link_depth measures
+    # the warmed small-bucket tick RTT and applies
     #   k >= RTT/interval - 0.6   (0.4-interval headroom for admit + jitter)
     # BST_CHURN_PIPELINE_DEPTH overrides (integer; "auto" = probe).
-    probe_dummies = [
-        RescoreGroup(
-            full_name=f"__rtt__/{i}",
-            min_member=1,
-            member_request={"cpu": 1},
-            has_pod=True,
-        )
-        for i in range(8)
-    ]
-    rtts = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        r.tick(None, probe_dummies)
-        rtts.append(time.perf_counter() - t0)
-    link_rtt = float(np.median(rtts))
+    depth, link_rtt = probe_link_depth(r, interval)
     depth_env = os.environ.get("BST_CHURN_PIPELINE_DEPTH", "auto")
-    if depth_env == "auto":
-        depth = max(1, min(4, math.ceil(link_rtt / interval - 0.6)))
-    else:
+    if depth_env != "auto":
         # clamped like auto mode: _DELTA_BUCKET and the window sizing are
         # rated for depth <= 4 (deeper would push catch-up drains into
         # the re-upload fallback the bucket exists to avoid)
@@ -351,57 +336,29 @@ def config5_churn(ticks: int = 50, interval: float = 0.1):
     # admit_verified skip). Disjoint windows are the tempting wrong
     # answer: siblings planned on pre-charge state collide with the
     # predecessor's best-fit seats almost every time (measured: ~800
-    # skips vs ~7, and a SLOWER drain). The dispatch itself runs on a
-    # helper thread: if the
-    # tunnel's PJRT client blocks the dispatching thread on per-argument
-    # h2d RPCs, that block rides the interval too instead of the loop; at
-    # depth >= 2 that thread can be packing a later dispatch WHILE the
-    # loop admits an earlier batch — the rescorer's internal state lock
-    # serializes admit/release against the dispatch-side pack and delta
-    # drain, so charges are never lost.
-    from collections import deque
-    from concurrent.futures import ThreadPoolExecutor
-
+    # skips vs ~7, and a SLOWER drain). The choreography — helper-thread
+    # dispatch, oldest-batch collect, whole-batch verified admission,
+    # placed-ever dedup — is the package's ops.rescore.TickPipeline; this
+    # loop owns only the churn events and the SLO clock.
     deadline_misses = 0
     loop_times = []  # the SLO series: wall time the LOOP spends per tick
     backlog_drained_tick = None
-    admit_skips = 0  # stale placements rejected by host-side re-verify
-    placed_ever: set = set()
-    inflight: deque = deque()  # (future, groups) oldest-first, len==depth
-    # context-managed: a mid-loop failure must not leave the interpreter
-    # joining an in-flight dispatch against a possibly-hung backend
-    with ThreadPoolExecutor(
-        max_workers=1, thread_name_prefix="tick-dispatch"
-    ) as pool:
+    pipe = TickPipeline(r, depth)
+    with pipe:
         for _ in range(depth):  # pipeline fill: each batch gets an interval
-            groups = pending[:window]
-            inflight.append(
-                (pool.submit(r.tick_dispatch, None, groups), groups)
-            )
+            pipe.submit(pending[:window])
             time.sleep(interval)
         for tick_i in range(ticks):
             t0 = time.perf_counter()
-            pend_f, tick_groups = inflight.popleft()
-            out = r.tick_collect(pend_f.result())
-
-            # admit: every gang the collected batch placed charges its
-            # assignment, re-verified against current occupancy (see loop
-            # comment). The whole batch admits ATOMICALLY from its one
-            # internally-consistent plan — partial admission (a per-tick
-            # fresh cap) or cross-batch mixing reintroduces exactly the
-            # collisions admit_verified exists to catch (measured: a
-            # capped/staggered variant skipped ~10x more). The per-tick
-            # admit bound is therefore the window (depth x ADMIT_WINDOW,
+            out, tick_groups = pipe.collect()
+            # whole-batch atomic admission (TickPipeline.admit_all): the
+            # per-tick admit bound is the window (depth x ADMIT_WINDOW,
             # tens of µs of host numpy per gang; dup re-carries skip for
-            # free), reached only on post-burst catch-up ticks.
-            placed = set(out.placed_groups())
-            for g in tick_groups:
-                if g.full_name in placed and g.full_name not in placed_ever:
-                    if r.admit_verified(out, g.full_name):
-                        placed_ever.add(g.full_name)
-                    else:
-                        admit_skips += 1
-            pending = [g for g in pending if g.full_name not in placed_ever]
+            # free), reached only on post-burst catch-up ticks
+            pipe.admit_all(out, tick_groups)
+            pending = [
+                g for g in pending if g.full_name not in pipe.placed_ever
+            ]
             if backlog_drained_tick is None and len(pending) < ADMIT_WINDOW:
                 backlog_drained_tick = tick_i
 
@@ -415,10 +372,7 @@ def config5_churn(ticks: int = 50, interval: float = 0.1):
                 if g is not None:
                     pending.append(g)
 
-            groups = pending[:window]
-            inflight.append(
-                (pool.submit(r.tick_dispatch, None, groups), groups)
-            )
+            pipe.submit(pending[:window])
 
             elapsed = time.perf_counter() - t0
             loop_times.append(elapsed)
@@ -426,10 +380,8 @@ def config5_churn(ticks: int = 50, interval: float = 0.1):
                 deadline_misses += 1
             else:
                 time.sleep(interval - elapsed)
-        while inflight:  # drain the in-flight batches (unmeasured)
-            pend_f, _ = inflight.popleft()
-            r.tick_collect(pend_f.result())
-            r.drop_last_stats()
+        # __exit__ drains the in-flight batches (unmeasured)
+    admit_skips = pipe.admit_skips
 
     s = r.summary()
     platform = jax.devices()[0].platform

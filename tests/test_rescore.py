@@ -376,48 +376,33 @@ def test_pipeline_depth_hides_simulated_link_rtt(monkeypatch):
     monkeypatch.setattr(rs, "collect_batch", slow_collect)
 
     def drive(depth):
-        from collections import deque
-        from concurrent.futures import ThreadPoolExecutor
+        from batch_scheduler_tpu.ops.rescore import TickPipeline
 
         r = ChurnRescorer(_nodes(8, cpu="8"))
         r.warm([8, WINDOW * depth])
         r.clear_stats()
         pending = [_gang(f"d{depth}-{i}", 2, ts=float(i)) for i in range(24)]
-        placed_ever, inflight = set(), deque()
         window = WINDOW * depth
         overruns = 0
-        with ThreadPoolExecutor(max_workers=1) as pool:
+        pipe = TickPipeline(r, depth)
+        with pipe:
             for _ in range(depth):
-                groups = pending[:window]
-                inflight.append(
-                    (pool.submit(r.tick_dispatch, None, groups), groups)
-                )
+                pipe.submit(pending[:window])
                 time.sleep(INTERVAL)
             for _ in range(TICKS):
                 t0 = time.perf_counter()
-                fut, tick_groups = inflight.popleft()
-                out = r.tick_collect(fut.result())
-                placed = set(out.placed_groups())
-                for g in tick_groups:
-                    if g.full_name in placed and g.full_name not in placed_ever:
-                        if r.admit_verified(out, g.full_name):
-                            placed_ever.add(g.full_name)
+                out, tick_groups = pipe.collect()
+                pipe.admit_all(out, tick_groups)
                 pending = [
-                    g for g in pending if g.full_name not in placed_ever
+                    g for g in pending if g.full_name not in pipe.placed_ever
                 ]
-                groups = pending[:window]
-                inflight.append(
-                    (pool.submit(r.tick_dispatch, None, groups), groups)
-                )
+                pipe.submit(pending[:window])
                 elapsed = time.perf_counter() - t0
                 if elapsed > INTERVAL:
                     overruns += 1
                 else:
                     time.sleep(INTERVAL - elapsed)
-            while inflight:
-                fut, _ = inflight.popleft()
-                r.tick_collect(fut.result())
-        return overruns, len(placed_ever)
+        return overruns, len(pipe.placed_ever)
 
     overruns_d1, placed_d1 = drive(1)
     overruns_d2, placed_d2 = drive(2)
