@@ -9,6 +9,7 @@ from .oracle import (
     schedule_batch,
     score_nodes,
 )
+from .rescore import ChurnRescorer, TickPipeline, probe_link_depth
 from .snapshot import ClusterSnapshot, GroupDemand, node_requested_from_pods
 
 __all__ = [
@@ -28,4 +29,7 @@ __all__ = [
     "ClusterSnapshot",
     "GroupDemand",
     "node_requested_from_pods",
+    "ChurnRescorer",
+    "TickPipeline",
+    "probe_link_depth",
 ]
