@@ -67,6 +67,21 @@ def build_parser() -> argparse.ArgumentParser:
     sim.add_argument("--oracle-addr", default=None, metavar="HOST:PORT",
                      help="score via a remote oracle sidecar (see `serve`) "
                           "instead of the in-process oracle")
+    sim.add_argument(
+        "--oracle-fallback", choices=["deny", "local-cpu"], default="deny",
+        help="behavior when the sidecar transport is down (breaker open / "
+             "retries exhausted): 'deny' surfaces the error into the cycle "
+             "(pods requeue with backoff); 'local-cpu' serves a "
+             "conservative host-side batch — deny only provably-infeasible "
+             "gangs, admit nothing speculatively (docs/resilience.md)",
+    )
+    sim.add_argument(
+        "--oracle-deadline-ms", type=int, default=None, metavar="MS",
+        help="per-request budget propagated to the sidecar: a batch "
+             "stalled past it (e.g. an unwarmed jit compile) answers an "
+             "in-band deadline error within ~2x the budget instead of "
+             "holding the scheduling cycle",
+    )
     sim.add_argument("--nodes", type=int, default=0,
                      help="synthetic nodes to add (in addition to manifests)")
     sim.add_argument("--node-cpu", default="32")
@@ -285,20 +300,29 @@ def cmd_sim(args) -> int:
         or cfg.plugin_config.oracle_background_refresh
     )
     if args.oracle_addr:
-        from ..service.client import OracleClient, RemoteScorer
+        from ..service.client import RemoteScorer, ResilientOracleClient
 
         host, _, port = args.oracle_addr.rpartition(":")
-        oracle_client = OracleClient(host or "127.0.0.1", int(port))
+        # resilient transport: reconnect + retry + breaker + deadline —
+        # connections are lazy, so a sidecar that is still coming up (or
+        # briefly gone) no longer kills the whole run at construction
+        oracle_client = ResilientOracleClient(
+            host or "127.0.0.1", int(port),
+            deadline_ms=args.oracle_deadline_ms, name="fg",
+        )
         # background refresh needs a second connection so row reads on the
         # current batch never contend with the in-flight background batch
         bg_client = None
         if want_bg_refresh:
-            try:
-                bg_client = OracleClient(host or "127.0.0.1", int(port))
-            except OSError:
-                oracle_client.close()
-                raise
-        scorer = RemoteScorer(oracle_client, background_client=bg_client)
+            bg_client = ResilientOracleClient(
+                host or "127.0.0.1", int(port),
+                deadline_ms=args.oracle_deadline_ms, name="bg",
+            )
+        scorer = RemoteScorer(
+            oracle_client,
+            background_client=bg_client,
+            fallback=args.oracle_fallback,
+        )
         remote_scorer = scorer
 
     cluster = SimCluster(

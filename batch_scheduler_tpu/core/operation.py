@@ -21,6 +21,7 @@ from ..api.types import Pod, PodGroup, PodGroupPhase
 from ..cache.pg_cache import PGStatusCache, PodGroupMatchStatus, PodNodePair
 from ..utils import errors as errs
 from ..utils.labels import get_wait_seconds, pod_group_name
+from ..utils.metrics import DEFAULT_REGISTRY
 from ..utils.patch import create_merge_patch
 from ..utils.ttl_cache import TTLCache
 from . import resources as rmath
@@ -201,6 +202,27 @@ class ScheduleOperation:
         if oracle.placed(full_name):
             self._stamp_plan(full_name, pgs, oracle)
             return
+        if getattr(oracle, "degraded", False):
+            # conservative fallback (sidecar unreachable, serving the
+            # local-CPU batch): no placement plan exists, so nothing is
+            # admitted speculatively — but the deny-by-default rule above
+            # would starve every gang for the outage's duration. Instead,
+            # deny ONLY the provably infeasible (independent feasibility
+            # is exact in the fallback batch); everything else proceeds
+            # through the per-pod scan + Permit-quorum path, whose fit
+            # checks run against live cluster state (docs/resilience.md).
+            feasible = oracle.gang_feasible(full_name)
+            DEFAULT_REGISTRY.counter(
+                "bst_oracle_fallback_decisions_total",
+                "PreFilter decisions made on the conservative CPU fallback",
+            ).inc(decision="pass" if feasible else "deny")
+            if feasible:
+                return
+            self.add_to_deny_cache(full_name)
+            raise errs.ResourceNotEnoughError(
+                f"{full_name}: provably infeasible "
+                f"({pgs.pod_group.spec.min_member} members; degraded oracle)"
+            )
         self.add_to_deny_cache(full_name)
         if oracle.gang_feasible(full_name):
             # Feasible alone, but higher-priority gangs consume the space in

@@ -31,7 +31,66 @@ from ..ops.oracle import execute_batch_host
 from ..ops.snapshot import ClusterSnapshot, GroupDemand
 from ..utils.errors import StaleBatchError
 
-__all__ = ["OracleScorer", "demand_from_status"]
+__all__ = ["OracleScorer", "demand_from_status", "conservative_cpu_batch"]
+
+
+def conservative_cpu_batch(snap: ClusterSnapshot):
+    """Degraded-mode batch: the conservative host-side answers a
+    RemoteScorer serves while the sidecar is unreachable (breaker open /
+    retries exhausted — docs/resilience.md).
+
+    Semantics match the kube-scheduler rule that a scorer outage makes
+    decisions conservative, never absent:
+
+    - per-(group, node) member CAPACITY is computed exactly from the
+      snapshot (lane-wise ``left // member_request`` under the fit mask),
+      so Filter/Score keep answering with real numbers;
+    - ``gang_feasible`` is exact INDEPENDENT feasibility (sum of per-node
+      capacity >= remaining members): when it is False the gang provably
+      cannot fit even alone, and PreFilter may deny it;
+    - ``placed`` is all-False and no assignment exists: nothing is
+      admitted speculatively through a whole-gang plan — members that do
+      pass PreFilter go through the per-pod scan + Permit-quorum path,
+      whose fit checks run against live cluster state.
+
+    Returns the same ``(host, row_fetcher)`` pair as a real batch. Built
+    lane-by-lane (R passes over a [G, N] array) so the degraded path
+    never materialises the [G, N, R] broadcast cube.
+    """
+    left = np.maximum(
+        snap.alloc.astype(np.int64) - snap.requested.astype(np.int64), 0
+    )  # [N, R]
+    group_req = snap.group_req.astype(np.int64)  # [G, R]
+    g_count, n_count = group_req.shape[0], left.shape[0]
+    cap = np.full((g_count, n_count), np.iinfo(np.int32).max, dtype=np.int64)
+    for r in range(group_req.shape[1]):
+        req_r = group_req[:, r]
+        has = req_r > 0
+        if not has.any():
+            continue
+        lane_cap = left[:, r][None, :] // np.maximum(req_r, 1)[:, None]
+        cap = np.where(has[:, None], np.minimum(cap, lane_cap), cap)
+    cap = np.where(snap.fit_mask, cap, 0)  # [1,N] broadcast or [G,N]
+    cap = np.where(snap.node_valid[None, :], cap, 0)
+    cap = np.clip(cap, 0, np.iinfo(np.int32).max).astype(np.int32)
+    feasible = np.asarray(snap.group_valid) & (
+        cap.sum(axis=1, dtype=np.int64) >= snap.remaining
+    )
+    host = {
+        "gang_feasible": feasible,
+        "placed": np.zeros(g_count, dtype=bool),
+        "progress": np.zeros(g_count, dtype=np.int32),
+        "best": 0,
+        "best_exists": False,
+        "assignment_nodes": np.zeros((g_count, 1), dtype=np.int32),
+        "assignment_counts": np.zeros((g_count, 1), dtype=np.int32),
+    }
+
+    def row_fetcher(kind: str, g: int) -> np.ndarray:
+        # capacity doubles as the score rank: more headroom, better seat
+        return cap[g]
+
+    return host, row_fetcher
 
 
 def demand_from_status(full_name: str, pgs: PodGroupMatchStatus) -> GroupDemand:
@@ -98,6 +157,11 @@ class OracleScorer:
     """Caches one batch of oracle results; invalidated by ``mark_dirty``."""
 
     supports_background_refresh = True
+    # True while the served batch came from a degraded (conservative
+    # fallback) path — RemoteScorer flips it; the in-process scorer never
+    # degrades. ScheduleOperation reads it to relax the deny-by-default
+    # PreFilter rule to deny-only-provably-infeasible.
+    degraded = False
 
     def __init__(
         self,
@@ -273,8 +337,20 @@ class OracleScorer:
 
         return host, row_fetcher
 
+    def _probe_due(self) -> bool:
+        """Whether a degraded batch is worth re-attempting now (overridden
+        by RemoteScorer to ask its client's breaker). Gating on the
+        breaker keeps the degraded steady state cheap: while the cooldown
+        runs, the fallback batch is served as an ordinary fresh batch."""
+        return True
+
     def _stale(self, cluster) -> bool:
         if self._dirty_gen != self._clean_gen or self._state is None:
+            return True
+        if self.degraded and self._probe_due():
+            # a conservative fallback batch auto-expires the moment the
+            # transport is worth probing again, so recovery needs no
+            # cluster change to trigger it
             return True
         version_fn = getattr(cluster, "version", None)
         if callable(version_fn):
@@ -416,10 +492,15 @@ class OracleScorer:
         try:
             return int(state.row("capacity", g)[n])
         except StaleBatchError:
-            # the row raced a newer batch — answer conservatively, the
-            # caller's next cycle refreshes. ONLY this error class is
-            # swallowed: a dead transport turning into an invisible
-            # all-deny is exactly the failure mode to avoid.
+            # the batch's rows no longer exist — raced by a newer batch,
+            # or (remotely) lost with a re-established connection. Answer
+            # conservatively NOW and invalidate, so the next ensure_fresh
+            # re-batches: on a static cluster nothing else would, and the
+            # rowless batch would serve capacity-0 denials forever (the
+            # chaos-fuzz livelock). ONLY this error class is swallowed: a
+            # dead transport turning into an invisible all-deny is
+            # exactly the failure mode to avoid.
+            self.mark_dirty()
             return 0
 
     def node_score(self, full_name: str, node_name: str) -> int:
@@ -433,6 +514,7 @@ class OracleScorer:
         try:
             return int(state.row("scores", g)[n])
         except StaleBatchError:
+            self.mark_dirty()  # see node_capacity
             return -(2**30)
 
     def assignment(self, full_name: str) -> Dict[str, int]:

@@ -19,7 +19,11 @@ from ..api.types import Pod, PodPhase
 from ..client.apiserver import NotFoundError
 from ..client.clientset import Clientset
 from ..core import resources as rmath
-from ..utils.errors import SchedulingError
+from ..utils.errors import (
+    OracleDeadlineError,
+    OracleTransportError,
+    SchedulingError,
+)
 from ..utils.labels import pod_group_name
 from ..utils.metrics import DEFAULT_REGISTRY
 from .cluster import ClusterState
@@ -129,6 +133,14 @@ class Scheduler:
         )
         self._binds_total = DEFAULT_REGISTRY.counter(
             "bst_pods_bound_total", "Pods successfully bound"
+        )
+        # cycles aborted by an unexpected error (pod requeued with
+        # backoff), split by cause: "oracle-transport" covers sidecar
+        # transport/deadline failures in --oracle-fallback=deny mode —
+        # the series an operator alerts on during a sidecar outage
+        self._cycle_errors = DEFAULT_REGISTRY.counter(
+            "bst_cycle_errors_total",
+            "Scheduling cycles aborted by an error, by kind",
         )
 
     # -- lifecycle ---------------------------------------------------------
@@ -472,9 +484,16 @@ class Scheduler:
         try:
             with self._cycle_seconds.time():
                 return self._schedule_one(info)
-        except Exception:
+        except Exception as e:
             # a broken cycle must not kill the loop; release any
             # capacity assumed mid-cycle, then retry the pod
+            self._cycle_errors.inc(
+                kind=(
+                    "oracle-transport"
+                    if isinstance(e, (OracleTransportError, OracleDeadlineError))
+                    else "other"
+                )
+            )
             self.cluster.forget(info.uid)
             if self.plugin is not None:
                 self.plugin.mark_dirty()

@@ -330,14 +330,15 @@ class ChurnRescorer:
             with self._state_lock:
                 deltas = self._req_deltas
                 rows_total = sum(len(d[0]) for d in deltas)
+                cur_dev = self._req_dev
                 resync = (
-                    self._req_dev is None
-                    or self._req_dev.shape != padded_requested.shape
+                    cur_dev is None
+                    or cur_dev.shape != padded_requested.shape
                     or rows_total > _DELTA_BUCKET  # burst: re-upload wins
                 )
                 drained = None
                 if resync:
-                    if self._req_dev is not None:
+                    if cur_dev is not None:
                         # an established mirror falling back is the perf
                         # cliff the bucket sizing exists to avoid — count it
                         self.reupload_fallbacks += 1
@@ -373,7 +374,13 @@ class ChurnRescorer:
                     self._req_dev = dev
                     self._req_uploading = False
             elif drained is not None:
-                self._req_dev = _scatter_add_rows(self._req_dev, *drained)
+                # every None<->non-None transition of _req_dev happens
+                # under _state_lock (admit/release read it there); the
+                # scatter is already dispatched off ``cur_dev``, so the
+                # critical section is a single store (ADVICE r5)
+                dev = _scatter_add_rows(cur_dev, *drained)
+                with self._state_lock:
+                    self._req_dev = dev
             return self._req_dev
         except Exception:
             with self._state_lock:
@@ -626,6 +633,85 @@ def probe_link_depth(
     return max(1, min(cap, math.ceil(rtt / interval - 0.6))), rtt
 
 
+class _DispatchFuture:
+    """Minimal future for :class:`_DaemonDispatcher`: result/exception
+    delivery via an Event. Cancellation exists only as
+    ``shutdown(cancel_futures=True)`` failing still-queued futures."""
+
+    __slots__ = ("_done", "_result", "_exc")
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._result = None
+        self._exc: Optional[BaseException] = None
+
+    def _finish(self, result=None, exc: Optional[BaseException] = None) -> None:
+        self._result, self._exc = result, exc
+        self._done.set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("dispatch result not ready")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class _DaemonDispatcher:
+    """Single-worker executor on a DAEMON thread.
+
+    Exists because concurrent.futures joins its (non-daemon) workers from
+    an interpreter-exit hook even after ``shutdown(wait=False)``, so a
+    dispatch hung inside a dead backend would block interpreter exit
+    forever — the residual join ADVICE r5 flagged in TickPipeline's
+    failure path. A daemon worker dies with the process instead; the
+    clean path still drains and joins exactly as before."""
+
+    def __init__(self, name: str):
+        from collections import deque
+
+        self._cond = threading.Condition()
+        self._items = deque()  # (fn, args, future)
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, name=name, daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, fn, *args) -> _DispatchFuture:
+        fut = _DispatchFuture()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("dispatcher is shut down")
+            self._items.append((fn, args, fut))
+            self._cond.notify()
+        return fut
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._items and not self._closed:
+                    self._cond.wait()
+                if not self._items:
+                    return  # closed and drained
+                fn, args, fut = self._items.popleft()
+            try:
+                fut._finish(result=fn(*args))
+            except BaseException as e:  # noqa: BLE001 — delivered via result()
+                fut._finish(exc=e)
+
+    def shutdown(self, wait: bool = True, cancel_futures: bool = False) -> None:
+        with self._cond:
+            self._closed = True
+            if cancel_futures:
+                for _, _, fut in self._items:
+                    fut._finish(exc=RuntimeError("dispatch cancelled"))
+                self._items.clear()
+            self._cond.notify_all()
+        if wait:
+            self._thread.join()
+
+
 class TickPipeline:
     """Depth-k software pipeline around a :class:`ChurnRescorer`.
 
@@ -665,16 +751,13 @@ class TickPipeline:
 
     def __init__(self, rescorer: "ChurnRescorer", depth: int):
         from collections import deque
-        from concurrent.futures import ThreadPoolExecutor
 
         self.rescorer = rescorer
         self.depth = max(1, int(depth))
         self.placed_ever: set = set()
         self.admit_skips = 0
         self._inflight = deque()  # (future, groups) oldest-first
-        self._pool = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="tick-dispatch"
-        )
+        self._pool = _DaemonDispatcher(name="tick-dispatch")
 
     # -- pipeline ----------------------------------------------------------
 
@@ -735,9 +818,12 @@ class TickPipeline:
         # a mid-loop failure must not leave the interpreter joining an
         # in-flight dispatch against a possibly-hung backend forever:
         # drain only on the clean path; on the failure path cancel the
-        # queued not-yet-started dispatches too (without cancel_futures
-        # they would still execute against the possibly-hung backend,
-        # and concurrent.futures' atexit hook would join the worker)
+        # queued not-yet-started dispatches (they would still execute
+        # against the possibly-hung backend) and skip the join — the
+        # worker is a daemon thread (_DaemonDispatcher), so even a
+        # dispatch already RUNNING against a hung backend can never
+        # block interpreter exit (ADVICE r5: concurrent.futures' exit
+        # hook would join it regardless of wait=False)
         try:
             if exc_type is None:
                 self.drain()

@@ -1,10 +1,11 @@
 from . import protocol
-from .client import OracleClient, RemoteScorer
+from .client import OracleClient, RemoteScorer, ResilientOracleClient
 from .server import OracleServer, serve_background
 
 __all__ = [
     "protocol",
     "OracleClient",
+    "ResilientOracleClient",
     "RemoteScorer",
     "OracleServer",
     "serve_background",

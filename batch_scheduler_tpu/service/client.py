@@ -1,7 +1,12 @@
 """Oracle sidecar clients.
 
 ``OracleClient`` is the raw protocol client (one TCP connection, serialized
-round-trips). ``RemoteScorer`` plugs it into ScheduleOperation with the same
+round-trips, no recovery). ``ResilientOracleClient`` is the production
+transport: same surface, plus automatic reconnect, bounded retries with
+exponential backoff + full jitter (utils.retry.RetryPolicy), per-request
+deadline propagation, and a circuit breaker that fails fast during an
+outage and re-closes through a half-open ping probe (docs/resilience.md).
+``RemoteScorer`` plugs either into ScheduleOperation with the same
 interface as the in-process OracleScorer — the control plane is agnostic to
 whether the oracle lives in-process on the local chip or behind the sidecar
 (the deployment split of the north star: Go plugin <-> JAX sidecar).
@@ -11,22 +16,52 @@ from __future__ import annotations
 
 import socket
 import threading
-from typing import Tuple
+import time
+from typing import Optional, Tuple
 
 import numpy as np
 
-from ..core.oracle_scorer import OracleScorer
+from ..core.oracle_scorer import OracleScorer, conservative_cpu_batch
 from ..ops.snapshot import ClusterSnapshot
+from ..utils.errors import (
+    CircuitOpenError,
+    OracleDeadlineError,
+    OracleTransportError,
+    StaleBatchError,
+)
+from ..utils.metrics import DEFAULT_REGISTRY, Registry
+from ..utils.retry import CircuitBreaker, RetryPolicy
 from . import protocol as proto
 
-__all__ = ["OracleClient", "RemoteScorer"]
+__all__ = ["OracleClient", "ResilientOracleClient", "RemoteScorer"]
+
+
+def in_band_error(message: str) -> Exception:
+    """Classify an in-band ERROR frame's message: stale-batch answers
+    (protocol.is_stale_batch_message — including the post-reconnect
+    "before any batch" form) map to StaleBatchError, the one class the
+    scorer's row reads answer conservatively; everything else is a plain
+    server error. Neither is a transport failure."""
+    if proto.is_stale_batch_message(message):
+        return StaleBatchError(message)
+    return RuntimeError(f"oracle server error: {message}")
 
 
 class OracleClient:
     # default generous enough to sit through a first TPU jit compile of a
     # new bucket shape (~20-40s) plus the batch itself
-    def __init__(self, host: str, port: int, timeout: float = 120.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout: float = 120.0,
+        connect_timeout: Optional[float] = None,
+    ):
+        self._timeout = timeout
+        self._sock = socket.create_connection(
+            (host, port), timeout=connect_timeout or timeout
+        )
+        self._sock.settimeout(timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
 
@@ -36,39 +71,319 @@ class OracleClient:
         except OSError:
             pass
 
-    def _round_trip(self, msg_type: int, payload: bytes) -> Tuple[int, bytes]:
+    def _round_trip(
+        self, msg_type: int, payload: bytes, deadline_ms: Optional[int] = None
+    ) -> Tuple[int, bytes]:
         with self._lock:
-            proto.write_frame(self._sock, msg_type, payload)
-            resp_type, resp = proto.read_frame(self._sock)
+            if deadline_ms is not None:
+                # bound the wait to ~2x the announced budget: the server
+                # answers a DEADLINE_ERROR within the deadline itself, so
+                # anything past 2x is a transport stall, not a slow batch
+                self._sock.settimeout(
+                    min(self._timeout, deadline_ms / 1000.0 * 2.0 + 0.25)
+                )
+            try:
+                if deadline_ms is not None:
+                    proto.write_frame(
+                        self._sock,
+                        proto.MsgType.DEADLINE,
+                        proto.pack_deadline(deadline_ms),
+                    )
+                proto.write_frame(self._sock, msg_type, payload)
+                try:
+                    resp_type, resp = proto.read_frame(self._sock)
+                except ValueError as e:
+                    # bad magic / oversized length: the STREAM is broken,
+                    # not the request — classify as transport here so a
+                    # client-side packing ValueError (a programming error,
+                    # raised before any bytes move) stays distinguishable
+                    raise OracleTransportError(f"desynced stream: {e}") from e
+            finally:
+                if deadline_ms is not None:
+                    self._sock.settimeout(self._timeout)
+        if resp_type == proto.MsgType.DEADLINE_ERROR:
+            raise OracleDeadlineError(resp.decode(errors="replace"))
         if resp_type == proto.MsgType.ERROR:
-            message = resp.decode(errors="replace")
-            if "stale batch" in message:
-                from ..utils.errors import StaleBatchError
-
-                raise StaleBatchError(message)
-            raise RuntimeError(f"oracle server error: {message}")
+            raise in_band_error(resp.decode(errors="replace"))
         return resp_type, resp
 
-    def ping(self) -> bool:
-        resp_type, _ = self._round_trip(proto.MsgType.PING, b"")
+    def ping(self, deadline_ms: Optional[int] = None) -> bool:
+        # a deadline here mostly buys the tightened client-side socket
+        # timeout (the server answers pings inline, ignoring the budget):
+        # the breaker's half-open probe must stay bounded against a
+        # hung-but-accepting sidecar
+        resp_type, _ = self._round_trip(
+            proto.MsgType.PING, b"", deadline_ms=deadline_ms
+        )
         return resp_type == proto.MsgType.PONG
 
-    def schedule(self, req: proto.ScheduleRequest) -> proto.ScheduleResponse:
+    def schedule(
+        self, req: proto.ScheduleRequest, deadline_ms: Optional[int] = None
+    ) -> proto.ScheduleResponse:
         resp_type, resp = self._round_trip(
-            proto.MsgType.SCHEDULE_REQ, proto.pack_schedule_request(req)
+            proto.MsgType.SCHEDULE_REQ,
+            proto.pack_schedule_request(req),
+            deadline_ms=deadline_ms,
         )
         if resp_type != proto.MsgType.SCHEDULE_RESP:
-            raise RuntimeError(f"unexpected response type {resp_type}")
-        return proto.unpack_schedule_response(resp)
+            raise OracleTransportError(
+                f"unexpected response type {resp_type} (desynced stream)"
+            )
+        try:
+            return proto.unpack_schedule_response(resp)
+        except ValueError as e:  # truncated/garbled payload: stream damage
+            raise OracleTransportError(f"undecodable response: {e}") from e
 
-    def row(self, kind: str, group_index: int, batch_seq: int = 0) -> np.ndarray:
+    def row(
+        self,
+        kind: str,
+        group_index: int,
+        batch_seq: int = 0,
+        deadline_ms: Optional[int] = None,
+    ) -> np.ndarray:
         resp_type, resp = self._round_trip(
             proto.MsgType.ROW_REQ,
             proto.pack_row_request(kind, group_index, batch_seq),
+            deadline_ms=deadline_ms,
         )
         if resp_type != proto.MsgType.ROW_RESP:
-            raise RuntimeError(f"unexpected response type {resp_type}")
-        return np.frombuffer(resp, dtype="<i4")
+            raise OracleTransportError(
+                f"unexpected response type {resp_type} (desynced stream)"
+            )
+        try:
+            return np.frombuffer(resp, dtype="<i4")
+        except ValueError as e:  # payload not a whole int32 row: desync
+            raise OracleTransportError(f"undecodable row: {e}") from e
+
+
+# what counts as a TRANSPORT failure (retried, advances the breaker):
+# socket errors incl. timeouts (OSError covers ConnectionError), EOF, and
+# OracleTransportError (which OracleClient raises for frame-level desync:
+# bad magic, oversized length, undecodable response). Deliberately NOT
+# ValueError: a request-packing ValueError is a client-side programming
+# error raised before any bytes move — retrying it against a healthy
+# sidecar (and degrading to the CPU fallback) would mask the bug as an
+# outage. In-band answers (StaleBatchError, OracleDeadlineError, plain
+# RuntimeError) rode a WORKING transport and are excluded by catch order.
+_TRANSPORT_ERRORS = (OSError, EOFError, OracleTransportError)
+
+_BREAKER_STATE_VALUES = {"closed": 0, "open": 1, "half-open": 2}
+
+
+class ResilientOracleClient:
+    """OracleClient with reconnect, retry, deadline, and circuit breaker.
+
+    Same call surface as OracleClient (ping/schedule/row/close), so
+    RemoteScorer takes either. The connection is lazy: constructed on
+    first use and re-established after any transport failure. Per
+    request: the breaker gates admission (open => CircuitOpenError
+    without touching the socket; half-open => one ping() probe decides),
+    then up to ``retry_policy.max_attempts`` attempts run with
+    full-jitter backoff, reconnecting between attempts. Semantic answers
+    — StaleBatchError, in-band server errors, OracleDeadlineError — are
+    never retried and never advance the breaker.
+
+    Observability (registry, default the process registry):
+    bst_oracle_retries_total, bst_oracle_transport_failures_total,
+    bst_oracle_reconnects_total, bst_oracle_deadline_errors_total
+    (counters) and bst_oracle_breaker_state (gauge; 0=closed 1=open
+    2=half-open), all labelled by ``client`` (``name`` or host:port).
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout: float = 120.0,
+        connect_timeout: float = 5.0,
+        retry_policy: Optional[RetryPolicy] = None,
+        breaker: Optional[CircuitBreaker] = None,
+        deadline_ms: Optional[int] = None,
+        name: Optional[str] = None,
+        registry: Optional[Registry] = None,
+    ):
+        self._host, self._port = host, port
+        self._timeout = timeout
+        self._connect_timeout = connect_timeout
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.deadline_ms = self._check_deadline(deadline_ms)
+        self._client: Optional[OracleClient] = None
+        self._connected_once = False
+        self._lock = threading.RLock()
+        reg = registry or DEFAULT_REGISTRY
+        self._label = name or f"{host}:{port}"
+        self._retries = reg.counter(
+            "bst_oracle_retries_total",
+            "Oracle requests retried after a transport failure",
+        )
+        self._failures = reg.counter(
+            "bst_oracle_transport_failures_total",
+            "Oracle transport failures (per attempt, pre-retry)",
+        )
+        self._reconnects = reg.counter(
+            "bst_oracle_reconnects_total",
+            "Oracle connections re-established after a transport failure",
+        )
+        self._deadline_errors = reg.counter(
+            "bst_oracle_deadline_errors_total",
+            "Oracle requests answered with an in-band deadline error",
+        )
+        self._breaker_gauge = reg.gauge(
+            "bst_oracle_breaker_state",
+            "Oracle circuit breaker state (0=closed 1=open 2=half-open)",
+        )
+        self.breaker = breaker or CircuitBreaker()
+        self.breaker.on_transition = self._record_breaker_state
+        self._record_breaker_state(self.breaker.state)
+
+    @staticmethod
+    def _check_deadline(deadline_ms: Optional[int]) -> Optional[int]:
+        """Validate a deadline at CONFIG time. Left to pack_deadline, an
+        invalid value would raise ValueError inside the request path,
+        where it is indistinguishable from a desynced-stream transport
+        failure — retried, reconnected, breaker-tripped, and (with the
+        local-cpu fallback) silently degrading against a healthy sidecar."""
+        if deadline_ms is not None and not 0 < deadline_ms <= 0xFFFFFFFF:
+            raise ValueError(
+                f"deadline_ms must be in 1..{0xFFFFFFFF}, got {deadline_ms}"
+            )
+        return deadline_ms
+
+    def _record_breaker_state(self, state: str) -> None:
+        self._breaker_gauge.set(
+            _BREAKER_STATE_VALUES.get(state, -1), client=self._label
+        )
+
+    def would_attempt(self) -> bool:
+        """True when the next call would actually touch the transport
+        (breaker closed/half-open/cooldown elapsed) — the scorer's cue
+        that a degraded batch is worth re-probing."""
+        return self.breaker.would_attempt()
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop()
+
+    def _ensure(self) -> OracleClient:
+        if self._client is None:
+            self._client = OracleClient(
+                self._host,
+                self._port,
+                timeout=self._timeout,
+                connect_timeout=self._connect_timeout,
+            )
+            if self._connected_once:
+                self._reconnects.inc(client=self._label)
+            self._connected_once = True
+        return self._client
+
+    def _drop(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+    def _admit(self) -> None:
+        decision = self.breaker.admit()
+        if decision == "refuse":
+            raise CircuitOpenError(
+                f"oracle circuit open ({self._label}); "
+                f"retrying after {self.breaker.reset_timeout}s cooldown"
+            )
+        if decision == "probe":
+            # the probe must stay BOUNDED against a hung-but-accepting
+            # sidecar: without a deadline it would wait the full base
+            # socket timeout (default 120s) inside a scheduling cycle on
+            # every cooldown expiry — the exact stall the breaker exists
+            # to prevent. Use the configured deadline, else the connect
+            # timeout as the probe budget.
+            probe_ms = (
+                self.deadline_ms
+                if self.deadline_ms is not None
+                else max(int(self._connect_timeout * 1000), 100)
+            )
+            try:
+                ok = self._ensure().ping(deadline_ms=probe_ms)
+            except Exception:  # noqa: BLE001 — any probe failure re-opens
+                ok = False
+            if not ok:
+                self._drop()
+                self.breaker.record_failure()
+                raise CircuitOpenError(
+                    f"oracle half-open probe failed ({self._label})"
+                )
+            self.breaker.record_success()
+
+    def _call(self, op: str, fn):
+        with self._lock:
+            self._admit()
+            last: Optional[BaseException] = None
+            for attempt in range(self.retry_policy.max_attempts):
+                if attempt:
+                    self._retries.inc(op=op, client=self._label)
+                    time.sleep(self.retry_policy.backoff(attempt - 1))
+                try:
+                    result = fn(self._ensure())
+                except (StaleBatchError, OracleDeadlineError) as e:
+                    # semantic answers over a live transport: never
+                    # retried (stale stays stale; a deadline retry blows
+                    # the same budget), never advance the breaker
+                    if isinstance(e, OracleDeadlineError):
+                        self._deadline_errors.inc(client=self._label)
+                    self.breaker.record_success()
+                    raise
+                except _TRANSPORT_ERRORS as e:
+                    self._failures.inc(op=op, client=self._label)
+                    self._drop()
+                    self.breaker.record_failure()
+                    last = e
+                    if not self.breaker.would_attempt():
+                        break  # breaker opened mid-loop: stop burning attempts
+                except RuntimeError:
+                    # in-band server error (bad request, row out of
+                    # range): the transport answered — surface as-is
+                    self.breaker.record_success()
+                    raise
+                else:
+                    self.breaker.record_success()
+                    return result
+            raise OracleTransportError(
+                f"oracle {op} via {self._label} failed after "
+                f"{self.retry_policy.max_attempts} attempts: {last}"
+            ) from last
+
+    def ping(self, deadline_ms: Optional[int] = None) -> bool:
+        d = (
+            self.deadline_ms
+            if deadline_ms is None
+            else self._check_deadline(deadline_ms)
+        )
+        return self._call("ping", lambda c: c.ping(deadline_ms=d))
+
+    def schedule(
+        self, req: proto.ScheduleRequest, deadline_ms: Optional[int] = None
+    ) -> proto.ScheduleResponse:
+        d = (
+            self.deadline_ms
+            if deadline_ms is None
+            else self._check_deadline(deadline_ms)
+        )
+        return self._call("schedule", lambda c: c.schedule(req, deadline_ms=d))
+
+    def row(
+        self,
+        kind: str,
+        group_index: int,
+        batch_seq: int = 0,
+        deadline_ms: Optional[int] = None,
+    ) -> np.ndarray:
+        d = (
+            self.deadline_ms
+            if deadline_ms is None
+            else self._check_deadline(deadline_ms)
+        )
+        return self._call(
+            "row", lambda c: c.row(kind, group_index, batch_seq, deadline_ms=d)
+        )
 
 
 class RemoteScorer(OracleScorer):
@@ -85,21 +400,60 @@ class RemoteScorer(OracleScorer):
     batch's row fetcher is pinned to the connection that executed it (the
     server keeps batch state per connection), so row reads on the current
     batch never contend with the next batch running on the other
-    connection."""
+    connection.
+
+    ``fallback`` decides what a batch does when the sidecar transport is
+    down (retries exhausted or breaker open) or over deadline:
+
+    - ``"deny"`` (default): the error surfaces into the scheduling cycle
+      (the cycle requeues the pod with backoff — visible failure).
+    - ``"local-cpu"``: serve a CONSERVATIVE host-side batch instead
+      (core.oracle_scorer.conservative_cpu_batch): real per-node member
+      capacities and exact independent-feasibility, but no placements and
+      no plans — so nothing is admitted speculatively, and PreFilter
+      denies only provably-infeasible gangs (docs/resilience.md). The
+      scorer marks itself ``degraded``; with a ResilientOracleClient it
+      re-probes automatically once the breaker cooldown elapses."""
+
+    FALLBACK_MODES = ("deny", "local-cpu")
 
     def __init__(
-        self, client: OracleClient, background_client: OracleClient = None
+        self,
+        client: OracleClient,
+        background_client: OracleClient = None,
+        fallback: str = "deny",
     ):
         super().__init__()
+        if fallback not in self.FALLBACK_MODES:
+            raise ValueError(
+                f"unknown fallback {fallback!r} (use one of {self.FALLBACK_MODES})"
+            )
         self._clients = [client] if background_client is None else [
             client, background_client,
         ]
         self._next = 0
+        self.fallback = fallback
         self.supports_background_refresh = background_client is not None
+        self._fallback_batches = DEFAULT_REGISTRY.counter(
+            "bst_oracle_fallback_batches_total",
+            "Oracle batches served by the conservative local-CPU fallback",
+        )
+        self._degraded_gauge = DEFAULT_REGISTRY.gauge(
+            "bst_oracle_degraded",
+            "1 while the remote scorer serves the conservative CPU fallback",
+        )
 
     def close(self) -> None:
         for c in self._clients:
             c.close()
+
+    def _probe_due(self) -> bool:
+        """While degraded, a batch is worth re-attempting only when the
+        next transport call would actually go out (breaker cooldown
+        elapsed). A plain OracleClient has no breaker: always re-attempt."""
+        client = self._clients[self._next]
+        would = getattr(client, "would_attempt", None)
+        return True if would is None else would()
 
     def _execute(self, snap: ClusterSnapshot):
         # fit_mask may be the [1,N] broadcast fast path; the wire carries
@@ -124,7 +478,25 @@ class RemoteScorer(OracleScorer):
         # the CURRENT batch's rows are not being read from
         client = self._clients[self._next]
         self._next = (self._next + 1) % len(self._clients)
-        resp = client.schedule(req)
+        try:
+            resp = client.schedule(req)
+        except _TRANSPORT_ERRORS + (OracleDeadlineError,):
+            # raw OSError/EOFError included, not just the resilient
+            # client's wrapped OracleTransportError: a plain OracleClient
+            # is a supported transport here, and its bare socket errors
+            # must reach the same fallback
+            if self.fallback != "local-cpu":
+                raise
+            # conservative degradation: safe progress over exact answers.
+            # CircuitOpenError lands here too, so during an outage this
+            # path costs one host-side numpy pass, no connect timeout.
+            self.degraded = True
+            self._degraded_gauge.set(1)
+            self._fallback_batches.inc()
+            return conservative_cpu_batch(snap)
+        if self.degraded:
+            self.degraded = False
+            self._degraded_gauge.set(0)
         host = {
             "gang_feasible": resp.gang_feasible,
             "placed": resp.placed,

@@ -101,6 +101,20 @@ class NativeOracleClient:
     def _error(self) -> str:
         return self._lib.bsp_last_error(self._handle).decode(errors="replace")
 
+    def _raise_op_error(self, op: str) -> None:
+        """Classify a failed native call like the Python client does:
+        stale-batch answers become StaleBatchError so the scorer's row
+        reads stay conservative through the C++ transport too, instead of
+        a RuntimeError killing the scheduling cycle. (The native client
+        does not send DEADLINE frames; deadline propagation is a
+        ResilientOracleClient feature.)"""
+        from ..utils.errors import StaleBatchError
+
+        message = self._error()
+        if proto.is_stale_batch_message(message):
+            raise StaleBatchError(message)
+        raise RuntimeError(f"{op} failed: {message}")
+
     def ping(self) -> bool:
         return self._lib.bsp_ping(self._handle) == 0
 
@@ -138,7 +152,7 @@ class NativeOracleClient:
             ctypes.byref(k_out), k_cap, ctypes.byref(batch_seq),
         )
         if rc != 0:
-            raise RuntimeError(f"bsp_schedule failed: {self._error()}")
+            self._raise_op_error("bsp_schedule")
         k = int(k_out.value)
         return proto.ScheduleResponse(
             gang_feasible=gang_feasible.astype(bool),
@@ -164,5 +178,5 @@ class NativeOracleClient:
             ctypes.byref(n_out),
         )
         if rc != 0:
-            raise RuntimeError(f"bsp_row failed: {self._error()}")
+            self._raise_op_error("bsp_row")
         return out[: int(n_out.value)].copy()

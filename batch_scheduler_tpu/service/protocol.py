@@ -20,6 +20,18 @@ Message types:
   ROW_RESP      : the row, int32[N]
   PING/PONG     : liveness
   ERROR         : UTF-8 message
+  DEADLINE      : u32 budget in ms, annotating the NEXT request on this
+                  connection (no reply); the server answers that request
+                  with DEADLINE_ERROR if its budget elapses first. A
+                  separate annotation frame instead of a request-header
+                  field so every existing layout (and the native C++
+                  client, which never sends deadlines) stays bit-for-bit
+                  unchanged. Ship client and server together: a pre-BSO2.1
+                  server answers DEADLINE with an ERROR frame and desyncs.
+  DEADLINE_ERROR: UTF-8 message — the annotated request's budget elapsed
+                  server-side (the batch keeps running; its result is
+                  dropped). Deliberately distinct from ERROR so clients
+                  can tell "sidecar alive but slow" from a real failure.
 """
 
 from __future__ import annotations
@@ -43,6 +55,9 @@ __all__ = [
     "unpack_schedule_response",
     "pack_row_request",
     "unpack_row_request",
+    "pack_deadline",
+    "unpack_deadline",
+    "is_stale_batch_message",
 ]
 
 # bumped BSO1 -> BSO2 when the request header grew mask_rows: the layout
@@ -63,6 +78,8 @@ class MsgType:
     PING = 5
     PONG = 6
     ERROR = 7
+    DEADLINE = 8
+    DEADLINE_ERROR = 9
 
 
 ROW_KINDS = ("capacity", "scores")
@@ -241,6 +258,31 @@ def unpack_schedule_response(payload: bytes) -> ScheduleResponse:
         assignment_counts=take(g * k, "<i4", (g, k)),
         batch_seq=batch_seq,
     )
+
+
+def is_stale_batch_message(message: str) -> bool:
+    """True when an in-band server error means "this batch's rows no
+    longer exist": an explicit stale-batch refusal, or a row request on a
+    connection with no batch state yet (the same situation seen through a
+    reconnect). Shared by the Python client and the native-client
+    bindings so both transports map it to StaleBatchError — the one class
+    the scorer's row reads may answer conservatively."""
+    return "stale batch" in message or "before any batch" in message
+
+
+# -- deadline annotation ---------------------------------------------------
+
+_DEADLINE = struct.Struct("<I")
+
+
+def pack_deadline(deadline_ms: int) -> bytes:
+    if not 0 < deadline_ms <= 0xFFFFFFFF:
+        raise ValueError(f"deadline_ms out of range: {deadline_ms}")
+    return _DEADLINE.pack(deadline_ms)
+
+
+def unpack_deadline(payload: bytes) -> int:
+    return int(_DEADLINE.unpack(payload)[0])
 
 
 # -- row request/response --------------------------------------------------

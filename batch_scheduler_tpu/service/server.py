@@ -6,6 +6,12 @@ this sidecar, which owns the TPU and answers with O(G) verdicts + compact
 assignments. Stateless across batches (all durable state stays in the CRD
 status, SURVEY.md §5 checkpoint/resume) — per-connection, the last batch's
 (G,N) tensors are kept on device so row fetches don't resend the batch.
+
+Deadline enforcement (docs/resilience.md): a DEADLINE annotation frame
+bounds the next request; request bodies run on a per-connection daemon
+worker so the handler can answer a DEADLINE_ERROR frame the moment the
+budget elapses instead of letting a slow jit compile blow the caller's
+scheduling-cycle budget.
 """
 
 from __future__ import annotations
@@ -56,75 +62,199 @@ def _pad_request(req: proto.ScheduleRequest):
     return batch_args, progress_args, (n, g)
 
 
+_DEADLINE_HIT = object()
+
+
+class _ConnWorker:
+    """Per-connection daemon worker running request bodies, so the handler
+    thread can enforce a client-announced deadline: it waits a bounded
+    time and answers a DEADLINE_ERROR frame while the stalled computation
+    (e.g. an unwarmed jit compile) keeps running here — its result is
+    dropped at delivery, never applied to connection state. Jobs
+    serialize per connection, so a request queued behind a stalled one
+    spends its own budget waiting, which is the correct signal for a
+    wedged device. Daemon thread: a hung job must never block server
+    shutdown or interpreter exit."""
+
+    def __init__(self):
+        import queue
+
+        self._q = queue.SimpleQueue()
+        threading.Thread(
+            target=self._loop, name="oracle-conn-worker", daemon=True
+        ).start()
+
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, slot, done = item
+            try:
+                slot[:] = [(True, fn())]
+            except BaseException as e:  # noqa: BLE001 — re-raised at run()
+                slot[:] = [(False, e)]
+            done.set()
+
+    def run(self, fn, budget_ms: Optional[int]):
+        """Execute ``fn`` on the worker; block up to ``budget_ms`` (None =
+        forever). Returns the result, re-raises fn's exception, or returns
+        ``_DEADLINE_HIT`` when the budget elapsed first (fn keeps running;
+        its outcome is discarded)."""
+        slot: list = []
+        done = threading.Event()
+        self._q.put((fn, slot, done))
+        timeout = None if budget_ms is None else max(int(budget_ms), 1) / 1000.0
+        if not done.wait(timeout):
+            return _DEADLINE_HIT
+        ok, value = slot[0]
+        if not ok:
+            raise value
+        return value
+
+    def close(self) -> None:
+        self._q.put(None)
+
+
 class _Handler(socketserver.BaseRequestHandler):
+    def _run(self, fn, budget_ms: Optional[int]):
+        """Run one request body: inline while the connection has never
+        armed a deadline (the common case — the native client never does —
+        pays no worker thread and no queue hop), else on the lazily
+        created per-connection worker so the budget is enforceable. Once a
+        worker exists, ALL subsequent requests route through it, keeping
+        them serialized behind any abandoned still-running job instead of
+        racing it."""
+        if budget_ms is None and self._worker is None:
+            return fn()
+        if self._worker is None:
+            self._worker = _ConnWorker()
+        return self._worker.run(fn, budget_ms)
+
     def handle(self) -> None:
         last_batch: Optional[dict] = None
         last_counts = (0, 0)
         batch_seq = 0
-        while True:
-            try:
-                msg_type, payload = proto.read_frame(self.request)
-            except (ConnectionError, OSError):
-                return
-            except ValueError:
-                return  # not speaking our protocol: drop the connection
-            try:
-                if msg_type == proto.MsgType.PING:
-                    proto.write_frame(self.request, proto.MsgType.PONG, b"")
-                elif msg_type == proto.MsgType.SCHEDULE_REQ:
-                    req = proto.unpack_schedule_request(payload)
-                    args, progress_args, (n, g) = _pad_request(req)
-                    mesh = self.server.scan_mesh
-                    if mesh is not None:
-                        from ..parallel.mesh import shard_snapshot_args
-
-                        args = shard_snapshot_args(mesh, args)
-                    host, last_batch = execute_batch_host(
-                        args, progress_args, scan_mesh=mesh
-                    )
-                    last_counts = (n, g)
-                    batch_seq += 1
-                    resp = proto.ScheduleResponse(
-                        gang_feasible=np.asarray(host["gang_feasible"])[:g],
-                        placed=np.asarray(host["placed"])[:g],
-                        progress=np.asarray(host["progress"])[:g],
-                        best=int(host["best"]),
-                        best_exists=bool(host["best_exists"]),
-                        assignment_nodes=np.asarray(host["assignment_nodes"])[:g],
-                        assignment_counts=np.asarray(host["assignment_counts"])[:g],
-                        batch_seq=batch_seq,
-                    )
-                    proto.write_frame(
-                        self.request,
-                        proto.MsgType.SCHEDULE_RESP,
-                        proto.pack_schedule_response(resp),
-                    )
-                elif msg_type == proto.MsgType.ROW_REQ:
-                    kind, gidx, req_seq = proto.unpack_row_request(payload)
-                    if last_batch is None:
-                        raise ValueError("row request before any batch")
-                    if req_seq != batch_seq:
-                        raise ValueError(
-                            f"stale batch: row for seq {req_seq}, current {batch_seq}"
-                        )
-                    n, g = last_counts
-                    if not 0 <= gidx < g:
-                        raise ValueError(f"row index {gidx} out of range {g}")
-                    row = np.asarray(
-                        jax.device_get(last_batch[kind][gidx])
-                    ).astype("<i4")[:n]
-                    proto.write_frame(
-                        self.request, proto.MsgType.ROW_RESP, row.tobytes()
-                    )
-                else:
-                    raise ValueError(f"unknown message type {msg_type}")
-            except Exception as e:  # protocol errors answer in-band
+        deadline_ms: Optional[int] = None  # armed for the NEXT request
+        self._worker: Optional[_ConnWorker] = None
+        try:
+            while True:
                 try:
-                    proto.write_frame(
-                        self.request, proto.MsgType.ERROR, str(e).encode()
-                    )
-                except OSError:
+                    msg_type, payload = proto.read_frame(self.request)
+                except (ConnectionError, OSError):
                     return
+                except ValueError:
+                    return  # not speaking our protocol: drop the connection
+                try:
+                    if msg_type == proto.MsgType.DEADLINE:
+                        deadline_ms = proto.unpack_deadline(payload)
+                        continue  # annotation only; no reply
+                    budget_ms, deadline_ms = deadline_ms, None
+                    if msg_type == proto.MsgType.PING:
+                        # answered inline, never through the worker:
+                        # liveness must stay observable even while a
+                        # stalled batch occupies the worker (the client's
+                        # half-open breaker probe depends on it)
+                        proto.write_frame(self.request, proto.MsgType.PONG, b"")
+                    elif msg_type == proto.MsgType.SCHEDULE_REQ:
+
+                        def run_schedule(payload=payload):
+                            req = proto.unpack_schedule_request(payload)
+                            args, progress_args, (n, g) = _pad_request(req)
+                            mesh = self.server.scan_mesh
+                            if mesh is not None:
+                                from ..parallel.mesh import shard_snapshot_args
+
+                                args = shard_snapshot_args(mesh, args)
+                            # ONE batch on the device at a time, across all
+                            # connections: the sidecar owns a single
+                            # accelerator (concurrency buys nothing), and on
+                            # a sharded mesh two concurrent executions
+                            # interleave their collectives' rendezvous and
+                            # stall for seconds — an abandoned-deadline
+                            # batch overlapping a reconnected client's retry
+                            # hits exactly that without this lock
+                            with self.server.execute_lock:
+                                host, batch = execute_batch_host(
+                                    args, progress_args, scan_mesh=mesh
+                                )
+                            return host, batch, (n, g)
+
+                        outcome = self._run(run_schedule, budget_ms)
+                        if outcome is _DEADLINE_HIT:
+                            proto.write_frame(
+                                self.request,
+                                proto.MsgType.DEADLINE_ERROR,
+                                f"schedule exceeded deadline of {budget_ms}ms".encode(),
+                            )
+                            continue
+                        host, last_batch, (n, g) = outcome
+                        last_counts = (n, g)
+                        batch_seq += 1
+                        resp = proto.ScheduleResponse(
+                            gang_feasible=np.asarray(host["gang_feasible"])[:g],
+                            placed=np.asarray(host["placed"])[:g],
+                            progress=np.asarray(host["progress"])[:g],
+                            best=int(host["best"]),
+                            best_exists=bool(host["best_exists"]),
+                            assignment_nodes=np.asarray(host["assignment_nodes"])[:g],
+                            assignment_counts=np.asarray(host["assignment_counts"])[:g],
+                            batch_seq=batch_seq,
+                        )
+                        proto.write_frame(
+                            self.request,
+                            proto.MsgType.SCHEDULE_RESP,
+                            proto.pack_schedule_response(resp),
+                        )
+                    elif msg_type == proto.MsgType.ROW_REQ:
+                        kind, gidx, req_seq = proto.unpack_row_request(payload)
+                        if last_batch is None:
+                            raise ValueError("row request before any batch")
+                        if req_seq != batch_seq:
+                            raise ValueError(
+                                f"stale batch: row for seq {req_seq}, current {batch_seq}"
+                            )
+                        n, g = last_counts
+                        if not 0 <= gidx < g:
+                            raise ValueError(f"row index {gidx} out of range {g}")
+                        batch = last_batch
+
+                        def run_row(batch=batch, kind=kind, gidx=gidx, n=n):
+                            # under the same lock as batch execution: on a
+                            # sharded mesh, device_get of a sharded (G,N)
+                            # tensor launches its own cross-device gather,
+                            # and one interleaving with a concurrent
+                            # batch's collectives deadlocks the rendezvous
+                            # (seen as a 2-minute stall in the dual-
+                            # connection background-refresh test)
+                            with self.server.execute_lock:
+                                return np.asarray(
+                                    jax.device_get(batch[kind][gidx])
+                                ).astype("<i4")[:n]
+
+                        outcome = self._run(run_row, budget_ms)
+                        if outcome is _DEADLINE_HIT:
+                            proto.write_frame(
+                                self.request,
+                                proto.MsgType.DEADLINE_ERROR,
+                                f"row fetch exceeded deadline of {budget_ms}ms".encode(),
+                            )
+                            continue
+                        proto.write_frame(
+                            self.request, proto.MsgType.ROW_RESP, outcome.tobytes()
+                        )
+                    else:
+                        raise ValueError(f"unknown message type {msg_type}")
+                except Exception as e:  # protocol errors answer in-band
+                    try:
+                        proto.write_frame(
+                            self.request, proto.MsgType.ERROR, str(e).encode()
+                        )
+                    except OSError:
+                        return
+        finally:
+            if self._worker is not None:
+                self._worker.close()
 
 
 class OracleServer(socketserver.ThreadingTCPServer):
@@ -133,6 +263,8 @@ class OracleServer(socketserver.ThreadingTCPServer):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         super().__init__((host, port), _Handler)
+        # serializes batch execution across connections (see run_schedule)
+        self.execute_lock = threading.Lock()
         # Multi-chip deployments (v5e-4 DP config of BASELINE, or a full
         # slice after init_distributed) shard batches over the global mesh
         # with the replicated-scan layout; one chip stays single-device.

@@ -1,0 +1,237 @@
+"""ChaosProxy: an in-process fault-injecting TCP proxy for the oracle wire.
+
+Sits between an oracle client and a real OracleServer and injects the
+transport-failure classes a production sidecar link actually exhibits, on a
+probability schedule (or deterministically via ``limit``):
+
+- ``reset``     : hard connection reset mid-exchange (SO_LINGER 0 => RST,
+                  the kill -9 / LB-drain failure mode)
+- ``hang``      : black-hole — the response frame is swallowed and the
+                  connection goes silent (hung sidecar / dropped route);
+                  bounded by ``hang_s`` so test runs always terminate
+- ``delay``     : the response frame arrives ``delay_s`` late (congested
+                  or tunneled link)
+- ``truncate``  : the frame header promises more payload than is sent
+                  before the connection closes (peer died mid-write)
+- ``garbage``   : bytes that are not a protocol frame at all (desynced or
+                  hostile peer)
+
+Faults are injected at FRAME granularity on the server->client direction
+(the request made it out; the response is what suffers — exercising the
+client's read/recovery path, which is where the resilient client lives).
+The client->server direction relays raw bytes untouched.
+
+Used by tests/test_chaos_oracle.py to prove ResilientOracleClient survives
+every class, and by the chaos-enabled fuzz e2e (tests/test_fuzz_e2e.py).
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Optional, Union
+
+from ..service import protocol as proto
+
+__all__ = ["ChaosProxy", "FAULT_KINDS"]
+
+FAULT_KINDS = ("reset", "hang", "delay", "truncate", "garbage")
+
+
+class ChaosProxy:
+    def __init__(
+        self,
+        upstream_host: str,
+        upstream_port: int,
+        host: str = "127.0.0.1",
+        seed: int = 0,
+    ):
+        self._upstream = (upstream_host, upstream_port)
+        self._listener = socket.create_server((host, 0))
+        self._listener.settimeout(0.2)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._rng = random.Random(seed)
+        # kind -> probability per response frame; drawn in FAULT_KINDS order
+        self._faults: Dict[str, float] = {}
+        self._limit: Optional[int] = None
+        self.delay_s = 0.05
+        self.hang_s = 30.0
+        self.injected: Dict[str, int] = {k: 0 for k in FAULT_KINDS}
+        self._socks: list = [self._listener]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="chaos-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    @property
+    def address(self):
+        return self._listener.getsockname()[:2]
+
+    # -- fault schedule ----------------------------------------------------
+
+    def set_fault(
+        self,
+        kind: Union[str, Dict[str, float], None],
+        probability: float = 1.0,
+        limit: Optional[int] = None,
+        delay_s: Optional[float] = None,
+        hang_s: Optional[float] = None,
+    ) -> None:
+        """Arm the schedule: one ``kind`` with ``probability``, or a
+        ``{kind: probability}`` mix. ``limit`` bounds TOTAL injections
+        before auto-disarm (deterministic single-fault tests use
+        ``probability=1.0, limit=1``); None = unlimited. ``None`` kind
+        disarms."""
+        with self._lock:
+            if kind is None:
+                self._faults = {}
+            elif isinstance(kind, str):
+                if kind not in FAULT_KINDS:
+                    raise ValueError(f"unknown fault {kind!r} (use {FAULT_KINDS})")
+                self._faults = {kind: probability}
+            else:
+                bad = set(kind) - set(FAULT_KINDS)
+                if bad:
+                    raise ValueError(f"unknown faults {bad} (use {FAULT_KINDS})")
+                self._faults = dict(kind)
+            self._limit = limit
+            if delay_s is not None:
+                self.delay_s = delay_s
+            if hang_s is not None:
+                self.hang_s = hang_s
+
+    def clear_fault(self) -> None:
+        self.set_fault(None)
+
+    def _draw(self) -> Optional[str]:
+        with self._lock:
+            if not self._faults or self._limit == 0:
+                return None
+            for kind in FAULT_KINDS:
+                p = self._faults.get(kind, 0.0)
+                if p > 0 and self._rng.random() < p:
+                    self.injected[kind] += 1
+                    if self._limit is not None:
+                        self._limit -= 1
+                    return kind
+            return None
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                upstream = socket.create_connection(self._upstream, timeout=5.0)
+            except OSError:
+                client.close()
+                continue
+            with self._lock:
+                self._socks += [client, upstream]
+            threading.Thread(
+                target=self._pump_raw, args=(client, upstream),
+                name="chaos-c2s", daemon=True,
+            ).start()
+            threading.Thread(
+                target=self._pump_frames, args=(upstream, client),
+                name="chaos-s2c", daemon=True,
+            ).start()
+
+    @staticmethod
+    def _close_pair(a: socket.socket, b: socket.socket) -> None:
+        for s in (a, b):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _read_exact(self, sock: socket.socket, n: int) -> Optional[bytes]:
+        chunks = []
+        while n:
+            try:
+                chunk = sock.recv(min(n, 1 << 20))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def _pump_raw(self, src: socket.socket, dst: socket.socket) -> None:
+        """client -> server: relay untouched."""
+        try:
+            while not self._stop.is_set():
+                try:
+                    data = src.recv(1 << 16)
+                except OSError:
+                    break
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            self._close_pair(src, dst)
+
+    def _pump_frames(self, src: socket.socket, dst: socket.socket) -> None:
+        """server -> client: relay at frame granularity, injecting faults."""
+        try:
+            while not self._stop.is_set():
+                header = self._read_exact(src, proto._HEADER.size)
+                if header is None:
+                    break
+                _, _, length = proto._HEADER.unpack(header)
+                payload = b""
+                if length:
+                    payload = self._read_exact(src, length)
+                    if payload is None:
+                        break
+                fault = self._draw()
+                if fault is None:
+                    dst.sendall(header + payload)
+                elif fault == "delay":
+                    time.sleep(self.delay_s)
+                    dst.sendall(header + payload)
+                elif fault == "reset":
+                    # SO_LINGER 0: close sends RST, the client sees
+                    # ECONNRESET instead of a clean EOF
+                    dst.setsockopt(
+                        socket.SOL_SOCKET,
+                        socket.SO_LINGER,
+                        struct.pack("ii", 1, 0),
+                    )
+                    break
+                elif fault == "hang":
+                    # black-hole: swallow the frame, go silent, then drop
+                    self._stop.wait(self.hang_s)
+                    break
+                elif fault == "truncate":
+                    dst.sendall(header + payload[: len(payload) // 2])
+                    break
+                elif fault == "garbage":
+                    dst.sendall(b"JUNK" + bytes(self._rng.randrange(256) for _ in range(28)))
+                    break
+        except OSError:
+            pass
+        finally:
+            self._close_pair(src, dst)
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            socks, self._socks = self._socks, []
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass

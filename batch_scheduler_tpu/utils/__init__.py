@@ -1,10 +1,14 @@
 from .errors import (
+    CircuitOpenError,
     DeniedError,
     NotMatchedError,
     OccupiedError,
+    OracleDeadlineError,
+    OracleTransportError,
     PodGroupNotFoundError,
     ResourceNotEnoughError,
     SchedulingError,
+    StaleBatchError,
     WaitingError,
 )
 from .labels import (
@@ -16,9 +20,16 @@ from .labels import (
     pod_group_name,
 )
 from .patch import apply_merge_patch, create_merge_patch
+from .retry import CircuitBreaker, RetryPolicy
 from .ttl_cache import NO_EXPIRY, TTLCache
 
 __all__ = [
+    "CircuitOpenError",
+    "OracleDeadlineError",
+    "OracleTransportError",
+    "StaleBatchError",
+    "CircuitBreaker",
+    "RetryPolicy",
     "DeniedError",
     "NotMatchedError",
     "OccupiedError",

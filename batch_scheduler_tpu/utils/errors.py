@@ -35,3 +35,25 @@ class StaleBatchError(RuntimeError):
     old batch no longer exists. Callers answer conservatively and let the
     next cycle refresh — the ONLY error class the scorer's row reads may
     swallow (anything else, e.g. a dead sidecar transport, must surface)."""
+
+
+class OracleTransportError(RuntimeError):
+    """The oracle sidecar transport failed (dropped socket, EOF mid-frame,
+    desynced/garbage stream, connect failure) and the resilient client's
+    retries were exhausted. Distinct from in-band server answers
+    (StaleBatchError, RuntimeError) and from deadline overruns
+    (OracleDeadlineError): only THIS class advances the circuit breaker."""
+
+
+class CircuitOpenError(OracleTransportError):
+    """The oracle circuit breaker is open: the request was refused without
+    touching the transport. Raised until the cooldown elapses and a
+    half-open ping probe succeeds (utils.retry.CircuitBreaker)."""
+
+
+class OracleDeadlineError(RuntimeError):
+    """The sidecar answered an in-band deadline-exceeded frame: the request
+    was received but its ``deadline_ms`` budget elapsed before the batch
+    finished (e.g. an unwarmed jit compile). The transport is ALIVE — this
+    never trips the breaker and is never retried (a retry would blow the
+    same budget again)."""

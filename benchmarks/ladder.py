@@ -299,10 +299,21 @@ def config5_churn(ticks: int = 50, interval: float = 0.1):
     depth, link_rtt = probe_link_depth(r, interval)
     depth_env = os.environ.get("BST_CHURN_PIPELINE_DEPTH", "auto")
     if depth_env != "auto":
-        # clamped like auto mode: _DELTA_BUCKET and the window sizing are
-        # rated for depth <= 4 (deeper would push catch-up drains into
-        # the re-upload fallback the bucket exists to avoid)
-        depth = max(1, min(4, int(depth_env)))
+        try:
+            depth_override = int(depth_env)
+        except ValueError:
+            # a typo'd override must not crash a whole ladder run; the
+            # probed depth is always a working configuration
+            print(
+                f"ignoring unparseable BST_CHURN_PIPELINE_DEPTH={depth_env!r}; "
+                f"using probed depth {depth}",
+                file=sys.stderr,
+            )
+        else:
+            # clamped like auto mode: _DELTA_BUCKET and the window sizing
+            # are rated for depth <= 4 (deeper would push catch-up drains
+            # into the re-upload fallback the bucket exists to avoid)
+            depth = max(1, min(4, depth_override))
     # the dispatch window widens with depth so the oldest-batch stream
     # still drains ~ADMIT_WINDOW fresh gangs per tick (see loop comment);
     # precompile every bucket the loop can visit, INCLUDING the widened

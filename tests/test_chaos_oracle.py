@@ -1,0 +1,333 @@
+"""Chaos suite for the oracle transport (docs/resilience.md): every fault
+class the sim.chaos proxy injects — connection reset, black-hole hang,
+delayed frames, truncated frames, garbage frames — individually survived by
+ResilientOracleClient; the circuit breaker's closed -> open -> half-open ->
+closed lifecycle; server-side deadline enforcement (an in-band deadline
+error within 2x the budget, distinct from transport failure); and the
+conservative local-CPU fallback making only safe decisions during a full
+outage, then recovering on its own once the sidecar returns."""
+
+import time
+
+import numpy as np
+import pytest
+
+from batch_scheduler_tpu.cache import PGStatusCache
+from batch_scheduler_tpu.core import ScheduleOperation
+from batch_scheduler_tpu.service import (
+    OracleClient,
+    RemoteScorer,
+    ResilientOracleClient,
+    protocol as proto,
+    serve_background,
+)
+from batch_scheduler_tpu.sim.chaos import FAULT_KINDS, ChaosProxy
+from batch_scheduler_tpu.utils import errors as errs
+from batch_scheduler_tpu.utils.metrics import DEFAULT_REGISTRY, Registry
+from batch_scheduler_tpu.utils.retry import CircuitBreaker, RetryPolicy
+
+from helpers import FakeCluster, make_group, make_node, make_pod, status_for
+
+
+def _request(n=4, g=2, r=5, members=3):
+    alloc = np.zeros((n, r), np.int32)
+    alloc[:, 0] = 8000
+    alloc[:, 3] = 20
+    requested = np.zeros((n, r), np.int32)
+    group_req = np.zeros((g, r), np.int32)
+    group_req[:, 0] = 1000
+    group_req[:, 3] = 1
+    return proto.ScheduleRequest(
+        alloc=alloc,
+        requested=requested,
+        group_req=group_req,
+        remaining=np.full(g, members, np.int32),
+        fit_mask=np.ones((g, n), bool),
+        group_valid=np.ones(g, bool),
+        order=np.arange(g, dtype=np.int32),
+        min_member=np.full(g, members, np.int32),
+        scheduled=np.zeros(g, np.int32),
+        matched=np.zeros(g, np.int32),
+        ineligible=np.zeros(g, bool),
+        creation_rank=np.arange(g, dtype=np.int32),
+    )
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = serve_background()
+    # warm the jit cache through a direct connection so the chaos tests'
+    # deliberately short socket timeouts never race a first compile
+    warm = OracleClient(*srv.address)
+    warm.schedule(_request())
+    warm.close()
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture
+def proxy(server):
+    p = ChaosProxy(*server.address)
+    yield p
+    p.stop()
+
+
+def _quick_client(proxy, registry, timeout=0.8, attempts=4, **breaker_kwargs):
+    return ResilientOracleClient(
+        *proxy.address,
+        timeout=timeout,
+        registry=registry,
+        retry_policy=RetryPolicy(
+            max_attempts=attempts, base_delay=0.01, max_delay=0.05
+        ),
+        breaker=CircuitBreaker(
+            failure_threshold=breaker_kwargs.pop("failure_threshold", 8),
+            reset_timeout=breaker_kwargs.pop("reset_timeout", 0.3),
+        ),
+    )
+
+
+# -- fault classes, individually ------------------------------------------
+
+
+@pytest.mark.parametrize("kind", FAULT_KINDS)
+def test_client_survives_each_fault_class(proxy, kind):
+    """One injected fault of each class: the request still completes (via
+    retry + reconnect where needed), the breaker stays closed, and no
+    transport error escapes to the caller."""
+    reg = Registry()
+    client = _quick_client(proxy, reg)
+    label = "%s:%s" % proxy.address
+    assert client.schedule(_request()).placed.all()  # healthy baseline
+
+    proxy.set_fault(kind, probability=1.0, limit=1, delay_s=0.1)
+    resp = client.schedule(_request())
+    assert resp.placed.all()
+    assert proxy.injected[kind] == 1, proxy.injected
+    assert client.breaker.state == "closed"
+    retries = reg.counter("bst_oracle_retries_total").value(
+        op="schedule", client=label
+    )
+    if kind == "delay":
+        # a late frame is not a failure: no retry, no reconnect
+        assert retries == 0
+        assert reg.counter("bst_oracle_transport_failures_total").value(
+            op="schedule", client=label
+        ) == 0
+    else:
+        assert retries >= 1
+    # the connection (possibly re-established) stays fully usable
+    assert client.ping()
+    client.close()
+
+
+def test_reconnect_makes_old_batch_rows_stale(proxy):
+    """After a mid-run reconnect the server's per-connection batch state is
+    gone; a row fetch against the pre-fault batch must surface as
+    StaleBatchError (conservative answer), not a transport error or a
+    foreign batch's row."""
+    reg = Registry()
+    client = _quick_client(proxy, reg)
+    resp = client.schedule(_request())
+    proxy.set_fault("reset", probability=1.0, limit=1)
+    assert client.ping()  # consumes the reset; client reconnects
+    with pytest.raises(errs.StaleBatchError):
+        client.row("capacity", 0, resp.batch_seq)
+    client.close()
+
+
+# -- circuit breaker lifecycle --------------------------------------------
+
+
+def test_breaker_opens_fails_fast_and_recovers(proxy):
+    reg = Registry()
+    label = "%s:%s" % proxy.address
+    client = ResilientOracleClient(
+        *proxy.address,
+        timeout=1.0,
+        registry=reg,
+        retry_policy=RetryPolicy(max_attempts=2, base_delay=0.01, max_delay=0.02),
+        breaker=CircuitBreaker(failure_threshold=3, reset_timeout=0.3),
+    )
+    gauge = reg.gauge("bst_oracle_breaker_state")
+    assert client.schedule(_request()).placed.all()
+    assert gauge.value(client=label) == 0  # closed
+
+    proxy.set_fault("reset", probability=1.0)  # sustained outage
+    for _ in range(2):
+        with pytest.raises(errs.OracleTransportError):
+            client.schedule(_request())
+    assert client.breaker.state == "open"
+    assert gauge.value(client=label) == 1
+
+    # open: refused WITHOUT touching the transport — instant, no new
+    # transport failures recorded
+    failures = reg.counter("bst_oracle_transport_failures_total").value(
+        op="schedule", client=label
+    )
+    t0 = time.perf_counter()
+    with pytest.raises(errs.CircuitOpenError):
+        client.schedule(_request())
+    assert time.perf_counter() - t0 < 0.05
+    assert reg.counter("bst_oracle_transport_failures_total").value(
+        op="schedule", client=label
+    ) == failures
+
+    # cooldown elapses while the fault persists: the half-open ping probe
+    # fails and the breaker re-opens for a fresh cooldown
+    time.sleep(0.35)
+    with pytest.raises(errs.CircuitOpenError):
+        client.schedule(_request())
+    assert client.breaker.state == "open"
+
+    # sidecar recovers: cooldown -> half-open probe succeeds -> closed
+    proxy.clear_fault()
+    time.sleep(0.35)
+    assert client.schedule(_request()).placed.all()
+    assert client.breaker.state == "closed"
+    assert gauge.value(client=label) == 0
+    client.close()
+
+
+# -- deadline propagation --------------------------------------------------
+
+
+def test_deadline_error_within_two_x_budget(server, monkeypatch):
+    """A server-side stall longer than deadline_ms answers an in-band
+    deadline error within 2x the deadline, surfaced as OracleDeadlineError
+    — distinctly NOT a transport failure (no retry, breaker untouched)."""
+    import batch_scheduler_tpu.service.server as server_mod
+
+    reg = Registry()
+    label = "%s:%s" % server.address
+    client = ResilientOracleClient(
+        *server.address,
+        timeout=10.0,
+        registry=reg,
+        breaker=CircuitBreaker(failure_threshold=1, reset_timeout=60.0),
+    )
+    assert client.schedule(_request()).placed.all()
+
+    real = server_mod.execute_batch_host
+
+    def stalled(*args, **kwargs):
+        time.sleep(1.5)
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(server_mod, "execute_batch_host", stalled)
+    t0 = time.perf_counter()
+    with pytest.raises(errs.OracleDeadlineError):
+        client.schedule(_request(), deadline_ms=300)
+    elapsed = time.perf_counter() - t0
+    assert elapsed <= 0.6, f"deadline answer took {elapsed:.3f}s (> 2x 300ms)"
+    # distinct from transport: threshold-1 breaker would have opened on
+    # any transport classification, and nothing was retried
+    assert client.breaker.state == "closed"
+    assert reg.counter("bst_oracle_retries_total").value(
+        op="schedule", client=label
+    ) == 0
+    assert reg.counter("bst_oracle_deadline_errors_total").value(client=label) == 1
+
+    # the abandoned batch keeps running server-side; a later request (the
+    # stall undone) queues behind it and still completes
+    monkeypatch.setattr(server_mod, "execute_batch_host", real)
+    assert client.schedule(_request(), deadline_ms=30000).placed.all()
+    client.close()
+
+
+def test_deadline_generous_budget_is_a_noop(server):
+    client = ResilientOracleClient(
+        *server.address, timeout=10.0, registry=Registry(), deadline_ms=60000
+    )
+    resp = client.schedule(_request())
+    assert resp.placed.all()
+    # rows inherit the client-default deadline annotation too
+    assert client.row("capacity", 0, resp.batch_seq).shape[0] >= 4
+    client.close()
+
+
+# -- conservative local-CPU fallback --------------------------------------
+
+
+def _gang_fixture():
+    node = make_node("n1", {"cpu": "8", "memory": "32Gi", "pods": "110"})
+    cluster = FakeCluster([node])
+    cache = PGStatusCache()
+    ok_members = [
+        make_pod(f"okgang-{i}", group="okgang", requests={"cpu": "1"})
+        for i in range(2)
+    ]
+    status_for(make_group("okgang", 2, creation_ts=1.0), cache, rep_pod=ok_members[0])
+    bad_members = [
+        make_pod(f"badgang-{i}", group="badgang", requests={"cpu": "64"})
+        for i in range(2)
+    ]
+    status_for(make_group("badgang", 2, creation_ts=2.0), cache, rep_pod=bad_members[0])
+    return cluster, cache, ok_members, bad_members
+
+
+def test_fallback_local_cpu_is_conservative_and_recovers(proxy):
+    """Breaker open => the scorer serves the conservative CPU batch:
+    feasible gangs pass PreFilter (no speculative plan, no deny-cache
+    poisoning), provably-infeasible gangs get ResourceNotEnoughError,
+    Filter/Score answer real capacities — and once the sidecar returns the
+    scorer re-probes through the breaker on its own and resumes exact
+    batch placement."""
+    cluster, cache, ok_members, bad_members = _gang_fixture()
+    client = ResilientOracleClient(
+        *proxy.address,
+        timeout=2.0,
+        registry=Registry(),
+        retry_policy=RetryPolicy(max_attempts=2, base_delay=0.01, max_delay=0.02),
+        breaker=CircuitBreaker(failure_threshold=2, reset_timeout=0.4),
+    )
+    scorer = RemoteScorer(client, fallback="local-cpu")
+    op = ScheduleOperation(cache, cluster, scorer=scorer)
+
+    proxy.set_fault("reset", probability=1.0)  # total outage from the start
+    decisions = DEFAULT_REGISTRY.counter("bst_oracle_fallback_decisions_total")
+    passes0 = decisions.value(decision="pass")
+    denies0 = decisions.value(decision="deny")
+
+    op.pre_filter(ok_members[0])  # no exception: conservative pass
+    assert scorer.degraded
+    assert op.gang_plan(ok_members[0]) is None  # nothing speculative
+    assert not op.last_denied_pg.contains("default/okgang")
+    with pytest.raises(errs.ResourceNotEnoughError):
+        op.pre_filter(bad_members[0])
+    assert decisions.value(decision="pass") == passes0 + 1
+    assert decisions.value(decision="deny") == denies0 + 1
+
+    # Filter/Score still answer from real (host-computed) capacities
+    op.filter(ok_members[0], "n1")
+    assert op.score(ok_members[0], "n1") > 0
+
+    # sidecar recovers; after the cooldown the next query re-probes
+    # (degraded batches auto-expire via _stale) and exact answers return
+    proxy.clear_fault()
+    time.sleep(0.45)
+    op.pre_filter(ok_members[1])
+    assert not scorer.degraded
+    assert scorer.placed("default/okgang")
+    assert op.gang_plan(ok_members[1]) is not None  # real plan stamped
+    scorer.close()
+
+
+def test_fallback_deny_mode_surfaces_transport_error(proxy):
+    """Default fallback ('deny'): the transport error reaches the caller
+    (the scheduling cycle requeues with backoff) — never a silent deny."""
+    cluster, cache, ok_members, _ = _gang_fixture()
+    client = ResilientOracleClient(
+        *proxy.address,
+        timeout=1.0,
+        registry=Registry(),
+        retry_policy=RetryPolicy(max_attempts=2, base_delay=0.01, max_delay=0.02),
+        breaker=CircuitBreaker(failure_threshold=3, reset_timeout=0.3),
+    )
+    scorer = RemoteScorer(client)  # fallback="deny"
+    op = ScheduleOperation(cache, cluster, scorer=scorer)
+    proxy.set_fault("reset", probability=1.0)
+    with pytest.raises(errs.OracleTransportError):
+        op.pre_filter(ok_members[0])
+    assert not scorer.degraded
+    scorer.close()

@@ -272,6 +272,64 @@ def test_fuzz_churn_backfill_capacity_cycles(sim):
     _assert_no_overcommit(cluster)
 
 
+def test_fuzz_full_framework_invariants_with_chaos_faults(sim):
+    """The standing fuzz invariants with TRANSPORT FAULTS enabled: the
+    oracle is remote (real sidecar server) behind a chaos proxy injecting
+    delayed, reset, truncated and garbage frames throughout the run, with
+    the resilient client + conservative local-CPU fallback absorbing them
+    (docs/resilience.md). The scheduler must still fully bind the feasible
+    set with gang atomicity and no over-commit — no scheduling cycle may
+    die on an unhandled transport error."""
+    from batch_scheduler_tpu.service import (
+        RemoteScorer,
+        ResilientOracleClient,
+        serve_background,
+    )
+    from batch_scheduler_tpu.sim.chaos import ChaosProxy
+    from batch_scheduler_tpu.utils.retry import CircuitBreaker, RetryPolicy
+
+    srv = serve_background()
+    proxy = ChaosProxy(*srv.address, seed=909)
+    # a steady drizzle of every fault class (delay dominates, hard faults
+    # rarer), never disarmed — the run must make progress THROUGH them
+    proxy.set_fault(
+        {"delay": 0.15, "reset": 0.04, "truncate": 0.03, "garbage": 0.03},
+        delay_s=0.03,
+        hang_s=1.0,
+    )
+    client = ResilientOracleClient(
+        *proxy.address,
+        timeout=5.0,
+        retry_policy=RetryPolicy(max_attempts=4, base_delay=0.02, max_delay=0.2),
+        breaker=CircuitBreaker(failure_threshold=4, reset_timeout=0.3),
+    )
+    scorer = RemoteScorer(client, fallback="local-cpu")
+    try:
+        cluster, feasible, infeasible, n_loose = _fuzz_scenario(
+            sim, 909, scorer=scorer
+        )
+        expected = sum(m for _, m in feasible) + n_loose
+        assert _await_binds(cluster, expected, timeout=120.0), (
+            "feasible work never fully bound under chaos faults",
+            expected,
+            cluster.scheduler.stats,
+            proxy.injected,
+        )
+        _assert_no_overcommit(cluster)
+        for name, members in feasible:
+            bound = [p for p in cluster.member_pods(name) if p.spec.node_name]
+            assert len(bound) >= members, (name, len(bound), members)
+        for name, members in infeasible:
+            bound = [p for p in cluster.member_pods(name) if p.spec.node_name]
+            assert bound == [], f"infeasible gang {name} bound {len(bound)} pods"
+        # the run actually exercised the fault injector
+        assert sum(proxy.injected.values()) > 0, proxy.injected
+    finally:
+        scorer.close()
+        proxy.stop()
+        srv.shutdown()
+
+
 def _fuzz_selector_scenario(sim, seed, **cluster_kwargs):
     """Randomized zones + taints + per-gang selectors/tolerations (VERDICT
     r3 item 6): forces the oracle's per-group [G,N] fit-mask path and the

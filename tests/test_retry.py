@@ -1,0 +1,128 @@
+"""Unit tests for utils.retry: full-jitter backoff bounds, the reusable
+RetryPolicy.call driver, and the CircuitBreaker state machine (the pieces
+ResilientOracleClient composes; docs/resilience.md)."""
+
+import random
+
+import pytest
+
+from batch_scheduler_tpu.utils.retry import CircuitBreaker, RetryPolicy
+
+
+def test_backoff_full_jitter_bounds():
+    policy = RetryPolicy(base_delay=0.1, max_delay=1.0, multiplier=2.0)
+    rng = random.Random(42)
+    for i in range(8):
+        cap = min(1.0, 0.1 * 2.0 ** i)
+        for _ in range(50):
+            d = policy.backoff(i, rng=rng)
+            assert 0.0 <= d <= cap, (i, d, cap)
+    # the draw actually spreads (full jitter, not equal-jitter floor)
+    draws = [policy.backoff(3, rng=rng) for _ in range(200)]
+    assert min(draws) < 0.2 and max(draws) > 0.6
+
+
+def test_call_retries_then_succeeds():
+    sleeps = []
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise OSError("boom")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=4, base_delay=0.01, max_delay=0.02)
+    result = policy.call(flaky, retry_on=(OSError,), sleep=sleeps.append)
+    assert result == "ok"
+    assert len(attempts) == 3
+    assert len(sleeps) == 2  # one sleep per retry, none after success
+
+
+def test_call_exhaustion_reraises_last_error_unwrapped():
+    def always():
+        raise OSError("dead")
+
+    policy = RetryPolicy(max_attempts=3, base_delay=0.01)
+    with pytest.raises(OSError, match="dead"):
+        policy.call(always, retry_on=(OSError,), sleep=lambda _d: None)
+
+
+def test_call_no_retry_wins_over_retry_on():
+    attempts = []
+
+    def semantic():
+        attempts.append(1)
+        raise ValueError("semantic answer")
+
+    policy = RetryPolicy(max_attempts=5, base_delay=0.01)
+    with pytest.raises(ValueError):
+        policy.call(
+            semantic,
+            retry_on=(Exception,),
+            no_retry=(ValueError,),
+            sleep=lambda _d: None,
+        )
+    assert len(attempts) == 1  # never retried
+
+
+def test_call_on_retry_observes_each_retry():
+    seen = []
+
+    def flaky():
+        if len(seen) < 2:
+            raise OSError("x")
+        return True
+
+    policy = RetryPolicy(max_attempts=4, base_delay=0.01)
+    assert policy.call(
+        flaky,
+        retry_on=(OSError,),
+        sleep=lambda _d: None,
+        on_retry=lambda i, e, d: seen.append((i, type(e).__name__)),
+    )
+    assert seen == [(0, "OSError"), (1, "OSError")]
+
+
+def test_breaker_lifecycle_with_fake_clock():
+    now = [0.0]
+    transitions = []
+    breaker = CircuitBreaker(
+        failure_threshold=3,
+        reset_timeout=5.0,
+        clock=lambda: now[0],
+        on_transition=transitions.append,
+    )
+    assert breaker.state == "closed"
+    assert breaker.admit() == "attempt"
+
+    # below threshold: still closed; a success resets the count
+    breaker.record_failure()
+    breaker.record_failure()
+    breaker.record_success()
+    breaker.record_failure()
+    breaker.record_failure()
+    assert breaker.state == "closed"
+
+    breaker.record_failure()  # third consecutive -> open
+    assert breaker.state == "open"
+    assert breaker.admit() == "refuse"
+    assert not breaker.would_attempt()
+
+    now[0] = 4.9
+    assert breaker.admit() == "refuse"  # cooldown not elapsed
+    now[0] = 5.1
+    assert breaker.would_attempt()
+    assert breaker.admit() == "probe"  # half-open
+    assert breaker.state == "half-open"
+
+    # failed probe re-opens with a FRESH cooldown
+    breaker.record_failure()
+    assert breaker.state == "open"
+    assert breaker.admit() == "refuse"
+    now[0] = 10.2
+    assert breaker.admit() == "probe"
+    breaker.record_success()
+    assert breaker.state == "closed"
+    assert breaker.admit() == "attempt"
+    assert transitions == ["open", "half-open", "open", "half-open", "closed"]

@@ -8,6 +8,7 @@ import pytest
 from batch_scheduler_tpu.service import (
     OracleClient,
     RemoteScorer,
+    ResilientOracleClient,
     protocol as proto,
     serve_background,
 )
@@ -222,6 +223,47 @@ def test_native_client_protocol_constants_in_sync():
         m = re.search(rf"{name}\s*=\s*(\d+)", src)
         assert m, f"{name} not found in bsp_client.cpp"
         assert int(m.group(1)) == value, f"{name} drifted: C++ {m.group(1)} != py {value}"
+
+
+def test_resilient_client_stale_batch_is_semantic_not_transport(server):
+    """StaleBatchError through the retry layer: a stale-batch answer is a
+    SEMANTIC response over a live transport — never retried (retrying
+    cannot un-stale it) and never counted against the circuit breaker
+    (with threshold=1 any transport classification would open it)."""
+    from batch_scheduler_tpu.utils.metrics import Registry
+    from batch_scheduler_tpu.utils.retry import CircuitBreaker, RetryPolicy
+
+    host, port = server.address
+    reg = Registry()
+    client = ResilientOracleClient(
+        host,
+        port,
+        timeout=30.0,
+        registry=reg,
+        retry_policy=RetryPolicy(max_attempts=3, base_delay=0.01),
+        breaker=CircuitBreaker(failure_threshold=1, reset_timeout=60.0),
+    )
+    label = f"{host}:{port}"
+    resp1 = client.schedule(_request())
+    resp2 = client.schedule(_request())
+    assert resp2.batch_seq != resp1.batch_seq
+    with pytest.raises(errs.StaleBatchError):
+        client.row("capacity", 0, resp1.batch_seq)
+    assert client.breaker.state == "closed"
+    assert reg.counter("bst_oracle_retries_total").value(
+        op="row", client=label
+    ) == 0
+    assert reg.counter("bst_oracle_transport_failures_total").value(
+        op="row", client=label
+    ) == 0
+
+    # other in-band server errors are equally semantic: surfaced as-is,
+    # unretried, breaker untouched, connection still usable
+    with pytest.raises(RuntimeError, match="out of range"):
+        client.row("capacity", 99999, resp2.batch_seq)
+    assert client.breaker.state == "closed"
+    assert client.ping()
+    client.close()
 
 
 def test_remote_scorer_dual_connection_background_refresh(server):
