@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all build vet test test-cpu bench native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e
+.PHONY: all build vet test test-cpu bench bench-scan native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e
 
 all: vet native test
 
@@ -29,6 +29,12 @@ test-cpu:
 # headline benchmark on the default platform (one JSON line)
 bench:
 	$(PY) bench.py
+
+# scan-vs-scoring split + wavefront-scan stats (the SCAN_SPLIT artifact:
+# scan fraction, waves-per-batch, sequential-step count) — tracks the
+# scan-fraction trajectory per round; BST_SCAN_WAVE overrides the width
+bench-scan:
+	$(PY) benchmarks/scan_split.py
 
 # BASELINE.json measurement ladder, configs 1-6 (asserts regressions)
 ladder:

@@ -213,6 +213,40 @@ def _maybe_serve_metrics(args):
     return server
 
 
+def _enable_compilation_cache() -> None:
+    """Persistent XLA compilation cache for the serving entry points.
+
+    A cold sidecar's first batch pays the full jit compile (~20-40s on
+    the accelerator for a new bucket shape) — exactly the stall the PR-1
+    deadline budget has to absorb at startup. Persisting compiled
+    modules across process restarts turns every warm restart's
+    first-batch latency into a cache read. Opt-out/override via
+    BST_COMPILATION_CACHE_DIR (empty/"off"/"0" disables); failures
+    degrade to no cache, never block serving."""
+    cache_dir = os.environ.get(
+        "BST_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "bst-xla-cache"),
+    )
+    if cache_dir.strip().lower() in ("", "0", "off"):
+        return
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # the oracle's modules are small but expensive to BUILD (the
+        # assignment scan unrolls G steps): cache on compile time, not
+        # artifact size
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as e:  # noqa: BLE001 — cache is an optimization only
+        print(
+            f"persistent compilation cache unavailable ({e!r}); "
+            "continuing without",
+            file=sys.stderr,
+        )
+
+
 def _resolve_backend_or_degrade() -> None:
     """Probe the accelerator backend before first device use: a hung TPU
     tunnel would otherwise wedge the process inside the first compile with
@@ -247,6 +281,7 @@ def cmd_serve(args) -> int:
         )
     else:
         _resolve_backend_or_degrade()
+    _enable_compilation_cache()
 
     if args.warmup:
         print(f"warmup compile done in {warm_oracle():.1f}s", flush=True)
@@ -291,6 +326,7 @@ def cmd_sim(args) -> int:
 
     _maybe_serve_metrics(args)
     _resolve_backend_or_degrade()
+    _enable_compilation_cache()
 
     scorer = cfg.plugin_config.scorer
     oracle_client = None

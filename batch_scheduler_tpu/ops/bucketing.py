@@ -12,15 +12,42 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["bucket_size", "pad_to", "pad_rows", "pad_oracle_batch"]
+__all__ = [
+    "bucket_size",
+    "wave_width_bucket",
+    "pad_to",
+    "pad_rows",
+    "pad_oracle_batch",
+]
 
 _MIN_BUCKET = 8
+
+# Static widths the wavefront assignment scan compiles for. Powers of two
+# between 2 and 32: below 2 the wave degenerates to the serial scan; above
+# 32 the batched fast path's [W, N, R] prefix tensors outgrow their win
+# (and a single contended wave's serial replay grows linearly with W).
+_WAVE_MIN, _WAVE_MAX = 2, 32
 
 
 def bucket_size(n: int) -> int:
     """Smallest power-of-two bucket >= n (>= 8)."""
     b = _MIN_BUCKET
     while b < n:
+        b <<= 1
+    return b
+
+
+def wave_width_bucket(w: int) -> int:
+    """Static wave-width bucket for the wavefront assignment scan
+    (ops.oracle.assign_gangs_wavefront / the BST_SCAN_WAVE knob).
+
+    0 or 1 means "serial scan" and maps to 0; anything else snaps to the
+    nearest power of two in [2, 32] so the jitted scan compiles for a
+    bounded set of wave shapes no matter what the knob says."""
+    if w <= 1:
+        return 0
+    b = _WAVE_MIN
+    while b < w and b < _WAVE_MAX:
         b <<= 1
     return b
 

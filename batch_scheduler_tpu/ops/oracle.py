@@ -46,6 +46,7 @@ __all__ = [
     "find_max_group",
     "score_nodes",
     "assign_gangs",
+    "assign_gangs_wavefront",
     "schedule_batch",
     "execute_batch_host",
     "dispatch_batch",
@@ -306,16 +307,314 @@ def assign_gangs(left0, group_req, remaining, fit_mask, order):
     return alloc, placed, left
 
 
+@partial(jax.jit, static_argnames=("wave", "with_stats"))
+def assign_gangs_wavefront(left0, group_req, remaining, fit_mask, order,
+                           wave: int = 8, with_stats: bool = False):
+    """Wavefront form of ``assign_gangs``: same inputs, same outputs,
+    bit-identical results, ~G/W sequential steps instead of G.
+
+    The serial scan's bottleneck is its step COUNT, not its step cost
+    (87% of batch compute at the north-star shape, SCAN_SPLIT_r05.json),
+    and partitioning each step drags collectives through the whole loop
+    (6x slower, SHARDING_r03.json). So this cuts steps instead: gangs are
+    pre-partitioned (in priority order) into waves of ``wave`` consecutive
+    gangs, and one ``lax.scan`` step places a whole wave:
+
+    1. **Uniform-wave fast path** — a wave whose gangs all share one
+       request row and one mask row (bulk submissions of identical gangs:
+       the north-star workload, and the padded tail) is placed with ONE
+       aggregate selection. For identical per-member requests, taking
+       ``t`` members off a node drops its capacity by exactly ``t``
+       (``floor((x-t*q)/q) == floor(x/q)-t`` per lane), so the serial
+       gang-by-gang tightest-first fill equals a single member stream
+       ordered by (tightness bucket, node index): gang j takes the
+       stream interval ``[sum of earlier feasible needs, +need_j)``.
+       Stream positions come from the same histogram machinery as
+       ``_select_best_fit``, with within-bucket (node index) resolution
+       computed only for the <= W+1 buckets that contain a gang
+       boundary; per-gang feasibility is verified batched at the assumed
+       boundaries, and any infeasible gang demotes the wave to the
+       serial replay — so a committed wave costs ~one selection instead
+       of W.
+    2. **Batched speculative path** — otherwise, every gang computes its
+       capacities and tightest-first take against the WAVE-START
+       leftover, as if it were first (one vmapped ``_select_best_fit``,
+       W-way), then a **conflict check** recomputes each gang's capacity
+       vector under the exclusive prefix of the wave's earlier takes. If
+       every gang's capacities are unchanged, the fast takes ARE the
+       serial takes (induction over the wave: gang j's serial leftover is
+       the wave-start leftover minus exactly those prefix deltas, and the
+       selection is a deterministic function of the capacity vector).
+    3. **Demotion** — any mismatch demotes the wave to a ``lax.cond``
+       branch that replays it serially (the exact per-gang body of
+       ``assign_gangs``), so contended waves pay the serial cost and
+       nothing else changes.
+
+    Bit-identity therefore holds by construction on EVERY input: the
+    uniform path is the serial fill in aggregate form, the speculative
+    path is proven equal before it commits, and the slow path is the
+    serial scan. Uniform and low-contention workloads commit every wave
+    on a fast path, dropping the sequential dependency chain to
+    ceil(G/W) steps.
+
+    Overflow discipline: prefix leftovers are accumulated with a clamp at
+    ``-_BIG`` (each wave delta is bounded by the wave-start leftover
+    <= LANE_MAX, so one clamped subtraction cannot wrap int32), and a
+    clamped-negative leftover yields capacity 0 exactly like its
+    unclamped value would — the conflict check is exact. On the
+    no-conflict path no clamp ever fires (the running value equals the
+    serial leftover, which stays >= 0), so the committed leftover is
+    exact too.
+
+    ``with_stats`` additionally returns per-wave diagnostics for the
+    SCAN_SPLIT artifact: ``(conflicts[S], uniform[S])`` — waves demoted
+    to the serial replay, and waves committed by the uniform aggregate
+    path.
+    """
+    n = left0.shape[0]
+    g = group_req.shape[0]
+    w = max(int(wave), 1)
+    per_group_mask = fit_mask.shape[0] != 1
+    if per_group_mask and fit_mask.shape[0] != g:
+        raise ValueError(
+            f"fit_mask rows {fit_mask.shape[0]} must be 1 or match "
+            f"group count {g}"
+        )
+
+    # pre-permute into scan order so each wave is a contiguous chunk (the
+    # pallas kernel's idiom); pad the group axis to a wave multiple with
+    # inert rows (zero demand, remaining 0, masked out) that run AFTER
+    # every real gang and take nothing.
+    steps = -(-g // w)
+    g_pad = steps * w
+    gr = jnp.take(group_req, order, axis=0)
+    rem = jnp.take(remaining, order, axis=0)
+    mask = fit_mask.astype(jnp.int32)
+    if per_group_mask:
+        mask = jnp.take(mask, order, axis=0)
+    if g_pad != g:
+        gr = jnp.pad(gr, ((0, g_pad - g), (0, 0)))
+        rem = jnp.pad(rem, ((0, g_pad - g),))
+        if per_group_mask:
+            mask = jnp.pad(mask, ((0, g_pad - g), (0, 0)))
+    r = gr.shape[1]
+    gr_w = gr.reshape(steps, w, r)
+    rem_w = rem.reshape(steps, w)
+    xs = (gr_w, rem_w, mask.reshape(steps, w, n)) if per_group_mask else (
+        gr_w, rem_w,
+    )
+    bcast_row = None if per_group_mask else mask  # [1, N]
+
+    def _one(cap, capc, need):
+        take2d, feas = _select_best_fit(cap[None, :], capc[None, :], need)
+        return take2d[0], feas
+
+    select_wave = jax.vmap(_one)
+    # the aggregate stream's histogram sums stay exact in int32 only while
+    # total_need * N fits (same bound class pad_oracle_batch enforces per
+    # gang); oversized waves fall through to the speculative path
+    mega_need_max = (2**31 - 1) // max(n, 1)
+
+    def step(left, chunk):
+        if per_group_mask:
+            req_c, need_c, mask_c = chunk  # [W,R], [W], [W,N]
+        else:
+            req_c, need_c = chunk
+            mask_c = bcast_row  # [1,N] broadcasts over the wave
+        total_need = jnp.sum(need_c)
+        uniform = jnp.all(req_c == req_c[0:1])
+        if per_group_mask:
+            uniform = uniform & jnp.all(mask_c == mask_c[0:1])
+        mega_ok = uniform & (total_need <= mega_need_max)
+
+        def replay_wave(left):
+            # the serial scan body, gang by gang — the demotion target of
+            # both fast paths; reports conflict=True (a demoted wave)
+            takes, feats = [], []
+            for j in range(w):
+                row = mask_c[j] if per_group_mask else mask_c[0]
+                cap_j = _member_capacity(left, req_c[j][None, :]) * row
+                capc_j = jnp.minimum(cap_j, need_c[j])
+                t, f = _one(cap_j, capc_j, need_c[j])
+                left = left - t[:, None] * req_c[j][None, :]
+                takes.append(t)
+                feats.append(f)
+            return (
+                jnp.stack(takes), jnp.stack(feats), left, jnp.bool_(True)
+            )
+
+        def mega(left):
+            # ONE aggregate tightest-first fill for a wave of identical
+            # demand rows, split at gang boundaries (see docstring).
+            # Gang boundaries are ASSUMED at the prefix sums of the needs
+            # (i.e. every gang feasible) so the boundary resolution can
+            # batch; the assumption is then verified batched, and any
+            # infeasible gang demotes the wave to the serial replay
+            # (sound by induction: if every gang passes its check at the
+            # assumed boundary, the assumed boundaries ARE the serial
+            # ones). Only the <= W+1 buckets containing a boundary need
+            # within-bucket (node-index) resolution — one [W+1, N]
+            # masked cumsum, NOT the full [_BINS, N] one (measured 76 ms
+            # a wave at the north-star shape, 10x the rest of the step).
+            req0 = req_c[0]
+            cap0 = _member_capacity(left, req0[None, :]) * mask_c[0]  # [N]
+            key = jnp.minimum(cap0, _BINS - 1)
+            capc_t = jnp.minimum(cap0, total_need)  # stream units per node
+            bins = jax.lax.broadcasted_iota(jnp.int32, (_BINS, 1), 0)
+            bin_totals = jnp.sum(
+                jnp.where(key[None, :] == bins, capc_t[None, :], 0),
+                axis=1,
+            )  # [_BINS]
+            cum_incl = _cumsum(bin_totals[None, :], axis=1)[0]
+            cum_excl = cum_incl - bin_totals
+            # assumed boundaries A_j = sum of earlier needs, j = 0..W
+            bounds = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32), jnp.cumsum(need_c)]
+            )  # [W+1]
+            # bucket containing each boundary (== _BINS when past the end)
+            bbkt = jnp.sum(
+                (cum_incl[None, :] <= bounds[:, None]).astype(jnp.int32),
+                axis=1,
+            )  # [W+1]
+            # within-bucket exclusive prefix, boundary buckets only
+            bmask = key[None, :] == bbkt[:, None]  # [W+1, N]
+            bvals = jnp.where(bmask, capc_t[None, :], 0)
+            bwithin = _cumsum(bvals, axis=1) - bvals
+            # taken[j, n]: units of node n inside the first A_j stream units
+            boffs = (bounds - jnp.take(cum_excl, bbkt, mode="clip"))[:, None]
+            taken = jnp.where(
+                key[None, :] < bbkt[:, None],
+                capc_t[None, :],
+                jnp.where(
+                    bmask, jnp.clip(boffs - bwithin, 0, capc_t[None, :]), 0
+                ),
+            )  # [W+1, N]
+            # verify the all-feasible assumption: remaining capacity after
+            # the first A_j members is exactly cap0 - taken_j
+            feas = (
+                jnp.sum(
+                    jnp.minimum(cap0[None, :] - taken[:-1], need_c[:, None]),
+                    axis=1,
+                )
+                >= need_c
+            )  # [W]
+            all_ok = jnp.all(feas)
+
+            def commit(left):
+                takes_m = taken[1:] - taken[:-1]  # telescoped intervals
+                left_after = left - taken[-1][:, None] * req0[None, :]
+                return (
+                    takes_m,
+                    jnp.ones((w,), bool),
+                    left_after,
+                    jnp.bool_(False),
+                )
+
+            return jax.lax.cond(all_ok, commit, replay_wave, left)
+
+        def speculative(left):
+            # batched fast path: every gang scores the wave-start leftover
+            cap = (
+                _member_capacity(left[None, :, :], req_c[:, None, :]) * mask_c
+            )
+            capc = jnp.minimum(cap, need_c[:, None])
+            takes_w, feas_w = select_wave(cap, capc, need_c)  # [W,N], [W]
+            deltas = takes_w[:, :, None] * req_c[:, None, :]  # [W,N,R]
+
+            # exclusive-prefix leftovers, clamp-accumulated (see docstring)
+            acc = left
+            prefixed = []
+            for j in range(w):
+                prefixed.append(acc)
+                acc = jnp.maximum(acc - deltas[j], -_BIG)
+            cap_pref = _member_capacity(
+                jnp.stack(prefixed), req_c[:, None, :]
+            ) * mask_c
+            conflict = jnp.any(cap_pref != cap)
+
+            def fast(left):
+                # acc == serial leftover after the wave (no clamp fired)
+                return takes_w, feas_w, acc, jnp.bool_(False)
+
+            return jax.lax.cond(conflict, replay_wave, fast, left)
+
+        takes_out, feas_out, left, conflict = jax.lax.cond(
+            mega_ok, mega, speculative, left
+        )
+        return left, (takes_out, feas_out, conflict, mega_ok)
+
+    left, (takes, placed, conflicts, megas) = jax.lax.scan(step, left0, xs)
+    takes = takes.reshape(g_pad, n)[:g]
+    placed = placed.reshape(g_pad)[:g]
+    alloc = jnp.zeros((g, n), jnp.int32).at[order].set(takes)
+    placed_full = jnp.zeros((g,), bool).at[order].set(placed)
+    if with_stats:
+        return alloc, placed_full, left, (conflicts, megas)
+    return alloc, placed_full, left
+
+
+# Process-wide gate for the wavefront scan (mirrors _pallas_enabled): a
+# compile/runtime failure on the wavefront path disables it for the process
+# and batches fall back to the serial scan. List-wrapped for lock-free
+# mutation from worker threads (same benign-race contract as
+# _pallas_enabled).
+_wave_enabled = [True]
+
+_WAVE_ENV = "BST_SCAN_WAVE"
+_wave_env_warned = [False]
+
+
+def _scan_wave_from_env() -> int:
+    """Parse the env-gated wave width: 0/unset/1 = serial scan (the
+    fallback), anything else bucketed to a static width
+    (ops.bucketing.wave_width_bucket) so jit signatures stay bounded.
+    Guarded by the same try/except-fallback idiom as
+    BST_CHURN_PIPELINE_DEPTH (benchmarks/ladder.py): a typo'd knob must
+    degrade to the always-working serial path, never crash a batch."""
+    raw = os.environ.get(_WAVE_ENV, "")
+    if not raw:
+        return 0
+    try:
+        requested = int(raw)
+    except ValueError:
+        if not _wave_env_warned[0]:
+            _wave_env_warned[0] = True
+            import sys
+
+            print(
+                f"ignoring unparseable {_WAVE_ENV}={raw!r}; "
+                "using the serial assignment scan",
+                file=sys.stderr,
+            )
+        return 0
+    from .bucketing import wave_width_bucket
+
+    return wave_width_bucket(requested)
+
+
+def _disable_wave(e: Exception) -> None:
+    _wave_enabled[0] = False
+    import warnings
+
+    warnings.warn(
+        f"wavefront assignment scan disabled after failure: {e!r}; "
+        "falling back to the serial lax.scan path"
+    )
+
+
 # Max distinct nodes one gang's compact assignment can report; a gang of M
 # members spans <= M nodes, so this only truncates gangs wider than 128
 # nodes (the dense `assignment` matrix remains authoritative on device).
 ASSIGNMENT_TOP_K = 128
 
 
-@partial(jax.jit, static_argnames=("use_pallas", "top_k", "scan_mesh"))
+@partial(
+    jax.jit, static_argnames=("use_pallas", "top_k", "scan_mesh", "scan_wave")
+)
 def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
                    group_valid, order, use_pallas: bool = False,
-                   top_k: int = ASSIGNMENT_TOP_K, scan_mesh=None):
+                   top_k: int = ASSIGNMENT_TOP_K, scan_mesh=None,
+                   scan_wave: int = 0):
     """Fused full-batch oracle: leftover -> capacity -> feasibility -> scores
     -> greedy gang assignment, one XLA computation.
 
@@ -329,6 +628,13 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
     handles both the broadcast [1,N] mask and the per-group [G,N] mask;
     the GSPMD-sharded path keeps the lax.scan form (a pallas_call is a
     black box to the partitioner).
+
+    ``scan_wave`` > 1 (the BST_SCAN_WAVE knob, bucketed —
+    ops.bucketing.wave_width_bucket) selects the wavefront assignment
+    scan: up to ``scan_wave`` gangs placed per sequential step,
+    bit-identical to the serial scan (``assign_gangs_wavefront``; the
+    pallas path uses its chunked-grid wavefront kernel variant). 0 = the
+    serial scan, the always-working fallback.
 
     This is the ``fit()`` of SURVEY.md §7: everything the control plane needs
     for one scheduling batch in a single device round-trip.
@@ -367,7 +673,11 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
         from .pallas_assign import assign_gangs_pallas
 
         assignment, placed, left_after = assign_gangs_pallas(
-            scan_left, scan_gr, scan_rem, scan_fm, order
+            scan_left, scan_gr, scan_rem, scan_fm, order, wave=scan_wave
+        )
+    elif scan_wave > 1:
+        assignment, placed, left_after = assign_gangs_wavefront(
+            scan_left, scan_gr, scan_rem, scan_fm, order, wave=scan_wave
         )
     else:
         assignment, placed, left_after = assign_gangs(
@@ -421,13 +731,16 @@ def batch_top_k(n_bucket: int, remaining_max: int) -> int:
 
 @partial(
     jax.jit,
-    static_argnames=("use_pallas", "pack_assignment", "top_k", "scan_mesh"),
+    static_argnames=(
+        "use_pallas", "pack_assignment", "top_k", "scan_mesh", "scan_wave"
+    ),
 )
 def _batch_blob(alloc_lanes, requested, group_req, remaining, fit_mask,
                 group_valid, order, min_member, scheduled, matched,
                 ineligible, creation_rank, use_pallas: bool = False,
                 pack_assignment: bool = True,
-                top_k: int = ASSIGNMENT_TOP_K, scan_mesh=None):
+                top_k: int = ASSIGNMENT_TOP_K, scan_mesh=None,
+                scan_wave: int = 0):
     """One device computation for a whole control-plane batch: the fused
     oracle + findMaxPG, with every O(G) host-needed output concatenated into
     a single int32 blob. On a high-latency host<->device link (the axon
@@ -445,7 +758,8 @@ def _batch_blob(alloc_lanes, requested, group_req, remaining, fit_mask,
     """
     out = schedule_batch(alloc_lanes, requested, group_req, remaining,
                          fit_mask, group_valid, order, use_pallas=use_pallas,
-                         top_k=top_k, scan_mesh=scan_mesh)
+                         top_k=top_k, scan_mesh=scan_mesh,
+                         scan_wave=scan_wave)
     best, exists, progress = find_max_group(min_member, scheduled, matched,
                                             ineligible, creation_rank)
     if pack_assignment:
@@ -464,6 +778,21 @@ def _batch_blob(alloc_lanes, requested, group_req, remaining, fit_mask,
             tail,
         ]
     )
+    if scan_mesh is not None:
+        # The blob concatenates pieces with MIXED shardings (gang_feasible
+        # rides the groups axis; the packed assignment tail is replicated
+        # off the replicated scan). Left to GSPMD, the concatenate resolves
+        # through a partial-sum representation and every element comes back
+        # multiplied by the node-axis shard count — the "shard-tiled
+        # indexes" bug the multi-device sidecar shipped to clients
+        # (ROADMAP PR-1 open item; node<<16|count decodes as node*S,
+        # count*S). Pinning the blob replicated forces a gather instead of
+        # the psum and the host copy is exact on every mesh shape.
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        blob = jax.lax.with_sharding_constraint(
+            blob, NamedSharding(scan_mesh, PartitionSpec())
+        )
     return blob, out
 
 
@@ -476,12 +805,13 @@ class PendingBatch:
     a tunneled TPU — behind that work."""
 
     __slots__ = (
-        "blob", "out", "pack", "used_pallas", "_rerun", "blob_np", "mask_mode"
+        "blob", "out", "pack", "used_pallas", "_rerun", "blob_np",
+        "mask_mode", "used_wave",
     )
 
     def __init__(
         self, blob, out, pack, used_pallas, rerun, blob_np=None,
-        mask_mode="broadcast",
+        mask_mode="broadcast", used_wave=0,
     ):
         self.blob = blob
         self.out = out
@@ -492,6 +822,9 @@ class PendingBatch:
         # scan path by fetching; don't pay the link round-trip twice)
         self.blob_np = blob_np
         self.mask_mode = mask_mode
+        # wavefront width this batch ran with (0 = serial scan): collect's
+        # blame policy needs to know which optional path was live
+        self.used_wave = used_wave
 
 
 def dispatch_batch(batch_args, progress_args, scan_mesh=None) -> PendingBatch:
@@ -508,6 +841,10 @@ def dispatch_batch(batch_args, progress_args, scan_mesh=None) -> PendingBatch:
     # every batch.
     mask_mode = "per_group" if batch_args[4].shape[0] != 1 else "broadcast"
     use_pallas = _pallas_enabled[mask_mode] and jax.default_backend() == "tpu"
+    # Wavefront width (0 = serial): env-gated, bucketed static, and behind
+    # the process-wide gate so one bad lowering degrades to the serial
+    # scan instead of failing every batch.
+    scan_wave = _scan_wave_from_env() if _wave_enabled[0] else 0
     # The packed form saturates per-node counts at 65535; a take can reach
     # the gang's full remaining count on one node, so gate the compact form
     # on the host-side remaining bound and fall back to the exact
@@ -519,32 +856,49 @@ def dispatch_batch(batch_args, progress_args, scan_mesh=None) -> PendingBatch:
     pack = n_bucket <= 2**15 and remaining_max <= 2**16 - 1
     top_k = batch_top_k(n_bucket, remaining_max)
 
-    def run(up: bool):
+    def run(up: bool, wave: int = 0):
         return _batch_blob(
             *batch_args, *progress_args, use_pallas=up, pack_assignment=pack,
-            top_k=top_k, scan_mesh=scan_mesh,
+            top_k=top_k, scan_mesh=scan_mesh, scan_wave=wave,
         )
 
-    blob_np = None
+    # Fallback ladder, most-capable first. Each downgrade drops exactly
+    # one optional feature, so a failure can be blamed precisely — and
+    # only once the downgraded form EXECUTES where the richer one failed
+    # (a cache-hit dispatch alone proves nothing, so the fallback forces
+    # the device round-trip; the fetched copy is kept for collect). If
+    # every rung fails, the problem is the batch/link, not the feature —
+    # the original error surfaces.
+    attempts = [(use_pallas, scan_wave)]
+    if scan_wave:
+        attempts.append((use_pallas, 0))
     if use_pallas:
+        attempts.append((False, 0))
+
+    blob_np = None
+    blob = out = None
+    errors: list = []
+    used_pallas, used_wave = attempts[0]
+    for i, (up, wave) in enumerate(attempts):
         try:
-            blob, out = run(True)
-        except Exception as e:  # noqa: BLE001 — lowering/compile failure
-            # Only blame (and permanently disable) the pallas kernel if the
-            # scan path EXECUTES where it failed — a cache-hit dispatch
-            # alone proves nothing, so force the device round-trip here (and
-            # keep the fetched copy for collect). If that fails too, the
-            # problem is the batch/link, not the kernel — surface the
-            # original error.
-            try:
-                blob, out = run(False)
+            blob, out = run(up, wave)
+            if i > 0:
                 blob_np = np.asarray(jax.device_get(blob))
-            except Exception:
-                raise e from None
-            _disable_pallas(e, mask_mode)
-            use_pallas = False
-    else:
-        blob, out = run(False)
+        except Exception as e:  # noqa: BLE001 — lowering/compile failure
+            errors.append(e)
+            if i == len(attempts) - 1:
+                raise errors[0] from None
+            continue
+        used_pallas, used_wave = up, wave
+        if i > 0:
+            # this rung executed where the one above it failed: the single
+            # feature dropped between the two is provably at fault
+            prev_up, prev_wave = attempts[i - 1]
+            if prev_wave and not wave and prev_up == up:
+                _disable_wave(errors[-1])
+            else:
+                _disable_pallas(errors[-1], mask_mode)
+        break
 
     # Queue the D2H copy now so it rides behind the computation instead of
     # waiting for the collect call (optional API; device_get works without).
@@ -554,7 +908,8 @@ def dispatch_batch(batch_args, progress_args, scan_mesh=None) -> PendingBatch:
         except (AttributeError, RuntimeError):
             pass
     return PendingBatch(
-        blob, out, pack, use_pallas, run, blob_np, mask_mode
+        blob, out, pack, used_pallas, run, blob_np, mask_mode,
+        used_wave=used_wave,
     )
 
 
@@ -583,17 +938,23 @@ def collect_batch(pending: PendingBatch):
         )
         out = pending.out
     except Exception as e:  # noqa: BLE001 — device-side runtime failure
-        if not pending.used_pallas:
+        if not pending.used_pallas and not pending.used_wave:
             raise
-        # Only blame (and permanently disable) the pallas kernel if the
-        # scan path succeeds where it failed; if that fails too, the
-        # problem is the batch/link, not the kernel — surface it.
+        # Only blame (and permanently disable) the optional path — the
+        # pallas kernel and/or the wavefront scan — if the plain serial
+        # scan succeeds where it failed; if that fails too, the problem is
+        # the batch/link, not the feature — surface it. When both were
+        # live, the single rerun cannot separate them; disabling both errs
+        # toward the always-working path (each re-proves itself never).
         try:
             blob, out = pending._rerun(False)
             blob_np = np.asarray(jax.device_get(blob))
         except Exception:
             raise e from None
-        _disable_pallas(e, pending.mask_mode)
+        if pending.used_pallas:
+            _disable_pallas(e, pending.mask_mode)
+        if pending.used_wave:
+            _disable_wave(e)
 
     g = out["assignment_nodes"].shape[0]
     k = out["assignment_nodes"].shape[1]

@@ -39,7 +39,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .oracle import _BIG, _exact_floordiv, _select_best_fit
+from .oracle import _BIG, _BINS, _cumsum, _exact_floordiv, _select_best_fit
 
 __all__ = ["assign_gangs_pallas", "CHUNK"]
 
@@ -48,6 +48,16 @@ __all__ = ["assign_gangs_pallas", "CHUNK"]
 # per-step cost ~8x; group counts that don't divide are padded with inert
 # rows (see assign_gangs_pallas).
 CHUNK = 8
+
+
+def _cap_t(left, req_col):
+    """ops.oracle._member_capacity in the kernel's transposed [R, N]
+    layout (lanes on axis 0 so the node axis rides the 128-wide lane
+    dimension). ``req_col`` is [R, 1]; returns cap [1, N]."""
+    safe_req = jnp.clip(req_col, 1, _BIG)
+    lpos = jnp.clip(left, 0, _BIG)
+    per_lane = jnp.where(req_col > 0, _exact_floordiv(lpos, safe_req), _BIG)
+    return jnp.min(per_lane, axis=0, keepdims=True)
 
 
 def _kernel(remaining_ref, left0_ref, group_req_ref, mask_ref,
@@ -75,14 +85,7 @@ def _kernel(remaining_ref, left0_ref, group_req_ref, mask_ref,
         req = group_req_ref[j]  # [R] (this chunk's block, static row)
         req_col = req.reshape(-1, 1)  # [R, 1]
 
-        # ops.oracle._member_capacity in the kernel's transposed [R, N]
-        # layout (lanes on axis 0 so the node axis rides the 128-wide lane
-        # dimension)
-        safe_req = jnp.clip(req_col, 1, _BIG)
-        lpos = jnp.clip(left, 0, _BIG)
-        per_lane = jnp.where(req_col > 0, _exact_floordiv(lpos, safe_req), _BIG)
-        cap = jnp.min(per_lane, axis=0, keepdims=True)  # [1, N]
-        cap = cap * mask
+        cap = _cap_t(left, req_col) * mask  # [1, N]
 
         capc = jnp.minimum(cap, need)
         take, _feasible = _select_best_fit(cap, capc, need)
@@ -98,15 +101,169 @@ def _kernel(remaining_ref, left0_ref, group_req_ref, mask_ref,
         left_after_ref[:] = left_scratch[:]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+def _kernel_wave(remaining_ref, left0_ref, group_req_ref, mask_ref,
+                 takes_ref, placed_ref, left_after_ref, left_scratch,
+                 *, per_group_mask: bool, wave: int, mega_need_max: int):
+    """Chunked-grid WAVEFRONT variant: grid step ``s`` places a whole wave
+    of ``wave`` gangs. Mirrors ops.oracle.assign_gangs_wavefront inside
+    the VMEM-resident sweep:
+
+    - uniform path: a wave of identical demand/mask rows is placed with
+      ONE aggregate tightest-first fill split at gang boundaries (the
+      identical-req member-stream equivalence — see the oracle
+      docstring), runtime-skipped otherwise;
+    - speculative path: every gang computes its take against the
+      wave-start leftover (the selections are independent, so Mosaic can
+      overlap them, unlike the serial chain of ``_kernel``), then a
+      conflict check recomputes each gang's capacity vector under the
+      clamp-accumulated exclusive prefix of the wave's earlier takes —
+      any mismatch means the fast takes are not provably the serial ones;
+    - demotion: a conflicted wave replays serially under ``pl.when``
+      (runtime-skipped when the wave commits), so results stay
+      bit-identical to the serial kernel by construction.
+    """
+    s = pl.program_id(0)
+    num_steps = pl.num_programs(0)
+
+    @pl.when(s == 0)
+    def _():
+        left_scratch[:] = left0_ref[:]
+
+    left = left_scratch[:]  # [R, N] wave-start leftover
+
+    if not per_group_mask:
+        mask_b = mask_ref[:].astype(jnp.int32)  # [1, N] broadcast row
+
+    # cheap uniformity check for the aggregate path (blocks are VMEM
+    # resident; these are elementwise compares + reductions)
+    req_block = group_req_ref[:]  # [wave, R]
+    uniform = jnp.all(req_block == req_block[0:1])
+    if per_group_mask:
+        mask_block = mask_ref[:].astype(jnp.int32)  # [wave, N]
+        uniform = jnp.logical_and(
+            uniform, jnp.all(mask_block == mask_block[0:1])
+        )
+    total_need = remaining_ref[s * wave]
+    for j in range(1, wave):
+        total_need = total_need + remaining_ref[s * wave + j]
+    mega_ok = jnp.logical_and(uniform, total_need <= mega_need_max)
+
+    @pl.when(mega_ok)
+    def _():
+        req0_col = group_req_ref[0].reshape(-1, 1)  # [R, 1]
+        mask0 = (
+            mask_ref[0].reshape(1, -1).astype(jnp.int32)
+            if per_group_mask
+            else mask_b
+        )
+        cap0 = _cap_t(left, req0_col) * mask0  # [1, N]
+        key = jnp.minimum(cap0, _BINS - 1)
+        capc_t = jnp.minimum(cap0, total_need)
+        bins = jax.lax.broadcasted_iota(jnp.int32, (_BINS, 1), 0)
+        bc = jnp.where(key == bins, capc_t, 0)  # [_BINS, N]
+        bin_totals = jnp.sum(bc, axis=1, keepdims=True)
+        cum_excl = _cumsum(bin_totals, axis=0) - bin_totals
+        within = _cumsum(bc, axis=1) - bc
+        pos_start = jnp.sum(
+            jnp.where(key == bins, cum_excl + within, 0),
+            axis=0,
+            keepdims=True,
+        )  # [1, N]
+        pos_end = pos_start + capc_t
+        a = jnp.int32(0)
+        placed_rows = []
+        total_take = jnp.zeros_like(cap0)
+        for j in range(wave):
+            need = remaining_ref[s * wave + j]
+            taken = jnp.clip(a - pos_start, 0, capc_t)
+            feas = jnp.sum(jnp.minimum(cap0 - taken, need)) >= need
+            start = a
+            end = a + need * feas.astype(jnp.int32)
+            take = jnp.clip(
+                jnp.minimum(end, pos_end) - jnp.maximum(start, pos_start),
+                0,
+                None,
+            )
+            takes_ref[j] = take[0]
+            total_take = total_take + take
+            placed_rows.append(feas.astype(jnp.int32))
+            a = end
+        left_scratch[:] = left - total_take * req0_col
+        placed_ref[:] = jnp.stack(placed_rows).reshape(wave, 1)
+
+    @pl.when(jnp.logical_not(mega_ok))
+    def _():
+        masks, req_cols, needs = [], [], []
+        takes_fast, placed_fast = [], []
+        acc = left  # clamp-accumulated prefix leftover (oracle docstring)
+        conflict = jnp.bool_(False)
+        for j in range(wave):
+            mask = (
+                mask_ref[j].reshape(1, -1).astype(jnp.int32)
+                if per_group_mask
+                else mask_b
+            )
+            need = remaining_ref[s * wave + j]
+            req_col = group_req_ref[j].reshape(-1, 1)  # [R, 1]
+            cap = _cap_t(left, req_col) * mask
+            capc = jnp.minimum(cap, need)
+            take, feas = _select_best_fit(cap, capc, need)
+            # exclusive prefix: acc excludes this gang's own delta
+            cap_pref = _cap_t(acc, req_col) * mask
+            conflict = conflict | jnp.any(cap_pref != cap)
+            acc = jnp.maximum(acc - take * req_col, -_BIG)
+            masks.append(mask)
+            req_cols.append(req_col)
+            needs.append(need)
+            takes_fast.append(take)
+            placed_fast.append(feas.astype(jnp.int32))
+
+        @pl.when(jnp.logical_not(conflict))
+        def _():
+            # no clamp fired on a conflict-free wave: acc IS the serial
+            # leftover after the whole wave
+            left_scratch[:] = acc
+            for j in range(wave):
+                takes_ref[j] = takes_fast[j][0]
+            placed_ref[:] = jnp.stack(placed_fast).reshape(wave, 1)
+
+        @pl.when(conflict)
+        def _():
+            live = left
+            placed_rows = []
+            for j in range(wave):
+                cap = _cap_t(live, req_cols[j]) * masks[j]
+                capc = jnp.minimum(cap, needs[j])
+                take, feas = _select_best_fit(cap, capc, needs[j])
+                live = live - take * req_cols[j]
+                takes_ref[j] = take[0]
+                placed_rows.append(feas.astype(jnp.int32))
+            left_scratch[:] = live
+            placed_ref[:] = jnp.stack(placed_rows).reshape(wave, 1)
+
+    @pl.when(s == num_steps - 1)
+    def _():
+        left_after_ref[:] = left_scratch[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "wave"))
 def assign_gangs_pallas(left0, group_req, remaining, fit_mask, order,
-                        *, interpret: bool = False):
+                        *, interpret: bool = False, wave: int = 0):
     """Drop-in for ``ops.oracle.assign_gangs`` (same signature/returns).
 
     ``fit_mask`` may be the broadcast ``[1,N]`` row (kept resident in the
     grid, the common no-selector case) or the full ``[G,N]`` per-group
     mask (selector/taint workloads): mask rows are pre-permuted into scan
     order alongside the request rows and DMA'd per chunk.
+
+    ``wave`` >= 2 (static, bucketed by the caller —
+    ops.bucketing.wave_width_bucket) selects the chunked-grid WAVEFRONT
+    kernel variant: the chunk width becomes the wave width and each grid
+    step places a whole conflict-checked wave (``_kernel_wave``),
+    bit-identical to the serial kernel. 0/1 keeps the serial-in-chunk
+    kernel. Both variants share the per-mask-mode fallback gating in
+    ops.oracle (a failure on one mask mode's kernel never poisons the
+    other).
 
     Returns (alloc[G,N] i32, placed[G] bool, left_after[N,R] i32).
     """
@@ -118,6 +275,17 @@ def assign_gangs_pallas(left0, group_req, remaining, fit_mask, order,
             f"fit_mask rows {fit_mask.shape[0]} must be 1 or match "
             f"group count {g}"
         )
+    chunk = wave if wave >= 2 else CHUNK
+    kernel = (
+        functools.partial(
+            _kernel_wave,
+            per_group_mask=per_group_mask,
+            wave=chunk,
+            mega_need_max=(2**31 - 1) // max(n, 1),
+        )
+        if wave >= 2
+        else functools.partial(_kernel, per_group_mask=per_group_mask)
+    )
 
     # pre-permute groups into scan order so each grid step reads/writes
     # contiguous chunk blocks; outputs are scattered back below. Pad the
@@ -129,7 +297,7 @@ def assign_gangs_pallas(left0, group_req, remaining, fit_mask, order,
     mask_in = fit_mask.astype(jnp.int32)
     if per_group_mask:
         mask_in = jnp.take(mask_in, order, axis=0)
-    g_pad = -(-g // CHUNK) * CHUNK
+    g_pad = -(-g // chunk) * chunk
     if g_pad != g:
         group_req_sorted = jnp.pad(group_req_sorted, ((0, g_pad - g), (0, 0)))
         remaining_sorted = jnp.pad(remaining_sorted, ((0, g_pad - g),))
@@ -137,28 +305,28 @@ def assign_gangs_pallas(left0, group_req, remaining, fit_mask, order,
             mask_in = jnp.pad(mask_in, ((0, g_pad - g), (0, 0)))
 
     mask_spec = (
-        pl.BlockSpec((CHUNK, n), lambda s, rem: (s, 0))  # chunk's mask rows
+        pl.BlockSpec((chunk, n), lambda s, rem: (s, 0))  # chunk's mask rows
         if per_group_mask
         else pl.BlockSpec((1, n), lambda s, rem: (0, 0))  # broadcast row
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,  # remaining (sorted)
-        grid=(g_pad // CHUNK,),
+        grid=(g_pad // chunk,),
         in_specs=[
             pl.BlockSpec((r, n), lambda s, rem: (0, 0)),  # left0^T
             # step s sees its chunk of the sorted request rows
-            pl.BlockSpec((CHUNK, r), lambda s, rem: (s, 0)),
+            pl.BlockSpec((chunk, r), lambda s, rem: (s, 0)),
             mask_spec,
         ],
         out_specs=[
-            pl.BlockSpec((CHUNK, n), lambda s, rem: (s, 0)),  # takes
-            pl.BlockSpec((CHUNK, 1), lambda s, rem: (s, 0)),  # placed
+            pl.BlockSpec((chunk, n), lambda s, rem: (s, 0)),  # takes
+            pl.BlockSpec((chunk, 1), lambda s, rem: (s, 0)),  # placed
             pl.BlockSpec((r, n), lambda s, rem: (0, 0)),  # left_after^T
         ],
         scratch_shapes=[pltpu.VMEM((r, n), jnp.int32)],
     )
     takes_sorted, placed_sorted, left_after_t = pl.pallas_call(
-        functools.partial(_kernel, per_group_mask=per_group_mask),
+        kernel,
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((g_pad, n), jnp.int32),

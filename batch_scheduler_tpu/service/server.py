@@ -191,14 +191,31 @@ class _Handler(socketserver.BaseRequestHandler):
                         host, last_batch, (n, g) = outcome
                         last_counts = (n, g)
                         batch_seq += 1
+                        # Map assignment node indexes back into the
+                        # CLIENT's node space before packing: the batch ran
+                        # in the server's bucket-padded (and, on a mesh,
+                        # shard-placed) node space, whose first n indexes
+                        # are the client's nodes and whose tail is padding.
+                        # Real takes can only land on the first n (pad
+                        # nodes are masked, zero-capacity), but top_k
+                        # backfills zero-count rows with arbitrary pad
+                        # indexes — zero those out so a client stamping a
+                        # whole-gang plan never sees an out-of-space index
+                        # (the PR-1 multi-device empty-plan bug; see
+                        # docs/scan_parallelism.md).
+                        a_nodes = np.asarray(host["assignment_nodes"])[:g]
+                        a_counts = np.asarray(host["assignment_counts"])[:g]
+                        in_space = a_nodes < n
+                        a_nodes = np.where(in_space, a_nodes, 0)
+                        a_counts = np.where(in_space, a_counts, 0)
                         resp = proto.ScheduleResponse(
                             gang_feasible=np.asarray(host["gang_feasible"])[:g],
                             placed=np.asarray(host["placed"])[:g],
                             progress=np.asarray(host["progress"])[:g],
                             best=int(host["best"]),
                             best_exists=bool(host["best_exists"]),
-                            assignment_nodes=np.asarray(host["assignment_nodes"])[:g],
-                            assignment_counts=np.asarray(host["assignment_counts"])[:g],
+                            assignment_nodes=a_nodes,
+                            assignment_counts=a_counts,
                             batch_seq=batch_seq,
                         )
                         proto.write_frame(

@@ -45,6 +45,15 @@ def resolve_platform(
     tunnel hang cannot demote the round's number of record to CPU
     (round-3 postmortem: the 2x75s probe gave up while the accelerator
     was merely slow to return).
+
+    Hang early-exit: two CONSECUTIVE full-timeout hangs end the probing
+    immediately, in both modes. A hung tunnel does not heal inside one
+    run's budget (round-5 postmortem: the deadline loop burned ~12 probes
+    x 75s — ~15 minutes of dead wall-clock per CPU-only bench run,
+    BENCH_r05.json — and every one of them hung), so the second hang is
+    the signal; the watcher loop re-captures hardware artifacts when the
+    tunnel answers. A hang followed by a fast failure resets the count
+    (mixed signals may be transient).
     """
     global _resolved
     if _resolved is not None:
@@ -78,6 +87,7 @@ def resolve_platform(
     delay = retry_delay_s
     attempt = 0
     same_fast_failures = 0
+    consecutive_hangs = 0
     while True:
         attempt += 1
         try:
@@ -91,9 +101,18 @@ def resolve_platform(
         except subprocess.TimeoutExpired:
             r = None
             same_fast_failures = 0
+            consecutive_hangs += 1
             last_err = f"backend probe hang (> {probe_timeout_s}s)"
             print(f"probe attempt {attempt}: {last_err}", file=sys.stderr)
+            if consecutive_hangs >= 2:
+                print(
+                    "probe hung twice in a row; a wedged tunnel does not "
+                    "heal inside one run — degrading to cpu now",
+                    file=sys.stderr,
+                )
+                break
         if r is not None:
+            consecutive_hangs = 0
             marker = [
                 l for l in r.stdout.splitlines() if l.startswith("PLATFORM=")
             ]
@@ -124,7 +143,10 @@ def resolve_platform(
         else:
             if attempt >= retries:
                 break
-            time.sleep(retry_delay_s)
+            # exponential backoff in fixed-count mode too: a recovering
+            # plugin gets more settle time on each later attempt
+            time.sleep(delay)
+            delay = min(delay * 2.0, 60.0)
 
     jax.config.update("jax_platforms", "cpu")
     _resolved = (jax.default_backend(), str(last_err))

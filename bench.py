@@ -283,26 +283,55 @@ def emit(value, vs_baseline, detail):
     )
 
 
-def recorded_tpu_artifacts():
-    """Repo-committed bench artifacts whose recorded platform is 'tpu' —
-    attached to any degraded (non-TPU or crashed) line so a CPU fallback
-    run is never mistaken for the framework's best hardware evidence.
-    Resolved against the repo root, not the cwd, like every other path
-    here; each candidate's JSON is checked, not just its filename."""
+def _tpu_bench_records():
+    """(basename, parsed record) for every repo-committed BENCH_r*.json
+    whose recorded platform is 'tpu'. Resolved against the repo root, not
+    the cwd, like every other path here; each candidate's JSON is
+    checked, not just its filename. The single artifact-scanning loop
+    behind both degraded-line surfaces below."""
     import glob
     import json as _json
 
     root = os.path.dirname(os.path.abspath(__file__))
-    out = []
     for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
         try:
             with open(path) as f:
                 rec = _json.load(f)
-            if rec.get("detail", {}).get("platform") == "tpu":
-                out.append(os.path.basename(path))
         except (OSError, ValueError):
             continue
-    return out
+        if rec.get("detail", {}).get("platform") == "tpu":
+            yield os.path.basename(path), rec
+
+
+def recorded_tpu_artifacts():
+    """TPU bench artifact filenames — attached to any degraded (non-TPU
+    or crashed) line so a CPU fallback run is never mistaken for the
+    framework's best hardware evidence."""
+    return [name for name, _ in _tpu_bench_records()]
+
+
+def best_tpu_artifact():
+    """The best (lowest wall-clock) recorded TPU bench line, surfaced IN
+    FULL alongside a degraded draw: a CPU fallback's headline understates
+    the round by ~15x (BENCH_r05.json vs BENCH_r05_late.json), and a
+    reader of the canonical slot should see the hardware number of record
+    without chasing filenames. Returns None when no TPU artifact parses."""
+    best = None
+    for name, rec in _tpu_bench_records():
+        detail = rec.get("detail", {})
+        value = rec.get("value")
+        if not isinstance(value, (int, float)) or value <= 0:
+            continue
+        if best is None or value < best["value"]:
+            best = {
+                "file": name,
+                "value": value,
+                "vs_baseline": rec.get("vs_baseline"),
+                "device_batch_s": detail.get("device_batch_s"),
+                "pipelined_batch_s": detail.get("pipelined_batch_s"),
+                "assignment_path": detail.get("assignment_path"),
+            }
+    return best
 
 
 def main():
@@ -325,6 +354,9 @@ def main():
         recorded = recorded_tpu_artifacts()
         if recorded:
             crash_detail["recorded_tpu_artifacts"] = recorded
+        best = best_tpu_artifact()
+        if best is not None:
+            crash_detail["best_tpu_artifact"] = best
         emit(-1.0, 0.0, crash_detail)
         return
 
@@ -365,6 +397,12 @@ def main():
         recorded = recorded_tpu_artifacts()
         if recorded:
             detail["recorded_tpu_artifacts"] = recorded
+        best = best_tpu_artifact()
+        if best is not None:
+            # the hardware number of record, right next to the CPU draw:
+            # the canonical slot must not understate the round by ~15x
+            # just because the tunnel was away during this run
+            detail["best_tpu_artifact"] = best
     emit(round(oracle["total_s"], 4), round(vs_baseline, 1), detail)
 
 

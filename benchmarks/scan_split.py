@@ -9,8 +9,17 @@ benchmark times the two terms separately (each as its own jit, hot,
 device-resident inputs, median of passes) and reports the Amdahl
 ceiling for sharded scoring at 4 and 8 chips.
 
-Run from the repo root: ``python benchmarks/scan_split.py`` — one JSON
-line (artifact: SCAN_SPLIT_r05.json when captured on TPU).
+It also measures the WAVEFRONT scan (ops.oracle.assign_gangs_wavefront,
+the BST_SCAN_WAVE path): wave width, sequential step count (waves per
+batch vs the serial scan's one-step-per-gang), conflict-demoted waves,
+and the scan fraction / Amdahl ceiling recomputed with the wavefront
+wall-clock — the per-round trajectory of the scan-fraction attack (see
+docs/scan_parallelism.md). BST_SCAN_WAVE overrides the measured wave
+width (default 8).
+
+Run from the repo root: ``python benchmarks/scan_split.py`` (or ``make
+bench-scan``) — one JSON line (artifact: SCAN_SPLIT_r05.json when
+captured on TPU).
 """
 
 from __future__ import annotations
@@ -64,12 +73,51 @@ def main() -> int:
 
     use_pallas = platform == "tpu"
 
+    from batch_scheduler_tpu.ops.bucketing import wave_width_bucket
+
+    wave_env = os.environ.get("BST_SCAN_WAVE", "")
+    try:
+        wave = wave_width_bucket(int(wave_env)) if wave_env else 8
+    except ValueError:
+        print(
+            f"ignoring unparseable BST_SCAN_WAVE={wave_env!r}; "
+            "measuring wave width 8",
+            file=sys.stderr,
+        )
+        wave = 8
+    if wave == 0:
+        # 0/1 mean "serial scan" for the production knob; as a MEASUREMENT
+        # width they'd time a degenerate one-gang wavefront — measure the
+        # default width instead (the serial scan is timed regardless)
+        print(
+            f"BST_SCAN_WAVE={wave_env!r} selects the serial scan; "
+            "measuring the wavefront at width 8",
+            file=sys.stderr,
+        )
+        wave = 8
+
     @jax.jit
     def scan_only_pallas(left, group_req, remaining, fit_mask, order):
         from batch_scheduler_tpu.ops.pallas_assign import assign_gangs_pallas
 
         assignment, placed, left_after = assign_gangs_pallas(
             left, group_req, remaining, fit_mask, order
+        )
+        return jnp.sum(assignment), jnp.sum(placed), jnp.sum(left_after)
+
+    @jax.jit
+    def scan_only_wave(left, group_req, remaining, fit_mask, order):
+        assignment, placed, left_after = O.assign_gangs_wavefront(
+            left, group_req, remaining, fit_mask, order, wave=wave
+        )
+        return jnp.sum(assignment), jnp.sum(placed), jnp.sum(left_after)
+
+    @jax.jit
+    def scan_only_wave_pallas(left, group_req, remaining, fit_mask, order):
+        from batch_scheduler_tpu.ops.pallas_assign import assign_gangs_pallas
+
+        assignment, placed, left_after = assign_gangs_pallas(
+            left, group_req, remaining, fit_mask, order, wave=wave
         )
         return jnp.sum(assignment), jnp.sum(placed), jnp.sum(left_after)
 
@@ -102,6 +150,42 @@ def main() -> int:
         except Exception as e:
             print(f"pallas scan timing failed: {e!r}", file=sys.stderr)
 
+    # wavefront scan: wall-clock (lax + pallas variants), verified
+    # bit-identical against the serial scan on this exact batch, plus the
+    # wave-level stats (sequential step count, conflict-demoted waves)
+    t_scan_wave = t_scan_wave_pallas = None
+    wave_stats = None
+    try:
+        t_scan_wave = timed(scan_only_wave, scan_args)
+        a_s, p_s, l_s = O.assign_gangs(*scan_args)
+        a_w, p_w, l_w, (conflicts, megas) = O.assign_gangs_wavefront(
+            *scan_args, wave=wave, with_stats=True
+        )
+        identical = bool(
+            (np.asarray(a_s) == np.asarray(a_w)).all()
+            and (np.asarray(p_s) == np.asarray(p_w)).all()
+            and (np.asarray(l_s) == np.asarray(l_w)).all()
+        )
+        g_bucket = int(group_req.shape[0])
+        conflicts = np.asarray(conflicts)
+        megas = np.asarray(megas)
+        wave_stats = {
+            "wave_width": wave,
+            "serial_sequential_steps": g_bucket,
+            "wavefront_sequential_steps": int(conflicts.shape[0]),
+            "waves_per_batch": int(conflicts.shape[0]),
+            "conflict_demoted_waves": int(conflicts.sum()),
+            "uniform_fastpath_waves": int(megas.sum()),
+            "bit_identical_to_serial": identical,
+        }
+    except Exception as e:
+        print(f"wavefront scan timing failed: {e!r}", file=sys.stderr)
+    if use_pallas:
+        try:
+            t_scan_wave_pallas = timed(scan_only_wave_pallas, scan_args)
+        except Exception as e:
+            print(f"pallas wavefront scan timing failed: {e!r}", file=sys.stderr)
+
     @jax.jit
     def full(*args):
         out = O.schedule_batch(*args, use_pallas=False)
@@ -113,8 +197,18 @@ def main() -> int:
     total = t_score + scan_t
     scan_frac = scan_t / total
 
-    def amdahl(n):
-        return round(1.0 / (scan_frac + (1 - scan_frac) / n), 2)
+    def amdahl(n, frac=None):
+        frac = scan_frac if frac is None else frac
+        return round(1.0 / (frac + (1 - frac) / n), 2)
+
+    # the wavefront trajectory: a shorter replicated scan shrinks the
+    # serial fraction Amdahl charges against the sharded scoring term
+    wave_t = (
+        t_scan_wave_pallas if t_scan_wave_pallas is not None else t_scan_wave
+    )
+    scan_frac_wave = None
+    if wave_t is not None:
+        scan_frac_wave = wave_t / (t_score + wave_t)
 
     print(
         json.dumps(
@@ -131,11 +225,33 @@ def main() -> int:
                         if t_scan_pallas is not None
                         else None
                     ),
+                    "scan_wavefront_s": (
+                        round(t_scan_wave, 5) if t_scan_wave is not None else None
+                    ),
+                    "scan_wavefront_pallas_s": (
+                        round(t_scan_wave_pallas, 5)
+                        if t_scan_wave_pallas is not None
+                        else None
+                    ),
+                    "wavefront": wave_stats,
+                    "scan_fraction_wavefront": (
+                        round(scan_frac_wave, 4)
+                        if scan_frac_wave is not None
+                        else None
+                    ),
                     "fused_full_batch_s": round(t_full, 5),
                     "sharded_scoring_amdahl_ceiling": {
                         "4_chips": amdahl(4),
                         "8_chips": amdahl(8),
                     },
+                    "sharded_scoring_amdahl_ceiling_wavefront": (
+                        {
+                            "4_chips": amdahl(4, scan_frac_wave),
+                            "8_chips": amdahl(8, scan_frac_wave),
+                        }
+                        if scan_frac_wave is not None
+                        else None
+                    ),
                     "layout": (
                         "scoring sharded over the mesh; scan replicated "
                         "(ops.oracle.schedule_batch scan_mesh; measured "

@@ -106,6 +106,61 @@ def test_pallas_rejects_mismatched_mask_rows():
         )
 
 
+def _run_wave(left, group_req, remaining, mask, order, wave):
+    a_ref, p_ref, l_ref = assign_gangs(left, group_req, remaining, mask, order)
+    a_pal, p_pal, l_pal = assign_gangs_pallas(
+        left, group_req, remaining, mask, order, interpret=True, wave=wave
+    )
+    np.testing.assert_array_equal(np.asarray(a_ref), np.asarray(a_pal))
+    np.testing.assert_array_equal(np.asarray(p_ref), np.asarray(p_pal))
+    np.testing.assert_array_equal(np.asarray(l_ref), np.asarray(l_pal))
+
+
+def test_pallas_wavefront_matches_scan_fuzz():
+    """The chunked-grid wavefront kernel variant (wave >= 2): bit-identity
+    against the serial scan over both mask modes, mixed demand rows (the
+    speculative/demotion paths) and identical demand rows (the uniform
+    aggregate path). ONE fixed shape — interpret-mode kernel builds are
+    seconds each, so value trials must ride the jit cache."""
+    rng = np.random.default_rng(31)
+    n, g, r = 12, 10, 3
+    for trial in range(6):
+        left = rng.integers(0, 200, size=(n, r)).astype(np.int32)
+        if trial % 3 == 0:
+            group_req = np.tile(
+                rng.integers(0, 4, size=(1, r)).astype(np.int32), (g, 1)
+            )
+        else:
+            group_req = rng.integers(0, 6, size=(g, r)).astype(np.int32)
+        remaining = rng.integers(0, 40, size=g).astype(np.int32)
+        order = rng.permutation(g).astype(np.int32)
+        rows = 1 if trial % 2 == 0 else g
+        mask = rng.random((rows, n)) > 0.2
+        _run_wave(left, group_req, remaining, mask, order, 8)
+
+
+def test_pallas_wavefront_contended_and_uniform_edges():
+    """Adversarial wavefront cases: a tight node every gang wants
+    (serial-replay demotion), an all-identical bulk gang submission with
+    infeasible gangs mid-stream (uniform aggregate path), and the
+    histogram clamp region (capacities > _BINS-1)."""
+    # contended, non-uniform
+    left = np.array([[10], [100]], np.int32)
+    group_req = np.array([[1 + (i % 2)] for i in range(8)], np.int32)
+    _run_wave(
+        left, group_req, np.full(8, 3, np.int32), np.ones((1, 2), bool),
+        np.arange(8, dtype=np.int32), 4,
+    )
+    # uniform with infeasible gangs and clamped capacities
+    left = np.array([[500, 9], [500, 9], [500, 300], [500, 0]], np.int32)
+    group_req = np.tile(np.array([[3, 1]], np.int32), (8, 1))
+    remaining = np.array([4, 900, 4, 4, 900, 4, 4, 4], np.int32)
+    _run_wave(
+        left, group_req, remaining, np.ones((1, 4), bool),
+        np.arange(8, dtype=np.int32), 8,
+    )
+
+
 def test_pallas_matches_scan_readback_tail_scenarios():
     """Interpret-mode equivalence at the compact-readback tail shapes
     (sim.scenarios.readback_tail_scenarios, the same scenarios the TPU
