@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all build vet test test-cpu bench bench-scan native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e
+.PHONY: all build vet test test-cpu bench bench-scan native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo
 
 all: vet native test
 
@@ -53,6 +53,13 @@ http-e2e:
 # (reference-parity) scorer at a scale where one run is ~1-2 minutes
 serial-e2e:
 	$(PY) benchmarks/serial_e2e.py
+
+# schedule-trace pipeline CI gate: short sim with tracing against a real
+# sidecar; validates the Chrome-trace JSON loads, client+server spans
+# stitch under one trace ID, and /debug/decisions serves placed+denied
+# blame records — fails on schema drift (docs/observability.md)
+trace-demo:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/trace_demo.py
 
 # capture the full hardware-evidence suite (bench, smoke, ladder, scale)
 # into the round's artifact files — aborts untouched if the TPU is away

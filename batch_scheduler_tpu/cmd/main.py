@@ -39,9 +39,52 @@ def _add_config_flag(p: argparse.ArgumentParser) -> None:
 def _add_metrics_flag(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--metrics-port", type=int, default=None, metavar="PORT",
-        help="serve Prometheus /metrics (+/healthz) on this port (0 = "
-             "ephemeral; the bound port is printed)",
+        help="serve Prometheus /metrics (+/healthz, /debug/trace, "
+             "/debug/decisions) on this port (0 = ephemeral; the bound "
+             "port is printed)",
     )
+
+
+def _add_trace_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--trace", action="store_true",
+        help="record spans for the full decision path (cycle -> gang "
+             "transaction -> oracle batch -> wire -> device scan -> bind) "
+             "into a bounded ring; sim exports a Chrome-trace JSON on "
+             "exit, and --metrics-port serves the live ring at "
+             "/debug/trace (docs/observability.md)",
+    )
+    p.add_argument(
+        "--trace-dir", default=".", metavar="DIR",
+        help="directory the Chrome-trace JSON is written to on exit "
+             "(sim only; default: current directory)",
+    )
+    p.add_argument(
+        "--trace-sample", type=float, default=1.0, metavar="FRACTION",
+        help="fraction of scheduling cycles traced (children follow "
+             "their root's fate; 1.0 = every cycle)",
+    )
+
+
+def _maybe_configure_trace(args) -> bool:
+    if not getattr(args, "trace", False):
+        return False
+    from ..utils import trace as trace_mod
+
+    trace_mod.configure(enabled=True, sample=args.trace_sample)
+    return True
+
+
+def _export_trace(args) -> None:
+    from ..utils import trace as trace_mod
+
+    os.makedirs(args.trace_dir, exist_ok=True)
+    path = os.path.join(
+        args.trace_dir, f"bst-trace-{os.getpid()}.json"
+    )
+    trace_mod.DEFAULT_RECORDER.export(path)
+    n = len(trace_mod.DEFAULT_RECORDER.snapshot())
+    print(f"trace written: {path} ({n} spans)", flush=True)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -97,6 +140,7 @@ def build_parser() -> argparse.ArgumentParser:
              "round-trip off the scheduling critical path",
     )
     _add_metrics_flag(sim)
+    _add_trace_flags(sim)
     sim.add_argument("--settle", type=float, default=3.0,
                      help="finish early once group phases and bound counts "
                           "have been stable this many seconds (a denied gang "
@@ -112,6 +156,7 @@ def build_parser() -> argparse.ArgumentParser:
              "(first TPU compile is ~20-40s; warmed shapes answer instantly)",
     )
     _add_metrics_flag(serve)
+    _add_trace_flags(serve)
 
     chk = sub.add_parser("check-config", help="validate a scheduler config JSON")
     _add_config_flag(chk)
@@ -289,6 +334,10 @@ def cmd_serve(args) -> int:
 
     freeze_startup()
 
+    # server-side local span ring: traced requests' spans land in this
+    # process's /debug/trace too (they ALWAYS go back to the client in
+    # TRACE_INFO frames, --trace or not)
+    _maybe_configure_trace(args)
     _maybe_serve_metrics(args)
 
     server = OracleServer(host=args.host, port=args.port)
@@ -324,6 +373,7 @@ def cmd_sim(args) -> int:
     if args.scorer:
         cfg.plugin_config.scorer = args.scorer
 
+    tracing = _maybe_configure_trace(args)
     _maybe_serve_metrics(args)
     _resolve_backend_or_degrade()
     _enable_compilation_cache()
@@ -470,6 +520,15 @@ def cmd_sim(args) -> int:
         oracle = getattr(cluster.runtime.operation, "oracle", None)
         if oracle is not None and getattr(oracle, "batches_run", 0):
             print(f"oracle stats: {oracle.stats()}")
+        if tracing:
+            from ..utils.trace import DEFAULT_FLIGHT_RECORDER
+
+            _export_trace(args)
+            verdicts: Dict[str, int] = {}
+            for recs in DEFAULT_FLIGHT_RECORDER.snapshot().values():
+                for r in recs:
+                    verdicts[r["verdict"]] = verdicts.get(r["verdict"], 0) + 1
+            print(f"flight recorder decisions: {verdicts}")
     finally:
         cluster.stop()
         if remote_scorer is not None:

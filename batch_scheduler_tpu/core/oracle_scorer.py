@@ -30,6 +30,7 @@ from ..cache.pg_cache import PGStatusCache, PodGroupMatchStatus
 from ..ops.oracle import execute_batch_host
 from ..ops.snapshot import ClusterSnapshot, GroupDemand
 from ..utils.errors import StaleBatchError
+from ..utils import trace as trace_mod
 
 __all__ = ["OracleScorer", "demand_from_status", "conservative_cpu_batch"]
 
@@ -244,6 +245,10 @@ class OracleScorer:
 
     def refresh(self, cluster, status_cache: PGStatusCache) -> None:
         """Rebuild the snapshot and run one fused oracle batch."""
+        with trace_mod.span("oracle.refresh", cat="oracle"):
+            self._refresh_traced(cluster, status_cache)
+
+    def _refresh_traced(self, cluster, status_cache: PGStatusCache) -> None:
         t0 = time.perf_counter()
         # Credits, the dirty generation, and the version base are all taken
         # BEFORE reading state: any change landing mid-refresh leaves
@@ -280,10 +285,15 @@ class OracleScorer:
             and self._schema.covers_names(node_req.values())
         ):
             schema = self._schema
-        snap = ClusterSnapshot(nodes, node_req, demands, schema=schema)
+        with trace_mod.span("oracle.snapshot_pack", cat="oracle"):
+            snap = ClusterSnapshot(nodes, node_req, demands, schema=schema)
         self._schema, self._schema_key = snap.schema, schema_key
         t_pack = time.perf_counter()
-        host, row_fetcher = self._execute(snap)
+        with trace_mod.span(
+            "oracle.batch", cat="oracle",
+            groups=len(snap.group_names), nodes=len(snap.node_names),
+        ):
+            host, row_fetcher = self._execute(snap)
         t_batch = time.perf_counter()
         max_group = (
             snap.group_names[int(host["best"])]
@@ -312,17 +322,42 @@ class OracleScorer:
             self.pack_seconds.append(t_pack - t0)
             self.batch_seconds.append(t_batch - t_pack)
             del self.pack_seconds[:-1000], self.batch_seconds[:-1000]
-        from ..utils.metrics import DEFAULT_REGISTRY
+        from ..utils.metrics import DEFAULT_REGISTRY, LONG_OP_BUCKETS
 
         DEFAULT_REGISTRY.counter(
             "bst_oracle_batches_total", "Fused oracle batches executed"
         ).inc()
+        # LONG_OP buckets: a cold batch includes the XLA compile (~20-40s
+        # on the accelerator) — the default 10s ceiling would saturate
         DEFAULT_REGISTRY.histogram(
-            "bst_oracle_batch_seconds", "Device time per fused oracle batch"
+            "bst_oracle_batch_seconds",
+            "Device time per fused oracle batch (compiles included)",
+            buckets=LONG_OP_BUCKETS,
         ).observe(t_batch - t_pack)
         DEFAULT_REGISTRY.histogram(
             "bst_oracle_pack_seconds", "Host snapshot-pack time per batch"
         ).observe(t_pack - t0)
+        # flight-recorder batch record: the device-side evidence (scan
+        # path, wave stats, compile) later gang decisions rest on. The
+        # telemetry dict is NESTED, never splatted: on the remote path it
+        # arrives verbatim from the peer's TRACE_INFO JSON, and a
+        # version-skewed sidecar's key colliding with record()'s own
+        # parameters must not TypeError the refresh into a cycle error
+        # (same contract as record_remote_spans: malformed peer data
+        # never breaks the caller).
+        telemetry = host.get("telemetry") if isinstance(host, dict) else None
+        trace_mod.DEFAULT_FLIGHT_RECORDER.record(
+            "_batch",
+            phase="batch",
+            verdict="info",
+            batch=self.batches_run,
+            batch_ms=round((t_batch - t_pack) * 1000, 2),
+            pack_ms=round((t_pack - t0) * 1000, 2),
+            groups=len(snap.group_names),
+            nodes=len(snap.node_names),
+            degraded=bool(self.degraded),
+            telemetry=telemetry or {},
+        )
 
     def _execute(self, snap: ClusterSnapshot):
         """Run one batch locally on the attached device. Returns the O(G)

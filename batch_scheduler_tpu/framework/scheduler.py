@@ -26,6 +26,8 @@ from ..utils.errors import (
 )
 from ..utils.labels import pod_group_name
 from ..utils.metrics import DEFAULT_REGISTRY
+from ..utils import trace as trace_mod
+from ..utils.trace import DEFAULT_FLIGHT_RECORDER
 from .cluster import ClusterState
 from .queue import SchedulingQueue
 from .types import PodInfo, StatusCode
@@ -142,6 +144,9 @@ class Scheduler:
             "bst_cycle_errors_total",
             "Scheduling cycles aborted by an error, by kind",
         )
+        # feasible-node count of the last _select_node scan (evidence for
+        # the flight recorder's "no feasible node" blame records)
+        self._last_scan_feasible = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -342,6 +347,13 @@ class Scheduler:
                 self._gang_buffer.append(
                     (gang, pod.metadata.namespace, assigned)
                 )
+            DEFAULT_FLIGHT_RECORDER.record(
+                gang,
+                phase="gang_transaction",
+                verdict="placed",
+                members=len(assigned),
+                nodes=len({n for _, _, n in assigned}),
+            )
         except Exception:
             # unexpected failure (transport, bug): release what was only
             # assumed, hand the gang back, and let the outer handler run
@@ -483,16 +495,29 @@ class Scheduler:
     def _run_cycle(self, info: PodInfo) -> Optional[str]:
         try:
             with self._cycle_seconds.time():
-                return self._schedule_one(info)
+                # root span: one trace per scheduling cycle (pop ->
+                # prefilter -> select -> permit/park), the unit the
+                # sidecar round-trip stitches into (docs/observability.md)
+                with trace_mod.start_trace(
+                    "schedule_cycle", pod=info.name,
+                    gang=_gang_key(info) or "",
+                ):
+                    return self._schedule_one(info)
         except Exception as e:
             # a broken cycle must not kill the loop; release any
             # capacity assumed mid-cycle, then retry the pod
-            self._cycle_errors.inc(
-                kind=(
-                    "oracle-transport"
-                    if isinstance(e, (OracleTransportError, OracleDeadlineError))
-                    else "other"
-                )
+            kind = (
+                "oracle-transport"
+                if isinstance(e, (OracleTransportError, OracleDeadlineError))
+                else "other"
+            )
+            self._cycle_errors.inc(kind=kind)
+            DEFAULT_FLIGHT_RECORDER.record(
+                _gang_key(info) or info.name,
+                phase="cycle",
+                verdict="error",
+                reason=f"{type(e).__name__}: {e}",
+                kind=kind,
             )
             self.cluster.forget(info.uid)
             if self.plugin is not None:
@@ -558,7 +583,8 @@ class Scheduler:
 
         if self.plugin is not None:
             try:
-                self.plugin.pre_filter(pod)
+                with trace_mod.span("pre_filter"):
+                    self.plugin.pre_filter(pod)
             except SchedulingError as e:
                 self._unschedulable(info, str(e))
                 return
@@ -566,10 +592,15 @@ class Scheduler:
             # gang's plan); a plan covering the quorum admits the gang as
             # one transaction and consumes its queued siblings
             if info.gang and hasattr(self.plugin, "gang_plan"):
-                if self._gang_transaction(info, pod, _gang_key(info)):
+                with trace_mod.span("gang_transaction"):
+                    admitted = self._gang_transaction(
+                        info, pod, _gang_key(info)
+                    )
+                if admitted:
                     return
 
-        node_name, from_plan = self._select_node(pod)
+        with trace_mod.span("select_node"):
+            node_name, from_plan = self._select_node(pod)
         if node_name is None:
             # preemption cycle (the role upstream kube-scheduler's
             # PostFilter plays for the reference, whose policy hooks are
@@ -600,9 +631,25 @@ class Scheduler:
 
         code, timeout = self.plugin.permit(pod, node_name)
         if code == StatusCode.SUCCESS:
+            DEFAULT_FLIGHT_RECORDER.record(
+                _gang_key(info) or info.name,
+                phase="permit",
+                verdict="placed",
+                pod=info.name,
+                node=node_name,
+                from_plan=from_plan,
+            )
             self._bind(pod, node_name)
         elif code == StatusCode.WAIT:
             self.stats["permit_waits"] += 1
+            DEFAULT_FLIGHT_RECORDER.record(
+                _gang_key(info) or info.name,
+                phase="permit",
+                verdict="wait",
+                pod=info.name,
+                node=node_name,
+                timeout_s=timeout,
+            )
             wp = WaitingPod(pod, node_name, self._clock() + timeout)
             wp._info = info  # carried for requeue on reject/timeout
             self.waiting.park(wp)
@@ -640,10 +687,12 @@ class Scheduler:
                         node, self.cluster.node_requested(hint), None
                     )
                     if rmath.resource_satisfied(left, require):
+                        self._last_scan_feasible = 1
                         return hint, True
                 # plan slot unusable (node gone/full): fall through to the
                 # scan, which sees the live cluster
         best_name, best_score = None, None
+        feasible = 0
         for node in self.cluster.list_nodes():
             if node.spec.unschedulable:
                 continue
@@ -659,6 +708,7 @@ class Scheduler:
                     self.plugin.filter(pod, node.metadata.name)
                 except SchedulingError:
                     continue
+            feasible += 1
             score = (
                 self.plugin.score(pod, node.metadata.name)
                 if self.plugin is not None
@@ -666,6 +716,7 @@ class Scheduler:
             )
             if best_score is None or score > best_score:
                 best_name, best_score = node.metadata.name, score
+        self._last_scan_feasible = feasible
         return best_name, False
 
     def _try_preempt(self, pod: Pod) -> bool:
@@ -783,6 +834,20 @@ class Scheduler:
 
     def _unschedulable(self, info: PodInfo, reason: str) -> None:
         self.stats["unschedulable"] += 1
+        # flight recorder: the blame record for a denied pod/gang — the
+        # reason string IS the blame (PreFilter's SchedulingError message
+        # carries the oracle's verdict: infeasible vs reserved vs denied-
+        # recently; "no feasible node" carries the scan's feasible count)
+        rec = {"pod": info.name}
+        if reason == "no feasible node":
+            rec["feasible_nodes"] = self._last_scan_feasible
+        DEFAULT_FLIGHT_RECORDER.record(
+            _gang_key(info) or info.name,
+            phase="cycle",
+            verdict="denied",
+            reason=reason,
+            **rec,
+        )
         self.queue.push_backoff(info)
 
     # -- binding cycle -----------------------------------------------------

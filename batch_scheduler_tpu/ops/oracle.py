@@ -669,6 +669,7 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
         scan_left, scan_gr, scan_rem, scan_fm = (
             left, group_req, remaining, fit_mask,
         )
+    wave_stats = None
     if use_pallas:
         from .pallas_assign import assign_gangs_pallas
 
@@ -676,8 +677,13 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
             scan_left, scan_gr, scan_rem, scan_fm, order, wave=scan_wave
         )
     elif scan_wave > 1:
-        assignment, placed, left_after = assign_gangs_wavefront(
-            scan_left, scan_gr, scan_rem, scan_fm, order, wave=scan_wave
+        # with_stats costs nothing extra: the per-wave conflict/mega flags
+        # are already carried through the scan; surfacing them feeds the
+        # serving-path wave metrics (bst_scan_wave_*) that were previously
+        # only computed inside benchmarks/scan_split.py
+        assignment, placed, left_after, wave_stats = assign_gangs_wavefront(
+            scan_left, scan_gr, scan_rem, scan_fm, order, wave=scan_wave,
+            with_stats=True,
         )
     else:
         assignment, placed, left_after = assign_gangs(
@@ -701,6 +707,8 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
         "placed": placed,
         "left_after": left_after,
     }
+    if wave_stats is not None:
+        out["wave_conflicts"], out["wave_megas"] = wave_stats
     if assignment.shape[1] <= 2**15:
         # Compact fetch: (node << 16 | count) halves the host-link bytes for
         # the top-K assignment — the bulk of the per-batch result transfer.
@@ -755,6 +763,12 @@ def _batch_blob(alloc_lanes, requested, group_req, remaining, fit_mask,
       [3G+1]       best_exists (0/1)
       [3G+2:...]   assignment top-K: packed (node<<16|count), G*K — or, when
                    ``pack_assignment=False``, nodes then counts, 2*G*K
+      [tail..]     wavefront scan stats, ONLY when the lax wavefront scan
+                   ran (scan_wave > 1 and not use_pallas): 3 int32 —
+                   waves-per-batch (sequential steps), conflict-demoted
+                   waves (serial replays), uniform-fastpath waves. Static
+                   per jit signature, so collect_batch slices by the same
+                   predicate.
     """
     out = schedule_batch(alloc_lanes, requested, group_req, remaining,
                          fit_mask, group_valid, order, use_pallas=use_pallas,
@@ -769,15 +783,25 @@ def _batch_blob(alloc_lanes, requested, group_req, remaining, fit_mask,
             [out["assignment_nodes"].reshape(-1),
              out["assignment_counts"].reshape(-1)]
         )
-    blob = jnp.concatenate(
-        [
-            out["placed"].astype(jnp.int32),
-            out["gang_feasible"].astype(jnp.int32),
-            progress.astype(jnp.int32),
-            jnp.stack([best, exists.astype(jnp.int32)]),
-            tail,
-        ]
-    )
+    parts = [
+        out["placed"].astype(jnp.int32),
+        out["gang_feasible"].astype(jnp.int32),
+        progress.astype(jnp.int32),
+        jnp.stack([best, exists.astype(jnp.int32)]),
+        tail,
+    ]
+    if "wave_conflicts" in out:
+        conflicts, megas = out["wave_conflicts"], out["wave_megas"]
+        parts.append(
+            jnp.concatenate(
+                [
+                    jnp.full((1,), conflicts.shape[0], jnp.int32),
+                    conflicts.astype(jnp.int32).sum(keepdims=True),
+                    megas.astype(jnp.int32).sum(keepdims=True),
+                ]
+            )
+        )
+    blob = jnp.concatenate(parts)
     if scan_mesh is not None:
         # The blob concatenates pieces with MIXED shardings (gang_feasible
         # rides the groups axis; the packed assignment tail is replicated
@@ -806,12 +830,13 @@ class PendingBatch:
 
     __slots__ = (
         "blob", "out", "pack", "used_pallas", "_rerun", "blob_np",
-        "mask_mode", "used_wave",
+        "mask_mode", "used_wave", "compiled", "n_bucket", "g_bucket",
     )
 
     def __init__(
         self, blob, out, pack, used_pallas, rerun, blob_np=None,
-        mask_mode="broadcast", used_wave=0,
+        mask_mode="broadcast", used_wave=0, compiled=None,
+        n_bucket=0, g_bucket=0,
     ):
         self.blob = blob
         self.out = out
@@ -825,6 +850,12 @@ class PendingBatch:
         # wavefront width this batch ran with (0 = serial scan): collect's
         # blame policy needs to know which optional path was live
         self.used_wave = used_wave
+        # oracle device telemetry (docs/observability.md): did this
+        # dispatch compile a new executable (jit-cache miss — the 20-40s
+        # cold-TPU stall class), and which bucket shape did it run
+        self.compiled = compiled
+        self.n_bucket = n_bucket
+        self.g_bucket = g_bucket
 
 
 def dispatch_batch(batch_args, progress_args, scan_mesh=None) -> PendingBatch:
@@ -851,10 +882,20 @@ def dispatch_batch(batch_args, progress_args, scan_mesh=None) -> PendingBatch:
     # nodes+counts blob tail for wider gangs (or > 2**15-node buckets, where
     # the node<<16 packing would overflow).
     n_bucket = batch_args[0].shape[0]
+    g_bucket = batch_args[2].shape[0]
     remaining_host = np.asarray(batch_args[3])
     remaining_max = int(remaining_host.max(initial=0))
     pack = n_bucket <= 2**15 and remaining_max <= 2**16 - 1
     top_k = batch_top_k(n_bucket, remaining_max)
+    # Compile-cache hit/miss telemetry: the jit cache growing across this
+    # dispatch means a new executable was BUILT (the cold-batch stall
+    # class the PR-1 deadline budget absorbs). Private API, so absence
+    # degrades to "unknown" (None), never breaks a batch.
+    cache_size_fn = getattr(_batch_blob, "_cache_size", None)
+    try:
+        cache_before = cache_size_fn() if cache_size_fn is not None else None
+    except Exception:  # noqa: BLE001 — telemetry only
+        cache_before = None
 
     def run(up: bool, wave: int = 0):
         return _batch_blob(
@@ -900,6 +941,13 @@ def dispatch_batch(batch_args, progress_args, scan_mesh=None) -> PendingBatch:
                 _disable_pallas(errors[-1], mask_mode)
         break
 
+    compiled = None
+    if cache_before is not None:
+        try:
+            compiled = cache_size_fn() > cache_before
+        except Exception:  # noqa: BLE001 — telemetry only
+            compiled = None
+
     # Queue the D2H copy now so it rides behind the computation instead of
     # waiting for the collect call (optional API; device_get works without).
     if blob_np is None:
@@ -909,7 +957,8 @@ def dispatch_batch(batch_args, progress_args, scan_mesh=None) -> PendingBatch:
             pass
     return PendingBatch(
         blob, out, pack, used_pallas, run, blob_np, mask_mode,
-        used_wave=used_wave,
+        used_wave=used_wave, compiled=compiled,
+        n_bucket=n_bucket, g_bucket=g_bucket,
     )
 
 
@@ -930,6 +979,7 @@ def collect_batch(pending: PendingBatch):
     A device-side kernel failure surfaces here; if the Pallas path was used,
     the batch re-runs once on the lax.scan form before the kernel is blamed
     and permanently disabled (same policy as the synchronous path)."""
+    used_pallas, used_wave = pending.used_pallas, pending.used_wave
     try:
         blob_np = (
             pending.blob_np
@@ -955,11 +1005,17 @@ def collect_batch(pending: PendingBatch):
             _disable_pallas(e, pending.mask_mode)
         if pending.used_wave:
             _disable_wave(e)
+        used_pallas, used_wave = False, 0  # the blob in hand is serial
 
     g = out["assignment_nodes"].shape[0]
     k = out["assignment_nodes"].shape[1]
     pack = pending.pack
-    tail = blob_np[3 * g + 2:]
+    # the wave-stat triple rides at the very end of the blob, only when the
+    # lax wavefront scan produced THIS blob (a collect-side serial rerun
+    # has none) — slice the assignment tail by its exact static length
+    has_wave_stats = used_wave > 1 and not used_pallas
+    tail_len = g * k if pack else 2 * g * k
+    tail = blob_np[3 * g + 2: 3 * g + 2 + tail_len]
     if pack:
         packed_np = tail.reshape(g, k)
         nodes_np = packed_np >> 16
@@ -967,6 +1023,21 @@ def collect_batch(pending: PendingBatch):
     else:
         nodes_np = tail[: g * k].reshape(g, k)
         counts_np = tail[g * k:].reshape(g, k)
+    telemetry = {
+        "used_pallas": bool(used_pallas),
+        "wave_width": int(used_wave),
+        "mask_mode": pending.mask_mode,
+        "compiled": pending.compiled,
+        "n_bucket": int(pending.n_bucket),
+        "g_bucket": int(pending.g_bucket),
+    }
+    if has_wave_stats:
+        stats_np = blob_np[3 * g + 2 + tail_len:]
+        if stats_np.shape[0] >= 3:
+            telemetry["waves_per_batch"] = int(stats_np[0])
+            telemetry["wave_demotions"] = int(stats_np[1])
+            telemetry["wave_uniform"] = int(stats_np[2])
+    _fold_batch_metrics(telemetry)
     host = {
         "placed": blob_np[:g].astype(bool),
         "gang_feasible": blob_np[g:2 * g].astype(bool),
@@ -975,9 +1046,58 @@ def collect_batch(pending: PendingBatch):
         "best_exists": bool(blob_np[3 * g + 1]),
         "assignment_nodes": nodes_np,
         "assignment_counts": counts_np,
+        "telemetry": telemetry,
     }
     device_result = {"capacity": out["capacity"], "scores": out["scores"]}
     return host, device_result
+
+
+def _fold_batch_metrics(telemetry: dict) -> None:
+    """Serving-path batch telemetry -> Prometheus. This is where the
+    wavefront scan stats become live series (previously only computed
+    inside benchmarks/scan_split.py — production runs with BST_SCAN_WAVE
+    were blind): waves per batch, demotions (serial replays), uniform
+    fast-path waves, plus the scan-path mix and the compile-cache misses.
+    Called per batch from collect_batch so the in-process scorer and the
+    sidecar server both report without extra wiring."""
+    from ..utils.metrics import DEFAULT_REGISTRY as reg
+
+    path = (
+        "pallas"
+        if telemetry["used_pallas"]
+        else "wavefront" if telemetry["wave_width"] > 1 else "serial"
+    )
+    reg.counter(
+        "bst_scan_batches_total", "Oracle batches by assignment-scan path"
+    ).inc(path=path)
+    if telemetry.get("compiled"):
+        reg.counter(
+            "bst_oracle_compiles_total",
+            "Oracle batches that built a new executable (jit-cache miss)",
+        ).inc()
+    reg.gauge(
+        "bst_scan_wave_enabled",
+        "1 while the wavefront scan path is enabled (0 after a failure "
+        "permanently demoted the process to the serial scan)",
+    ).set(1.0 if _wave_enabled[0] else 0.0)
+    if "waves_per_batch" in telemetry:
+        reg.histogram(
+            "bst_scan_waves_per_batch",
+            "Sequential wavefront steps per oracle batch",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+        ).observe(float(telemetry["waves_per_batch"]))
+        reg.counter(
+            "bst_scan_waves_total", "Wavefront steps executed"
+        ).inc(telemetry["waves_per_batch"])
+        reg.counter(
+            "bst_scan_wave_demotions_total",
+            "Waves demoted to the serial replay (conflict or infeasible "
+            "boundary)",
+        ).inc(telemetry["wave_demotions"])
+        reg.counter(
+            "bst_scan_wave_uniform_total",
+            "Waves served by the uniform-demand aggregate fast path",
+        ).inc(telemetry["wave_uniform"])
 
 
 def execute_batch_host(batch_args, progress_args, scan_mesh=None):

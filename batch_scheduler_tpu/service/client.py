@@ -29,8 +29,9 @@ from ..utils.errors import (
     OracleTransportError,
     StaleBatchError,
 )
-from ..utils.metrics import DEFAULT_REGISTRY, Registry
+from ..utils.metrics import DEFAULT_REGISTRY, LONG_OP_BUCKETS, Registry
 from ..utils.retry import CircuitBreaker, RetryPolicy
+from ..utils import trace as trace_mod
 from . import protocol as proto
 
 __all__ = ["OracleClient", "ResilientOracleClient", "RemoteScorer"]
@@ -72,7 +73,11 @@ class OracleClient:
             pass
 
     def _round_trip(
-        self, msg_type: int, payload: bytes, deadline_ms: Optional[int] = None
+        self,
+        msg_type: int,
+        payload: bytes,
+        deadline_ms: Optional[int] = None,
+        trace_ctx: Optional[Tuple[str, str]] = None,
     ) -> Tuple[int, bytes]:
         with self._lock:
             if deadline_ms is not None:
@@ -89,9 +94,23 @@ class OracleClient:
                         proto.MsgType.DEADLINE,
                         proto.pack_deadline(deadline_ms),
                     )
+                if trace_ctx is not None:
+                    proto.write_frame(
+                        self._sock,
+                        proto.MsgType.TRACE,
+                        proto.pack_trace(*trace_ctx),
+                    )
                 proto.write_frame(self._sock, msg_type, payload)
                 try:
                     resp_type, resp = proto.read_frame(self._sock)
+                    # A traced request's real response is preceded by the
+                    # server's TRACE_INFO frame: fold its spans into the
+                    # local ring (stitching both sides of the wire under
+                    # one trace ID) and its device telemetry into the
+                    # registry, then keep reading for the actual answer.
+                    while resp_type == proto.MsgType.TRACE_INFO:
+                        self._absorb_trace_info(resp)
+                        resp_type, resp = proto.read_frame(self._sock)
                 except ValueError as e:
                     # bad magic / oversized length: the STREAM is broken,
                     # not the request — classify as transport here so a
@@ -107,6 +126,32 @@ class OracleClient:
             raise in_band_error(resp.decode(errors="replace"))
         return resp_type, resp
 
+    # last TRACE_INFO telemetry absorbed off the wire (oracle device
+    # telemetry: compile-cache hit, bucket shape, wave stats, device
+    # wall-clock) — kept for callers/tests; metrics fold as it lands
+    last_telemetry: Optional[dict] = None
+
+    def _absorb_trace_info(self, payload: bytes) -> None:
+        info = proto.unpack_trace_info(payload)
+        spans = info.get("spans")
+        if isinstance(spans, list):
+            trace_mod.record_remote_spans(spans, pid="oracle-server")
+        telemetry = info.get("telemetry")
+        if isinstance(telemetry, dict):
+            self.last_telemetry = telemetry
+            device_s = telemetry.get("device_seconds")
+            if isinstance(device_s, (int, float)):
+                DEFAULT_REGISTRY.histogram(
+                    "bst_oracle_device_seconds",
+                    "Sidecar-reported device wall-clock per traced batch",
+                    buckets=LONG_OP_BUCKETS,
+                ).observe(float(device_s))
+            if telemetry.get("compiled"):
+                DEFAULT_REGISTRY.counter(
+                    "bst_oracle_remote_compiles_total",
+                    "Traced sidecar batches that built a new executable",
+                ).inc()
+
     def ping(self, deadline_ms: Optional[int] = None) -> bool:
         # a deadline here mostly buys the tightened client-side socket
         # timeout (the server answers pings inline, ignoring the budget):
@@ -120,10 +165,19 @@ class OracleClient:
     def schedule(
         self, req: proto.ScheduleRequest, deadline_ms: Optional[int] = None
     ) -> proto.ScheduleResponse:
+        # propagate the live span context over the wire (the TRACE
+        # annotation frame); None when tracing is off or no span is open,
+        # which keeps the wire bytes identical to a pre-trace client
+        trace_ctx = trace_mod.current_context() if trace_mod.enabled() else None
+        # last_telemetry is per-request: cleared up front so an untraced
+        # (sampled-out) batch can never be attributed the PREVIOUS traced
+        # batch's device evidence
+        self.last_telemetry = None
         resp_type, resp = self._round_trip(
             proto.MsgType.SCHEDULE_REQ,
             proto.pack_schedule_request(req),
             deadline_ms=deadline_ms,
+            trace_ctx=trace_ctx,
         )
         if resp_type != proto.MsgType.SCHEDULE_RESP:
             raise OracleTransportError(
@@ -259,6 +313,13 @@ class ResilientOracleClient:
         (breaker closed/half-open/cooldown elapsed) — the scorer's cue
         that a degraded batch is worth re-probing."""
         return self.breaker.would_attempt()
+
+    @property
+    def last_telemetry(self) -> Optional[dict]:
+        """The underlying connection's last absorbed TRACE_INFO telemetry
+        (None before any traced batch or while disconnected)."""
+        c = self._client
+        return c.last_telemetry if c is not None else None
 
     def close(self) -> None:
         with self._lock:
@@ -479,7 +540,8 @@ class RemoteScorer(OracleScorer):
         client = self._clients[self._next]
         self._next = (self._next + 1) % len(self._clients)
         try:
-            resp = client.schedule(req)
+            with trace_mod.span("oracle.wire_round_trip", cat="oracle"):
+                resp = client.schedule(req)
         except _TRANSPORT_ERRORS + (OracleDeadlineError,):
             # raw OSError/EOFError included, not just the resilient
             # client's wrapped OracleTransportError: a plain OracleClient
@@ -506,6 +568,12 @@ class RemoteScorer(OracleScorer):
             "best_exists": resp.best_exists,
             "progress": resp.progress,
         }
+        # traced batches carry the sidecar's device telemetry back in the
+        # TRACE_INFO frame; surface it like the in-process path does so
+        # the flight recorder's batch records are transport-agnostic
+        telemetry = getattr(client, "last_telemetry", None)
+        if telemetry:
+            host["telemetry"] = telemetry
         batch_seq = resp.batch_seq
 
         def row_fetcher(kind: str, g: int) -> np.ndarray:

@@ -32,6 +32,24 @@ Message types:
                   server-side (the batch keeps running; its result is
                   dropped). Deliberately distinct from ERROR so clients
                   can tell "sidecar alive but slow" from a real failure.
+  TRACE         : 16-hex trace ID + 8-hex parent span ID, annotating the
+                  NEXT request on this connection (no reply; same
+                  annotation-frame pattern as DEADLINE, so every
+                  existing request/response layout — and the native C++
+                  client, which never traces — stays bit-for-bit
+                  unchanged). The server times the annotated request's
+                  phases and answers a TRACE_INFO frame BEFORE the
+                  normal response.
+  TRACE_INFO    : JSON {trace_id, spans: [...], telemetry: {...}} — the
+                  server-side spans (stamped with the client's trace ID,
+                  so both sides stitch into one Chrome-trace timeline)
+                  plus per-batch oracle device telemetry: compile-cache
+                  hit/miss, bucket shape, wave count/demotions, device
+                  wall-clock (docs/observability.md). Sent ONLY to a
+                  peer that sent TRACE, so pre-trace clients never see
+                  it; as with DEADLINE, ship client and server together
+                  (a pre-trace server answers TRACE with an ERROR frame
+                  and desyncs).
 """
 
 from __future__ import annotations
@@ -57,6 +75,10 @@ __all__ = [
     "unpack_row_request",
     "pack_deadline",
     "unpack_deadline",
+    "pack_trace",
+    "unpack_trace",
+    "pack_trace_info",
+    "unpack_trace_info",
     "is_stale_batch_message",
 ]
 
@@ -80,6 +102,8 @@ class MsgType:
     ERROR = 7
     DEADLINE = 8
     DEADLINE_ERROR = 9
+    TRACE = 10
+    TRACE_INFO = 11
 
 
 ROW_KINDS = ("capacity", "scores")
@@ -283,6 +307,51 @@ def pack_deadline(deadline_ms: int) -> bytes:
 
 def unpack_deadline(payload: bytes) -> int:
     return int(_DEADLINE.unpack(payload)[0])
+
+
+# -- trace annotation + trace-info reply -----------------------------------
+
+# fixed-width ascii: 16-hex trace id + 8-hex parent span id. Binary-fixed
+# (not JSON) because the annotation rides the REQUEST hot path; the reply
+# (TRACE_INFO) is JSON because it is only ever sent to a tracing client.
+_TRACE = struct.Struct("<16s8s")
+
+
+def pack_trace(trace_id: str, parent_span_id: str = "") -> bytes:
+    tid = trace_id.encode("ascii")
+    sid = parent_span_id.encode("ascii")
+    if len(tid) != 16:
+        raise ValueError(f"trace_id must be 16 hex chars, got {trace_id!r}")
+    return _TRACE.pack(tid, sid[:8].ljust(8, b"\0"))
+
+
+def unpack_trace(payload: bytes) -> Tuple[str, str]:
+    tid, sid = _TRACE.unpack(payload)
+    return (
+        tid.decode("ascii", errors="replace"),
+        sid.rstrip(b"\0").decode("ascii", errors="replace"),
+    )
+
+
+def pack_trace_info(trace_id: str, spans: list, telemetry: dict) -> bytes:
+    import json
+
+    return json.dumps(
+        {"trace_id": trace_id, "spans": spans, "telemetry": telemetry},
+        default=str,
+    ).encode()
+
+
+def unpack_trace_info(payload: bytes) -> dict:
+    import json
+
+    try:
+        info = json.loads(payload.decode("utf-8", errors="replace"))
+    except ValueError:
+        return {}
+    if not isinstance(info, dict):
+        return {}
+    return info
 
 
 # -- row request/response --------------------------------------------------

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import socketserver
 import threading
+import time
 from typing import Optional
 
 import jax
@@ -25,6 +26,8 @@ import numpy as np
 
 from ..ops.bucketing import pad_oracle_batch
 from ..ops.oracle import execute_batch_host
+from ..utils.metrics import DEFAULT_REGISTRY, LONG_OP_BUCKETS
+from ..utils import trace as trace_mod
 from . import protocol as proto
 
 __all__ = ["OracleServer", "serve_background"]
@@ -131,12 +134,40 @@ class _Handler(socketserver.BaseRequestHandler):
             self._worker = _ConnWorker()
         return self._worker.run(fn, budget_ms)
 
+    @staticmethod
+    def _mk_span(name: str, ts_epoch: float, dur_s: float, trace_ctx, **args):
+        """One Chrome-trace span dict for the TRACE_INFO reply, stamped
+        with the CLIENT's trace/parent IDs so both sides of the wire
+        stitch into a single timeline (utils.trace.record_remote_spans)."""
+        trace_id, parent_id = trace_ctx
+        a = {"trace_id": trace_id, **args}
+        if parent_id:
+            a["parent_id"] = parent_id
+        return {
+            "name": name,
+            "cat": "oracle",
+            "ts": ts_epoch * 1e6,
+            "dur": dur_s * 1e6,
+            "args": a,
+        }
+
     def handle(self) -> None:
         last_batch: Optional[dict] = None
         last_counts = (0, 0)
         batch_seq = 0
         deadline_ms: Optional[int] = None  # armed for the NEXT request
+        trace_ctx: Optional[tuple] = None  # armed for the NEXT request
         self._worker: Optional[_ConnWorker] = None
+        batch_seconds = DEFAULT_REGISTRY.histogram(
+            "bst_oracle_server_batch_seconds",
+            "Sidecar-side wall-clock per schedule batch (unpack + pad + "
+            "device), compile stalls included",
+            buckets=LONG_OP_BUCKETS,
+        )
+        batches_total = DEFAULT_REGISTRY.counter(
+            "bst_oracle_server_batches_total",
+            "Schedule batches executed by the sidecar, by traced",
+        )
         try:
             while True:
                 try:
@@ -149,7 +180,11 @@ class _Handler(socketserver.BaseRequestHandler):
                     if msg_type == proto.MsgType.DEADLINE:
                         deadline_ms = proto.unpack_deadline(payload)
                         continue  # annotation only; no reply
+                    if msg_type == proto.MsgType.TRACE:
+                        trace_ctx = proto.unpack_trace(payload)
+                        continue  # annotation only; no reply
                     budget_ms, deadline_ms = deadline_ms, None
+                    req_trace, trace_ctx = trace_ctx, None
                     if msg_type == proto.MsgType.PING:
                         # answered inline, never through the worker:
                         # liveness must stay observable even while a
@@ -159,6 +194,12 @@ class _Handler(socketserver.BaseRequestHandler):
                     elif msg_type == proto.MsgType.SCHEDULE_REQ:
 
                         def run_schedule(payload=payload):
+                            # phase timings double as the TRACE_INFO span
+                            # source and the server metric observations —
+                            # epoch stamps so client+server spans share a
+                            # clock domain in the stitched timeline
+                            ts0 = time.time()
+                            t0 = time.perf_counter()
                             req = proto.unpack_schedule_request(payload)
                             args, progress_args, (n, g) = _pad_request(req)
                             mesh = self.server.scan_mesh
@@ -166,6 +207,7 @@ class _Handler(socketserver.BaseRequestHandler):
                                 from ..parallel.mesh import shard_snapshot_args
 
                                 args = shard_snapshot_args(mesh, args)
+                            t1 = time.perf_counter()
                             # ONE batch on the device at a time, across all
                             # connections: the sidecar owns a single
                             # accelerator (concurrency buys nothing), and on
@@ -175,10 +217,18 @@ class _Handler(socketserver.BaseRequestHandler):
                             # batch overlapping a reconnected client's retry
                             # hits exactly that without this lock
                             with self.server.execute_lock:
+                                t2 = time.perf_counter()
                                 host, batch = execute_batch_host(
                                     args, progress_args, scan_mesh=mesh
                                 )
-                            return host, batch, (n, g)
+                                t3 = time.perf_counter()
+                            timings = {
+                                "ts0": ts0,
+                                "unpack_pad": t1 - t0,
+                                "lock_wait": t2 - t1,
+                                "device": t3 - t2,
+                            }
+                            return host, batch, (n, g), timings
 
                         outcome = self._run(run_schedule, budget_ms)
                         if outcome is _DEADLINE_HIT:
@@ -188,9 +238,69 @@ class _Handler(socketserver.BaseRequestHandler):
                                 f"schedule exceeded deadline of {budget_ms}ms".encode(),
                             )
                             continue
-                        host, last_batch, (n, g) = outcome
+                        host, last_batch, (n, g), timings = outcome
                         last_counts = (n, g)
                         batch_seq += 1
+                        total_s = (
+                            timings["unpack_pad"]
+                            + timings["lock_wait"]
+                            + timings["device"]
+                        )
+                        batch_seconds.observe(total_s)
+                        batches_total.inc(
+                            traced="yes" if req_trace else "no"
+                        )
+                        if req_trace is not None:
+                            telemetry = dict(host.get("telemetry") or {})
+                            telemetry.update(
+                                device_seconds=round(timings["device"], 6),
+                                lock_wait_seconds=round(
+                                    timings["lock_wait"], 6
+                                ),
+                                unpack_pad_seconds=round(
+                                    timings["unpack_pad"], 6
+                                ),
+                                batch_seq=batch_seq,
+                                n=n,
+                                g=g,
+                            )
+                            ts0 = timings["ts0"]
+                            spans = [
+                                self._mk_span(
+                                    "oracle.schedule", ts0, total_s,
+                                    req_trace, n=n, g=g,
+                                ),
+                                self._mk_span(
+                                    "oracle.unpack_pad", ts0,
+                                    timings["unpack_pad"], req_trace,
+                                ),
+                                self._mk_span(
+                                    "oracle.lock_wait",
+                                    ts0 + timings["unpack_pad"],
+                                    timings["lock_wait"], req_trace,
+                                ),
+                                self._mk_span(
+                                    "oracle.device_batch",
+                                    ts0 + timings["unpack_pad"]
+                                    + timings["lock_wait"],
+                                    timings["device"], req_trace,
+                                    compiled=telemetry.get("compiled"),
+                                ),
+                            ]
+                            if trace_mod.enabled():
+                                # server-side local ring (serve --trace):
+                                # the same spans land in this process's
+                                # /debug/trace too
+                                trace_mod.record_remote_spans(
+                                    spans, pid="oracle-server"
+                                )
+                            proto.write_frame(
+                                self.request,
+                                proto.MsgType.TRACE_INFO,
+                                proto.pack_trace_info(
+                                    req_trace[0], spans, telemetry
+                                ),
+                            )
                         # Map assignment node indexes back into the
                         # CLIENT's node space before packing: the batch ran
                         # in the server's bucket-padded (and, on a mesh,

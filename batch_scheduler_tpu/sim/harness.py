@@ -214,6 +214,18 @@ class SimCluster:
             counts[phase] = counts.get(phase, 0) + 1
         return counts
 
+    def decisions(self, group: Optional[str] = None) -> Dict[str, list]:
+        """The gang decision flight recorder's records (utils.trace):
+        why a gang was placed/denied/parked, with blame reasons and the
+        device evidence — the harness-side view of /debug/decisions.
+        ``group`` may be "name" (default namespace assumed) or
+        "namespace/name"."""
+        from ..utils.trace import DEFAULT_FLIGHT_RECORDER
+
+        if group is not None and "/" not in group:
+            group = f"default/{group}"
+        return DEFAULT_FLIGHT_RECORDER.snapshot(group)
+
     def wait_for(
         self,
         predicate: Callable[[], bool],

@@ -9,7 +9,9 @@ Prometheus text format over a tiny HTTP endpoint.
 
 Usage: components take a ``Registry`` (default: the process-wide
 ``DEFAULT_REGISTRY``); ``serve_metrics(registry)`` exposes ``/metrics`` and
-``/healthz``.
+``/healthz``, plus the trace/explain surfaces ``/debug/trace`` (the span
+ring as Chrome-trace JSON, utils.trace) and ``/debug/decisions`` (the gang
+decision flight recorder) — docs/observability.md has the catalog.
 """
 
 from __future__ import annotations
@@ -25,6 +27,7 @@ __all__ = [
     "Histogram",
     "Registry",
     "DEFAULT_REGISTRY",
+    "LONG_OP_BUCKETS",
     "serve_metrics",
 ]
 
@@ -32,6 +35,18 @@ __all__ = [
 _DEFAULT_BUCKETS = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
     0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+# Compile/long-op preset: the default buckets top out at 10s, which
+# saturates for XLA compile times and cold TPU batches (a first compile of
+# a new bucket shape is ~20-40s on the accelerator, docs/resilience.md) —
+# every such observation would land in +Inf and quantiles would cap at 10s.
+# Use this preset at compile-time/long-op observation sites
+# (bst_oracle_batch_seconds, bst_oracle_server_batch_seconds,
+# bst_oracle_device_seconds).
+LONG_OP_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0, 20.0, 40.0, 80.0, 160.0, 320.0,
 )
 
 
@@ -224,11 +239,33 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         pass
 
     def do_GET(self) -> None:
-        if self.path.split("?")[0] == "/metrics":
+        path = self.path.split("?")[0]
+        if path == "/metrics":
             body = self.registry.render().encode()
             ctype = "text/plain; version=0.0.4"
-        elif self.path.split("?")[0] == "/healthz":
+        elif path == "/healthz":
             body, ctype = b"ok\n", "text/plain"
+        elif path == "/debug/trace":
+            # the span ring as Chrome-trace JSON (load at chrome://tracing
+            # or ui.perfetto.dev); bounded by the recorder's ring capacity
+            import json
+
+            from . import trace as trace_mod
+
+            body = json.dumps(trace_mod.DEFAULT_RECORDER.chrome_trace()).encode()
+            ctype = "application/json"
+        elif path == "/debug/decisions":
+            # the gang decision flight recorder: per-gang rings of
+            # structured decision records (docs/observability.md).
+            # ?gang=<ns/name> scopes to one gang.
+            from urllib.parse import parse_qs, urlparse
+
+            from . import trace as trace_mod
+
+            q = parse_qs(urlparse(self.path).query)
+            gang = (q.get("gang") or [None])[0]
+            body = trace_mod.DEFAULT_FLIGHT_RECORDER.to_json(gang)
+            ctype = "application/json"
         else:
             self.send_response(404)
             self.end_headers()

@@ -59,6 +59,21 @@ echo "== scan-vs-scoring split (multi-chip honesty) =="
 timeout 900 python benchmarks/scan_split.py > "SCAN_SPLIT_${TAG}.json" 2>/dev/null \
     || { echo "scan split failed"; rm -f "SCAN_SPLIT_${TAG}.json"; fail=1; }
 
+echo "== schedule trace on hardware (wave stats with attribution) =="
+# a traced wavefront run over the wire: the exported Chrome trace ties
+# the hardware wave stats (waves/demotions, device wall-clock, compile
+# cache) to the batches that produced them — the attribution the ROADMAP
+# bench-scan follow-up asks for. Artifact: TRACE_${TAG}.json + the
+# validator's one-line summary.
+if BST_SCAN_WAVE=8 BST_TRACE_DIR=/tmp timeout 900 \
+        python benchmarks/trace_demo.py > "/tmp/TRACE_${TAG}.out" 2>/dev/null \
+        && grep -q '"ok": true' "/tmp/TRACE_${TAG}.out"; then
+    cp /tmp/trace_demo.json "TRACE_${TAG}.json"
+    cat "/tmp/TRACE_${TAG}.out"
+else
+    echo "trace capture failed"; fail=1
+fi
+
 echo "== scale headroom probe =="
 timeout 1200 python benchmarks/scale_probe.py > "SCALE_${TAG}.json" 2>/dev/null \
     || { echo "scale probe failed"; rm -f "SCALE_${TAG}.json"; fail=1; }

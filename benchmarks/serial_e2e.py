@@ -44,6 +44,16 @@ def main() -> int:
         make_sim_node,
     )
 
+    # BST_TRACE=1 turns the span pipeline ON for an overhead A/B: the
+    # acceptance bar is that the default (disabled) run is within noise
+    # of pre-trace numbers — the disabled path is one boolean read per
+    # span site (utils.trace), so any measurable delta is a regression
+    trace_on = os.environ.get("BST_TRACE", "") not in ("", "0")
+    if trace_on:
+        from batch_scheduler_tpu.utils import trace as trace_mod
+
+        trace_mod.configure(enabled=True)
+
     cluster = SimCluster(
         scorer="serial", bind_workers=16, kubelet_start_delay=0.05
     )
@@ -98,6 +108,7 @@ def main() -> int:
                 "unit": "s",
                 "detail": {
                     "bound_all": ok,
+                    "trace_enabled": trace_on,
                     "pods": total,
                     "nodes": NODES,
                     "pods_per_sec": round(pods_per_sec, 1),

@@ -1,0 +1,158 @@
+"""Trace-pipeline demo + schema validator (the ``make trace-demo`` CI
+target).
+
+Runs a short sim with tracing enabled against a real oracle sidecar
+(in-process ``serve_background``, so the wire path — TRACE annotation
+frame out, TRACE_INFO spans back — is exercised end-to-end), with one
+placeable gang and one provably-infeasible gang, then validates:
+
+- the exported Chrome-trace JSON loads and every event carries the
+  Chrome-trace schema fields (name/ph/ts/pid — drift here breaks
+  chrome://tracing and Perfetto silently, hence the CI gate);
+- at least one trace ID appears in BOTH scheduler-side and
+  oracle-server-side spans — the stitched-across-the-wire acceptance
+  of the schedule-trace pipeline (docs/observability.md);
+- ``/debug/decisions`` (served by the metrics endpoint) returns a blame
+  record for at least one placed and one denied gang, as JSON.
+
+Run from the repo root: ``python benchmarks/trace_demo.py`` — one JSON
+summary line; exit 1 on any schema drift. Runs on whatever backend the
+environment resolves (``make trace-demo`` pins CPU; the TPU artifact
+capture runs it on hardware with BST_SCAN_WAVE set so the trace records
+hardware wave stats with attribution). BST_TRACE_DIR overrides where the
+Chrome-trace JSON lands (default: a fresh temp dir).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REQUIRED_EVENT_FIELDS = ("name", "ph", "ts", "dur", "pid", "args")
+
+
+def _fail(msg: str, **detail) -> int:
+    print(json.dumps({"ok": False, "error": msg, **detail}))
+    return 1
+
+
+def main() -> int:
+    from batch_scheduler_tpu.service.client import RemoteScorer, ResilientOracleClient
+    from batch_scheduler_tpu.service.server import serve_background
+    from batch_scheduler_tpu.sim import (
+        SimCluster,
+        make_member_pods,
+        make_sim_group,
+        make_sim_node,
+    )
+    from batch_scheduler_tpu.utils import trace as trace_mod
+    from batch_scheduler_tpu.utils.metrics import serve_metrics
+
+    trace_mod.configure(enabled=True, sample=1.0)
+    trace_mod.DEFAULT_RECORDER.clear()
+    trace_mod.DEFAULT_FLIGHT_RECORDER.clear()
+
+    srv = serve_background()
+    client = ResilientOracleClient(*srv.address, name="trace-demo")
+    scorer = RemoteScorer(client)
+    cluster = SimCluster(scorer=scorer)
+    metrics_srv = serve_metrics(port=0)
+    try:
+        cluster.add_nodes(
+            [make_sim_node(f"n{i}", {"cpu": "8", "pods": "64"}) for i in range(4)]
+        )
+        ok_gang = make_sim_group("traceable", 4)
+        cluster.create_group(ok_gang)
+        # a gang no node can ever fit: its PreFilter denial produces the
+        # "denied" blame record the validator requires
+        denied = make_sim_group("toobig", 2)
+        denied.spec.min_resources = {"cpu": 64000}
+        cluster.create_group(denied)
+        cluster.start()
+        cluster.create_pods(make_member_pods("traceable", 4, {"cpu": "1"}))
+        cluster.create_pods(make_member_pods("toobig", 2, {"cpu": "64"}))
+        if not cluster.wait_for_bound("traceable", 4, timeout=60.0):
+            return _fail("placeable gang never bound", stats=cluster.scheduler.stats)
+        cluster.wait_for(
+            lambda: any(
+                r["verdict"] == "denied"
+                for r in cluster.decisions("toobig").get("default/toobig", [])
+            ),
+            timeout=30.0,
+        )
+
+        # -- /debug/decisions over HTTP ---------------------------------
+        port = metrics_srv.server_address[1]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/decisions", timeout=5
+        ) as r:
+            if "application/json" not in r.headers.get("Content-Type", ""):
+                return _fail("decisions content-type drift",
+                             content_type=r.headers.get("Content-Type"))
+            decisions = json.loads(r.read().decode())["decisions"]
+        verdicts = {rec["verdict"] for recs in decisions.values() for rec in recs}
+        if "placed" not in verdicts or "denied" not in verdicts:
+            return _fail("flight recorder missing placed/denied records",
+                         verdicts=sorted(verdicts))
+
+        # -- exported Chrome trace --------------------------------------
+        trace_dir = os.environ.get("BST_TRACE_DIR") or tempfile.mkdtemp(
+            prefix="bst-trace-"
+        )
+        path = os.path.join(trace_dir, "trace_demo.json")
+        trace_mod.DEFAULT_RECORDER.export(path)
+        with open(path) as f:
+            doc = json.load(f)
+        events = doc.get("traceEvents")
+        if not isinstance(events, list) or not events:
+            return _fail("trace JSON has no traceEvents")
+        for e in events:
+            # metadata rows ("M": process names) carry no timestamps;
+            # every span row ("X") must have the full complete-event shape
+            required = (
+                REQUIRED_EVENT_FIELDS
+                if e.get("ph") == "X"
+                else ("name", "ph", "pid")
+            )
+            missing = [k for k in required if k not in e]
+            if missing:
+                return _fail("trace event schema drift", missing=missing, event=e)
+
+        # stitched: one trace ID present on both sides of the wire
+        by_side = {}
+        for e in events:
+            tid = (e.get("args") or {}).get("trace_id")
+            if tid:
+                by_side.setdefault(tid, set()).add(e["pid"])
+        stitched = [
+            tid for tid, pids in by_side.items()
+            if "scheduler" in pids and "oracle-server" in pids
+        ]
+        if not stitched:
+            return _fail(
+                "no trace ID spans both scheduler and oracle-server",
+                sides={t: sorted(p) for t, p in list(by_side.items())[:5]},
+            )
+
+        print(json.dumps({
+            "ok": True,
+            "trace_path": path,
+            "spans": len(events),
+            "stitched_traces": len(stitched),
+            "verdicts": sorted(verdicts),
+        }))
+        return 0
+    finally:
+        metrics_srv.shutdown()
+        cluster.stop()
+        scorer.close()
+        srv.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

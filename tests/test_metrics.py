@@ -100,6 +100,125 @@ def test_histogram_quantile_and_snapshot_window():
     assert total_n == 200 and abs(total_sum - (100 * 0.05 + 100 * 5.0)) < 1e-6
 
 
+def test_histogram_quantile_window_edge_cases():
+    """quantile(since=...) windowing: empty window, single-bucket window,
+    and a ``since`` snapshot NEWER than the series (counter reuse after a
+    registry swap) must all answer 0.0, never negative/garbage."""
+    reg = Registry()
+    h = reg.histogram("w_seconds", "h", buckets=(0.01, 0.1, 1.0))
+    # empty series, no window
+    assert h.quantile(0.5) == 0.0
+    h.observe(0.05)
+    # empty window: snapshot taken after the only observation
+    snap = h.snapshot()
+    assert h.quantile(0.5, since=snap) == 0.0
+    # single-bucket window: all new observations in one bucket
+    for _ in range(10):
+        h.observe(0.5)
+    q = h.quantile(0.5, since=snap)
+    assert 0.1 < q <= 1.0
+    # regression after counter reuse: a "since" snapshot with HIGHER
+    # counts than the live series (the old registry's counters outlived a
+    # swap) yields a negative window total — must clamp to 0.0
+    h2 = reg.histogram("w2_seconds", "h", buckets=(0.01, 0.1, 1.0))
+    h2.observe(0.05)
+    stale_since = ([5, 5, 5], 99.0, 5)
+    assert h2.quantile(0.5, since=stale_since) == 0.0
+    # labels isolate windows
+    h3 = reg.histogram("w3_seconds", "h", buckets=(0.01, 0.1, 1.0))
+    h3.observe(0.05, op="a")
+    snap_a = h3.snapshot(op="a")
+    h3.observe(0.5, op="b")
+    assert h3.quantile(0.5, since=snap_a, op="a") == 0.0
+    assert h3.quantile(0.5, op="b") > 0.1
+
+
+def test_long_op_buckets_cover_compile_times():
+    """The compile/long-op preset must not saturate at 10s (XLA compiles
+    and cold TPU batches are 20-40s): a 35s observation lands in a finite
+    bucket and the quantile resolves above 10s."""
+    from batch_scheduler_tpu.utils.metrics import LONG_OP_BUCKETS
+
+    assert max(LONG_OP_BUCKETS) > 40.0
+    reg = Registry()
+    h = reg.histogram("c_seconds", "h", buckets=LONG_OP_BUCKETS)
+    h.observe(35.0)
+    assert 20.0 < h.quantile(0.5) <= 40.0
+    # the default preset would have capped this at its 10s ceiling
+    d = reg.histogram("d_seconds", "h")
+    d.observe(35.0)
+    assert d.quantile(0.5) == 10.0
+
+
+def test_debug_trace_and_decisions_endpoints():
+    """/debug/trace serves the span ring as Chrome-trace JSON and
+    /debug/decisions serves the flight recorder — JSON content type,
+    bounded size, and safe under concurrent writes."""
+    import json
+    import threading
+
+    from batch_scheduler_tpu.utils import trace as trace_mod
+
+    trace_mod.DEFAULT_RECORDER.clear()
+    trace_mod.DEFAULT_FLIGHT_RECORDER.clear()
+    trace_mod.configure(enabled=True)
+    try:
+        with trace_mod.start_trace("cycle"):
+            with trace_mod.span("select_node"):
+                pass
+        trace_mod.DEFAULT_FLIGHT_RECORDER.record(
+            "default/g0", phase="cycle", verdict="denied", reason="no fit"
+        )
+        server = serve_metrics(Registry(), port=0)
+        stop = threading.Event()
+
+        def hammer():
+            i = 0
+            while not stop.is_set():
+                trace_mod.DEFAULT_FLIGHT_RECORDER.record(
+                    f"default/h{i % 50}", phase="cycle", verdict="placed"
+                )
+                with trace_mod.start_trace("cycle"):
+                    pass
+                i += 1
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        try:
+            port = server.server_address[1]
+            for _ in range(5):  # scrape while writes hammer
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/trace", timeout=5
+                ) as r:
+                    assert "application/json" in r.headers["Content-Type"]
+                    doc = json.loads(r.read().decode())
+                events = doc["traceEvents"]
+                assert len(events) <= trace_mod.DEFAULT_RECORDER._events.maxlen + 10
+                assert any(e.get("name") == "select_node" for e in events)
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/decisions", timeout=5
+                ) as r:
+                    assert "application/json" in r.headers["Content-Type"]
+                    decisions = json.loads(r.read().decode())["decisions"]
+                assert decisions["default/g0"][0]["verdict"] == "denied"
+                assert decisions["default/g0"][0]["reason"] == "no fit"
+            # ?gang= scoping
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/decisions?gang=default/g0",
+                timeout=5,
+            ) as r:
+                scoped = json.loads(r.read().decode())["decisions"]
+            assert set(scoped) == {"default/g0"}
+        finally:
+            stop.set()
+            t.join(timeout=5)
+            server.shutdown()
+    finally:
+        trace_mod.configure(enabled=False)
+        trace_mod.DEFAULT_RECORDER.clear()
+        trace_mod.DEFAULT_FLIGHT_RECORDER.clear()
+
+
 def test_cli_metrics_port_flag():
     """--metrics-port 0 on sim binds an ephemeral /metrics endpoint."""
     import argparse

@@ -1,0 +1,330 @@
+"""The schedule-trace pipeline (utils.trace + the TRACE/TRACE_INFO wire
+frames): span nesting and context propagation, ring bounds, Chrome-trace
+export schema, the flight recorder, and the client+server stitch over the
+real sidecar wire."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from batch_scheduler_tpu.service import (
+    OracleClient,
+    protocol as proto,
+    serve_background,
+)
+from batch_scheduler_tpu.utils import trace as trace_mod
+from batch_scheduler_tpu.utils.trace import FlightRecorder, TraceRecorder
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace_state():
+    trace_mod.DEFAULT_RECORDER.clear()
+    trace_mod.DEFAULT_FLIGHT_RECORDER.clear()
+    yield
+    trace_mod.configure(enabled=False)
+    trace_mod.DEFAULT_RECORDER.clear()
+    trace_mod.DEFAULT_FLIGHT_RECORDER.clear()
+
+
+def test_disabled_is_noop():
+    trace_mod.configure(enabled=False)
+    s = trace_mod.start_trace("root")
+    assert s is trace_mod._NULL_SPAN
+    with s:
+        assert trace_mod.current_context() is None
+        assert trace_mod.span("child") is trace_mod._NULL_SPAN
+    assert trace_mod.DEFAULT_RECORDER.snapshot() == []
+
+
+def test_span_nesting_and_context():
+    trace_mod.configure(enabled=True)
+    with trace_mod.start_trace("root", pod="p0") as root:
+        tid, sid = trace_mod.current_context()
+        assert tid == root.trace_id and sid == root.span_id
+        with trace_mod.span("child") as child:
+            assert child.trace_id == tid
+            assert child.parent_id == root.span_id
+            child.set(extra=7)
+        # child popped: context back to the root span
+        assert trace_mod.current_context() == (tid, root.span_id)
+    assert trace_mod.current_context() is None
+    events = trace_mod.DEFAULT_RECORDER.snapshot()
+    assert [e["name"] for e in events] == ["child", "root"]  # close order
+    child_ev, root_ev = events
+    assert child_ev["args"]["parent_id"] == root_ev["args"]["span_id"]
+    assert child_ev["args"]["trace_id"] == root_ev["args"]["trace_id"]
+    assert child_ev["args"]["extra"] == 7
+    assert root_ev["args"]["pod"] == "p0"
+
+
+def test_child_span_without_root_records_nothing():
+    trace_mod.configure(enabled=True)
+    with trace_mod.span("orphan"):
+        pass
+    assert trace_mod.DEFAULT_RECORDER.snapshot() == []
+
+
+def test_sampling_keeps_fraction():
+    trace_mod.configure(enabled=True, sample=0.25)
+    kept = 0
+    for _ in range(100):
+        with trace_mod.start_trace("r") as s:
+            if s is not trace_mod._NULL_SPAN:
+                kept += 1
+    assert kept == 25
+    trace_mod.configure(enabled=True, sample=0.0)
+    assert trace_mod.start_trace("r") is trace_mod._NULL_SPAN
+
+
+def test_recorder_ring_bounded_and_concurrent():
+    rec = TraceRecorder(capacity=64)
+
+    def writer(i):
+        for j in range(100):
+            rec.add({"name": f"w{i}-{j}", "ph": "X", "ts": 0, "pid": "p"})
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events = rec.snapshot()
+    assert len(events) == 64  # bounded, oldest dropped
+    assert rec.dropped == 8 * 100 - 64
+
+
+def test_chrome_trace_export_schema(tmp_path):
+    trace_mod.configure(enabled=True)
+    with trace_mod.start_trace("root"):
+        with trace_mod.span("child"):
+            pass
+    path = trace_mod.DEFAULT_RECORDER.export(str(tmp_path / "t.json"))
+    doc = json.loads(open(path).read())
+    events = doc["traceEvents"]
+    assert doc["displayTimeUnit"] == "ms"
+    # process-name metadata rows precede the spans
+    meta = [e for e in events if e["ph"] == "M"]
+    spans = [e for e in events if e["ph"] == "X"]
+    assert meta and spans
+    for e in spans:
+        for field in ("name", "cat", "ph", "ts", "dur", "pid", "tid", "args"):
+            assert field in e, (field, e)
+
+
+def test_record_remote_spans_stitch_and_malformed():
+    trace_mod.configure(enabled=True)
+    trace_mod.record_remote_spans(
+        [
+            {"name": "oracle.device_batch", "ts": 123.0, "dur": 5.0,
+             "args": {"trace_id": "a" * 16}},
+            {"no_name": True},  # malformed: skipped, never raises
+            "not-a-dict",
+        ],
+        pid="oracle-server",
+    )
+    events = trace_mod.DEFAULT_RECORDER.snapshot()
+    assert len(events) == 1
+    assert events[0]["pid"] == "oracle-server"
+    assert events[0]["args"]["trace_id"] == "a" * 16
+
+
+def test_flight_recorder_rings_and_lru():
+    fr = FlightRecorder(per_gang=2, max_gangs=3)
+    for i in range(5):
+        fr.record(f"g{i}", phase="cycle", verdict="denied", reason="r")
+    snap = fr.snapshot()
+    assert set(snap) == {"g2", "g3", "g4"}  # LRU-bounded on gangs
+    assert fr.dropped_gangs == 2
+    for _ in range(5):
+        fr.record("g4", phase="permit", verdict="placed")
+    assert len(fr.snapshot("g4")["g4"]) == 2  # per-gang ring bounded
+    assert fr.last("g4")["verdict"] == "placed"
+    doc = json.loads(fr.to_json().decode())
+    assert "decisions" in doc and doc["dropped_gangs"] == 2
+
+
+def test_flight_recorder_stamps_trace_id():
+    trace_mod.configure(enabled=True)
+    fr = FlightRecorder()
+    with trace_mod.start_trace("root") as s:
+        fr.record("default/g", phase="cycle", verdict="placed")
+    assert fr.last("default/g")["trace_id"] == s.trace_id
+
+
+def test_trace_frame_roundtrip():
+    tid = trace_mod.new_trace_id()
+    payload = proto.pack_trace(tid, "abcd1234")
+    assert proto.unpack_trace(payload) == (tid, "abcd1234")
+    with pytest.raises(ValueError):
+        proto.pack_trace("short")
+    info = proto.pack_trace_info(tid, [{"name": "s", "ts": 1, "dur": 2}],
+                                 {"device_seconds": 0.5})
+    back = proto.unpack_trace_info(info)
+    assert back["trace_id"] == tid and back["telemetry"]["device_seconds"] == 0.5
+    assert proto.unpack_trace_info(b"\xff not json") == {}
+
+
+def _request(n=4, g=2, r=5, members=3):
+    alloc = np.zeros((n, r), np.int32)
+    alloc[:, 0] = 8000
+    alloc[:, 3] = 20
+    requested = np.zeros((n, r), np.int32)
+    group_req = np.zeros((g, r), np.int32)
+    group_req[:, 0] = 1000
+    group_req[:, 3] = 1
+    return proto.ScheduleRequest(
+        alloc=alloc,
+        requested=requested,
+        group_req=group_req,
+        remaining=np.full(g, members, np.int32),
+        fit_mask=np.ones((1, n), bool),
+        group_valid=np.ones(g, bool),
+        order=np.arange(g, dtype=np.int32),
+        min_member=np.full(g, members, np.int32),
+        scheduled=np.zeros(g, np.int32),
+        matched=np.zeros(g, np.int32),
+        ineligible=np.zeros(g, bool),
+        creation_rank=np.arange(g, dtype=np.int32),
+    )
+
+
+def test_wire_stitch_over_real_sidecar():
+    """A traced schedule request stitches: the server's spans come back in
+    the TRACE_INFO frame under the client's trace ID, the device telemetry
+    lands on the client, and an untraced client sees byte-identical
+    behavior (no TRACE_INFO ever sent)."""
+    srv = serve_background()
+    try:
+        host, port = srv.address
+        # untraced first: pre-trace behavior intact
+        trace_mod.configure(enabled=False)
+        plain = OracleClient(host, port)
+        resp = plain.schedule(_request())
+        assert resp.placed.all()
+        assert plain.last_telemetry is None
+        plain.close()
+
+        trace_mod.configure(enabled=True)
+        client = OracleClient(host, port)
+        with trace_mod.start_trace("schedule_cycle") as root:
+            resp = client.schedule(_request())
+            assert resp.placed.all()
+        tele = client.last_telemetry
+        assert tele is not None
+        assert tele["n"] == 4 and tele["g"] == 2
+        assert "device_seconds" in tele and "mask_mode" in tele
+        server_spans = [
+            e for e in trace_mod.DEFAULT_RECORDER.snapshot()
+            if e["pid"] == "oracle-server"
+        ]
+        assert server_spans, "no server spans stitched into the local ring"
+        assert {e["args"]["trace_id"] for e in server_spans} == {root.trace_id}
+        names = {e["name"] for e in server_spans}
+        assert "oracle.device_batch" in names and "oracle.schedule" in names
+        # rows still work after the trace exchange (stream not desynced)
+        row = client.row("capacity", 0, resp.batch_seq)
+        assert row.shape[0] >= 4
+        # an untraced (sampled-out) batch must NOT inherit the previous
+        # traced batch's telemetry — last_telemetry is per-request
+        client.schedule(_request())
+        assert client.last_telemetry is None
+        client.close()
+    finally:
+        srv.shutdown()
+
+
+def test_batch_flight_record_nests_peer_telemetry():
+    """The per-batch flight record nests the telemetry dict rather than
+    splatting it: a version-skewed sidecar shipping a telemetry key that
+    collides with record()'s own parameters (phase/verdict/batch/...)
+    must not TypeError the refresh path into a cycle error."""
+    from batch_scheduler_tpu.core.oracle_scorer import OracleScorer
+
+    class _HostileScorer(OracleScorer):
+        def _execute(self, snap):
+            import numpy as np
+
+            g = len(snap.group_names)
+            host = {
+                "gang_feasible": np.zeros(g, bool),
+                "placed": np.zeros(g, bool),
+                "progress": np.zeros(g, np.int32),
+                "best": 0,
+                "best_exists": False,
+                "assignment_nodes": np.zeros((g, 1), np.int32),
+                "assignment_counts": np.zeros((g, 1), np.int32),
+                # reserved-name collisions straight off the wire
+                "telemetry": {"phase": "evil", "verdict": "evil",
+                              "batch": -1, "gang": "x", "reason": "x"},
+            }
+            return host, lambda kind, gi: np.zeros(1, np.int32)
+
+    from helpers import FakeCluster, make_node  # noqa: F401
+    from batch_scheduler_tpu.cache import PGStatusCache
+
+    scorer = _HostileScorer()
+    scorer.refresh(FakeCluster([make_node("n0", {"cpu": "8"})]), PGStatusCache())
+    rec = trace_mod.DEFAULT_FLIGHT_RECORDER.last("_batch")
+    assert rec["phase"] == "batch" and rec["verdict"] == "info"
+    assert rec["telemetry"]["phase"] == "evil"  # nested, not splatted
+
+
+def test_in_process_batch_telemetry_and_wave_metrics():
+    """collect_batch attaches device telemetry to the host result and the
+    wavefront stats flow to Prometheus from the SERVING path (not just
+    benchmarks/scan_split.py)."""
+    import os
+
+    from batch_scheduler_tpu.ops.oracle import execute_batch_host
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot, GroupDemand
+    from batch_scheduler_tpu.sim.scenarios import make_sim_node
+    from batch_scheduler_tpu.utils.metrics import DEFAULT_REGISTRY
+
+    nodes = [
+        make_sim_node(f"n{i}", {"cpu": "8", "memory": "32Gi", "pods": "110"})
+        for i in range(4)
+    ]
+    groups = [
+        GroupDemand(f"default/g{i}", 2, member_request={"cpu": 1000})
+        for i in range(6)
+    ]
+    snap = ClusterSnapshot(nodes, {}, groups)
+
+    host, _ = execute_batch_host(snap.device_args(), snap.progress_args())
+    tele = host["telemetry"]
+    assert tele["wave_width"] == 0 and tele["n_bucket"] >= 4
+
+    old = os.environ.get("BST_SCAN_WAVE")
+    os.environ["BST_SCAN_WAVE"] = "4"
+    try:
+        demote_before = DEFAULT_REGISTRY.counter(
+            "bst_scan_wave_demotions_total"
+        ).value()
+        host, _ = execute_batch_host(snap.device_args(), snap.progress_args())
+        tele = host["telemetry"]
+        assert tele["wave_width"] == 4
+        assert tele["waves_per_batch"] >= 1
+        assert tele["wave_demotions"] >= 0
+        # the serving-path series moved
+        assert DEFAULT_REGISTRY.histogram("bst_scan_waves_per_batch").count() > 0
+        assert (
+            DEFAULT_REGISTRY.counter("bst_scan_wave_demotions_total").value()
+            >= demote_before
+        )
+        # wavefront result identical to the serial scan (bit-identical by
+        # construction — re-assert through the telemetry-carrying path)
+        os.environ["BST_SCAN_WAVE"] = "0"
+        host_serial, _ = execute_batch_host(
+            snap.device_args(), snap.progress_args()
+        )
+        np.testing.assert_array_equal(host["placed"], host_serial["placed"])
+        np.testing.assert_array_equal(
+            host["assignment_counts"], host_serial["assignment_counts"]
+        )
+    finally:
+        if old is None:
+            os.environ.pop("BST_SCAN_WAVE", None)
+        else:
+            os.environ["BST_SCAN_WAVE"] = old
