@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all build vet test test-cpu bench bench-scan native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo
+.PHONY: all build vet test test-cpu bench bench-scan bench-pipeline native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo
 
 all: vet native test
 
@@ -35,6 +35,14 @@ bench:
 # scan-fraction trajectory per round; BST_SCAN_WAVE overrides the width
 bench-scan:
 	$(PY) benchmarks/scan_split.py
+
+# overlapped-batch pipeline CI gate (CPU): window-2 pipelined vs steady
+# (fails if pipelined exceeds steady by >5% — the BENCH_r05 regression),
+# delta snapshot packing >= 2x + bit-identical, dispatch-ahead plan
+# identity under mid-flight invalidation, compile-warmer hit on a bucket
+# transition (docs/pipelining.md)
+bench-pipeline:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/pipeline_gate.py
 
 # BASELINE.json measurement ladder, configs 1-6 (asserts regressions)
 ladder:

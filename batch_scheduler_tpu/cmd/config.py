@@ -87,6 +87,8 @@ class SchedulerConfiguration:
             oracle_background_refresh=_require_bool(
                 args, "oracle_background_refresh"
             ),
+            oracle_dispatch_ahead=_require_bool(args, "oracle_dispatch_ahead"),
+            oracle_compile_warmer=_require_bool(args, "oracle_compile_warmer"),
         )
         return cls(
             plugin_config=plugin_config,

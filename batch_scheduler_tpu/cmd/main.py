@@ -139,6 +139,25 @@ def build_parser() -> argparse.ArgumentParser:
              "reading the stale (known-complete) batch — takes the device "
              "round-trip off the scheduling critical path",
     )
+    sim.add_argument(
+        "--dispatch-ahead",
+        action="store_true",
+        help="speculatively pack + dispatch batch N+1 while the control "
+             "plane works against batch N; a later refresh publishes it "
+             "without a blocking device round-trip iff nothing changed "
+             "since it packed (bit-identical plans either way — "
+             "docs/pipelining.md). With --oracle-addr the client gets an "
+             "in-flight window of 2 connections",
+    )
+    sim.add_argument(
+        "--compile-warmer",
+        action="store_true",
+        help="precompile the adjacent (G, N) bucket shapes around the "
+             "live working set on a daemon thread so a bucket transition "
+             "never pays the cold XLA compile on the serving path "
+             "(in-process oracle; for --oracle-addr pass --compile-warmer "
+             "to `serve` instead)",
+    )
     _add_metrics_flag(sim)
     _add_trace_flags(sim)
     sim.add_argument("--settle", type=float, default=3.0,
@@ -154,6 +173,14 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="jit-compile the smallest bucket shape before accepting traffic "
              "(first TPU compile is ~20-40s; warmed shapes answer instantly)",
+    )
+    serve.add_argument(
+        "--compile-warmer",
+        action="store_true",
+        help="keep a background thread precompiling the adjacent (G, N) "
+             "bucket shapes around live traffic so bucket transitions hit "
+             "warm executables (hit/miss counters in /metrics and "
+             "TRACE_INFO telemetry — docs/pipelining.md)",
     )
     _add_metrics_flag(serve)
     _add_trace_flags(serve)
@@ -187,6 +214,12 @@ def cmd_check_config(args) -> int:
                 ),
                 "oracle_background_refresh": (
                     cfg.plugin_config.oracle_background_refresh
+                ),
+                "oracle_dispatch_ahead": (
+                    cfg.plugin_config.oracle_dispatch_ahead
+                ),
+                "oracle_compile_warmer": (
+                    cfg.plugin_config.oracle_compile_warmer
                 ),
             }
         )
@@ -340,7 +373,9 @@ def cmd_serve(args) -> int:
     _maybe_configure_trace(args)
     _maybe_serve_metrics(args)
 
-    server = OracleServer(host=args.host, port=args.port)
+    server = OracleServer(
+        host=args.host, port=args.port, compile_warmer=args.compile_warmer
+    )
     host, port = server.address
     print(f"oracle sidecar listening on {host}:{port}", flush=True)
     try:
@@ -385,16 +420,26 @@ def cmd_sim(args) -> int:
         args.oracle_background_refresh
         or cfg.plugin_config.oracle_background_refresh
     )
+    want_dispatch_ahead = (
+        args.dispatch_ahead or cfg.plugin_config.oracle_dispatch_ahead
+    )
+    want_warmer = (
+        args.compile_warmer or cfg.plugin_config.oracle_compile_warmer
+    )
     if args.oracle_addr:
         from ..service.client import RemoteScorer, ResilientOracleClient
 
         host, _, port = args.oracle_addr.rpartition(":")
         # resilient transport: reconnect + retry + breaker + deadline —
         # connections are lazy, so a sidecar that is still coming up (or
-        # briefly gone) no longer kills the whole run at construction
+        # briefly gone) no longer kills the whole run at construction.
+        # Dispatch-ahead widens the in-flight window to 2 connection
+        # slots so the speculative batch never contends with row reads
+        # on the served batch (docs/pipelining.md).
         oracle_client = ResilientOracleClient(
             host or "127.0.0.1", int(port),
             deadline_ms=args.oracle_deadline_ms, name="fg",
+            window=2 if want_dispatch_ahead else 1,
         )
         # background refresh needs a second connection so row reads on the
         # current batch never contend with the in-flight background batch
@@ -410,6 +455,13 @@ def cmd_sim(args) -> int:
             fallback=args.oracle_fallback,
         )
         remote_scorer = scorer
+        if want_warmer:
+            print(
+                "note: --compile-warmer warms the LOCAL jit cache; with "
+                "--oracle-addr batches compile on the sidecar — start "
+                "`serve --compile-warmer` there instead",
+                file=sys.stderr,
+            )
 
     cluster = SimCluster(
         scorer=scorer,
@@ -417,6 +469,8 @@ def cmd_sim(args) -> int:
         enabled_points=cfg.enabled_points,
         min_batch_interval=cfg.plugin_config.min_batch_interval_seconds,
         oracle_background_refresh=want_bg_refresh,
+        oracle_dispatch_ahead=want_dispatch_ahead,
+        oracle_compile_warmer=want_warmer and oracle_client is None,
     )
 
     nodes: List[Node] = []

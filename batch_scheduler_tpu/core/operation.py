@@ -88,6 +88,8 @@ class ScheduleOperation:
         clock: Callable[[], float] = time.monotonic,
         min_batch_interval: float = 0.0,
         background_refresh: bool = False,
+        dispatch_ahead: bool = False,
+        compile_warmer: bool = False,
     ):
         self.status_cache = status_cache
         self.cluster = cluster
@@ -105,6 +107,8 @@ class ScheduleOperation:
                 OracleScorer(
                     min_batch_interval=min_batch_interval,
                     background_refresh=background_refresh,
+                    dispatch_ahead=dispatch_ahead,
+                    compile_warmer=compile_warmer,
                 )
                 if scorer == "oracle"
                 else None
@@ -133,6 +137,20 @@ class ScheduleOperation:
                         "(single-connection transports would stall row "
                         "reads behind the background batch); running with "
                         "blocking refresh"
+                    )
+            if dispatch_ahead:
+                if getattr(scorer, "supports_dispatch_ahead", True):
+                    scorer.dispatch_ahead = True
+                else:
+                    import warnings
+
+                    warnings.warn(
+                        "dispatch_ahead requested but "
+                        f"{type(scorer).__name__} does not support it "
+                        "(a single-connection transport would stall row "
+                        "reads behind the speculative batch; pass a "
+                        "windowed client or a background_client); running "
+                        "with blocking refresh"
                     )
         self.last_denied_pg = TTLCache(DENY_CACHE_DEFAULT_TTL, DENY_CACHE_JANITOR, clock=clock)
         self.last_permitted_pod = TTLCache(PERMITTED_CACHE_DEFAULT_TTL, DENY_CACHE_JANITOR, clock=clock)

@@ -28,7 +28,7 @@ import numpy as np
 
 from ..cache.pg_cache import PGStatusCache, PodGroupMatchStatus
 from ..ops.oracle import execute_batch_host
-from ..ops.snapshot import ClusterSnapshot, GroupDemand
+from ..ops.snapshot import ClusterSnapshot, DeltaSnapshotPacker, GroupDemand
 from ..utils.errors import StaleBatchError
 from ..utils import trace as trace_mod
 
@@ -158,6 +158,9 @@ class OracleScorer:
     """Caches one batch of oracle results; invalidated by ``mark_dirty``."""
 
     supports_background_refresh = True
+    # In-process batches dispatch-ahead freely; RemoteScorer narrows this
+    # to multi-lane transports (see service.client).
+    supports_dispatch_ahead = True
     # True while the served batch came from a degraded (conservative
     # fallback) path — RemoteScorer flips it; the in-process scorer never
     # degrades. ScheduleOperation reads it to relax the deny-by-default
@@ -169,6 +172,8 @@ class OracleScorer:
         min_batch_interval: float = 0.0,
         scan_mesh=None,
         background_refresh: bool = False,
+        dispatch_ahead: bool = False,
+        compile_warmer: bool = False,
     ):
         # Dirty tracking is a GENERATION pair, not a bool: refresh() clears
         # staleness by recording the generation it observed BEFORE packing
@@ -214,9 +219,41 @@ class OracleScorer:
         # inside existing semantics.
         self.min_batch_interval = min_batch_interval
         self._last_batch_t = 0.0
-        # cached lane schema across batches (see _refresh)
+        # Persistent packed host buffers (ops.snapshot.DeltaSnapshotPacker):
+        # low-churn refreshes rewrite only the node/group rows that changed
+        # instead of re-walking every dict; subsumes the per-batch schema
+        # reuse this class used to do inline (the packer enforces the same
+        # covers/covers_names validity rules and full-repacks on schema
+        # change). self._schema mirrors the packer's for compatibility.
+        self._packer = DeltaSnapshotPacker()
         self._schema = None
-        self._schema_key = None
+        # Dispatch-ahead (docs/pipelining.md): after each published batch,
+        # a daemon thread packs and dispatches the NEXT batch speculatively
+        # so a later ensure_fresh can publish it without a blocking device
+        # round-trip. The existing generation/version dirty-tracking
+        # decides at consume time whether the speculative batch is
+        # servable (nothing changed since it packed -> bit-identical to
+        # the blocking refresh it replaces) or discarded (any mark_dirty
+        # or uncredited version bump mid-flight).
+        self.dispatch_ahead = dispatch_ahead
+        self._spec_lock = threading.Lock()
+        self._spec_thread: Optional[threading.Thread] = None
+        # (snap, host, row_fetcher, gen, version, pack_s, batch_s)
+        self._spec: Optional[tuple] = None
+        self._spec_error: Optional[Exception] = None
+        self.spec_served = 0
+        self.spec_discarded = 0
+        # Compile-ahead bucket warmer (ops.bucketing.CompileWarmer):
+        # precompiles the adjacent (G, N) bucket shapes around the live
+        # working set on a daemon thread, so a bucket transition on the
+        # serving path lands on a warm executable. Local batches only —
+        # RemoteScorer batches compile on the sidecar (the server runs
+        # its own warmer).
+        self._warmer = None
+        if compile_warmer:
+            from ..ops.bucketing import maybe_compile_warmer
+
+            self._warmer = maybe_compile_warmer(scan_mesh)
         # oracle-batch latency telemetry (SURVEY.md §5: schedule-cycle
         # latency is the headline metric; the reference has no equivalent
         # instrumentation, only klog verbosity)
@@ -248,12 +285,15 @@ class OracleScorer:
         with trace_mod.span("oracle.refresh", cat="oracle"):
             self._refresh_traced(cluster, status_cache)
 
-    def _refresh_traced(self, cluster, status_cache: PGStatusCache) -> None:
+    def _pack_current(self, cluster, status_cache: PGStatusCache):
+        """Read cluster state and build one snapshot via the delta packer.
+        Returns (snap, dirty_gen, version_base, pack_seconds).
+
+        Credits, the dirty generation, and the version base are all taken
+        BEFORE reading state: any change landing mid-pack leaves version()
+        ahead of the base (or the generation ahead of the one recorded at
+        completion) and re-batches conservatively."""
         t0 = time.perf_counter()
-        # Credits, the dirty generation, and the version base are all taken
-        # BEFORE reading state: any change landing mid-refresh leaves
-        # version() ahead of the base (or the generation ahead of the one
-        # recorded at completion) and re-batches conservatively.
         dirty_gen = self._dirty_gen
         version_fn = getattr(cluster, "version", None)
         version_base = version_fn() if callable(version_fn) else None
@@ -265,41 +305,47 @@ class OracleScorer:
         node_req = {
             n.metadata.name: cluster.node_requested(n.metadata.name) for n in nodes
         }
-        # Schema reuse across batches: re-collecting lane shifts scans every
-        # node dict (~1/3 of pack time at 5k nodes). The cached schema stays
-        # valid while the node set is identical (name+resource_version key;
-        # any node update bumps its version), every group demand packs
-        # exactly (covers), and every requested-resource NAME is known
-        # (names-only check: a node's requested values are bounded by its
-        # allocatable through the scheduler's fit accounting, so
-        # alloc-derived shifts cover their magnitudes — but a lingering
-        # name from an evicted workload must still force a re-collect).
-        schema_key = tuple(
-            (n.metadata.name, n.metadata.resource_version) for n in nodes
-        )
-        schema = None
-        if (
-            self._schema is not None
-            and schema_key == self._schema_key
-            and self._schema.covers([g.member_request for g in demands])
-            and self._schema.covers_names(node_req.values())
-        ):
-            schema = self._schema
         with trace_mod.span("oracle.snapshot_pack", cat="oracle"):
-            snap = ClusterSnapshot(nodes, node_req, demands, schema=schema)
-        self._schema, self._schema_key = snap.schema, schema_key
-        t_pack = time.perf_counter()
+            snap = self._packer.pack(nodes, node_req, demands)
+        self._schema = self._packer.schema
+        return snap, dirty_gen, version_base, time.perf_counter() - t0
+
+    def _refresh_traced(self, cluster, status_cache: PGStatusCache) -> None:
+        snap, dirty_gen, version_base, pack_s = self._pack_current(
+            cluster, status_cache
+        )
+        t1 = time.perf_counter()
         with trace_mod.span(
             "oracle.batch", cat="oracle",
             groups=len(snap.group_names), nodes=len(snap.node_names),
         ):
             host, row_fetcher = self._execute(snap)
-        t_batch = time.perf_counter()
+        batch_s = time.perf_counter() - t1
+        self._publish(
+            snap, host, row_fetcher, dirty_gen, version_base, pack_s, batch_s
+        )
+
+    def _publish(
+        self, snap, host, row_fetcher, dirty_gen, version_base,
+        pack_s: float, batch_s: float, speculative: bool = False,
+    ) -> None:
+        """Install one executed batch as the served state — shared by the
+        blocking refresh and the dispatch-ahead consume path."""
         max_group = (
             snap.group_names[int(host["best"])]
             if bool(host["best_exists"]) and int(host["best"]) < len(snap.group_names)
             else ""
         )
+        # Degradedness is a property of the SERVED batch, applied only at
+        # publication: a speculative batch that degraded (or recovered)
+        # mid-flight must not change PreFilter semantics while the healthy
+        # (or fallback) batch is still the one being served — and a
+        # discarded speculative batch must not change them at all.
+        degraded_marker = (
+            host.pop("_degraded", None) if isinstance(host, dict) else None
+        )
+        if degraded_marker is not None:
+            self._set_degraded(bool(degraded_marker))
         self._state = _BatchState(snap, host, max_group, row_fetcher)
         self._cluster_version = version_base
         self._clean_gen = dirty_gen  # compare-and-clear: later marks survive
@@ -319,8 +365,8 @@ class OracleScorer:
             self._version_credits = 0
         self._last_batch_t = time.monotonic()
         with self._stats_lock:
-            self.pack_seconds.append(t_pack - t0)
-            self.batch_seconds.append(t_batch - t_pack)
+            self.pack_seconds.append(pack_s)
+            self.batch_seconds.append(batch_s)
             del self.pack_seconds[:-1000], self.batch_seconds[:-1000]
         from ..utils.metrics import DEFAULT_REGISTRY, LONG_OP_BUCKETS
 
@@ -333,10 +379,10 @@ class OracleScorer:
             "bst_oracle_batch_seconds",
             "Device time per fused oracle batch (compiles included)",
             buckets=LONG_OP_BUCKETS,
-        ).observe(t_batch - t_pack)
+        ).observe(batch_s)
         DEFAULT_REGISTRY.histogram(
             "bst_oracle_pack_seconds", "Host snapshot-pack time per batch"
-        ).observe(t_pack - t0)
+        ).observe(pack_s)
         # flight-recorder batch record: the device-side evidence (scan
         # path, wave stats, compile) later gang decisions rest on. The
         # telemetry dict is NESTED, never splatted: on the remote path it
@@ -346,31 +392,59 @@ class OracleScorer:
         # (same contract as record_remote_spans: malformed peer data
         # never breaks the caller).
         telemetry = host.get("telemetry") if isinstance(host, dict) else None
+        if self._warmer is not None:
+            try:
+                # donate matches what _execute dispatched with, so the
+                # warmer warms the SAME jit (donated and non-donated
+                # variants keep separate caches)
+                self._warmer.note_batch(
+                    snap.device_args(), snap.progress_args(), telemetry or {},
+                    donate=self._donate(),
+                )
+            except Exception:  # noqa: BLE001 — warm accounting never fatal
+                pass
         trace_mod.DEFAULT_FLIGHT_RECORDER.record(
             "_batch",
             phase="batch",
             verdict="info",
             batch=self.batches_run,
-            batch_ms=round((t_batch - t_pack) * 1000, 2),
-            pack_ms=round((t_pack - t0) * 1000, 2),
+            batch_ms=round(batch_s * 1000, 2),
+            pack_ms=round(pack_s * 1000, 2),
             groups=len(snap.group_names),
             nodes=len(snap.node_names),
             degraded=bool(self.degraded),
+            speculative=speculative,
             telemetry=telemetry or {},
         )
+
+    def _donate(self) -> bool:
+        """Donate the [N,R] input buffers to the batch (docs/pipelining.md):
+        the scorer always dispatches from host numpy snapshots, so the
+        donated buffer is fresh per batch; gated to the dispatch-ahead
+        pipeline (where the warmer warms the matching donated signature)
+        and to backends where donation buys anything."""
+        from ..ops.oracle import donation_supported
+
+        return self.dispatch_ahead and donation_supported()
 
     def _execute(self, snap: ClusterSnapshot):
         """Run one batch locally on the attached device. Returns the O(G)
         host result dict and a lazy (G,N)-row fetcher. RemoteScorer swaps
         this for the sidecar round-trip."""
         host, device_result = execute_batch_host(
-            snap.device_args(), snap.progress_args(), scan_mesh=self.scan_mesh
+            snap.device_args(), snap.progress_args(),
+            scan_mesh=self.scan_mesh, donate=self._donate(),
         )
 
         def row_fetcher(kind: str, g: int) -> np.ndarray:
             return np.asarray(jax.device_get(device_result[kind][g]))
 
         return host, row_fetcher
+
+    def _set_degraded(self, flag: bool) -> None:
+        """Install the served batch's degradedness (see _publish).
+        RemoteScorer mirrors the flip into its gauge/counter."""
+        self.degraded = flag
 
     def _probe_due(self) -> bool:
         """Whether a degraded batch is worth re-attempting now (overridden
@@ -426,13 +500,28 @@ class OracleScorer:
             if self.background_refresh and self._bg_error is None:
                 self._kick_background_refresh(cluster, status_cache)
                 return
+        published = False
         with self._refresh_lock:
             if self._stale(cluster) or self._group_missing(group):
-                # a background failure is consumed here: this blocking
-                # refresh either succeeds (recovery) or raises into the
-                # caller's cycle (visible failure)
-                self._bg_error = None
-                self.refresh(cluster, status_cache)
+                # dispatch-ahead: a speculative batch packed from the
+                # CURRENT cluster state replaces the blocking refresh
+                # outright (taking the lock above also waited out an
+                # in-flight speculative execution, so its device time
+                # overlapped the caller's host work instead of this
+                # cycle). A stale speculative batch is discarded and the
+                # blocking path runs — bit-identical either way.
+                if self._consume_speculative(cluster, group):
+                    published = True
+                else:
+                    # a background/speculative failure is consumed here:
+                    # this blocking refresh either succeeds (recovery) or
+                    # raises into the caller's cycle (visible failure)
+                    self._bg_error = None
+                    self._spec_error = None
+                    self.refresh(cluster, status_cache)
+                    published = True
+        if published and self.dispatch_ahead:
+            self._kick_speculative(cluster, status_cache)
 
     def drain_background(self, timeout: float = 60.0) -> bool:
         """Wait out any in-flight background batch. MUST be called before
@@ -450,18 +539,115 @@ class OracleScorer:
         with self._bg_lock:
             self.background_refresh = False  # no new kicks after drain
             t = self._bg_thread
-        if t is not None and t.is_alive():
-            t.join(timeout)
-            if t.is_alive():
-                import sys
+        with self._spec_lock:
+            self.dispatch_ahead = False  # no new speculative kicks either
+            spec_t = self._spec_thread
+        ok = True
+        for name, th in (("background", t), ("dispatch-ahead", spec_t)):
+            if th is not None and th.is_alive():
+                th.join(timeout)
+                if th.is_alive():
+                    import sys
 
-                print(
-                    "drain_background: background batch still in flight "
-                    f"after {timeout}s; teardown would race an XLA call",
-                    file=sys.stderr,
-                )
-                return False
+                    print(
+                        f"drain_background: {name} batch still in flight "
+                        f"after {timeout}s; teardown would race an XLA call",
+                        file=sys.stderr,
+                    )
+                    ok = False
+        if self._warmer is not None:
+            ok = self._warmer.stop(timeout) and ok
+        return ok
+
+    # -- dispatch-ahead (docs/pipelining.md) --------------------------------
+
+    def _consume_speculative(self, cluster, group: Optional[str]) -> bool:
+        """Publish the speculative batch iff NOTHING changed since it was
+        packed — the same generation + raw-version equality the staleness
+        check uses, with no credit forgiveness (a credited bump means an
+        assume the speculative snapshot may predate; serving its plan
+        would risk divergence, so it is discarded). Caller holds
+        ``_refresh_lock``. Returns True when the batch was published."""
+        spec = self._spec
+        if spec is None:
+            return False
+        self._spec = None  # consumed either way
+        snap, host, row_fetcher, gen, version, pack_s, batch_s = spec
+        version_fn = getattr(cluster, "version", None)
+        current_version = version_fn() if callable(version_fn) else None
+        from ..utils.metrics import DEFAULT_REGISTRY
+
+        spec_counter = DEFAULT_REGISTRY.counter(
+            "bst_oracle_spec_batches_total",
+            "Dispatch-ahead speculative batches by outcome (served = "
+            "published without a blocking device round-trip; discarded = "
+            "invalidated by a mid-flight cluster change)",
+        )
+        if (
+            gen != self._dirty_gen
+            or current_version != version
+            or (group is not None and snap.group_index(group) is None)
+        ):
+            self.spec_discarded += 1
+            spec_counter.inc(outcome="discarded")
+            return False
+        self._publish(
+            snap, host, row_fetcher, gen, version, pack_s, batch_s,
+            speculative=True,
+        )
+        self.spec_served += 1
+        spec_counter.inc(outcome="served")
         return True
+
+    def _kick_speculative(self, cluster, status_cache: PGStatusCache) -> None:
+        """Pack + execute the NEXT batch on a daemon thread so a later
+        ensure_fresh can publish it without a blocking round-trip. At most
+        one in flight; a failure parks the mode until the next successful
+        blocking refresh (mirroring ``_bg_error``)."""
+        with self._spec_lock:
+            if not self.dispatch_ahead or self._spec_error is not None:
+                return
+            if self._spec_thread is not None and self._spec_thread.is_alive():
+                return
+
+            def _run() -> None:
+                try:
+                    with self._refresh_lock:
+                        if self._spec is not None:
+                            return  # an unconsumed batch is already banked
+                        snap, gen, version, pack_s = self._pack_current(
+                            cluster, status_cache
+                        )
+                        # invalidated while packing: consume would discard
+                        # it anyway — skip the device round-trip (and the
+                        # _refresh_lock hold) entirely. A change landing
+                        # AFTER this check still discards at consume time;
+                        # under sustained churn dispatch-ahead degrades to
+                        # pack-and-discard, which is why it is opt-in and
+                        # aimed at steady serving (docs/pipelining.md).
+                        version_fn = getattr(cluster, "version", None)
+                        if gen != self._dirty_gen or (
+                            callable(version_fn) and version_fn() != version
+                        ):
+                            return
+                        t1 = time.perf_counter()
+                        with trace_mod.span(
+                            "oracle.spec_batch", cat="oracle",
+                            groups=len(snap.group_names),
+                            nodes=len(snap.node_names),
+                        ):
+                            host, row_fetcher = self._execute(snap)
+                        self._spec = (
+                            snap, host, row_fetcher, gen, version, pack_s,
+                            time.perf_counter() - t1,
+                        )
+                except Exception as e:  # noqa: BLE001 — surfaced via consume
+                    self._spec_error = e
+
+            self._spec_thread = threading.Thread(
+                target=_run, name="oracle-dispatch-ahead", daemon=True
+            )
+            self._spec_thread.start()
 
     def _kick_background_refresh(self, cluster, status_cache: PGStatusCache) -> None:
         with self._bg_lock:
@@ -500,6 +686,18 @@ class OracleScorer:
             out["batch_p50_ms"] = round(float(np.median(batches)) * 1000, 2)
             out["batch_max_ms"] = round(float(max(batches)) * 1000, 2)
             out["pack_p50_ms"] = round(float(np.median(packs)) * 1000, 2)
+        # delta-pack + pipelining evidence (docs/pipelining.md): how much
+        # of the steady state rode the fast paths
+        packer = self._packer
+        if packer.delta_packs or packer.full_repacks:
+            out["delta_packs"] = packer.delta_packs
+            out["full_repacks"] = packer.full_repacks
+            out["rows_rewritten_last"] = packer.last_rows_rewritten
+        if self.dispatch_ahead or self.spec_served or self.spec_discarded:
+            out["spec_served"] = self.spec_served
+            out["spec_discarded"] = self.spec_discarded
+        if self._warmer is not None:
+            out.update(self._warmer.stats())
         return out
 
     def max_group(self) -> str:

@@ -10,6 +10,9 @@ rank combination.
 
 from __future__ import annotations
 
+import threading
+from typing import Optional
+
 import numpy as np
 
 __all__ = [
@@ -18,6 +21,9 @@ __all__ = [
     "pad_to",
     "pad_rows",
     "pad_oracle_batch",
+    "adjacent_bucket_shapes",
+    "CompileWarmer",
+    "maybe_compile_warmer",
 ]
 
 _MIN_BUCKET = 8
@@ -171,3 +177,260 @@ def pad_oracle_batch(
         pad_rows(np.asarray(creation_rank, dtype=np.int32), gb, fill=gb - 1),
     )
     return batch_args, progress_args
+
+
+def adjacent_bucket_shapes(g_bucket: int, n_bucket: int) -> list:
+    """The (G, N) bucket shapes one transition away from the current
+    working set — what the compile warmer precompiles. One dimension moves
+    at a time (a cluster crosses one bucket boundary per transition; the
+    cross product would quadruple the warm cost for shapes two transitions
+    out)."""
+    shapes = []
+    for gb in (g_bucket // 2, g_bucket * 2):
+        if gb >= _MIN_BUCKET:
+            shapes.append((gb, n_bucket))
+    for nb in (n_bucket // 2, n_bucket * 2):
+        if nb >= _MIN_BUCKET:
+            shapes.append((g_bucket, nb))
+    return shapes
+
+
+def _resize_rows(arr: np.ndarray, size: int, fill=0) -> np.ndarray:
+    if arr.shape[0] >= size:
+        return np.ascontiguousarray(arr[:size])
+    return pad_to(arr, size, axis=0, fill=fill)
+
+
+class CompileWarmer:
+    """Background precompiler for the bucket shapes adjacent to the serving
+    working set (docs/pipelining.md, warmer policy).
+
+    A bucket transition on the serving path — the cluster or group count
+    crossing a power-of-two boundary — pays a cold XLA compile (~20-40s on
+    the accelerator; the stall PR 3's 320s histogram ceiling exists to
+    measure). This thread precompiles the adjacent ``(G, N)`` bucket
+    shapes around each shape it is shown, at the process's live wave
+    width, so the transition lands on a warm executable.
+
+    Warm batches are built from the REAL padded prototype (pad/slice of
+    the last served batch's args), so the derived static arguments —
+    pack flag, top-K tier, mask mode — match what serving traffic at that
+    bucket would compile. XLA compilation releases the GIL, so the compile
+    runs concurrently with serving; the tiny dummy execution that seeds
+    the jit cache is negligible on a single device, and serialized under
+    ``run_lock`` when a mesh is live (two concurrent sharded executions
+    interleave collectives — service/server.py's executor rule).
+
+    Hit/miss accounting (``note_batch``): a served batch that compiled a
+    new executable is a warmer **miss**; one whose shape this warmer had
+    precompiled and that hit the jit cache is a **hit**. Batches on
+    long-running steady shapes (cache-hot regardless of the warmer) count
+    as neither.
+    """
+
+    def __init__(self, scan_mesh=None, run_lock: Optional[threading.Lock] = None,
+                 registry=None):
+        import queue
+
+        from ..utils.metrics import DEFAULT_REGISTRY
+
+        self.scan_mesh = scan_mesh
+        self._run_lock = run_lock
+        self._q = queue.SimpleQueue()
+        self._state_lock = threading.Lock()
+        self._warmed: set = set()  # shapes THIS warmer precompiled
+        self._seen: set = set()    # shapes serving traffic already compiled
+        self._failed: set = set()
+        self._last_key = None
+        self._stopped = False
+        reg = registry or DEFAULT_REGISTRY
+        self._hits = reg.counter(
+            "bst_compile_warmer_hits_total",
+            "Serving batches whose bucket shape the compile warmer had "
+            "precompiled (cold compile absorbed off the serving path)",
+        )
+        self._misses = reg.counter(
+            "bst_compile_warmer_misses_total",
+            "Serving batches that built a new executable on the serving "
+            "path (shape not precompiled in time)",
+        )
+        self._warms = reg.counter(
+            "bst_compile_warmer_precompiles_total",
+            "Bucket shapes precompiled by the warmer thread",
+        )
+        self._thread = threading.Thread(
+            target=self._loop, name="compile-warmer", daemon=True
+        )
+        self._thread.start()
+
+    @staticmethod
+    def _key(g_bucket: int, n_bucket: int, lanes: int, mask_rows: int,
+             wave: int, donate: bool) -> tuple:
+        return (g_bucket, n_bucket, lanes, mask_rows > 1, wave, donate)
+
+    def warmed_shapes(self) -> set:
+        with self._state_lock:
+            return set(self._warmed)
+
+    def stats(self) -> dict:
+        with self._state_lock:
+            warmed = len(self._warmed)
+        return {
+            "warmer_hits": int(self._hits.value()),
+            "warmer_misses": int(self._misses.value()),
+            "warmer_shapes": warmed,
+        }
+
+    def note_batch(self, batch_args, progress_args, telemetry: dict,
+                   donate: bool = False) -> None:
+        """Account one served batch against the warm set and (on a shape
+        change) queue its adjacent shapes for precompilation. ``batch_args``
+        must be the HOST-side padded args (pre-sharding)."""
+        g_bucket = int(batch_args[2].shape[0])
+        n_bucket = int(batch_args[0].shape[0])
+        lanes = int(batch_args[0].shape[1])
+        mask_rows = int(batch_args[4].shape[0])
+        wave = int((telemetry or {}).get("wave_width", 0))
+        key = self._key(g_bucket, n_bucket, lanes, mask_rows, wave, donate)
+        with self._state_lock:
+            in_warmed = key in self._warmed
+            is_new = key != self._last_key
+            self._last_key = key
+            # the served shape is compiled now by definition — recorded so
+            # an A->B->A bucket oscillation never re-warms A, but kept out
+            # of _warmed: steady cache-hot batches are not warmer hits
+            self._seen.add(key)
+        if (telemetry or {}).get("compiled"):
+            self._misses.inc()
+        elif in_warmed and is_new:
+            # a bucket TRANSITION landing on a precompiled executable —
+            # the cold compile the warmer absorbed; steady cache-hot
+            # batches at an already-served shape count as neither
+            self._hits.inc()
+        if is_new and not self._stopped:
+            # snapshot the prototype: the caller keeps mutating its arrays
+            proto = (
+                tuple(np.array(a) for a in batch_args),
+                tuple(np.array(a) for a in progress_args),
+                wave,
+                donate,
+            )
+            self._q.put(proto)
+
+    def stop(self, timeout: float = 60.0) -> bool:
+        """Drain the warmer before process teardown (same XLA-daemon-thread
+        rule as OracleScorer.drain_background)."""
+        self._stopped = True
+        self._q.put(None)
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    # -- worker -------------------------------------------------------------
+
+    def _variant(self, batch_args, progress_args, gb: int, nb: int):
+        (alloc, requested, group_req, remaining, fit_mask, group_valid,
+         order) = batch_args
+        min_member, scheduled, matched, ineligible, creation_rank = (
+            progress_args
+        )
+        v_mask = fit_mask
+        if v_mask.shape[0] > 1:
+            v_mask = _resize_rows(v_mask, gb, fill=False)
+        if v_mask.shape[1] != nb:
+            if v_mask.shape[1] >= nb:
+                v_mask = np.ascontiguousarray(v_mask[:, :nb])
+            else:
+                v_mask = pad_to(v_mask, nb, axis=1, fill=False)
+        vbatch = (
+            _resize_rows(alloc, nb),
+            _resize_rows(requested, nb),
+            _resize_rows(group_req, gb),
+            _resize_rows(remaining, gb),
+            v_mask,
+            _resize_rows(group_valid, gb, fill=False),
+            # any permutation compiles the same executable; arange keeps
+            # the variant a valid batch on every resize
+            np.arange(gb, dtype=np.int32),
+        )
+        vprogress = (
+            _resize_rows(min_member, gb),
+            _resize_rows(scheduled, gb),
+            _resize_rows(matched, gb),
+            _resize_rows(ineligible, gb, fill=True),
+            np.arange(gb, dtype=np.int32),
+        )
+        return vbatch, vprogress
+
+    def _loop(self) -> None:
+        from .oracle import collect_batch, dispatch_batch
+
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            batch_args, progress_args, wave, donate = item
+            g_bucket = int(batch_args[2].shape[0])
+            n_bucket = int(batch_args[0].shape[0])
+            lanes = int(batch_args[0].shape[1])
+            mask_rows = int(batch_args[4].shape[0])
+            for gb, nb in adjacent_bucket_shapes(g_bucket, n_bucket):
+                key = self._key(gb, nb, lanes, mask_rows, wave, donate)
+                with self._state_lock:
+                    if (
+                        key in self._warmed
+                        or key in self._seen
+                        or key in self._failed
+                    ):
+                        continue
+                if self._stopped:
+                    return
+                try:
+                    vbatch, vprogress = self._variant(
+                        batch_args, progress_args, gb, nb
+                    )
+                    pending = None
+                    if self._run_lock is not None:
+                        with self._run_lock:
+                            pending = dispatch_batch(
+                                vbatch, vprogress, scan_mesh=self.scan_mesh,
+                                donate=donate,
+                            )
+                            collect_batch(pending)
+                    else:
+                        collect_batch(dispatch_batch(
+                            vbatch, vprogress, scan_mesh=self.scan_mesh,
+                            donate=donate,
+                        ))
+                except Exception as e:  # noqa: BLE001 — warm-only, never fatal
+                    import sys
+
+                    print(
+                        f"compile warmer: shape (G={gb}, N={nb}) failed "
+                        f"({e!r}); not retried",
+                        file=sys.stderr,
+                    )
+                    with self._state_lock:
+                        self._failed.add(key)
+                    continue
+                with self._state_lock:
+                    self._warmed.add(key)
+                self._warms.inc()
+
+
+def maybe_compile_warmer(scan_mesh=None) -> Optional[CompileWarmer]:
+    """A CompileWarmer when warm execution is safe — single device only.
+    On a sharded mesh a warm batch would have to serialize with live
+    batches (the collective-interleaving rule), stalling them behind the
+    warm COMPILE — the exact inversion of the warmer's purpose — so the
+    skip is printed and None returned. THE single eligibility rule,
+    shared by the sidecar server and the in-process scorer."""
+    if scan_mesh is None:
+        return CompileWarmer()
+    import sys
+
+    print(
+        "compile warmer skipped: sharded-mesh warm batches would "
+        "stall live batches behind the warm compile",
+        file=sys.stderr,
+    )
+    return None

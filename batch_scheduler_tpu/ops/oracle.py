@@ -33,6 +33,7 @@ resolves ties deterministically: prefer groups with nothing scheduled yet
 from __future__ import annotations
 
 import os
+import threading
 from functools import partial
 
 import jax
@@ -51,6 +52,7 @@ __all__ = [
     "execute_batch_host",
     "dispatch_batch",
     "collect_batch",
+    "donation_supported",
     "PendingBatch",
 ]
 
@@ -737,18 +739,12 @@ def batch_top_k(n_bucket: int, remaining_max: int) -> int:
     )
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "use_pallas", "pack_assignment", "top_k", "scan_mesh", "scan_wave"
-    ),
-)
-def _batch_blob(alloc_lanes, requested, group_req, remaining, fit_mask,
-                group_valid, order, min_member, scheduled, matched,
-                ineligible, creation_rank, use_pallas: bool = False,
-                pack_assignment: bool = True,
-                top_k: int = ASSIGNMENT_TOP_K, scan_mesh=None,
-                scan_wave: int = 0):
+def _batch_blob_impl(alloc_lanes, requested, group_req, remaining, fit_mask,
+                     group_valid, order, min_member, scheduled, matched,
+                     ineligible, creation_rank, use_pallas: bool = False,
+                     pack_assignment: bool = True,
+                     top_k: int = ASSIGNMENT_TOP_K, scan_mesh=None,
+                     scan_wave: int = 0):
     """One device computation for a whole control-plane batch: the fused
     oracle + findMaxPG, with every O(G) host-needed output concatenated into
     a single int32 blob. On a high-latency host<->device link (the axon
@@ -820,6 +816,51 @@ def _batch_blob(alloc_lanes, requested, group_req, remaining, fit_mask,
     return blob, out
 
 
+_BLOB_STATICS = ("use_pallas", "pack_assignment", "top_k", "scan_mesh",
+                 "scan_wave")
+_batch_blob = jax.jit(_batch_blob_impl, static_argnames=_BLOB_STATICS)
+# Donated variant for the double-buffered dispatch-ahead pipeline: the two
+# [N, R] inputs (alloc, requested) are donated so XLA can reuse their
+# device memory for the same-shaped outputs (left / left_after) instead of
+# allocating a third copy per in-flight batch. Callers MUST hand it
+# freshly device_put buffers they will not touch again — dispatch_batch's
+# donate path does exactly that, and the window-2 in-flight cap means the
+# buffer being donated for batch N+1 is never one batch N still reads
+# (the A/B alternation: each dispatch's H2D lands in a new buffer while
+# the previous one is still owned by the in-flight computation).
+_batch_blob_donated = jax.jit(
+    _batch_blob_impl, static_argnames=_BLOB_STATICS, donate_argnums=(0, 1)
+)
+
+# In-flight fused batches (dispatched, not yet collected), process-wide:
+# the pipelining observability the dispatch-ahead paths hang off.
+_inflight_lock = threading.Lock()
+_inflight_count = [0]
+
+
+def _note_inflight(delta: int) -> None:
+    from ..utils.metrics import DEFAULT_REGISTRY
+
+    with _inflight_lock:
+        _inflight_count[0] += delta
+        count = _inflight_count[0]
+    DEFAULT_REGISTRY.gauge(
+        "bst_oracle_inflight_batches",
+        "Fused oracle batches dispatched to the device and not yet "
+        "collected (>1 means the pipeline is overlapping batches)",
+    ).set(float(count))
+
+
+def donation_supported() -> bool:
+    """Whether input-buffer donation buys anything on this backend.
+    CPU donation is a per-call warning and a no-op; BST_DONATE=0/1
+    overrides the backend default."""
+    env = os.environ.get("BST_DONATE", "").strip()
+    if env in ("0", "1"):
+        return env == "1"
+    return jax.default_backend() in ("tpu", "gpu")
+
+
 class PendingBatch:
     """An in-flight fused batch: dispatched, device->host copy started, not
     yet synced. Produced by ``dispatch_batch``; ``collect_batch`` is the
@@ -858,12 +899,23 @@ class PendingBatch:
         self.g_bucket = g_bucket
 
 
-def dispatch_batch(batch_args, progress_args, scan_mesh=None) -> PendingBatch:
+def dispatch_batch(
+    batch_args, progress_args, scan_mesh=None, donate: bool = False
+) -> PendingBatch:
     """Launch one fused batch + max-progress selection WITHOUT waiting for
     the result, and start an async device->host copy of the packed O(G)
     blob. Compilation (including a Pallas Mosaic lowering failure) surfaces
     here synchronously; device execution and the transfer proceed in the
-    background until ``collect_batch``."""
+    background until ``collect_batch``.
+
+    ``donate=True`` (dispatch-ahead pipeline, docs/pipelining.md) routes
+    through the donated jit: the [N, R] alloc/requested inputs are handed
+    to XLA for output reuse. The caller must treat those two args as
+    CONSUMED after this call — host numpy args are safe (the H2D transfer
+    makes the donated buffer fresh every dispatch, which is what keeps a
+    donation from ever aliasing an in-flight batch's inputs); pre-placed
+    device arrays must not be reused or re-dispatched. No-op on backends
+    without donation (CPU) — see ``donation_supported``."""
     # The fused Pallas scan is single-device TPU only (both mask modes —
     # broadcast [1,N] and per-group [G,N]), and Mosaic lowering is
     # hardware-path-only (tests exercise interpret mode): if a variant
@@ -887,18 +939,23 @@ def dispatch_batch(batch_args, progress_args, scan_mesh=None) -> PendingBatch:
     remaining_max = int(remaining_host.max(initial=0))
     pack = n_bucket <= 2**15 and remaining_max <= 2**16 - 1
     top_k = batch_top_k(n_bucket, remaining_max)
+    donate = donate and donation_supported()
     # Compile-cache hit/miss telemetry: the jit cache growing across this
     # dispatch means a new executable was BUILT (the cold-batch stall
     # class the PR-1 deadline budget absorbs). Private API, so absence
-    # degrades to "unknown" (None), never breaks a batch.
-    cache_size_fn = getattr(_batch_blob, "_cache_size", None)
+    # degrades to "unknown" (None), never breaks a batch. The donated
+    # variant keeps its own cache — track the one this dispatch uses.
+    cache_size_fn = getattr(
+        _batch_blob_donated if donate else _batch_blob, "_cache_size", None
+    )
     try:
         cache_before = cache_size_fn() if cache_size_fn is not None else None
     except Exception:  # noqa: BLE001 — telemetry only
         cache_before = None
 
-    def run(up: bool, wave: int = 0):
-        return _batch_blob(
+    def run(up: bool, wave: int = 0, dn: bool = False):
+        fn = _batch_blob_donated if dn else _batch_blob
+        return fn(
             *batch_args, *progress_args, use_pallas=up, pack_assignment=pack,
             top_k=top_k, scan_mesh=scan_mesh, scan_wave=wave,
         )
@@ -922,7 +979,10 @@ def dispatch_batch(batch_args, progress_args, scan_mesh=None) -> PendingBatch:
     used_pallas, used_wave = attempts[0]
     for i, (up, wave) in enumerate(attempts):
         try:
-            blob, out = run(up, wave)
+            # only the first rung donates: a fallback rung re-runs from the
+            # same caller args, which a donated first attempt may already
+            # have consumed on-device — the ladder must stay replayable
+            blob, out = run(up, wave, dn=donate and i == 0)
             if i > 0:
                 blob_np = np.asarray(jax.device_get(blob))
         except Exception as e:  # noqa: BLE001 — lowering/compile failure
@@ -955,6 +1015,7 @@ def dispatch_batch(batch_args, progress_args, scan_mesh=None) -> PendingBatch:
             blob.copy_to_host_async()
         except (AttributeError, RuntimeError):
             pass
+    _note_inflight(+1)
     return PendingBatch(
         blob, out, pack, used_pallas, run, blob_np, mask_mode,
         used_wave=used_wave, compiled=compiled,
@@ -979,6 +1040,13 @@ def collect_batch(pending: PendingBatch):
     A device-side kernel failure surfaces here; if the Pallas path was used,
     the batch re-runs once on the lax.scan form before the kernel is blamed
     and permanently disabled (same policy as the synchronous path)."""
+    try:
+        return _collect_batch_inner(pending)
+    finally:
+        _note_inflight(-1)
+
+
+def _collect_batch_inner(pending: PendingBatch):
     used_pallas, used_wave = pending.used_pallas, pending.used_wave
     try:
         blob_np = (
@@ -1100,7 +1168,8 @@ def _fold_batch_metrics(telemetry: dict) -> None:
         ).inc(telemetry["wave_uniform"])
 
 
-def execute_batch_host(batch_args, progress_args, scan_mesh=None):
+def execute_batch_host(batch_args, progress_args, scan_mesh=None,
+                       donate: bool = False):
     """Run one fused batch + max-progress selection and fetch ONLY the O(G)
     host vectors (as ONE packed transfer — see _batch_blob); the (G,N)
     tensors come back as device handles for lazy row reads. The single
@@ -1108,5 +1177,9 @@ def execute_batch_host(batch_args, progress_args, scan_mesh=None):
     and the sidecar server (service.server) — one place to change when the
     oracle's outputs change. Synchronous form of dispatch_batch +
     collect_batch; pipelined callers (ops.rescore.ChurnRescorer's
-    tick_dispatch/tick_collect) use the split halves directly."""
-    return collect_batch(dispatch_batch(batch_args, progress_args, scan_mesh))
+    tick_dispatch/tick_collect) use the split halves directly. ``donate``
+    follows dispatch_batch's buffer-donation contract (host numpy args
+    only)."""
+    return collect_batch(
+        dispatch_batch(batch_args, progress_args, scan_mesh, donate=donate)
+    )

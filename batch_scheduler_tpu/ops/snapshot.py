@@ -25,7 +25,12 @@ from ..api.types import Node, Pod, Toleration
 from .bucketing import pad_oracle_batch, pad_rows
 from .lanes import LaneSchema
 
-__all__ = ["GroupDemand", "ClusterSnapshot", "node_requested_from_pods"]
+__all__ = [
+    "GroupDemand",
+    "ClusterSnapshot",
+    "DeltaSnapshotPacker",
+    "node_requested_from_pods",
+]
 
 
 @dataclass
@@ -70,6 +75,15 @@ def node_requested_from_pods(pods: Sequence[Pod]) -> Dict[str, int]:
     return total
 
 
+def _member_request_row(g: GroupDemand) -> Dict[str, int]:
+    """A group's per-member demand dict with the implicit pod slot applied —
+    THE conversion both the full pack and the delta packer use, so the two
+    can never drift."""
+    req = dict(g.member_request)
+    req["pods"] = max(req.get("pods", 0), 1)
+    return req
+
+
 class ClusterSnapshot:
     """Padded, device-ready view of (nodes × groups) for one batch."""
 
@@ -81,6 +95,7 @@ class ClusterSnapshot:
         schema: Optional[LaneSchema] = None,
         requested_lanes: Optional[np.ndarray] = None,
         alloc_lanes: Optional[np.ndarray] = None,
+        group_req_lanes: Optional[np.ndarray] = None,
         min_buckets: tuple = (0, 0),
     ):
         self.node_names = [n.metadata.name for n in nodes]
@@ -131,12 +146,20 @@ class ClusterSnapshot:
             [not n.spec.unschedulable for n in nodes], dtype=bool
         )
 
-        member_reqs = []
-        for g in groups:
-            req = dict(g.member_request)
-            req["pods"] = max(req.get("pods", 0), 1)
-            member_reqs.append(req)
-        group_req = self.schema.pack_many(member_reqs)
+        if group_req_lanes is not None:
+            # delta-pack fast path: the caller (DeltaSnapshotPacker) packed
+            # the member-demand rows — with the implicit pod slot already
+            # applied — against THIS schema and hands over ownership.
+            group_req = np.asarray(group_req_lanes, dtype=np.int32)
+            if group_req.shape != (len(groups), self.schema.num_lanes):
+                raise ValueError(
+                    f"group_req_lanes shape {group_req.shape} != "
+                    f"({len(groups)}, {self.schema.num_lanes})"
+                )
+        else:
+            group_req = self.schema.pack_many(
+                [_member_request_row(g) for g in groups]
+            )
 
         fit = self._fit_mask(nodes, groups) & node_valid[None, :]
 
@@ -252,4 +275,172 @@ class ClusterSnapshot:
             self.group_req.shape[0],
             self.alloc.shape[0],
             self.schema.num_lanes,
+        )
+
+
+class DeltaSnapshotPacker:
+    """Persistent packed host buffers: rewrite only churned rows per refresh.
+
+    The full pack walks every node/group dict every batch — schema collect
+    alone scans ~11k dicts at the north-star shape, and ``pack_many``
+    re-keys all of them even when the memo hits. On a low-churn steady
+    state almost none of that work changes between refreshes. This packer
+    keeps the packed ``[N, R]`` / ``[G, R]`` arrays alive across calls and
+    rewrites only:
+
+    - node **requested** rows whose requested-dict content changed (the
+      resource_version does not cover scheduler-side accounting, so the
+      dict is compared directly — still ~10x cheaper than re-packing);
+    - group demand rows, rebuilt from a persistent per-demand row memo
+      (group membership churns; the memo makes each row a copy).
+
+    Full repack remains the fallback whenever a node OBJECT changed
+    (``(name, resource_version)`` key — the lane shifts are sized from
+    the alloc peaks, so alloc churn must re-collect the schema exactly
+    like the scorer's old per-batch schema reuse did), the node list
+    changed, or a churned demand/requested row stops packing exactly
+    under the cached schema (new resource name, out-of-domain value —
+    ``LaneSchema.covers``).
+
+    Handed-over arrays are COPIES: a published ClusterSnapshot must stay
+    what was actually scored while the packer keeps mutating its buffers.
+    Not thread-safe; callers serialize packs (the scorer's refresh lock).
+    """
+
+    def __init__(self):
+        self.schema: Optional[LaneSchema] = None
+        self._node_names: Optional[tuple] = None
+        self._alloc_keys: list = []
+        self._req_dicts: list = []  # copies: validity is dict equality
+        self._alloc: Optional[np.ndarray] = None
+        self._requested: Optional[np.ndarray] = None
+        # persistent row memos (cleared when the schema actually changes;
+        # a memo hit implies the row was validated exact at insert time)
+        self._req_row_memo: Dict[tuple, np.ndarray] = {}
+        self._group_row_memo: Dict[tuple, np.ndarray] = {}
+        self.full_repacks = 0
+        self.delta_packs = 0
+        self.last_rows_rewritten = 0
+
+    # -- internals ----------------------------------------------------------
+
+    class _SchemaMiss(Exception):
+        """A churned row no longer packs exactly under the cached schema
+        (new resource name or out-of-domain value): fall back to the full
+        repack, never to a silent clamp."""
+
+    def _full_repack(self, nodes, alloc_dicts, req_dicts, groups) -> None:
+        new_schema = LaneSchema.collect(
+            list(req_dicts) + list(alloc_dicts)
+            + [g.member_request for g in groups]
+        )
+        if (
+            self.schema is None
+            or new_schema.names != self.schema.names
+            or new_schema.shifts != self.schema.shifts
+        ):
+            # packing actually changes: the memoized rows are stale
+            self.schema = new_schema
+            self._req_row_memo.clear()
+            self._group_row_memo.clear()
+        self._node_names = tuple(n.metadata.name for n in nodes)
+        self._alloc_keys = [
+            (n.metadata.name, n.metadata.resource_version) for n in nodes
+        ]
+        self._req_dicts = [dict(d) for d in req_dicts]
+        self._alloc = self.schema.pack_many(alloc_dicts, capacity=True)
+        self._requested = self.schema.pack_many(req_dicts)
+        self.full_repacks += 1
+        self.last_rows_rewritten = 2 * len(nodes)
+
+    def _delta_rows(self, nodes, req_dicts) -> int:
+        """Rewrite churned REQUESTED rows in place; raises _SchemaMiss when
+        a churned row stops packing exactly under the cached schema — or
+        when any node OBJECT changed (resource_version bump). Alloc-side
+        churn always full-repacks: the lane shifts are sized from the
+        observed alloc peaks, and a delta rewrite under the cached shifts
+        could keep a stale (coarser) granularity after the peak node
+        shrank — the old per-batch schema reuse re-collected on exactly
+        this key, and the packer must not weaken that. Node updates are
+        rare (scheduler-side accounting moves ``requested``, not the node
+        object), so the steady state stays on the delta path."""
+        schema = self.schema
+        rewritten = 0
+        req_memo = self._req_row_memo
+        for i, n in enumerate(nodes):
+            if (n.metadata.name, n.metadata.resource_version) != self._alloc_keys[i]:
+                raise self._SchemaMiss
+            d = req_dicts[i]
+            if d != self._req_dicts[i]:
+                key = tuple(sorted(d.items()))
+                row = req_memo.get(key)
+                if row is None:
+                    if not schema.covers([d]):
+                        raise self._SchemaMiss
+                    row = schema.pack(d)
+                    req_memo[key] = row
+                self._requested[i] = row
+                self._req_dicts[i] = dict(d)
+                rewritten += 1
+        return rewritten
+
+    def _group_rows(self, groups) -> np.ndarray:
+        """Demand rows from the persistent memo: membership churns freely
+        and a memo hit is one O(R) copy. Raises _SchemaMiss on a demand
+        the cached schema cannot pack exactly."""
+        schema = self.schema
+        memo = self._group_row_memo
+        out = np.empty((len(groups), schema.num_lanes), np.int32)
+        for gi, g in enumerate(groups):
+            key = tuple(sorted(g.member_request.items()))
+            row = memo.get(key)
+            if row is None:
+                d = _member_request_row(g)
+                if not schema.covers([d]):
+                    raise self._SchemaMiss
+                row = schema.pack(d)
+                memo[key] = row
+            out[gi] = row
+        return out
+
+    def pack(
+        self,
+        nodes: Sequence[Node],
+        node_requested: Dict[str, Dict[str, int]],
+        groups: Sequence[GroupDemand],
+    ) -> ClusterSnapshot:
+        """Build one ClusterSnapshot, rewriting only churned rows when the
+        cached schema and node list still hold."""
+        alloc_dicts = [n.status.allocatable for n in nodes]
+        req_dicts = [node_requested.get(n.metadata.name, {}) for n in nodes]
+        names = tuple(n.metadata.name for n in nodes)
+
+        group_req = None
+        if self._alloc is not None and names == self._node_names:
+            try:
+                rewritten = self._delta_rows(nodes, req_dicts)
+                group_req = self._group_rows(groups)
+                self.delta_packs += 1
+                self.last_rows_rewritten = rewritten
+            except self._SchemaMiss:
+                group_req = None
+        if group_req is None:
+            self._full_repack(nodes, alloc_dicts, req_dicts, groups)
+            group_req = self._group_rows(groups)
+
+        from ..utils.metrics import DEFAULT_REGISTRY
+
+        DEFAULT_REGISTRY.counter(
+            "bst_pack_rows_rewritten",
+            "Node lane rows rewritten by the delta snapshot packer "
+            "(2N on a full repack)",
+        ).inc(self.last_rows_rewritten)
+        return ClusterSnapshot(
+            nodes,
+            node_requested,
+            groups,
+            schema=self.schema,
+            alloc_lanes=self._alloc.copy(),
+            requested_lanes=self._requested,  # ClusterSnapshot copies
+            group_req_lanes=group_req,  # freshly allocated per pack
         )

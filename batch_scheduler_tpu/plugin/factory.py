@@ -48,6 +48,15 @@ class PluginConfig:
     # known-complete) batch — takes the device round-trip off the
     # scheduling cycle's critical path (see OracleScorer.background_refresh).
     oracle_background_refresh: bool = False
+    # Dispatch-ahead: speculatively pack + execute batch N+1 while the
+    # control plane works against batch N; a later refresh publishes it
+    # without a blocking device round-trip iff nothing changed since it
+    # packed — bit-identical plans either way (docs/pipelining.md).
+    oracle_dispatch_ahead: bool = False
+    # Compile-ahead bucket warmer: precompile the adjacent (G, N) bucket
+    # shapes around the live working set on a daemon thread so a bucket
+    # transition never pays the cold XLA compile on the serving path.
+    oracle_compile_warmer: bool = False
     controller_workers: int = 10
     leader_poll_seconds: float = 1.0
     lease_renew_seconds: float = 3.0
@@ -165,6 +174,8 @@ def new_plugin_runtime(
         scorer=config.scorer,
         min_batch_interval=config.min_batch_interval_seconds,
         background_refresh=config.oracle_background_refresh,
+        dispatch_ahead=config.oracle_dispatch_ahead,
+        compile_warmer=config.oracle_compile_warmer,
         **kwargs,
     )
 

@@ -224,6 +224,50 @@ _TRANSPORT_ERRORS = (OSError, EOFError, OracleTransportError)
 _BREAKER_STATE_VALUES = {"closed": 0, "open": 1, "half-open": 2}
 
 
+class _ClientSlot:
+    """One in-flight lane of a windowed ResilientOracleClient: the same
+    retry/breaker/deadline policy, its own connection and lock, so a
+    dispatch-ahead speculative batch on one lane never contends with row
+    reads on the batch the other lane executed. RemoteScorer pins each
+    batch's row fetcher to the slot that ran it (the server keeps batch
+    state per connection)."""
+
+    __slots__ = ("_parent", "_idx")
+
+    def __init__(self, parent: "ResilientOracleClient", idx: int):
+        self._parent = parent
+        self._idx = idx
+
+    def ping(self, deadline_ms: Optional[int] = None) -> bool:
+        return self._parent.ping(deadline_ms, _slot=self._idx)
+
+    def schedule(
+        self, req: proto.ScheduleRequest, deadline_ms: Optional[int] = None
+    ) -> proto.ScheduleResponse:
+        return self._parent.schedule(req, deadline_ms, _slot=self._idx)
+
+    def row(
+        self,
+        kind: str,
+        group_index: int,
+        batch_seq: int = 0,
+        deadline_ms: Optional[int] = None,
+    ) -> np.ndarray:
+        return self._parent.row(
+            kind, group_index, batch_seq, deadline_ms, _slot=self._idx
+        )
+
+    def would_attempt(self) -> bool:
+        return self._parent.would_attempt()
+
+    @property
+    def last_telemetry(self) -> Optional[dict]:
+        return self._parent.slot_telemetry(self._idx)
+
+    def close(self) -> None:
+        self._parent.close_slot(self._idx)
+
+
 class ResilientOracleClient:
     """OracleClient with reconnect, retry, deadline, and circuit breaker.
 
@@ -236,6 +280,14 @@ class ResilientOracleClient:
     full-jitter backoff, reconnecting between attempts. Semantic answers
     — StaleBatchError, in-band server errors, OracleDeadlineError — are
     never retried and never advance the breaker.
+
+    ``window`` > 1 provisions that many independent connection SLOTS
+    (lazily dialed, shared breaker/retry policy, per-slot locks) exposed
+    via ``slot(i)`` — the in-flight window of the dispatch-ahead path: a
+    speculative batch runs on one slot while the served batch's row reads
+    proceed on another, with each batch pinned to the slot (and so the
+    server-side connection) that executed it. The default window of 1 is
+    exactly the old single-connection behavior.
 
     Observability (registry, default the process registry):
     bst_oracle_retries_total, bst_oracle_transport_failures_total,
@@ -255,15 +307,17 @@ class ResilientOracleClient:
         deadline_ms: Optional[int] = None,
         name: Optional[str] = None,
         registry: Optional[Registry] = None,
+        window: int = 1,
     ):
         self._host, self._port = host, port
         self._timeout = timeout
         self._connect_timeout = connect_timeout
         self.retry_policy = retry_policy or RetryPolicy()
         self.deadline_ms = self._check_deadline(deadline_ms)
-        self._client: Optional[OracleClient] = None
-        self._connected_once = False
-        self._lock = threading.RLock()
+        self.window = max(1, int(window))
+        self._slot_clients: list = [None] * self.window
+        self._slot_connected: list = [False] * self.window
+        self._slot_locks = [threading.RLock() for _ in range(self.window)]
         reg = registry or DEFAULT_REGISTRY
         self._label = name or f"{host}:{port}"
         self._retries = reg.counter(
@@ -314,36 +368,50 @@ class ResilientOracleClient:
         that a degraded batch is worth re-probing."""
         return self.breaker.would_attempt()
 
+    def slot(self, idx: int) -> _ClientSlot:
+        """A view pinned to connection slot ``idx`` (< window) — see the
+        class docstring's in-flight-window contract."""
+        if not 0 <= idx < self.window:
+            raise IndexError(f"slot {idx} out of window {self.window}")
+        return _ClientSlot(self, idx)
+
+    def slot_telemetry(self, slot: int) -> Optional[dict]:
+        c = self._slot_clients[slot]
+        return c.last_telemetry if c is not None else None
+
     @property
     def last_telemetry(self) -> Optional[dict]:
         """The underlying connection's last absorbed TRACE_INFO telemetry
         (None before any traced batch or while disconnected)."""
-        c = self._client
-        return c.last_telemetry if c is not None else None
+        return self.slot_telemetry(0)
 
     def close(self) -> None:
-        with self._lock:
-            self._drop()
+        for idx in range(self.window):
+            self.close_slot(idx)
 
-    def _ensure(self) -> OracleClient:
-        if self._client is None:
-            self._client = OracleClient(
+    def close_slot(self, idx: int) -> None:
+        with self._slot_locks[idx]:
+            self._drop(idx)
+
+    def _ensure(self, slot: int = 0) -> OracleClient:
+        if self._slot_clients[slot] is None:
+            self._slot_clients[slot] = OracleClient(
                 self._host,
                 self._port,
                 timeout=self._timeout,
                 connect_timeout=self._connect_timeout,
             )
-            if self._connected_once:
+            if self._slot_connected[slot]:
                 self._reconnects.inc(client=self._label)
-            self._connected_once = True
-        return self._client
+            self._slot_connected[slot] = True
+        return self._slot_clients[slot]
 
-    def _drop(self) -> None:
-        if self._client is not None:
-            self._client.close()
-            self._client = None
+    def _drop(self, slot: int = 0) -> None:
+        if self._slot_clients[slot] is not None:
+            self._slot_clients[slot].close()
+            self._slot_clients[slot] = None
 
-    def _admit(self) -> None:
+    def _admit(self, slot: int = 0) -> None:
         decision = self.breaker.admit()
         if decision == "refuse":
             raise CircuitOpenError(
@@ -363,27 +431,27 @@ class ResilientOracleClient:
                 else max(int(self._connect_timeout * 1000), 100)
             )
             try:
-                ok = self._ensure().ping(deadline_ms=probe_ms)
+                ok = self._ensure(slot).ping(deadline_ms=probe_ms)
             except Exception:  # noqa: BLE001 — any probe failure re-opens
                 ok = False
             if not ok:
-                self._drop()
+                self._drop(slot)
                 self.breaker.record_failure()
                 raise CircuitOpenError(
                     f"oracle half-open probe failed ({self._label})"
                 )
             self.breaker.record_success()
 
-    def _call(self, op: str, fn):
-        with self._lock:
-            self._admit()
+    def _call(self, op: str, fn, slot: int = 0):
+        with self._slot_locks[slot]:
+            self._admit(slot)
             last: Optional[BaseException] = None
             for attempt in range(self.retry_policy.max_attempts):
                 if attempt:
                     self._retries.inc(op=op, client=self._label)
                     time.sleep(self.retry_policy.backoff(attempt - 1))
                 try:
-                    result = fn(self._ensure())
+                    result = fn(self._ensure(slot))
                 except (StaleBatchError, OracleDeadlineError) as e:
                     # semantic answers over a live transport: never
                     # retried (stale stays stale; a deadline retry blows
@@ -394,7 +462,7 @@ class ResilientOracleClient:
                     raise
                 except _TRANSPORT_ERRORS as e:
                     self._failures.inc(op=op, client=self._label)
-                    self._drop()
+                    self._drop(slot)
                     self.breaker.record_failure()
                     last = e
                     if not self.breaker.would_attempt():
@@ -412,23 +480,28 @@ class ResilientOracleClient:
                 f"{self.retry_policy.max_attempts} attempts: {last}"
             ) from last
 
-    def ping(self, deadline_ms: Optional[int] = None) -> bool:
+    def ping(self, deadline_ms: Optional[int] = None, _slot: int = 0) -> bool:
         d = (
             self.deadline_ms
             if deadline_ms is None
             else self._check_deadline(deadline_ms)
         )
-        return self._call("ping", lambda c: c.ping(deadline_ms=d))
+        return self._call("ping", lambda c: c.ping(deadline_ms=d), slot=_slot)
 
     def schedule(
-        self, req: proto.ScheduleRequest, deadline_ms: Optional[int] = None
+        self,
+        req: proto.ScheduleRequest,
+        deadline_ms: Optional[int] = None,
+        _slot: int = 0,
     ) -> proto.ScheduleResponse:
         d = (
             self.deadline_ms
             if deadline_ms is None
             else self._check_deadline(deadline_ms)
         )
-        return self._call("schedule", lambda c: c.schedule(req, deadline_ms=d))
+        return self._call(
+            "schedule", lambda c: c.schedule(req, deadline_ms=d), slot=_slot
+        )
 
     def row(
         self,
@@ -436,6 +509,7 @@ class ResilientOracleClient:
         group_index: int,
         batch_seq: int = 0,
         deadline_ms: Optional[int] = None,
+        _slot: int = 0,
     ) -> np.ndarray:
         d = (
             self.deadline_ms
@@ -443,7 +517,9 @@ class ResilientOracleClient:
             else self._check_deadline(deadline_ms)
         )
         return self._call(
-            "row", lambda c: c.row(kind, group_index, batch_seq, deadline_ms=d)
+            "row",
+            lambda c: c.row(kind, group_index, batch_seq, deadline_ms=d),
+            slot=_slot,
         )
 
 
@@ -489,12 +565,23 @@ class RemoteScorer(OracleScorer):
             raise ValueError(
                 f"unknown fallback {fallback!r} (use one of {self.FALLBACK_MODES})"
             )
-        self._clients = [client] if background_client is None else [
-            client, background_client,
-        ]
+        if background_client is not None:
+            self._clients = [client, background_client]
+        elif getattr(client, "window", 1) > 1:
+            # a windowed ResilientOracleClient provides the second lane
+            # itself: slot views alternate exactly like an explicit
+            # background client, each batch pinned to the slot (server
+            # connection) that executed it
+            self._clients = [client.slot(0), client.slot(1)]
+        else:
+            self._clients = [client]
         self._next = 0
         self.fallback = fallback
-        self.supports_background_refresh = background_client is not None
+        self.supports_background_refresh = len(self._clients) > 1
+        # dispatch-ahead has the same single-connection hazard as
+        # background refresh: the speculative wire round-trip would hold
+        # the only connection while cycles read rows
+        self.supports_dispatch_ahead = len(self._clients) > 1
         self._fallback_batches = DEFAULT_REGISTRY.counter(
             "bst_oracle_fallback_batches_total",
             "Oracle batches served by the conservative local-CPU fallback",
@@ -515,6 +602,12 @@ class RemoteScorer(OracleScorer):
         client = self._clients[self._next]
         would = getattr(client, "would_attempt", None)
         return True if would is None else would()
+
+    def _set_degraded(self, flag: bool) -> None:
+        if flag:
+            self._fallback_batches.inc()
+        self.degraded = flag
+        self._degraded_gauge.set(1 if flag else 0)
 
     def _execute(self, snap: ClusterSnapshot):
         # fit_mask may be the [1,N] broadcast fast path; the wire carries
@@ -552,14 +645,15 @@ class RemoteScorer(OracleScorer):
             # conservative degradation: safe progress over exact answers.
             # CircuitOpenError lands here too, so during an outage this
             # path costs one host-side numpy pass, no connect timeout.
-            self.degraded = True
-            self._degraded_gauge.set(1)
-            self._fallback_batches.inc()
-            return conservative_cpu_batch(snap)
-        if self.degraded:
-            self.degraded = False
-            self._degraded_gauge.set(0)
+            # The degraded FLAG flips only when this batch is PUBLISHED
+            # (_publish consumes the marker): a dispatch-ahead speculative
+            # batch degrading mid-flight must not relax PreFilter
+            # semantics for the healthy batch still being served.
+            host, fetcher = conservative_cpu_batch(snap)
+            host["_degraded"] = True
+            return host, fetcher
         host = {
+            "_degraded": False,
             "gang_feasible": resp.gang_feasible,
             "placed": resp.placed,
             "assignment_nodes": resp.assignment_nodes,

@@ -12,25 +12,35 @@ bounds the next request; request bodies run on a per-connection daemon
 worker so the handler can answer a DEADLINE_ERROR frame the moment the
 budget elapses instead of letting a slow jit compile blow the caller's
 scheduling-cycle budget.
+
+Device work is issued by a single-owner executor queue thread
+(``DeviceExecutor``, docs/pipelining.md): connections unpack/pad
+concurrently and enqueue packed batches; the executor overlaps the next
+batch's dispatch with the current batch's device compute (in-flight
+window 2) while keeping every device's launch order total — the
+mesh-collective safety the PR-1 ``execute_lock`` bought, without the
+stop-and-wait.
 """
 
 from __future__ import annotations
 
+import queue
 import socketserver
 import threading
 import time
+from collections import deque
 from typing import Optional
 
 import jax
 import numpy as np
 
 from ..ops.bucketing import pad_oracle_batch
-from ..ops.oracle import execute_batch_host
+from ..ops.oracle import collect_batch, dispatch_batch
 from ..utils.metrics import DEFAULT_REGISTRY, LONG_OP_BUCKETS
 from ..utils import trace as trace_mod
 from . import protocol as proto
 
-__all__ = ["OracleServer", "serve_background"]
+__all__ = ["DeviceExecutor", "OracleServer", "serve_background"]
 
 
 def _pad_request(req: proto.ScheduleRequest):
@@ -66,6 +76,189 @@ def _pad_request(req: proto.ScheduleRequest):
 
 
 _DEADLINE_HIT = object()
+
+_EXEC_STOP = object()
+
+
+class _ExecJob:
+    """One unit of device work queued on the DeviceExecutor. ``wait``
+    blocks until the executor completes it; an abandoned waiter (deadline
+    hit on the connection worker) leaves the job to finish normally — its
+    result is simply never delivered, so the device pipeline stays
+    consistent no matter which side gave up."""
+
+    __slots__ = ("kind", "args", "progress_args", "fn", "enqueued",
+                 "queue_wait", "run_seconds", "_done", "_result", "_error")
+
+    def __init__(self, kind, args=None, progress_args=None, fn=None):
+        self.kind = kind
+        self.args = args
+        self.progress_args = progress_args
+        self.fn = fn
+        self.enqueued = time.perf_counter()
+        self.queue_wait = 0.0
+        self.run_seconds = 0.0
+        self._done = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def finish(self, result=None, error: Optional[BaseException] = None):
+        self._result, self._error = result, error
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("device executor job still running")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class DeviceExecutor:
+    """Single-owner device-executor queue thread: THE one thread that
+    issues device work (fused batches, row gathers), replacing the old
+    server-wide ``execute_lock``.
+
+    The lock existed because two threads executing batches concurrently on
+    a sharded mesh interleave their collectives' rendezvous and stall for
+    seconds — but it also made the server stop-and-wait: unpack/H2D of
+    batch N+1 couldn't start until batch N's device work AND D2H finished.
+    A single issuing thread gives the same total launch order on every
+    device (no interleaving is possible) while pipelining: a batch job is
+    DISPATCHED (async, ``ops.oracle.dispatch_batch``) and the executor
+    immediately picks up the next job, so the next batch's dispatch —
+    and every connection's unpack/pad, which now runs outside the device
+    path entirely — overlaps the current batch's device compute.
+    Collection happens in dispatch order with an in-flight window of
+    ``window`` (default 2: one computing, one being fed).
+
+    DEADLINE semantics are preserved one level up: the per-connection
+    worker abandons its wait when the client's budget elapses, and the
+    executor still collects the abandoned batch — the device pipeline and
+    every QUEUED batch stay intact (the chaos test's invariant).
+    """
+
+    def __init__(self, scan_mesh=None, window: int = 2):
+        self.scan_mesh = scan_mesh
+        self.window = max(1, int(window))
+        self._q: "queue.Queue" = queue.Queue()
+        self._stopped = False
+        self._depth = DEFAULT_REGISTRY.gauge(
+            "bst_oracle_executor_queue_depth",
+            "Batches/rows waiting in the sidecar device-executor queue",
+        )
+        self._thread = threading.Thread(
+            target=self._loop, name="oracle-device-executor", daemon=True
+        )
+        self._thread.start()
+
+    # -- submission ---------------------------------------------------------
+
+    def _submit(self, job: _ExecJob) -> _ExecJob:
+        # refuse after stop: a job enqueued behind the stop sentinel would
+        # never be processed and its waiter would block forever (the loop
+        # also fails any job that raced past this check — see _loop)
+        if self._stopped:
+            raise RuntimeError("device executor stopped")
+        self._q.put(job)
+        self._depth.set(float(self._q.qsize()))
+        return job
+
+    def submit_batch(self, batch_args, progress_args) -> _ExecJob:
+        return self._submit(
+            _ExecJob("batch", args=batch_args, progress_args=progress_args)
+        )
+
+    def run_batch(self, batch_args, progress_args):
+        """Blocking convenience: returns (host, batch, queue_wait_s,
+        run_s). The caller's thread (a per-connection worker) may be
+        abandoned on deadline — see class docstring."""
+        job = self.submit_batch(batch_args, progress_args)
+        host, batch = job.wait()
+        return host, batch, job.queue_wait, job.run_seconds
+
+    def run(self, fn):
+        """Execute an arbitrary device closure (row gather) in queue
+        order — same total-order guarantee as batches."""
+        return self._submit(_ExecJob("call", fn=fn)).wait()
+
+    def stop(self, timeout: float = 60.0) -> bool:
+        self._stopped = True
+        self._q.put(_EXEC_STOP)
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    # -- the executor thread ------------------------------------------------
+
+    def _collect_oldest(self, inflight: deque) -> None:
+        job, pending = inflight.popleft()
+        try:
+            result = collect_batch(pending)
+        except BaseException as e:  # noqa: BLE001 — delivered to the waiter
+            job.run_seconds = time.perf_counter() - job.enqueued - job.queue_wait
+            job.finish(error=e)
+            return
+        job.run_seconds = time.perf_counter() - job.enqueued - job.queue_wait
+        job.finish(result=result)
+
+    def _loop(self) -> None:
+        inflight: deque = deque()
+        while True:
+            if inflight:
+                # drain the queue opportunistically; with nothing queued,
+                # collecting the oldest in-flight batch IS the next job
+                try:
+                    job = self._q.get_nowait()
+                except queue.Empty:
+                    self._collect_oldest(inflight)
+                    continue
+            else:
+                job = self._q.get()
+            self._depth.set(float(self._q.qsize()))
+            if job is _EXEC_STOP:
+                while inflight:
+                    self._collect_oldest(inflight)
+                # fail anything that raced past the _stopped check into
+                # the queue: blocked waiters get an error, never a hang
+                while True:
+                    try:
+                        straggler = self._q.get_nowait()
+                    except queue.Empty:
+                        return
+                    if straggler is not _EXEC_STOP:
+                        straggler.finish(
+                            error=RuntimeError("device executor stopped")
+                        )
+            if job.kind == "batch":
+                while len(inflight) >= self.window:
+                    self._collect_oldest(inflight)
+                job.queue_wait = time.perf_counter() - job.enqueued
+                try:
+                    # single-device batches arrive as host numpy (fresh H2D
+                    # per dispatch) — safe to donate; sharded args are
+                    # pre-placed device arrays, which the donation
+                    # contract forbids re-dispatching (docs/pipelining.md)
+                    pending = dispatch_batch(
+                        job.args, job.progress_args, scan_mesh=self.scan_mesh,
+                        donate=self.scan_mesh is None,
+                    )
+                except BaseException as e:  # noqa: BLE001 — compile/lowering
+                    job.finish(error=e)
+                    continue
+                inflight.append((job, pending))
+            else:
+                # row gathers ride the same total order; their data
+                # dependency is an ALREADY-DISPATCHED batch, so they
+                # complete without waiting out the in-flight window
+                job.queue_wait = time.perf_counter() - job.enqueued
+                t0 = time.perf_counter()
+                try:
+                    result = job.fn()
+                except BaseException as e:  # noqa: BLE001
+                    job.finish(error=e)
+                    continue
+                job.run_seconds = time.perf_counter() - t0
+                job.finish(result=result)
 
 
 class _ConnWorker:
@@ -203,30 +396,45 @@ class _Handler(socketserver.BaseRequestHandler):
                             req = proto.unpack_schedule_request(payload)
                             args, progress_args, (n, g) = _pad_request(req)
                             mesh = self.server.scan_mesh
+                            warmer = self.server.warmer
                             if mesh is not None:
                                 from ..parallel.mesh import shard_snapshot_args
 
                                 args = shard_snapshot_args(mesh, args)
                             t1 = time.perf_counter()
-                            # ONE batch on the device at a time, across all
-                            # connections: the sidecar owns a single
-                            # accelerator (concurrency buys nothing), and on
-                            # a sharded mesh two concurrent executions
-                            # interleave their collectives' rendezvous and
-                            # stall for seconds — an abandoned-deadline
-                            # batch overlapping a reconnected client's retry
-                            # hits exactly that without this lock
-                            with self.server.execute_lock:
-                                t2 = time.perf_counter()
-                                host, batch = execute_batch_host(
-                                    args, progress_args, scan_mesh=mesh
+                            # All device work goes through the single-owner
+                            # executor queue (DeviceExecutor): one issuing
+                            # thread keeps mesh collectives un-interleaved
+                            # (the guarantee the old execute_lock bought)
+                            # while the executor overlaps this batch's
+                            # device compute with the NEXT batch's dispatch
+                            # — and this unpack/pad above already ran
+                            # outside the device path, concurrent across
+                            # connections.
+                            host, batch, queue_wait, run_s = (
+                                self.server.executor.run_batch(
+                                    args, progress_args
                                 )
-                                t3 = time.perf_counter()
+                            )
+                            if warmer is not None:
+                                try:
+                                    # donate mirrors the executor's
+                                    # dispatch, so the warmer warms the
+                                    # SAME jit variant serving traffic hits
+                                    warmer.note_batch(
+                                        args, progress_args,
+                                        host.get("telemetry") or {},
+                                        donate=mesh is None,
+                                    )
+                                except Exception:  # noqa: BLE001 — warm-only
+                                    pass
                             timings = {
                                 "ts0": ts0,
                                 "unpack_pad": t1 - t0,
-                                "lock_wait": t2 - t1,
-                                "device": t3 - t2,
+                                # span name kept for trace-schema stability:
+                                # with the executor this is QUEUE wait
+                                "lock_wait": queue_wait,
+                                "device": run_s,
                             }
                             return host, batch, (n, g), timings
 
@@ -263,7 +471,20 @@ class _Handler(socketserver.BaseRequestHandler):
                                 batch_seq=batch_seq,
                                 n=n,
                                 g=g,
+                                # pipelining evidence (docs/pipelining.md):
+                                # in-flight depth at collect time and the
+                                # warmer's absorption counters ride back to
+                                # the client with the device telemetry
+                                inflight_batches=int(
+                                    DEFAULT_REGISTRY.gauge(
+                                        "bst_oracle_inflight_batches"
+                                    ).value()
+                                ),
                             )
+                            if self.server.warmer is not None:
+                                telemetry.update(
+                                    self.server.warmer.stats()
+                                )
                             ts0 = timings["ts0"]
                             spans = [
                                 self._mk_span(
@@ -347,17 +568,20 @@ class _Handler(socketserver.BaseRequestHandler):
                         batch = last_batch
 
                         def run_row(batch=batch, kind=kind, gidx=gidx, n=n):
-                            # under the same lock as batch execution: on a
+                            # issued by the executor thread, in the same
+                            # total order as batch dispatches: on a
                             # sharded mesh, device_get of a sharded (G,N)
                             # tensor launches its own cross-device gather,
                             # and one interleaving with a concurrent
                             # batch's collectives deadlocks the rendezvous
                             # (seen as a 2-minute stall in the dual-
                             # connection background-refresh test)
-                            with self.server.execute_lock:
+                            def gather():
                                 return np.asarray(
                                     jax.device_get(batch[kind][gidx])
                                 ).astype("<i4")[:n]
+
+                            return self.server.executor.run(gather)
 
                         outcome = self._run(run_row, budget_ms)
                         if outcome is _DEADLINE_HIT:
@@ -388,10 +612,13 @@ class OracleServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        compile_warmer: bool = False,
+    ):
         super().__init__((host, port), _Handler)
-        # serializes batch execution across connections (see run_schedule)
-        self.execute_lock = threading.Lock()
         # Multi-chip deployments (v5e-4 DP config of BASELINE, or a full
         # slice after init_distributed) shard batches over the global mesh
         # with the replicated-scan layout; one chip stays single-device.
@@ -400,16 +627,34 @@ class OracleServer(socketserver.ThreadingTCPServer):
         from ..parallel.distributed import global_mesh
 
         self.scan_mesh = global_mesh() if len(jax.devices()) > 1 else None
+        # the single-owner device pipeline (replaces the PR-1 server-wide
+        # execute_lock; see DeviceExecutor)
+        self.executor = DeviceExecutor(scan_mesh=self.scan_mesh)
+        self.warmer = None
+        if compile_warmer:
+            from ..ops.bucketing import maybe_compile_warmer
+
+            self.warmer = maybe_compile_warmer(self.scan_mesh)
 
     @property
     def address(self):
         return self.server_address
 
+    def server_close(self) -> None:
+        try:
+            self.executor.stop(timeout=10.0)
+            if self.warmer is not None:
+                self.warmer.stop(timeout=10.0)
+        finally:
+            super().server_close()
 
-def serve_background(host: str = "127.0.0.1", port: int = 0) -> OracleServer:
+
+def serve_background(
+    host: str = "127.0.0.1", port: int = 0, compile_warmer: bool = False
+) -> OracleServer:
     """Start an OracleServer on a daemon thread; returns it (``.address``
     has the bound port, ``.shutdown()`` stops it)."""
-    server = OracleServer(host, port)
+    server = OracleServer(host, port, compile_warmer=compile_warmer)
     t = threading.Thread(
         target=server.serve_forever, name="oracle-server", daemon=True
     )

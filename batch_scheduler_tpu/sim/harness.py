@@ -39,6 +39,8 @@ class SimCluster:
         enabled_points=None,
         min_batch_interval: float = 0.0,
         oracle_background_refresh: bool = False,
+        oracle_dispatch_ahead: bool = False,
+        oracle_compile_warmer: bool = False,
         api=None,
     ):
         # ``api``: any APIServer-interface implementation — pass an
@@ -56,6 +58,8 @@ class SimCluster:
             controller_resync_seconds=controller_resync_seconds,
             min_batch_interval_seconds=min_batch_interval,
             oracle_background_refresh=oracle_background_refresh,
+            oracle_dispatch_ahead=oracle_dispatch_ahead,
+            oracle_compile_warmer=oracle_compile_warmer,
             **kwargs,
         )
         self.runtime = None

@@ -12,8 +12,11 @@ init here) and keeps working. Shared by ``bench.py`` and the CLI.
 
 from __future__ import annotations
 
+import json
+import os
 import subprocess
 import sys
+import tempfile
 import time
 from typing import Optional, Tuple
 
@@ -22,8 +25,66 @@ __all__ = ["resolve_platform"]
 PROBE_TIMEOUT_S = 75.0
 RETRIES = 2
 RETRY_DELAY_S = 10.0
+# Hard ceiling on probe wall-clock per invocation, in BOTH retry modes: a
+# caller's generous deadline_s budget (bench passes many minutes) must not
+# turn into a quarter hour of dead probes when the tunnel is down — the
+# BENCH_r05 postmortem burned 12 x 75s in one run. Override with
+# BST_PROBE_TOTAL_CAP_S (<= 0 disables the cap).
+PROBE_TOTAL_CAP_S = 300.0
+# Cross-process verdict cache: one capture run spawns many stages (bench,
+# smoke, ladder, scan split, trace...), each of which would otherwise
+# re-probe from scratch. A fresh verdict within the TTL is reused as-is.
+# The TTL bounds the TOCTOU exposure (a tunnel dropping right after a
+# cached "tpu" verdict hangs at first device use, exactly like one
+# dropping right after a live probe). BST_PROBE_CACHE_TTL_S overrides
+# (<= 0 disables); BST_PROBE_CACHE_FILE relocates.
+PROBE_CACHE_TTL_S = 600.0
 
 _resolved: Optional[Tuple[str, Optional[str]]] = None
+
+
+def _cache_path() -> str:
+    return os.environ.get(
+        "BST_PROBE_CACHE_FILE",
+        os.path.join(tempfile.gettempdir(), "bst_backend_probe.json"),
+    )
+
+
+def _cache_ttl() -> float:
+    try:
+        return float(os.environ.get("BST_PROBE_CACHE_TTL_S", PROBE_CACHE_TTL_S))
+    except ValueError:
+        return PROBE_CACHE_TTL_S
+
+
+def _read_cached_verdict() -> Optional[Tuple[str, Optional[str]]]:
+    ttl = _cache_ttl()
+    if ttl <= 0:
+        return None
+    try:
+        with open(_cache_path()) as f:
+            rec = json.load(f)
+        platform = rec["platform"]
+        age = time.time() - float(rec["ts"])
+        if not isinstance(platform, str) or not 0 <= age <= ttl:
+            return None
+        err = rec.get("error")
+        return platform, err if isinstance(err, str) else None
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def _write_cached_verdict(platform: str, err: Optional[str]) -> None:
+    if _cache_ttl() <= 0:
+        return
+    try:
+        path = _cache_path()
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"platform": platform, "error": err, "ts": time.time()}, f)
+        os.replace(tmp, path)  # atomic: concurrent stages never read torn JSON
+    except OSError:
+        pass
 
 
 def resolve_platform(
@@ -82,6 +143,30 @@ def resolve_platform(
         _resolved = ("cpu", None)
         return _resolved
 
+    # Cross-process cache: a verdict another stage of this capture/bench
+    # run just reached is reused instead of re-probing — the capture
+    # script's stages would otherwise each burn their own probe budget
+    # against the same tunnel (BENCH_r05 postmortem).
+    cached = _read_cached_verdict()
+    if cached is not None:
+        platform, err = cached
+        if platform != "tpu":
+            jax.config.update("jax_platforms", "cpu")
+        print(
+            f"backend probe verdict reused from cache: platform={platform}"
+            + (f" ({err})" if err else ""),
+            file=sys.stderr,
+        )
+        _resolved = cached
+        return _resolved
+
+    try:
+        total_cap = float(
+            os.environ.get("BST_PROBE_TOTAL_CAP_S", PROBE_TOTAL_CAP_S)
+        )
+    except ValueError:
+        total_cap = PROBE_TOTAL_CAP_S
+
     last_err = None
     start = time.monotonic()
     delay = retry_delay_s
@@ -118,6 +203,7 @@ def resolve_platform(
             ]
             if r.returncode == 0 and marker:
                 _resolved = (marker[-1].removeprefix("PLATFORM="), None)
+                _write_cached_verdict(*_resolved)
                 return _resolved
             err = f"probe rc={r.returncode}: {r.stderr.strip()[-300:]}"
             # a fast, repeating failure is deterministic (broken plugin),
@@ -135,6 +221,16 @@ def resolve_platform(
                 )
                 break
         elapsed = time.monotonic() - start
+        if total_cap > 0 and elapsed + delay + probe_timeout_s > total_cap:
+            # per-invocation wall-clock ceiling, regardless of how
+            # generous the caller's deadline budget is — probing cannot
+            # eat a capture stage's whole timeout window
+            print(
+                f"probe wall-clock cap ({total_cap:.0f}s) reached after "
+                f"{attempt} attempts; degrading to cpu now",
+                file=sys.stderr,
+            )
+            break
         if deadline_s is not None:
             if elapsed + delay + probe_timeout_s > deadline_s:
                 break
@@ -150,4 +246,5 @@ def resolve_platform(
 
     jax.config.update("jax_platforms", "cpu")
     _resolved = (jax.default_backend(), str(last_err))
+    _write_cached_verdict(*_resolved)
     return _resolved

@@ -163,27 +163,58 @@ def bench_oracle(nodes, groups, platform):
     out2 = schedule_batch(*snap.device_args(), use_pallas=use_pallas)
     jax.block_until_ready(out2["placed"])
     t_steady = time.perf_counter() - t2
-    # Pipelined device throughput: N batches dispatched back-to-back on
-    # resident inputs, ONE sync. Separates the chip's per-batch compute
-    # from the host link's dispatch+sync round trip (~65ms through the
-    # axon tunnel, ~0 co-located): steady_batch_s is the remote-link
-    # latency, this is what the hardware itself does per batch.
-    resident = jax.device_put(snap.device_args())
-    jax.block_until_ready(resident)
+    # Pipelined serving throughput: N batches through the REAL pipelined
+    # path — dispatch_batch/collect_batch with an in-flight window of 2,
+    # the same pipeline the dispatch-ahead scorer, the churn rescorer,
+    # and the sidecar device executor run (docs/pipelining.md). Each
+    # iteration dispatches batch N+1 (H2D included) while batch N
+    # computes, then collects N's O(G) blob; collecting promptly also
+    # frees N's (G,N) outputs, so at most two batches are ever alive.
+    #
+    # The pre-r06 form dispatched all 16 full-output batches with ONE
+    # final sync: every enqueued-but-incomplete batch's (G,N) output set
+    # stayed live at once (~hundreds of MB each at this shape) and the
+    # allocator pressure made "pipelined" SLOWER than steady on CPU
+    # (BENCH_r05: 1.697s vs 1.666s) — the regression the window-2 blob
+    # pipeline fixes.
+    from batch_scheduler_tpu.ops.oracle import collect_batch, dispatch_batch
+
+    # donate=True: the [N,R] inputs are host numpy, freshly H2D'd per
+    # dispatch, so the donated buffer never aliases an in-flight batch
+    # (no-op on CPU — ops.oracle.donation_supported)
+    host_args = tuple(np.asarray(a) for a in snap.device_args())
+    host_progress = tuple(np.asarray(a) for a in snap.progress_args())
+    collect_batch(dispatch_batch(host_args, host_progress, donate=True))
     pipeline_n = 16
+    window = []
     t3 = time.perf_counter()
-    outs = [
-        schedule_batch(*resident, use_pallas=use_pallas)["placed"]
-        for _ in range(pipeline_n)
-    ]
-    jax.block_until_ready(outs)
+    for _ in range(pipeline_n):
+        window.append(dispatch_batch(host_args, host_progress, donate=True))
+        if len(window) > 1:
+            collect_batch(window.pop(0))
+    while window:
+        collect_batch(window.pop(0))
     t_pipelined = (time.perf_counter() - t3) / pipeline_n
+
+    # Delta snapshot packing: the persistent-packer steady state (low
+    # churn — nothing changed since the last refresh) vs the full pack
+    # measured above. The delta path skips the schema re-collect and every
+    # unchanged row's dict walk; bit-identity with the full pack is CI-
+    # gated (make bench-pipeline).
+    from batch_scheduler_tpu.ops.snapshot import DeltaSnapshotPacker
+
+    packer = DeltaSnapshotPacker()
+    packer.pack(nodes, {}, groups)  # cold: full repack, schema collect
+    t4 = time.perf_counter()
+    packer.pack(nodes, {}, groups)  # steady: zero churned rows
+    t_pack_delta = time.perf_counter() - t4
     return {
         "total_s": total,
         "pack_s": t_pack,
         "device_s": t_device,
         "steady_batch_s": t_steady,
         "pipelined_batch_s": t_pipelined,
+        "pack_delta_s": t_pack_delta,
         "gangs_placed": placed,
         "assignment_path": "pallas" if use_pallas else "scan",
     }
@@ -376,6 +407,7 @@ def main():
     detail = {
         "pods_x_nodes_scored_per_sec": round(scored_per_sec),
         "snapshot_pack_s": round(oracle["pack_s"], 4),
+        "snapshot_pack_delta_s": round(oracle["pack_delta_s"], 5),
         "device_batch_s": round(oracle["device_s"], 4),
         "steady_batch_s": round(oracle["steady_batch_s"], 4),
         "pipelined_batch_s": round(oracle["pipelined_batch_s"], 5),

@@ -1,0 +1,296 @@
+"""CI gate for the overlapped-batch pipeline (make bench-pipeline).
+
+Pins the regression this round fixes and the invariants the pipeline
+rests on, all on CPU so it runs in any environment:
+
+1. **steady vs pipelined** — a window-2 in-flight pipeline over resident
+   inputs must not be slower than stop-and-wait batches by more than 5%
+   (the BENCH_r05 regression: the unwindowed 16-deep pipeline held every
+   batch's (G,N) outputs alive at once and LOST to steady).
+2. **delta snapshot packing** — the persistent packer's low-churn steady
+   state must be >= 2x faster than the full pack AND bit-identical to it.
+3. **dispatch-ahead bit-identity** — an OracleScorer in dispatch-ahead
+   mode must produce the same placements/plans as a serial scorer across
+   refreshes, including a mark-dirty landing mid-flight (speculative
+   batch discarded, not served).
+4. **compile-ahead warmer** — a bucket transition onto a shape the
+   warmer precompiled must hit the jit cache (telemetry ``compiled`` is
+   False, warmer hit counter advances), with the cold compile measured
+   for contrast on an unwarmed shape.
+
+Prints one JSON line with ``"ok"`` and per-check details; exits non-zero
+on any failure. Run from the repo root: ``make bench-pipeline``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# CPU by default: this is a CI gate and must run anywhere. The hardware
+# capture (benchmarks/capture_tpu_artifacts.sh) sets
+# BST_PIPELINE_GATE_PLATFORM=default to keep the probed backend instead.
+if os.environ.get("BST_PIPELINE_GATE_PLATFORM", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+PIPELINE_TOLERANCE = 1.05
+DELTA_SPEEDUP_FLOOR = 2.0
+NUM_NODES = 1024
+NUM_GROUPS = 128
+MEMBERS = 5
+
+
+def build_inputs(n=NUM_NODES, g=NUM_GROUPS):
+    from batch_scheduler_tpu.ops.snapshot import GroupDemand
+    from batch_scheduler_tpu.sim.scenarios import make_sim_node
+
+    nodes = [
+        make_sim_node(f"n{i:05d}", {"cpu": "64", "memory": "256Gi", "pods": "110"})
+        for i in range(n)
+    ]
+    groups = [
+        GroupDemand(
+            full_name=f"default/gang-{i:04d}",
+            min_member=MEMBERS,
+            member_request={"cpu": 4000, "memory": 8 * 1024**3},
+            creation_ts=float(i),
+        )
+        for i in range(g)
+    ]
+    return nodes, groups
+
+
+def check_steady_vs_pipelined(detail):
+    """Same computation (the fused blob batch), only the windowing
+    differs: stop-and-wait (collect each batch before dispatching the
+    next) vs the window-2 in-flight pipeline every pipelined caller runs
+    (dispatch-ahead scorer, churn rescorer, sidecar device executor)."""
+    from batch_scheduler_tpu.ops.oracle import collect_batch, dispatch_batch
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot
+
+    nodes, groups = build_inputs()
+    snap = ClusterSnapshot(nodes, {}, groups)
+    host_args = tuple(np.asarray(a) for a in snap.device_args())
+    host_progress = tuple(np.asarray(a) for a in snap.progress_args())
+    # warm the jit cache outside both clocks (donate as the pipeline does;
+    # host numpy args per the donation contract — no-op on CPU)
+    collect_batch(dispatch_batch(host_args, host_progress, donate=True))
+
+    n_batches = 12
+    t0 = time.perf_counter()
+    for _ in range(n_batches):
+        collect_batch(dispatch_batch(host_args, host_progress, donate=True))
+    steady = (time.perf_counter() - t0) / n_batches
+
+    window = []
+    t0 = time.perf_counter()
+    for _ in range(n_batches):
+        window.append(dispatch_batch(host_args, host_progress, donate=True))
+        if len(window) > 1:
+            collect_batch(window.pop(0))
+    while window:
+        collect_batch(window.pop(0))
+    pipelined = (time.perf_counter() - t0) / n_batches
+
+    detail["steady_batch_s"] = round(steady, 5)
+    detail["pipelined_batch_s"] = round(pipelined, 5)
+    ok = pipelined <= steady * PIPELINE_TOLERANCE
+    if not ok:
+        detail["pipeline_fail"] = (
+            f"pipelined {pipelined:.4f}s > {PIPELINE_TOLERANCE}x steady "
+            f"{steady:.4f}s — the BENCH_r05 regression is back"
+        )
+    return ok
+
+
+def check_delta_pack(detail):
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot, DeltaSnapshotPacker
+
+    # host-only check: use the north-star-class shape (no compile cost)
+    # with populated requested dicts, where the full pack's schema collect
+    # and dict walks are the real per-refresh cost being deleted
+    nodes, groups = build_inputs(n=4096, g=512)
+    node_req = {
+        n.metadata.name: {"cpu": 4000 * (i % 3 + 1), "pods": i % 5 + 1}
+        for i, n in enumerate(nodes)
+    }
+    t0 = time.perf_counter()
+    full = ClusterSnapshot(nodes, node_req, groups)
+    full_s = time.perf_counter() - t0
+
+    packer = DeltaSnapshotPacker()
+    packer.pack(nodes, node_req, groups)  # cold full repack
+    t0 = time.perf_counter()
+    delta = packer.pack(nodes, node_req, groups)  # low-churn steady state
+    delta_s = time.perf_counter() - t0
+
+    identical = all(
+        np.array_equal(getattr(full, a), getattr(delta, a))
+        for a in ("alloc", "requested", "group_req", "remaining", "fit_mask",
+                  "group_valid", "order", "min_member", "scheduled",
+                  "matched", "ineligible", "creation_rank", "node_valid")
+    )
+    speedup = full_s / max(delta_s, 1e-9)
+    detail["pack_full_s"] = round(full_s, 5)
+    detail["pack_delta_s"] = round(delta_s, 5)
+    detail["pack_delta_speedup"] = round(speedup, 1)
+    detail["pack_delta_identical"] = identical
+    detail["pack_rows_rewritten"] = packer.last_rows_rewritten
+    ok = identical and speedup >= DELTA_SPEEDUP_FLOOR
+    if not ok:
+        detail["delta_fail"] = (
+            f"identical={identical} speedup={speedup:.1f}x "
+            f"(floor {DELTA_SPEEDUP_FLOOR}x)"
+        )
+    return ok
+
+
+def check_dispatch_ahead_identity(detail):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+    from batch_scheduler_tpu.cache import PGStatusCache
+    from batch_scheduler_tpu.core.oracle_scorer import OracleScorer
+    from helpers import FakeCluster, make_group, make_node, make_pod, status_for
+
+    nodes = [
+        make_node(f"n{i}", {"cpu": "8", "memory": "32Gi", "pods": "110"})
+        for i in range(6)
+    ]
+    cluster = FakeCluster(nodes)
+    cache = PGStatusCache()
+    gangs = []
+    for i in range(4):
+        name = f"gang{i}"
+        pg = make_group(name, 3, creation_ts=float(i))
+        members = [
+            make_pod(f"{name}-{m}", group=name, requests={"cpu": "1"})
+            for m in range(3)
+        ]
+        status_for(pg, cache, rep_pod=members[0])
+        gangs.append((f"default/{name}", members))
+
+    serial = OracleScorer()
+    ahead = OracleScorer(dispatch_ahead=True)
+    mismatches = []
+    for round_no in range(4):
+        for scorer in (serial, ahead):
+            scorer.mark_dirty()  # lands mid-flight for any banked speculative
+            scorer.ensure_fresh(cluster, cache, group=gangs[0][0])
+        for full_name, _ in gangs:
+            if (
+                ahead.placed(full_name) != serial.placed(full_name)
+                or ahead.gang_feasible(full_name) != serial.gang_feasible(full_name)
+                or ahead.assignment(full_name) != serial.assignment(full_name)
+            ):
+                mismatches.append((round_no, full_name))
+        # mutate: bind one member's worth of capacity so plans shift
+        pod = make_pod(f"filler-{round_no}", requests={"cpu": "4"})
+        cluster.bind(pod, nodes[round_no].metadata.name)
+    ahead.drain_background()
+    detail["dispatch_ahead_rounds"] = 4
+    detail["spec_discarded"] = ahead.spec_discarded
+    detail["spec_served"] = ahead.spec_served
+    if mismatches:
+        detail["dispatch_ahead_fail"] = f"plan mismatches: {mismatches[:4]}"
+    return not mismatches
+
+
+def check_warmer(detail):
+    from batch_scheduler_tpu.ops.bucketing import CompileWarmer, pad_oracle_batch
+    from batch_scheduler_tpu.ops.oracle import collect_batch, dispatch_batch
+
+    def args_for(g, n, r=3):
+        alloc = np.full((n, r), 64, np.int32)
+        return pad_oracle_batch(
+            alloc=alloc,
+            requested=np.zeros((n, r), np.int32),
+            group_req=np.ones((g, r), np.int32),
+            remaining=np.full(g, 2, np.int32),
+            fit_mask=np.ones((1, n), bool),
+            group_valid=np.ones(g, bool),
+            order=np.arange(g, dtype=np.int32),
+            min_member=np.full(g, 2, np.int32),
+            scheduled=np.zeros(g, np.int32),
+            matched=np.zeros(g, np.int32),
+            ineligible=np.zeros(g, bool),
+            creation_rank=np.arange(g, dtype=np.int32),
+        )
+
+    # cold contrast FIRST (an unwarmed shape, never shown to the warmer)
+    cold_args = args_for(64, 8)
+    t0 = time.perf_counter()
+    host, _ = collect_batch(dispatch_batch(*cold_args))
+    cold_s = time.perf_counter() - t0
+    cold_compiled = host["telemetry"].get("compiled")
+
+    warmer = CompileWarmer()
+    base_args = args_for(8, 8)
+    host, _ = collect_batch(dispatch_batch(*base_args))
+    warmer.note_batch(base_args[0], base_args[1], host["telemetry"])
+    # adjacent shapes of (8, 8): (16, 8) and (8, 16)
+    deadline = time.monotonic() + 120.0
+    while len(warmer.warmed_shapes()) < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    warmed_count = len(warmer.warmed_shapes())
+
+    # the bucket transition: serving batch at the precompiled (16, 8)
+    trans_args = args_for(16, 8)
+    t0 = time.perf_counter()
+    host, _ = collect_batch(dispatch_batch(*trans_args))
+    warm_s = time.perf_counter() - t0
+    warm_compiled = host["telemetry"].get("compiled")
+    warmer.note_batch(trans_args[0], trans_args[1], host["telemetry"])
+    stats = warmer.stats()
+    warmer.stop()
+
+    detail["warmer_cold_compile_s"] = round(cold_s, 3)
+    detail["warmer_transition_s"] = round(warm_s, 4)
+    detail["warmer_transition_compiled"] = warm_compiled
+    detail["warmer_hits"] = stats["warmer_hits"]
+    detail["warmer_shapes"] = warmed_count
+    ok = (
+        warmed_count >= 2
+        and warm_compiled is False
+        and stats["warmer_hits"] >= 1
+        and cold_compiled is not False
+    )
+    if not ok:
+        detail["warmer_fail"] = (
+            f"warmed={warmed_count} transition_compiled={warm_compiled} "
+            f"hits={stats['warmer_hits']} cold_compiled={cold_compiled}"
+        )
+    return ok
+
+
+def main() -> int:
+    detail = {}
+    checks = {
+        "pipeline": check_steady_vs_pipelined,
+        "delta_pack": check_delta_pack,
+        "dispatch_ahead": check_dispatch_ahead_identity,
+        "warmer": check_warmer,
+    }
+    results = {}
+    for name, fn in checks.items():
+        try:
+            results[name] = bool(fn(detail))
+        except Exception as e:  # noqa: BLE001 — the JSON line must go out
+            import traceback
+
+            traceback.print_exc()
+            detail[f"{name}_error"] = repr(e)[:300]
+            results[name] = False
+    ok = all(results.values())
+    print(json.dumps({"ok": ok, "checks": results, "detail": detail}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

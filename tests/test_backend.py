@@ -10,9 +10,15 @@ from batch_scheduler_tpu.utils import backend
 
 
 @pytest.fixture(autouse=True)
-def _reset_cache():
+def _reset_cache(monkeypatch, tmp_path):
     saved = backend._resolved
     backend._resolved = None
+    # isolate the cross-process verdict cache: default OFF so the probe
+    # tests below exercise the live loop; cache tests re-enable per-case
+    monkeypatch.setenv("BST_PROBE_CACHE_TTL_S", "0")
+    monkeypatch.setenv(
+        "BST_PROBE_CACHE_FILE", str(tmp_path / "probe_cache.json")
+    )
     yield
     backend._resolved = saved
 
@@ -172,3 +178,98 @@ def test_deadline_mode_deterministic_failure_exits_early(monkeypatch):
     assert platform == "cpu"
     assert "plugin exploded" in err
     assert len(calls) == 3  # bounded, despite the huge budget
+
+
+def _unpin(monkeypatch):
+    import jax
+
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(
+        type(jax.config), "jax_platforms", property(lambda self: "axon"),
+        raising=False,
+    )
+    monkeypatch.setattr(jax.config, "update", lambda k, v: None)
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+
+
+def test_probe_total_cap_bounds_deadline_budget(monkeypatch):
+    """BST_PROBE_TOTAL_CAP_S caps probe wall-clock per invocation even
+    under a huge deadline budget: a slow-failing (non-identical-error)
+    probe loop stops at the cap instead of eating a capture stage's whole
+    timeout window (the 12 x 75s BENCH_r05 postmortem)."""
+    _unpin(monkeypatch)
+    monkeypatch.setenv("BST_PROBE_TOTAL_CAP_S", "100")
+    calls = []
+
+    class R:
+        returncode = 1
+        stdout = ""
+
+        @property
+        def stderr(self):
+            return f"transient error {len(calls)}"  # never identical
+
+    def fake_run(*a, **kw):
+        calls.append(1)
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    import time as _time
+
+    fake_now = [0.0]
+
+    def fake_sleep(s):
+        fake_now[0] += s
+
+    monkeypatch.setattr(_time, "sleep", fake_sleep)
+    monkeypatch.setattr(_time, "monotonic", lambda: fake_now[0])
+
+    platform, err = backend.resolve_platform(
+        probe_timeout_s=30.0, retry_delay_s=10.0, deadline_s=100000.0
+    )
+    assert platform == "cpu"
+    # the cap ends the loop after ~4 probes (~70s fake wall-clock);
+    # without it the 100000s deadline would admit dozens more
+    assert len(calls) <= 4
+
+
+def test_probe_verdict_cached_across_processes(monkeypatch, tmp_path):
+    """A cached verdict (another stage of the same capture run) is reused
+    without spawning a probe; an expired one is ignored."""
+    import json
+    import time as _time
+
+    _unpin(monkeypatch)
+    cache = tmp_path / "verdict.json"
+    monkeypatch.setenv("BST_PROBE_CACHE_FILE", str(cache))
+    monkeypatch.setenv("BST_PROBE_CACHE_TTL_S", "600")
+    cache.write_text(json.dumps(
+        {"platform": "cpu", "error": "probe hang", "ts": _time.time()}
+    ))
+
+    def boom(*a, **kw):
+        raise AssertionError("probe must not run with a fresh cache")
+
+    monkeypatch.setattr(subprocess, "run", boom)
+    platform, err = backend.resolve_platform()
+    assert platform == "cpu" and "hang" in err
+
+    # expired cache: the probe runs again (and rewrites the verdict)
+    backend._resolved = None
+    cache.write_text(json.dumps(
+        {"platform": "cpu", "error": "probe hang", "ts": _time.time() - 9999}
+    ))
+
+    def fake_run(*a, **kw):
+        class R:
+            returncode = 0
+            stdout = "PLATFORM=cpu\n"
+            stderr = ""
+
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    platform, err = backend.resolve_platform()
+    assert (platform, err) == ("cpu", None)
+    rec = json.loads(cache.read_text())
+    assert rec["platform"] == "cpu" and rec["error"] is None

