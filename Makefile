@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all build vet test test-cpu bench bench-scan bench-pipeline native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo
+.PHONY: all build vet test test-cpu bench bench-scan bench-pipeline native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
 
 all: vet native test
 
@@ -68,6 +68,15 @@ serial-e2e:
 # blame records — fails on schema drift (docs/observability.md)
 trace-demo:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/trace_demo.py
+
+# audit/replay/health CI gate (CPU): records a short sim into an audit
+# ring, replays every batch bit-identically (steady + cpu-ladder rungs),
+# proves a tampered record yields a structured blame report, flips
+# /debug/health ok -> breach under the chaos proxy's injected latency
+# (with the bst_slo_breach_total increment), and bounds audit recording
+# overhead at 5% of the steady batch (docs/observability.md)
+replay-gate:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/replay_gate.py
 
 # capture the full hardware-evidence suite (bench, smoke, ladder, scale)
 # into the round's artifact files — aborts untouched if the TPU is away

@@ -66,6 +66,45 @@ def _add_trace_flags(p: argparse.ArgumentParser) -> None:
     )
 
 
+def _add_audit_flags(p: argparse.ArgumentParser, identity: bool = False) -> None:
+    p.add_argument(
+        "--audit-dir", default=None, metavar="DIR",
+        help="record every oracle batch (the exact packed inputs + the "
+             "resulting plan digest) into a bounded on-disk audit ring in "
+             "DIR, written off the hot path — the black-box flight data "
+             "the `replay` subcommand re-executes deterministically "
+             "(docs/observability.md)",
+    )
+    p.add_argument(
+        "--audit-cap-mb", type=int, default=256, metavar="MB",
+        help="total size cap of the audit ring; oldest segments are "
+             "deleted first (default: 256)",
+    )
+    if identity:
+        p.add_argument(
+            "--identity-audit-every", type=int, default=0, metavar="K",
+            help="in-production identity audit: re-verify every Kth "
+                 "non-speculative batch bit-for-bit on the CPU fallback "
+                 "rung (daemon thread); a mismatch breaches /debug/health "
+                 "and flags the audit ring (0 = off)",
+        )
+
+
+def _maybe_audit_log(args):
+    if not getattr(args, "audit_dir", None):
+        return None
+    from ..utils.audit import AuditLog
+
+    log = AuditLog(
+        args.audit_dir, cap_bytes=max(args.audit_cap_mb, 1) * 1024 * 1024
+    )
+    print(
+        f"audit ring: {args.audit_dir} (cap {args.audit_cap_mb} MB)",
+        flush=True,
+    )
+    return log
+
+
 def _maybe_configure_trace(args) -> bool:
     if not getattr(args, "trace", False):
         return False
@@ -160,6 +199,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     _add_metrics_flag(sim)
     _add_trace_flags(sim)
+    _add_audit_flags(sim, identity=True)
     sim.add_argument("--settle", type=float, default=3.0,
                      help="finish early once group phases and bound counts "
                           "have been stable this many seconds (a denied gang "
@@ -184,6 +224,40 @@ def build_parser() -> argparse.ArgumentParser:
     )
     _add_metrics_flag(serve)
     _add_trace_flags(serve)
+    _add_audit_flags(serve)
+
+    rep = sub.add_parser(
+        "replay",
+        help="deterministically re-execute recorded oracle batches from "
+             "an audit ring (`sim`/`serve` --audit-dir) and bit-compare "
+             "the plans against their recorded digests",
+    )
+    rep.add_argument(
+        "audit_dir",
+        help="audit ring directory written by a --audit-dir run",
+    )
+    sel = rep.add_mutually_exclusive_group()
+    sel.add_argument(
+        "--batch", type=int, default=None, metavar="K",
+        help="replay only the record with seq K",
+    )
+    sel.add_argument(
+        "--all", action="store_true",
+        help="replay every reconstructable record (the default)",
+    )
+    rep.add_argument(
+        "--against", default="steady",
+        choices=("steady", "wavefront", "cpu-ladder"),
+        help="the rung to re-execute on: 'steady' = exactly what this "
+             "process would dispatch now (same-backend bit-identity); "
+             "'wavefront' = the wavefront scan forced on; 'cpu-ladder' = "
+             "the serial fallback rung pinned to a CPU device (the "
+             "cross-backend divergence probe)",
+    )
+    rep.add_argument(
+        "--json", default=None, metavar="PATH",
+        help="also write the summary JSON (with full blame reports) here",
+    )
 
     chk = sub.add_parser("check-config", help="validate a scheduler config JSON")
     _add_config_flag(chk)
@@ -341,6 +415,107 @@ def _resolve_backend_or_degrade() -> None:
         )
 
 
+def cmd_replay(args) -> int:
+    """Deterministic replay: reconstruct recorded batches from the audit
+    ring, re-execute each on the requested rung, and bit-compare the plan
+    digests. Exit 0 = all replayed batches identical; 1 = at least one
+    divergence (the structured blame reports are in the summary JSON);
+    2 = nothing replayable."""
+    from ..core.oracle_scorer import replay_audit_record
+    from ..utils.audit import AuditReader
+
+    _resolve_backend_or_degrade()
+    _enable_compilation_cache()
+    batches, skipped = AuditReader(args.audit_dir).batches()
+    if skipped:
+        print(
+            f"note: {len(skipped)} record(s) unreconstructable (ring "
+            "rotated past their keyframe)",
+            file=sys.stderr,
+        )
+    if not batches:
+        print(
+            f"error: no reconstructable batch records in {args.audit_dir}",
+            file=sys.stderr,
+        )
+        return 2
+    if args.batch is not None:
+        selected = [r for r in batches if r.get("seq") == args.batch]
+        if not selected:
+            print(
+                f"error: no batch with seq {args.batch} (have seqs "
+                f"{batches[0].get('seq')}..{batches[-1].get('seq')})",
+                file=sys.stderr,
+            )
+            return 2
+    else:
+        selected = batches
+    reports, divergent, skipped_degraded = [], 0, 0
+    for rec in selected:
+        rep = replay_audit_record(rec, against=args.against)
+        reports.append(rep)
+        if rep.get("skipped"):
+            skipped_degraded += 1
+            print(
+                f"batch seq={rep['seq']} audit_id={rep['audit_id']} "
+                f"skipped: {rep['skipped']}",
+                flush=True,
+            )
+            continue
+        if rep["identical"]:
+            fell_back = (
+                " (WARNING: requested rung fell back to serial)"
+                if rep.get("rung_fell_back") else ""
+            )
+            print(
+                f"batch seq={rep['seq']} audit_id={rep['audit_id']} "
+                f"[{args.against}] identical{fell_back}",
+                flush=True,
+            )
+            continue
+        divergent += 1
+        blame = rep.get("blame") or {}
+        print(
+            f"batch seq={rep['seq']} audit_id={rep['audit_id']} "
+            f"[{args.against}] DIVERGED: field={blame.get('field')} "
+            f"gang={blame.get('gang', blame.get('gang_index'))} "
+            f"node={blame.get('node', blame.get('node_index'))} "
+            f"recorded={blame.get('recorded')} "
+            f"replayed={blame.get('replayed')}",
+            flush=True,
+        )
+    summary = {
+        "audit_dir": args.audit_dir,
+        "against": args.against,
+        "replayed": len(selected) - skipped_degraded,
+        "identical": len(selected) - divergent - skipped_degraded,
+        "divergent": divergent,
+        "skipped_degraded": skipped_degraded,
+        "unreconstructable": len(skipped),
+        "reports": [
+            r for r in reports
+            if not r.get("skipped") and not r["identical"]
+        ],
+    }
+    print(json.dumps(summary, default=str))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=2, default=str)
+    if divergent:
+        return 1
+    if summary["replayed"] == 0:
+        # every selected record was a degraded conservative-fallback
+        # batch: nothing was actually verified, and exit 0 would let a
+        # capture step claim "bit-identical" on zero evidence
+        print(
+            "error: nothing replayed — every selected record is a "
+            "degraded conservative-fallback batch",
+            file=sys.stderr,
+        )
+        return 2
+    return 0
+
+
 def cmd_serve(args) -> int:
     from ..parallel.distributed import init_distributed
     from ..service.server import OracleServer
@@ -374,7 +549,8 @@ def cmd_serve(args) -> int:
     _maybe_serve_metrics(args)
 
     server = OracleServer(
-        host=args.host, port=args.port, compile_warmer=args.compile_warmer
+        host=args.host, port=args.port, compile_warmer=args.compile_warmer,
+        audit_log=_maybe_audit_log(args),
     )
     host, port = server.address
     print(f"oracle sidecar listening on {host}:{port}", flush=True)
@@ -463,6 +639,7 @@ def cmd_sim(args) -> int:
                 file=sys.stderr,
             )
 
+    audit_log = _maybe_audit_log(args)
     cluster = SimCluster(
         scorer=scorer,
         max_schedule_minutes=cfg.plugin_config.max_schedule_minutes,
@@ -471,6 +648,8 @@ def cmd_sim(args) -> int:
         oracle_background_refresh=want_bg_refresh,
         oracle_dispatch_ahead=want_dispatch_ahead,
         oracle_compile_warmer=want_warmer and oracle_client is None,
+        audit_log=audit_log,
+        identity_audit_every=args.identity_audit_every,
     )
 
     nodes: List[Node] = []
@@ -574,6 +753,25 @@ def cmd_sim(args) -> int:
         oracle = getattr(cluster.runtime.operation, "oracle", None)
         if oracle is not None and getattr(oracle, "batches_run", 0):
             print(f"oracle stats: {oracle.stats()}")
+        if audit_log is not None:
+            audit_log.flush()
+            print(f"audit stats: {audit_log.stats()}")
+            print(
+                "replay with: python -m batch_scheduler_tpu replay "
+                f"{args.audit_dir}"
+            )
+        # the SLO health verdict on exit: "degraded and why" without an
+        # operator asking (live form: /debug/health on --metrics-port)
+        health = cluster.health()
+        bad = {
+            name: sig.get("reason") or f"p95 {sig.get('p95_s')}s"
+            for name, sig in health["signals"].items()
+            if sig["verdict"] != "ok"
+        }
+        print(
+            f"slo health: {health['verdict']}"
+            + (f" ({bad})" if bad else "")
+        )
         if tracing:
             from ..utils.trace import DEFAULT_FLIGHT_RECORDER
 
@@ -585,6 +783,8 @@ def cmd_sim(args) -> int:
             print(f"flight recorder decisions: {verdicts}")
     finally:
         cluster.stop()
+        if audit_log is not None:
+            audit_log.stop()
         if remote_scorer is not None:
             remote_scorer.close()  # closes both connections
     return 0
@@ -595,6 +795,7 @@ COMMANDS = {
     "check-config": cmd_check_config,
     "serve": cmd_serve,
     "sim": cmd_sim,
+    "replay": cmd_replay,
 }
 
 

@@ -90,6 +90,8 @@ class ScheduleOperation:
         background_refresh: bool = False,
         dispatch_ahead: bool = False,
         compile_warmer: bool = False,
+        audit_log=None,
+        identity_audit_every: int = 0,
     ):
         self.status_cache = status_cache
         self.cluster = cluster
@@ -109,6 +111,8 @@ class ScheduleOperation:
                     background_refresh=background_refresh,
                     dispatch_ahead=dispatch_ahead,
                     compile_warmer=compile_warmer,
+                    audit_log=audit_log,
+                    identity_audit_every=identity_audit_every,
                 )
                 if scorer == "oracle"
                 else None
@@ -152,6 +156,13 @@ class ScheduleOperation:
                         "windowed client or a background_client); running "
                         "with blocking refresh"
                     )
+            if audit_log is not None or identity_audit_every:
+                # flight-data wiring for a caller-supplied instance
+                # (RemoteScorer): audit records are recorded CLIENT-side
+                # from the same padded snapshot the wire carried, and the
+                # batch's AUDIT_ID annotation correlates the sidecar's own
+                # record (service.protocol)
+                scorer.configure_audit(audit_log, identity_audit_every)
         self.last_denied_pg = TTLCache(DENY_CACHE_DEFAULT_TTL, DENY_CACHE_JANITOR, clock=clock)
         self.last_permitted_pod = TTLCache(PERMITTED_CACHE_DEFAULT_TTL, DENY_CACHE_JANITOR, clock=clock)
         self._lock = threading.RLock()

@@ -32,7 +32,141 @@ from ..ops.snapshot import ClusterSnapshot, DeltaSnapshotPacker, GroupDemand
 from ..utils.errors import StaleBatchError
 from ..utils import trace as trace_mod
 
-__all__ = ["OracleScorer", "demand_from_status", "conservative_cpu_batch"]
+__all__ = [
+    "OracleScorer",
+    "demand_from_status",
+    "conservative_cpu_batch",
+    "replay_batch",
+    "replay_audit_record",
+    "REPLAY_RUNGS",
+]
+
+
+# ---------------------------------------------------------------------------
+# deterministic replay (docs/observability.md "Audit log & replay")
+# ---------------------------------------------------------------------------
+
+# The rungs a recorded batch can be re-executed against:
+#   steady    — exactly what this process would dispatch right now (its
+#               live gates/env decide pallas/wavefront), on the default
+#               backend: the same-backend bit-identity check.
+#   wavefront — the wavefront assignment scan forced on (width 8 bucket),
+#               pallas off: exercises the bit-identity-by-construction
+#               claim of ops.oracle.assign_gangs_wavefront on real
+#               recorded inputs.
+#   cpu-ladder— the always-working fallback rung: serial lax.scan pinned
+#               to a CPU device — what the in-production identity audit
+#               (utils.health.IdentityAuditor) re-verifies against, and
+#               the cross-backend divergence probe for TPU-recorded
+#               audit logs.
+REPLAY_RUNGS = ("steady", "wavefront", "cpu-ladder")
+
+
+def replay_batch(batch_args, progress_args, against: str = "steady",
+                 scan_mesh=None, wave: int = 8):
+    """Re-entry API for deterministic replay: re-execute one recorded
+    oracle batch's EXACT packed inputs on the requested rung and return
+    ``(host, device_result)`` like ``execute_batch_host``. The rung pin is
+    thread-local (ops.oracle.forced_scan_rung), so replays — including the
+    identity audit's daemon-thread re-verification — never change which
+    rung concurrent serving batches run on, and a replay failure never
+    permanently demotes a serving feature."""
+    from ..ops.oracle import execute_batch_host, forced_scan_rung
+
+    batch_args = tuple(np.asarray(a) for a in batch_args)
+    progress_args = tuple(np.asarray(a) for a in progress_args)
+    if against == "steady":
+        return execute_batch_host(batch_args, progress_args,
+                                  scan_mesh=scan_mesh)
+    if against == "wavefront":
+        from ..ops.bucketing import wave_width_bucket
+
+        with forced_scan_rung(False, wave_width_bucket(wave)):
+            return execute_batch_host(batch_args, progress_args,
+                                      scan_mesh=scan_mesh)
+    if against == "cpu-ladder":
+        cpu = jax.local_devices(backend="cpu")[0]
+        with forced_scan_rung(False, 0), jax.default_device(cpu):
+            return execute_batch_host(batch_args, progress_args)
+    raise ValueError(
+        f"unknown replay rung {against!r} (use one of {REPLAY_RUNGS})"
+    )
+
+
+def replay_audit_record(record: dict, against: str = "steady") -> dict:
+    """Replay one reconstructed audit record (utils.audit.AuditReader) and
+    bit-compare the resulting plan against the recorded digest. Returns a
+    per-batch report; a divergence carries a structured blame dict —
+    backend + config fingerprints on both sides, bucket shape, the
+    fallback rung the replay actually ran, and the first differing plan
+    field / gang / node (named when the record kept names).
+
+    A record flagged ``degraded`` is SKIPPED, not replayed: the
+    conservative fallback batch (conservative_cpu_batch) was a host-side
+    answer with no device plan, so re-executing the real oracle against
+    it would report a guaranteed — and meaningless — divergence (the
+    identity auditor skips these for the same reason)."""
+    from ..utils import audit as audit_mod
+
+    if record.get("degraded"):
+        return {
+            "seq": record.get("seq"),
+            "audit_id": record.get("audit_id"),
+            "against": against,
+            "identical": None,
+            "skipped": "degraded conservative-fallback batch — no device "
+                       "plan to re-execute",
+        }
+    host, _ = replay_batch(
+        record["batch_args"], record["progress_args"], against=against
+    )
+    digest = audit_mod.plan_digest(host)
+    identical = digest == record.get("plan_digest")
+    exec_telemetry = host.get("telemetry") or {}
+    out = {
+        "seq": record.get("seq"),
+        "audit_id": record.get("audit_id"),
+        "against": against,
+        "identical": identical,
+        "recorded_digest": record.get("plan_digest"),
+        "replayed_digest": digest,
+        "shape": record.get("shape"),
+        # the rung that actually EXECUTED — the dispatch ladder still
+        # applies under a pin (a failing wavefront lowering falls back to
+        # serial without flipping the process gates), and an "identical"
+        # verdict for a rung that never ran would falsely validate it
+        "executed_rung": {
+            "used_pallas": exec_telemetry.get("used_pallas"),
+            "wave_width": exec_telemetry.get("wave_width"),
+        },
+    }
+    if against == "wavefront" and exec_telemetry.get("wave_width", 0) <= 1:
+        out["rung_fell_back"] = True
+    if not identical:
+        names = record.get("names") or {}
+        telemetry = exec_telemetry
+        shape = record.get("shape") or {}
+        blame = audit_mod.divergence_report(
+            record["result_arrays"],
+            host,
+            node_names=names.get("nodes"),
+            group_names=names.get("groups"),
+            context={
+                "recorded_config": record.get("config"),
+                "replay_config": audit_mod.config_fingerprint(),
+                "bucket": [shape.get("g_bucket"), shape.get("n_bucket")],
+                "fallback_rung": {
+                    "used_pallas": telemetry.get("used_pallas"),
+                    "wave_width": telemetry.get("wave_width"),
+                },
+            },
+        )
+        out["blame"] = blame or {
+            "field": "<record>",
+            "reason": "digest mismatch but every plan field matches — "
+                      "the recorded digest (not the plan) is damaged",
+        }
+    return out
 
 
 def conservative_cpu_batch(snap: ClusterSnapshot):
@@ -166,6 +300,11 @@ class OracleScorer:
     # degrades. ScheduleOperation reads it to relax the deny-by-default
     # PreFilter rule to deny-only-provably-infeasible.
     degraded = False
+    # Black-box flight data (utils.audit / docs/observability.md): class
+    # defaults so subclasses constructed without audit wiring (RemoteScorer)
+    # stay auditing-free until configure_audit is called on them.
+    audit_log = None
+    _identity = None
 
     def __init__(
         self,
@@ -174,6 +313,8 @@ class OracleScorer:
         background_refresh: bool = False,
         dispatch_ahead: bool = False,
         compile_warmer: bool = False,
+        audit_log=None,
+        identity_audit_every: int = 0,
     ):
         # Dirty tracking is a GENERATION pair, not a bool: refresh() clears
         # staleness by recording the generation it observed BEFORE packing
@@ -260,6 +401,24 @@ class OracleScorer:
         self.pack_seconds: list = []
         self.batch_seconds: list = []
         self._stats_lock = threading.Lock()
+        self.configure_audit(audit_log, identity_audit_every)
+
+    def configure_audit(self, audit_log=None,
+                        identity_audit_every: int = 0) -> None:
+        """Attach the black-box flight data layer: an ``utils.audit.AuditLog``
+        recording every published batch (inputs + plan digest, off the hot
+        path), and/or the sampled in-production identity audit — every Kth
+        non-speculative batch re-verified bit-for-bit on the CPU fallback
+        rung (utils.health.IdentityAuditor; a mismatch breaches /debug/health
+        and flags the audit ring). Also how RemoteScorer instances get
+        wired: the cmd layer constructs them before the config is known."""
+        self.audit_log = audit_log
+        if identity_audit_every and identity_audit_every > 0:
+            from ..utils.health import IdentityAuditor
+
+            self._identity = IdentityAuditor(identity_audit_every)
+        else:
+            self._identity = None
 
     def mark_dirty(self) -> None:
         # GIL-level increment; a lost update between two racing markers
@@ -344,6 +503,13 @@ class OracleScorer:
         degraded_marker = (
             host.pop("_degraded", None) if isinstance(host, dict) else None
         )
+        # audit correlation id minted at dispatch time (RemoteScorer sends
+        # it over the wire as the AUDIT_ID annotation so the sidecar's own
+        # record correlates) — popped unconditionally so the served result
+        # never carries transport-internal keys
+        audit_id_marker = (
+            host.pop("_audit_id", None) if isinstance(host, dict) else None
+        )
         if degraded_marker is not None:
             self._set_degraded(bool(degraded_marker))
         self._state = _BatchState(snap, host, max_group, row_fetcher)
@@ -414,8 +580,56 @@ class OracleScorer:
             nodes=len(snap.node_names),
             degraded=bool(self.degraded),
             speculative=speculative,
+            audit_id=audit_id_marker,
             telemetry=telemetry or {},
         )
+        if self.audit_log is not None or self._identity is not None:
+            self._audit_publish(
+                snap, host, audit_id_marker, speculative, telemetry
+            )
+
+    def _audit_publish(
+        self, snap, host, audit_id, speculative: bool, telemetry
+    ) -> None:
+        """Durable evidence for one PUBLISHED batch: the audit record (the
+        exact padded inputs + plan digest, enqueued to the daemon writer)
+        and the sampled identity audit. Evidence collection is never
+        allowed to fail the decision path."""
+        try:
+            from ..utils import audit as audit_mod
+
+            digest = audit_mod.plan_digest(host)
+            aid = audit_id or audit_mod.new_audit_id()
+            ctx = trace_mod.current_context()
+            if self.audit_log is not None:
+                self.audit_log.record_batch(
+                    batch_args=snap.device_args(),
+                    progress_args=snap.progress_args(),
+                    result=host,
+                    plan_digest=digest,
+                    node_names=snap.node_names,
+                    group_names=snap.group_names,
+                    audit_id=aid,
+                    trace_id=ctx[0] if ctx else None,
+                    speculative=speculative,
+                    degraded=bool(self.degraded),
+                    telemetry=telemetry or {},
+                )
+            if (
+                self._identity is not None
+                and not speculative
+                and not self.degraded
+            ):
+                # speculative batches are verified at publication anyway
+                # (a served spec batch is bit-identical to the blocking
+                # refresh by the consume-time generation check), and a
+                # degraded conservative batch has no plan to verify
+                self._identity.note_batch(
+                    snap.device_args(), snap.progress_args(), digest,
+                    aid, self.audit_log,
+                )
+        except Exception:  # noqa: BLE001 — evidence, never the decision path
+            pass
 
     def _donate(self) -> bool:
         """Donate the [N,R] input buffers to the batch (docs/pipelining.md):
@@ -557,6 +771,10 @@ class OracleScorer:
                     ok = False
         if self._warmer is not None:
             ok = self._warmer.stop(timeout) and ok
+        if self._identity is not None:
+            # the identity audit's re-verification is an XLA call on a
+            # daemon thread — same teardown rule as the refresh threads
+            ok = self._identity.drain(timeout) and ok
         return ok
 
     # -- dispatch-ahead (docs/pipelining.md) --------------------------------
@@ -698,6 +916,10 @@ class OracleScorer:
             out["spec_discarded"] = self.spec_discarded
         if self._warmer is not None:
             out.update(self._warmer.stats())
+        if self.audit_log is not None:
+            out.update(self.audit_log.stats())
+        if self._identity is not None:
+            out.update(self._identity.stats())
         return out
 
     def max_group(self) -> str:

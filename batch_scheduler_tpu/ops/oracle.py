@@ -54,6 +54,9 @@ __all__ = [
     "collect_batch",
     "donation_supported",
     "PendingBatch",
+    "forced_scan_rung",
+    "bucket_cost_report",
+    "bucket_cost_for",
 ]
 
 # Plain int (not a device array) so pallas kernels can share these helpers
@@ -85,6 +88,32 @@ _pallas_enabled = {
     mode: os.environ.get("BST_DISABLE_PALLAS", "") != "1"
     for mode in ("broadcast", "per_group")
 }
+
+# Thread-local scan-rung pin for deterministic replay
+# (core.oracle_scorer.replay_batch) and the in-production identity audit
+# (utils.health.IdentityAuditor): forces dispatch_batch onto an explicit
+# (use_pallas, scan_wave) rung FOR THE CURRENT THREAD without touching the
+# process-wide gates above — a replay exercising one rung must never
+# change which rung concurrent serving batches run on, and a replay
+# failure must never permanently demote the serving path (the ladder's
+# disable-on-failure policy is skipped while pinned).
+_rung_override = threading.local()
+
+
+class forced_scan_rung:
+    """Context manager pinning this thread's batches to one scan rung."""
+
+    def __init__(self, use_pallas: bool, scan_wave: int):
+        self._rung = (bool(use_pallas), int(scan_wave))
+
+    def __enter__(self):
+        self._prev = getattr(_rung_override, "value", None)
+        _rung_override.value = self._rung
+        return self
+
+    def __exit__(self, *exc):
+        _rung_override.value = self._prev
+        return False
 
 
 @jax.jit
@@ -872,12 +901,13 @@ class PendingBatch:
     __slots__ = (
         "blob", "out", "pack", "used_pallas", "_rerun", "blob_np",
         "mask_mode", "used_wave", "compiled", "n_bucket", "g_bucket",
+        "pinned",
     )
 
     def __init__(
         self, blob, out, pack, used_pallas, rerun, blob_np=None,
         mask_mode="broadcast", used_wave=0, compiled=None,
-        n_bucket=0, g_bucket=0,
+        n_bucket=0, g_bucket=0, pinned=False,
     ):
         self.blob = blob
         self.out = out
@@ -897,6 +927,9 @@ class PendingBatch:
         self.compiled = compiled
         self.n_bucket = n_bucket
         self.g_bucket = g_bucket
+        # dispatched under a forced_scan_rung pin (replay/identity audit):
+        # collect-side failures never permanently disable serving features
+        self.pinned = pinned
 
 
 def dispatch_batch(
@@ -928,6 +961,17 @@ def dispatch_batch(
     # the process-wide gate so one bad lowering degrades to the serial
     # scan instead of failing every batch.
     scan_wave = _scan_wave_from_env() if _wave_enabled[0] else 0
+    # replay/identity-audit rung pin (forced_scan_rung): this thread runs
+    # the requested rung, with the pallas gates still honored (a pinned
+    # pallas rung off-TPU would fail every batch) and the permanent
+    # disable-on-failure policy suppressed below.
+    forced = getattr(_rung_override, "value", None)
+    if forced is not None:
+        use_pallas = (
+            forced[0] and _pallas_enabled[mask_mode]
+            and jax.default_backend() == "tpu"
+        )
+        scan_wave = forced[1]
     # The packed form saturates per-node counts at 65535; a take can reach
     # the gang's full remaining count on one node, so gate the compact form
     # on the host-side remaining bound and fall back to the exact
@@ -991,9 +1035,11 @@ def dispatch_batch(
                 raise errors[0] from None
             continue
         used_pallas, used_wave = up, wave
-        if i > 0:
+        if i > 0 and forced is None:
             # this rung executed where the one above it failed: the single
-            # feature dropped between the two is provably at fault
+            # feature dropped between the two is provably at fault. A
+            # PINNED (replay) thread skips the permanent disable: its
+            # failure is replay evidence, not a serving-path verdict.
             prev_up, prev_wave = attempts[i - 1]
             if prev_wave and not wave and prev_up == up:
                 _disable_wave(errors[-1])
@@ -1007,6 +1053,22 @@ def dispatch_batch(
             compiled = cache_size_fn() > cache_before
         except Exception:  # noqa: BLE001 — telemetry only
             compiled = None
+    if compiled and scan_mesh is None and forced is None:
+        # a fresh executable was just built for this bucket shape: analyze
+        # its compiled cost in the background (once per shape per process).
+        # `i` is the winning ladder rung — only rung 0 dispatches donated,
+        # so the analysis lowers the variant that actually compiled.
+        # Pinned (replay/identity-audit) threads are excluded like the
+        # disable policy above: their rung is not what serves traffic, and
+        # latest-variant-wins must never replace the serving entry with
+        # the audit rung's numbers.
+        try:
+            _maybe_analyze_bucket(
+                batch_args, progress_args, used_pallas, pack, top_k,
+                used_wave, donated=donate and i == 0,
+            )
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
 
     # Queue the D2H copy now so it rides behind the computation instead of
     # waiting for the collect call (optional API; device_get works without).
@@ -1019,7 +1081,7 @@ def dispatch_batch(
     return PendingBatch(
         blob, out, pack, used_pallas, run, blob_np, mask_mode,
         used_wave=used_wave, compiled=compiled,
-        n_bucket=n_bucket, g_bucket=g_bucket,
+        n_bucket=n_bucket, g_bucket=g_bucket, pinned=forced is not None,
     )
 
 
@@ -1069,10 +1131,11 @@ def _collect_batch_inner(pending: PendingBatch):
             blob_np = np.asarray(jax.device_get(blob))
         except Exception:
             raise e from None
-        if pending.used_pallas:
-            _disable_pallas(e, pending.mask_mode)
-        if pending.used_wave:
-            _disable_wave(e)
+        if not pending.pinned:
+            if pending.used_pallas:
+                _disable_pallas(e, pending.mask_mode)
+            if pending.used_wave:
+                _disable_wave(e)
         used_pallas, used_wave = False, 0  # the blob in hand is serial
 
     g = out["assignment_nodes"].shape[0]
@@ -1105,6 +1168,12 @@ def _collect_batch_inner(pending: PendingBatch):
             telemetry["waves_per_batch"] = int(stats_np[0])
             telemetry["wave_demotions"] = int(stats_np[1])
             telemetry["wave_uniform"] = int(stats_np[2])
+    # per-bucket compiled-cost evidence (flops/bytes/collectives), once the
+    # background analysis for this shape has landed — rides to the flight
+    # recorder and, on the sidecar, back to the client in TRACE_INFO
+    cost = bucket_cost_for(pending.g_bucket, pending.n_bucket)
+    if cost and "error" not in cost:
+        telemetry["bucket_cost"] = cost
     _fold_batch_metrics(telemetry)
     host = {
         "placed": blob_np[:g].astype(bool),
@@ -1166,6 +1235,111 @@ def _fold_batch_metrics(telemetry: dict) -> None:
             "bst_scan_wave_uniform_total",
             "Waves served by the uniform-demand aggregate fast path",
         ).inc(telemetry["wave_uniform"])
+
+
+# -- per-bucket HLO cost/memory telemetry (docs/observability.md) -----------
+#
+# When a dispatch BUILDS a new executable (jit-cache miss), a daemon thread
+# re-lowers the same blob signature from ShapeDtypeStructs and runs the
+# guarded compiled-artifact analyses — cost_analysis / memory_analysis /
+# collective instruction counts (parallel.mesh.compiled_cost_summary) — so
+# /debug/buckets and TRACE_INFO can say what each bucket shape COSTS
+# (flops, bytes, collectives) and the compile warmer's precompile choices
+# are explainable rather than just counted. The persistent XLA compilation
+# cache (cmd.main._enable_compilation_cache) makes the re-lowering a cache
+# read on warm processes. Single-device signatures only: the sharded
+# module's collective counts are measured by benchmarks/sharding_scaling.py
+# with the real mesh shardings. BST_BUCKET_COST=0 disables.
+
+_bucket_costs: dict = {}
+_bucket_cost_lock = threading.Lock()
+_bucket_cost_inflight: set = set()
+
+
+def bucket_cost_report() -> dict:
+    """Per-bucket-shape compiled-cost entries, keyed "GxN" — the payload of
+    the metrics endpoint's /debug/buckets (utils.metrics)."""
+    with _bucket_cost_lock:
+        return {
+            f"{g}x{n}": dict(entry)
+            for (g, n), entry in sorted(_bucket_costs.items())
+        }
+
+
+def bucket_cost_for(g_bucket: int, n_bucket: int):
+    """The analyzed cost entry for one bucket shape, or None while the
+    analysis has not landed (it runs on a daemon thread)."""
+    with _bucket_cost_lock:
+        entry = _bucket_costs.get((int(g_bucket), int(n_bucket)))
+        return dict(entry) if entry else None
+
+
+def _maybe_analyze_bucket(batch_args, progress_args, use_pallas: bool,
+                          pack: bool, top_k: int, scan_wave: int,
+                          donated: bool = False) -> None:
+    """Kick one background cost analysis for a bucket shape that just
+    compiled on the serving path (at most one per (G, N) shape per
+    process). Telemetry only: every failure is recorded, never raised."""
+    if os.environ.get("BST_BUCKET_COST", "").strip() == "0":
+        return
+    key = (int(batch_args[2].shape[0]), int(batch_args[0].shape[0]))
+    with _bucket_cost_lock:
+        existing = _bucket_costs.get(key)
+        if existing is not None and (
+            existing.get("used_pallas") == bool(use_pallas)
+            and existing.get("wave_width") == int(scan_wave)
+            and existing.get("donated", False) == bool(donated)
+        ):
+            return
+        # a DIFFERENT variant compiled for this shape (e.g. the wave gate
+        # was disabled mid-run and serving fell back to serial): re-analyze
+        # so the telemetry describes the variant batches actually run,
+        # latest-variant-wins
+        if key in _bucket_cost_inflight:
+            return
+        _bucket_cost_inflight.add(key)
+    lanes = int(batch_args[0].shape[1])
+    mask_rows = int(batch_args[4].shape[0])
+    # lower from shape/dtype structs: no array data is retained, and the
+    # lowering is identical to what the serving dispatch compiled
+    shapes = tuple(
+        jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+        for a in (*batch_args, *progress_args)
+    )
+
+    def _run() -> None:
+        try:
+            from ..parallel.mesh import compiled_cost_summary
+
+            # lower the SAME variant the serving dispatch compiled: the
+            # donated jit keeps its own cache, so analyzing the
+            # non-donated form on a dispatch-ahead path would pay a
+            # second full compile per shape purely for telemetry
+            fn = _batch_blob_donated if donated else _batch_blob
+            compiled = fn.lower(
+                *shapes, use_pallas=use_pallas, pack_assignment=pack,
+                top_k=top_k, scan_mesh=None, scan_wave=scan_wave,
+            ).compile()
+            entry = {
+                "g_bucket": key[0],
+                "n_bucket": key[1],
+                "lanes": lanes,
+                "mask_rows": mask_rows,
+                "wave_width": int(scan_wave),
+                "used_pallas": bool(use_pallas),
+                "donated": bool(donated),
+                **compiled_cost_summary(compiled),
+            }
+        except Exception as e:  # noqa: BLE001 — telemetry only
+            entry = {"g_bucket": key[0], "n_bucket": key[1],
+                     "error": repr(e)[:200]}
+        with _bucket_cost_lock:
+            _bucket_costs[key] = entry
+            _bucket_cost_inflight.discard(key)
+
+    threading.Thread(
+        target=_run, name="bucket-cost-analysis", daemon=True
+    ).start()
 
 
 def execute_batch_host(batch_args, progress_args, scan_mesh=None,

@@ -30,6 +30,7 @@ __all__ = [
     "sharded_schedule_batch",
     "sharded_collective_counts",
     "count_collective_instructions",
+    "compiled_cost_summary",
     "COLLECTIVES",
 ]
 
@@ -57,6 +58,55 @@ def count_collective_instructions(hlo_text: str) -> dict:
             if f" {op}(" in line or f"{op}-start(" in line
         )
     return counts
+
+
+def compiled_cost_summary(compiled) -> dict:
+    """Guarded cost/memory/collective summary of one compiled executable
+    (a ``jax.stages.Compiled``): ``cost_analysis()`` (flops, bytes
+    accessed), ``memory_analysis()`` (argument/output/temp/code bytes),
+    and the collective instruction counts from the HLO text
+    (``count_collective_instructions`` — the same heuristic the sharding
+    benchmark gates on). Every probe is independently guarded: not all
+    backends expose all three analyses (TPU exposes memory_analysis, CPU
+    often only cost_analysis), and a missing analysis yields a smaller
+    dict, never an error — the consumer is telemetry
+    (ops.oracle bucket cost registry, /debug/buckets, TRACE_INFO)."""
+    out: dict = {}
+    try:
+        ca = compiled.cost_analysis()
+        # older jax returns a per-device list; newer a flat dict
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict):
+            for src, dst in (
+                ("flops", "flops"),
+                ("bytes accessed", "bytes_accessed"),
+                ("transcendentals", "transcendentals"),
+                ("utilization", "utilization"),
+            ):
+                v = ca.get(src)
+                if isinstance(v, (int, float)):
+                    out[dst] = float(v)
+    except Exception:  # noqa: BLE001 — backend-dependent, telemetry only
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        for attr in (
+            "generated_code_size_in_bytes",
+            "argument_size_in_bytes",
+            "output_size_in_bytes",
+            "temp_size_in_bytes",
+        ):
+            v = getattr(ma, attr, None)
+            if isinstance(v, (int, float)):
+                out[attr] = int(v)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        out["collectives"] = count_collective_instructions(compiled.as_text())
+    except Exception:  # noqa: BLE001
+        pass
+    return out
 
 
 def _factor_devices(n: int) -> tuple:

@@ -57,6 +57,16 @@ class PluginConfig:
     # shapes around the live working set on a daemon thread so a bucket
     # transition never pays the cold XLA compile on the serving path.
     oracle_compile_warmer: bool = False
+    # Black-box flight data (utils.audit / docs/observability.md): an
+    # AuditLog instance recording every published oracle batch — packed
+    # inputs + plan digest — to a bounded on-disk ring for deterministic
+    # replay (`python -m batch_scheduler_tpu replay`). None = off.
+    oracle_audit_log: Optional[object] = None
+    # Sampled in-production identity audit: every Kth non-speculative
+    # published batch re-verified bit-for-bit on the CPU fallback rung
+    # (utils.health.IdentityAuditor; mismatch => /debug/health breach).
+    # 0 = off.
+    oracle_identity_audit_every: int = 0
     controller_workers: int = 10
     leader_poll_seconds: float = 1.0
     lease_renew_seconds: float = 3.0
@@ -176,6 +186,8 @@ def new_plugin_runtime(
         background_refresh=config.oracle_background_refresh,
         dispatch_ahead=config.oracle_dispatch_ahead,
         compile_warmer=config.oracle_compile_warmer,
+        audit_log=config.oracle_audit_log,
+        identity_audit_every=config.oracle_identity_audit_every,
         **kwargs,
     )
 

@@ -78,6 +78,7 @@ class OracleClient:
         payload: bytes,
         deadline_ms: Optional[int] = None,
         trace_ctx: Optional[Tuple[str, str]] = None,
+        audit_id: Optional[str] = None,
     ) -> Tuple[int, bytes]:
         with self._lock:
             if deadline_ms is not None:
@@ -99,6 +100,14 @@ class OracleClient:
                         self._sock,
                         proto.MsgType.TRACE,
                         proto.pack_trace(*trace_ctx),
+                    )
+                if audit_id is not None:
+                    # audit correlation (utils.audit): the sidecar stamps
+                    # its own record of this batch with the client's ID
+                    proto.write_frame(
+                        self._sock,
+                        proto.MsgType.AUDIT_ID,
+                        proto.pack_audit_id(audit_id),
                     )
                 proto.write_frame(self._sock, msg_type, payload)
                 try:
@@ -163,7 +172,10 @@ class OracleClient:
         return resp_type == proto.MsgType.PONG
 
     def schedule(
-        self, req: proto.ScheduleRequest, deadline_ms: Optional[int] = None
+        self,
+        req: proto.ScheduleRequest,
+        deadline_ms: Optional[int] = None,
+        audit_id: Optional[str] = None,
     ) -> proto.ScheduleResponse:
         # propagate the live span context over the wire (the TRACE
         # annotation frame); None when tracing is off or no span is open,
@@ -178,6 +190,7 @@ class OracleClient:
             proto.pack_schedule_request(req),
             deadline_ms=deadline_ms,
             trace_ctx=trace_ctx,
+            audit_id=audit_id,
         )
         if resp_type != proto.MsgType.SCHEDULE_RESP:
             raise OracleTransportError(
@@ -242,9 +255,14 @@ class _ClientSlot:
         return self._parent.ping(deadline_ms, _slot=self._idx)
 
     def schedule(
-        self, req: proto.ScheduleRequest, deadline_ms: Optional[int] = None
+        self,
+        req: proto.ScheduleRequest,
+        deadline_ms: Optional[int] = None,
+        audit_id: Optional[str] = None,
     ) -> proto.ScheduleResponse:
-        return self._parent.schedule(req, deadline_ms, _slot=self._idx)
+        return self._parent.schedule(
+            req, deadline_ms, audit_id=audit_id, _slot=self._idx
+        )
 
     def row(
         self,
@@ -492,6 +510,7 @@ class ResilientOracleClient:
         self,
         req: proto.ScheduleRequest,
         deadline_ms: Optional[int] = None,
+        audit_id: Optional[str] = None,
         _slot: int = 0,
     ) -> proto.ScheduleResponse:
         d = (
@@ -500,7 +519,9 @@ class ResilientOracleClient:
             else self._check_deadline(deadline_ms)
         )
         return self._call(
-            "schedule", lambda c: c.schedule(req, deadline_ms=d), slot=_slot
+            "schedule",
+            lambda c: c.schedule(req, deadline_ms=d, audit_id=audit_id),
+            slot=_slot,
         )
 
     def row(
@@ -632,9 +653,19 @@ class RemoteScorer(OracleScorer):
         # the CURRENT batch's rows are not being read from
         client = self._clients[self._next]
         self._next = (self._next + 1) % len(self._clients)
+        # audit correlation: when this scorer records audit evidence, the
+        # batch's ID is minted HERE (before the round-trip) and sent as the
+        # AUDIT_ID annotation so the sidecar's own record of this batch
+        # carries the same ID; _publish consumes the marker for the
+        # client-side record (same ride-along contract as _degraded)
+        audit_id = None
+        if self.audit_log is not None:
+            from ..utils import audit as audit_mod
+
+            audit_id = audit_mod.new_audit_id()
         try:
             with trace_mod.span("oracle.wire_round_trip", cat="oracle"):
-                resp = client.schedule(req)
+                resp = client.schedule(req, audit_id=audit_id)
         except _TRANSPORT_ERRORS + (OracleDeadlineError,):
             # raw OSError/EOFError included, not just the resilient
             # client's wrapped OracleTransportError: a plain OracleClient
@@ -668,6 +699,8 @@ class RemoteScorer(OracleScorer):
         telemetry = getattr(client, "last_telemetry", None)
         if telemetry:
             host["telemetry"] = telemetry
+        if audit_id is not None:
+            host["_audit_id"] = audit_id
         batch_seq = resp.batch_seq
 
         def row_fetcher(kind: str, g: int) -> np.ndarray:

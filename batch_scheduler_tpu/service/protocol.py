@@ -40,6 +40,19 @@ Message types:
                   unchanged). The server times the annotated request's
                   phases and answers a TRACE_INFO frame BEFORE the
                   normal response.
+  AUDIT_ID      : 16-hex audit record ID, annotating the NEXT request on
+                  this connection (no reply; the same annotation-frame
+                  pattern as DEADLINE/TRACE, so every existing
+                  request/response layout — and the native C++ client,
+                  which never audits — stays bit-for-bit unchanged). The
+                  server's own batch audit record (utils.audit) is
+                  stamped with the client's ID, so the sidecar-side and
+                  client-side records of one batch correlate into a
+                  single evidence chain with the stitched trace spans
+                  and flight-recorder decisions (docs/observability.md).
+                  Sent only by auditing clients; a pre-audit server
+                  answers it with an ERROR frame and desyncs — ship
+                  client and server together, as with DEADLINE/TRACE.
   TRACE_INFO    : JSON {trace_id, spans: [...], telemetry: {...}} — the
                   server-side spans (stamped with the client's trace ID,
                   so both sides stitch into one Chrome-trace timeline)
@@ -79,6 +92,8 @@ __all__ = [
     "unpack_trace",
     "pack_trace_info",
     "unpack_trace_info",
+    "pack_audit_id",
+    "unpack_audit_id",
     "is_stale_batch_message",
 ]
 
@@ -104,6 +119,7 @@ class MsgType:
     DEADLINE_ERROR = 9
     TRACE = 10
     TRACE_INFO = 11
+    AUDIT_ID = 12
 
 
 ROW_KINDS = ("capacity", "scores")
@@ -352,6 +368,25 @@ def unpack_trace_info(payload: bytes) -> dict:
     if not isinstance(info, dict):
         return {}
     return info
+
+
+# -- audit-id annotation ----------------------------------------------------
+
+# fixed-width ascii like the TRACE annotation: 16-hex audit record ID
+# (utils.audit.new_audit_id) correlating the client's and the sidecar's
+# audit records of one batch
+_AUDIT = struct.Struct("<16s")
+
+
+def pack_audit_id(audit_id: str) -> bytes:
+    aid = audit_id.encode("ascii")
+    if len(aid) != 16:
+        raise ValueError(f"audit_id must be 16 hex chars, got {audit_id!r}")
+    return _AUDIT.pack(aid)
+
+
+def unpack_audit_id(payload: bytes) -> str:
+    return _AUDIT.unpack(payload)[0].decode("ascii", errors="replace")
 
 
 # -- row request/response --------------------------------------------------

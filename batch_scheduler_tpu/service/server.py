@@ -350,6 +350,7 @@ class _Handler(socketserver.BaseRequestHandler):
         batch_seq = 0
         deadline_ms: Optional[int] = None  # armed for the NEXT request
         trace_ctx: Optional[tuple] = None  # armed for the NEXT request
+        audit_ctx: Optional[str] = None  # armed for the NEXT request
         self._worker: Optional[_ConnWorker] = None
         batch_seconds = DEFAULT_REGISTRY.histogram(
             "bst_oracle_server_batch_seconds",
@@ -376,8 +377,12 @@ class _Handler(socketserver.BaseRequestHandler):
                     if msg_type == proto.MsgType.TRACE:
                         trace_ctx = proto.unpack_trace(payload)
                         continue  # annotation only; no reply
+                    if msg_type == proto.MsgType.AUDIT_ID:
+                        audit_ctx = proto.unpack_audit_id(payload)
+                        continue  # annotation only; no reply
                     budget_ms, deadline_ms = deadline_ms, None
                     req_trace, trace_ctx = trace_ctx, None
+                    req_audit, audit_ctx = audit_ctx, None
                     if msg_type == proto.MsgType.PING:
                         # answered inline, never through the worker:
                         # liveness must stay observable even while a
@@ -397,6 +402,14 @@ class _Handler(socketserver.BaseRequestHandler):
                             args, progress_args, (n, g) = _pad_request(req)
                             mesh = self.server.scan_mesh
                             warmer = self.server.warmer
+                            # host-side padded args, captured BEFORE mesh
+                            # placement: the audit record must replay on
+                            # any backend, so it keeps plain numpy
+                            audit_args = (
+                                (args, progress_args)
+                                if self.server.audit_log is not None
+                                else None
+                            )
                             if mesh is not None:
                                 from ..parallel.mesh import shard_snapshot_args
 
@@ -436,7 +449,7 @@ class _Handler(socketserver.BaseRequestHandler):
                                 "lock_wait": queue_wait,
                                 "device": run_s,
                             }
-                            return host, batch, (n, g), timings
+                            return host, batch, (n, g), timings, audit_args
 
                         outcome = self._run(run_schedule, budget_ms)
                         if outcome is _DEADLINE_HIT:
@@ -446,9 +459,37 @@ class _Handler(socketserver.BaseRequestHandler):
                                 f"schedule exceeded deadline of {budget_ms}ms".encode(),
                             )
                             continue
-                        host, last_batch, (n, g), timings = outcome
+                        host, last_batch, (n, g), timings, audit_args = outcome
                         last_counts = (n, g)
                         batch_seq += 1
+                        if audit_args is not None:
+                            # sidecar-side audit record, stamped with the
+                            # CLIENT's audit ID (the AUDIT_ID annotation)
+                            # so both sides' records of this batch join
+                            # one evidence chain; enqueue only — the
+                            # daemon writer owns serialization and disk
+                            try:
+                                from ..utils import audit as audit_mod
+
+                                self.server.audit_log.record_batch(
+                                    batch_args=audit_args[0],
+                                    progress_args=audit_args[1],
+                                    result=host,
+                                    plan_digest=audit_mod.plan_digest(host),
+                                    audit_id=req_audit,
+                                    trace_id=(
+                                        req_trace[0] if req_trace else None
+                                    ),
+                                    telemetry=host.get("telemetry") or {},
+                                    extra={
+                                        "side": "server",
+                                        "batch_seq": batch_seq,
+                                        "n": n,
+                                        "g": g,
+                                    },
+                                )
+                            except Exception:  # noqa: BLE001 — evidence only
+                                pass
                         total_s = (
                             timings["unpack_pad"]
                             + timings["lock_wait"]
@@ -481,6 +522,8 @@ class _Handler(socketserver.BaseRequestHandler):
                                     ).value()
                                 ),
                             )
+                            if req_audit is not None:
+                                telemetry["audit_id"] = req_audit
                             if self.server.warmer is not None:
                                 telemetry.update(
                                     self.server.warmer.stats()
@@ -617,8 +660,13 @@ class OracleServer(socketserver.ThreadingTCPServer):
         host: str = "127.0.0.1",
         port: int = 0,
         compile_warmer: bool = False,
+        audit_log=None,
     ):
         super().__init__((host, port), _Handler)
+        # sidecar-side batch audit ring (utils.audit): every executed
+        # batch's padded inputs + plan digest, correlated with the
+        # client's records via the AUDIT_ID annotation
+        self.audit_log = audit_log
         # Multi-chip deployments (v5e-4 DP config of BASELINE, or a full
         # slice after init_distributed) shard batches over the global mesh
         # with the replicated-scan layout; one chip stays single-device.
@@ -645,16 +693,21 @@ class OracleServer(socketserver.ThreadingTCPServer):
             self.executor.stop(timeout=10.0)
             if self.warmer is not None:
                 self.warmer.stop(timeout=10.0)
+            if self.audit_log is not None:
+                self.audit_log.stop(timeout=10.0)
         finally:
             super().server_close()
 
 
 def serve_background(
-    host: str = "127.0.0.1", port: int = 0, compile_warmer: bool = False
+    host: str = "127.0.0.1", port: int = 0, compile_warmer: bool = False,
+    audit_log=None,
 ) -> OracleServer:
     """Start an OracleServer on a daemon thread; returns it (``.address``
     has the bound port, ``.shutdown()`` stops it)."""
-    server = OracleServer(host, port, compile_warmer=compile_warmer)
+    server = OracleServer(
+        host, port, compile_warmer=compile_warmer, audit_log=audit_log
+    )
     t = threading.Thread(
         target=server.serve_forever, name="oracle-server", daemon=True
     )

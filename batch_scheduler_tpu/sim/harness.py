@@ -41,6 +41,8 @@ class SimCluster:
         oracle_background_refresh: bool = False,
         oracle_dispatch_ahead: bool = False,
         oracle_compile_warmer: bool = False,
+        audit_log=None,
+        identity_audit_every: int = 0,
         api=None,
     ):
         # ``api``: any APIServer-interface implementation — pass an
@@ -60,6 +62,8 @@ class SimCluster:
             oracle_background_refresh=oracle_background_refresh,
             oracle_dispatch_ahead=oracle_dispatch_ahead,
             oracle_compile_warmer=oracle_compile_warmer,
+            oracle_audit_log=audit_log,
+            oracle_identity_audit_every=identity_audit_every,
             **kwargs,
         )
         self.runtime = None
@@ -229,6 +233,14 @@ class SimCluster:
         if group is not None and "/" not in group:
             group = f"default/{group}"
         return DEFAULT_FLIGHT_RECORDER.snapshot(group)
+
+    def health(self) -> Dict:
+        """The live SLO health model's verdict (utils.health) — the
+        harness-side view of /debug/health, so tests and gates can assert
+        ok/warn/breach without standing up the metrics endpoint."""
+        from ..utils.health import DEFAULT_HEALTH
+
+        return DEFAULT_HEALTH.evaluate()
 
     def wait_for(
         self,

@@ -1,0 +1,670 @@
+"""Batch audit log: the scheduler's black-box flight data.
+
+The trace pipeline (utils.trace, PR 3) answers "which phase ate the
+budget?"; the flight recorder answers "why was gang G denied?". Neither
+can answer "re-run exactly what the scheduler saw at 10:41:07" — once the
+span ring rotates, the oracle's INPUTS are gone, and the overlapped
+pipeline's bit-identity claims (docs/pipelining.md) are only ever checked
+in CI. This module is the durable-evidence layer: every published oracle
+batch is written to a bounded on-disk ring as an :class:`AuditRecord` —
+the packed ``[N,R]``/``[G,R]`` host buffers, bucket shape, gang queue
+order, config fingerprint, and the resulting **plan digest** — so any
+batch inside the retention window can be reconstructed bit-exactly and
+replayed offline (``python -m batch_scheduler_tpu replay``,
+core.oracle_scorer.replay_batch).
+
+Cost discipline:
+
+- recording is OFF unless an :class:`AuditLog` is configured; the
+  disabled path is one ``is not None`` check in the scorer's publish;
+- the hot path only computes a sha256 over the O(G) result vectors and
+  enqueues ARRAY REFERENCES (a published ClusterSnapshot's arrays are
+  immutable by contract — ops.snapshot hands over copies); JSON/base64
+  serialization, delta diffing, and disk I/O all happen on a daemon
+  writer thread;
+- records are **delta-packed** like the snapshot packer that produced
+  them (ops.snapshot.DeltaSnapshotPacker): a keyframe record carries the
+  full arrays, subsequent records carry only the churned rows of the big
+  ``[N,R]``/``[G,R]`` lane arrays (diffed against the previously
+  recorded arrays — the audit validates what was actually SCORED, so the
+  diff is computed here rather than trusted from the packer), and any
+  shape/name change forces a fresh keyframe.
+
+Ring discipline: records append to ``audit-<seq>.jsonl`` segment files;
+when a segment exceeds ``segment_bytes`` a new one starts, and oldest
+segments are deleted once the directory exceeds ``cap_bytes``. The reader
+(:class:`AuditReader`) recovers from a rotated-away keyframe by skipping
+delta records (reported as unreconstructable, never a crash) until the
+next keyframe.
+
+See docs/observability.md ("Audit log & replay") for the record schema
+and retention knobs.
+"""
+
+from __future__ import annotations
+
+import base64
+import glob
+import hashlib
+import json
+import os
+import queue
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "AuditLog",
+    "AuditReader",
+    "new_audit_id",
+    "plan_digest",
+    "canonical_plan",
+    "config_fingerprint",
+    "divergence_report",
+    "PLAN_FIELDS",
+    "BATCH_ARG_NAMES",
+    "PROGRESS_ARG_NAMES",
+]
+
+# the plan fields the digest covers, in canonical order — everything a
+# whole-gang plan is stamped from plus the max-progress selection
+PLAN_FIELDS = (
+    "placed",
+    "gang_feasible",
+    "progress",
+    "best",
+    "best_exists",
+    "assignment_nodes",
+    "assignment_counts",
+)
+
+# ops.snapshot.ClusterSnapshot.device_args() / progress_args() order
+BATCH_ARG_NAMES = (
+    "alloc", "requested", "group_req", "remaining", "fit_mask",
+    "group_valid", "order",
+)
+PROGRESS_ARG_NAMES = (
+    "min_member", "scheduled", "matched", "ineligible", "creation_rank",
+)
+
+# the big lane arrays worth delta-packing; everything else is O(G) or a
+# broadcast row and rides full in every record
+_DELTA_ARRAYS = ("alloc", "requested", "group_req")
+
+_BOOL_ARRAYS = ("fit_mask", "group_valid", "ineligible", "placed",
+                "gang_feasible")
+
+
+def new_audit_id() -> str:
+    """16 lowercase hex chars — THE trace-ID contract (utils.trace), so an
+    audit record, its stitched spans, and its flight-recorder decisions
+    form one evidence chain keyed by one kind of small hex ID (and the
+    wire frame's 16-char check can never drift from the minting site)."""
+    from .trace import new_trace_id
+
+    return new_trace_id()
+
+
+def _canon(field: str, v) -> np.ndarray:
+    """Canonical array form of one plan field — the SINGLE definition both
+    the digest and the divergence compare use, so a dtype drift between
+    record and replay can never masquerade as a plan divergence."""
+    if field in ("placed", "gang_feasible", "best_exists"):
+        return np.ascontiguousarray(np.asarray(v), dtype=np.uint8)
+    return np.ascontiguousarray(np.asarray(v), dtype="<i4")
+
+
+def canonical_plan(host: dict) -> Dict[str, np.ndarray]:
+    """The canonical plan-field arrays of one batch result. Beyond dtype
+    canonicalization, ``assignment_nodes`` entries in ZERO-COUNT slots are
+    masked to 0: those indexes are top_k backfill noise with no semantic
+    content, and the sidecar already zeroes them for wire clients on
+    sharded meshes (service/server.py's client-space remap) — without the
+    mask, a remote-recorded plan and its local replay would differ on
+    semantically-dead slots and every sharded-sidecar record would
+    falsely diverge."""
+    out = {f: _canon(f, host[f]) for f in PLAN_FIELDS}
+    nodes, counts = out["assignment_nodes"], out["assignment_counts"]
+    if nodes.shape == counts.shape:
+        out["assignment_nodes"] = np.where(counts > 0, nodes, 0)
+    return out
+
+
+def plan_digest(host: dict) -> str:
+    """sha256 over the canonical plan fields of one batch result. THE
+    bit-identity token: recorded at publish, recomputed at replay, and
+    compared by the in-production identity audit (utils.health)."""
+    h = hashlib.sha256()
+    plan = canonical_plan(host)
+    for field in PLAN_FIELDS:
+        a = plan[field]
+        h.update(field.encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def config_fingerprint(extra: Optional[dict] = None) -> dict:
+    """The execution-relevant configuration a replay must know to explain a
+    divergence: backend, scan gates, donation — plus the build stamp.
+    Returned as the dict itself with a ``fingerprint`` sha over it, so the
+    blame report can show WHICH knob differed, not just that one did."""
+    cfg: Dict[str, object] = {}
+    try:
+        import jax
+
+        cfg["backend"] = jax.default_backend()
+        cfg["devices"] = len(jax.devices())
+    except Exception:  # noqa: BLE001 — fingerprinting never fatal
+        cfg["backend"] = "unknown"
+    try:
+        from ..ops import oracle as okern
+
+        cfg["scan_wave"] = okern._scan_wave_from_env() if okern._wave_enabled[0] else 0
+        cfg["pallas"] = dict(okern._pallas_enabled)
+        cfg["donate"] = okern.donation_supported()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from ..version import VERSION
+
+        cfg["version"] = VERSION
+    except Exception:  # noqa: BLE001
+        pass
+    if extra:
+        cfg.update(extra)
+    digest = hashlib.sha256(
+        json.dumps(cfg, sort_keys=True, default=str).encode()
+    ).hexdigest()
+    cfg["fingerprint"] = digest[:16]
+    return cfg
+
+
+def divergence_report(
+    recorded: dict,
+    replayed: dict,
+    *,
+    node_names: Optional[List[str]] = None,
+    group_names: Optional[List[str]] = None,
+    context: Optional[dict] = None,
+) -> Optional[dict]:
+    """Structured blame for a digest mismatch: the first differing plan
+    field, the first differing gang (named when the record kept names) and
+    node, with both values. Returns None when the plans are bit-identical
+    field by field (a digest mismatch with no field divergence means the
+    record itself is damaged — reported as field="<record>")."""
+    rec_plan = canonical_plan(recorded)
+    rep_plan = canonical_plan(replayed)
+    for field in PLAN_FIELDS:
+        a = rec_plan[field]
+        b = rep_plan[field]
+        if a.shape != b.shape:
+            return {
+                "field": field,
+                "reason": "shape mismatch",
+                "recorded_shape": list(a.shape),
+                "replayed_shape": list(b.shape),
+                **(context or {}),
+            }
+        if np.array_equal(a, b):
+            continue
+        diff = np.argwhere(a != b)
+        first = diff[0]
+        rep: Dict[str, object] = {
+            "field": field,
+            "differing_elements": int(diff.shape[0]),
+            "recorded": int(a[tuple(first)]),
+            "replayed": int(b[tuple(first)]),
+        }
+        if a.ndim >= 1 and a.shape and field != "best":
+            g = int(first[0])
+            rep["gang_index"] = g
+            # an EMPTY name list means the recorder had no names
+            # (server-side records), not that every index is padding —
+            # blame by index only in that case
+            if group_names and g < len(group_names):
+                rep["gang"] = group_names[g]
+            elif group_names:
+                rep["gang"] = "(pad)"
+        if field in ("assignment_nodes", "assignment_counts") and a.ndim == 2:
+            k = int(first[1])
+            rep["slot"] = k
+            node_idx = int(rec_plan["assignment_nodes"][first[0], k])
+            rep["node_index"] = node_idx
+            if node_names and node_idx < len(node_names):
+                rep["node"] = node_names[node_idx]
+        rep.update(context or {})
+        return rep
+    return None
+
+
+# ---------------------------------------------------------------------------
+# array (de)serialization
+# ---------------------------------------------------------------------------
+
+
+def _enc(arr: np.ndarray) -> dict:
+    a = np.asarray(arr)
+    if a.dtype == bool:
+        payload = np.ascontiguousarray(a, dtype=np.uint8)
+        return {"d": "bool", "s": list(a.shape),
+                "z": base64.b64encode(payload.tobytes()).decode("ascii")}
+    payload = np.ascontiguousarray(a, dtype="<i4")
+    return {"d": "<i4", "s": list(a.shape),
+            "z": base64.b64encode(payload.tobytes()).decode("ascii")}
+
+
+def _dec(spec: dict) -> np.ndarray:
+    raw = base64.b64decode(spec["z"])
+    if spec["d"] == "bool":
+        return np.frombuffer(raw, dtype=np.uint8).reshape(spec["s"]).astype(bool)
+    return np.frombuffer(raw, dtype="<i4").reshape(spec["s"]).copy()
+
+
+# ---------------------------------------------------------------------------
+# the writer
+# ---------------------------------------------------------------------------
+
+
+class AuditLog:
+    """Bounded on-disk ring of audit records, written off the hot path.
+
+    ``record_batch`` is the only hot-path call: it builds a small dict of
+    array REFERENCES and enqueues it (bounded queue; a full queue drops the
+    record and counts it — auditing must never apply backpressure to
+    scheduling). The daemon writer serializes (keyframe or row-delta),
+    appends JSON lines to the current segment, rotates segments at
+    ``segment_bytes``, and deletes oldest segments past ``cap_bytes``.
+
+    Retention knobs (docs/observability.md): ``cap_bytes`` (total ring
+    size), ``segment_bytes`` (rotation granularity — also the keyframe
+    blast radius: a deleted segment loses at most its own records plus the
+    delta tail that depended on its last keyframe), ``keyframe_every``
+    (delta chain length; 1 = every record full).
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        cap_bytes: int = 256 * 1024 * 1024,
+        segment_bytes: int = 8 * 1024 * 1024,
+        keyframe_every: int = 16,
+        queue_max: int = 64,
+    ):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.cap_bytes = max(int(cap_bytes), 1)
+        self.segment_bytes = max(int(segment_bytes), 4096)
+        self.keyframe_every = max(int(keyframe_every), 1)
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_max)
+        # resume the seq counter past an existing ring: a restarted
+        # process appending to the same directory must not mint duplicate
+        # seqs (`replay --batch K` selects by seq)
+        self._seq = self._last_seq_on_disk()
+        self._since_keyframe = 0
+        self._prev: Optional[Dict[str, np.ndarray]] = None
+        self._prev_names: Optional[tuple] = None
+        self._segment_path: Optional[str] = None
+        self._segment_size = 0
+        self._segment_index = self._next_segment_index()
+        self.records_written = 0
+        self.records_dropped = 0
+        self.write_errors = 0
+        self.bytes_written = 0
+        self._config = None  # computed lazily on the writer thread
+        from .metrics import DEFAULT_REGISTRY
+
+        self._written_counter = DEFAULT_REGISTRY.counter(
+            "bst_audit_records_total",
+            "Audit records by outcome (written / dropped on a full queue)",
+        )
+        self._thread = threading.Thread(
+            target=self._loop, name="audit-writer", daemon=True
+        )
+        self._thread.start()
+
+    # -- hot path ------------------------------------------------------------
+
+    def record_batch(
+        self,
+        *,
+        batch_args: tuple,
+        progress_args: tuple,
+        result: dict,
+        plan_digest: str,
+        node_names: Optional[List[str]] = None,
+        group_names: Optional[List[str]] = None,
+        audit_id: Optional[str] = None,
+        trace_id: Optional[str] = None,
+        speculative: bool = False,
+        degraded: bool = False,
+        telemetry: Optional[dict] = None,
+        extra: Optional[dict] = None,
+    ) -> str:
+        """Enqueue one batch record; returns its audit ID. Array arguments
+        are held BY REFERENCE — callers pass published (immutable)
+        snapshot/result arrays only."""
+        aid = audit_id or new_audit_id()
+        item = {
+            "kind": "batch",
+            "audit_id": aid,
+            "ts": time.time(),
+            "trace_id": trace_id,
+            "speculative": bool(speculative),
+            "degraded": bool(degraded),
+            "telemetry": telemetry or {},
+            "plan_digest": plan_digest,
+            "_arrays": dict(zip(BATCH_ARG_NAMES, batch_args))
+            | dict(zip(PROGRESS_ARG_NAMES, progress_args)),
+            "_result": {k: result[k] for k in PLAN_FIELDS},
+            "_names": (list(node_names or []), list(group_names or [])),
+        }
+        if extra:
+            item.update(extra)
+        self._enqueue(item)
+        return aid
+
+    def record_event(self, event: str, **fields) -> None:
+        """A non-batch evidence record (e.g. an identity-audit mismatch
+        flag) appended to the same ring, correlated by audit_id."""
+        self._enqueue({"kind": "event", "event": event, "ts": time.time(),
+                       **fields})
+
+    def _enqueue(self, item: dict) -> None:
+        try:
+            self._q.put_nowait(item)
+        except queue.Full:
+            self.records_dropped += 1
+            self._written_counter.inc(outcome="dropped")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Block up to ``timeout`` until every enqueued record is on disk
+        (tests, sim exit). NEVER blocks past the timeout: a wedged writer
+        (hung disk) makes this return False, not hang — auditing must not
+        be able to block shutdown any more than it can block scheduling."""
+        deadline = time.monotonic() + timeout
+        while not self._q.empty():
+            if time.monotonic() > deadline:
+                return False
+            time.sleep(0.01)
+        # one extra tick: the writer may still be serializing the last item
+        done = threading.Event()
+        try:
+            self._q.put_nowait({"kind": "_sync", "_event": done})
+        except queue.Full:
+            return False  # writer wedged with a refilled queue
+        return done.wait(max(deadline - time.monotonic(), 0.1))
+
+    def stop(self, timeout: float = 10.0) -> bool:
+        self.flush(timeout)
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass  # wedged writer: the join below times out -> False
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    def stats(self) -> dict:
+        return {
+            "audit_records": self.records_written,
+            "audit_dropped": self.records_dropped,
+            "audit_write_errors": self.write_errors,
+            "audit_bytes": self.bytes_written,
+            "audit_dir": self.directory,
+        }
+
+    # -- writer thread -------------------------------------------------------
+
+    def _next_segment_index(self) -> int:
+        existing = sorted(glob.glob(os.path.join(self.directory, "audit-*.jsonl")))
+        if not existing:
+            return 0
+        try:
+            return int(os.path.basename(existing[-1])[6:-6]) + 1
+        except ValueError:
+            return len(existing)
+
+    def _last_seq_on_disk(self) -> int:
+        """Highest record seq already in the ring (0 for a fresh one).
+        Scans segments newest-first and stops at the first that carries
+        any seq, so resuming on a large ring reads one segment, not all."""
+        for path in sorted(
+            glob.glob(os.path.join(self.directory, "audit-*.jsonl")),
+            reverse=True,
+        ):
+            best = 0
+            try:
+                with open(path) as f:
+                    for line in f:
+                        try:
+                            seq = json.loads(line).get("seq")
+                        except ValueError:
+                            continue
+                        if isinstance(seq, int):
+                            best = max(best, seq)
+            except OSError:
+                continue
+            if best:
+                return best
+        return 0
+
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            if item.get("kind") == "_sync":
+                item["_event"].set()
+                continue
+            try:
+                line = self._serialize(item)
+                self._append(line)
+                self.records_written += 1
+                self._written_counter.inc(outcome="written")
+            except Exception:  # noqa: BLE001 — auditing must never crash serving
+                self.write_errors += 1
+                # _serialize may have advanced _prev before the append
+                # failed: the failed record is NOT on disk, so diffing the
+                # next record against it would make the reader reconstruct
+                # WRONG inputs (stale rows applied as if current). Drop the
+                # delta chain — the next record is forced to be a keyframe.
+                self._prev = None
+
+    def _serialize(self, item: dict) -> str:
+        if item["kind"] != "batch":
+            return json.dumps(item, default=str, sort_keys=True)
+        arrays: Dict[str, np.ndarray] = item.pop("_arrays")
+        result: Dict[str, np.ndarray] = item.pop("_result")
+        names = item.pop("_names")
+        self._seq += 1
+        item["seq"] = self._seq
+        item["shape"] = {
+            "n_bucket": int(np.asarray(arrays["alloc"]).shape[0]),
+            "g_bucket": int(np.asarray(arrays["group_req"]).shape[0]),
+            "lanes": int(np.asarray(arrays["alloc"]).shape[1]),
+            "mask_rows": int(np.asarray(arrays["fit_mask"]).shape[0]),
+        }
+        snap = {k: np.asarray(v) for k, v in arrays.items()}
+        keyframe = (
+            self._prev is None
+            or self._since_keyframe >= self.keyframe_every - 1
+            or self._prev_names != (tuple(names[0]), tuple(names[1]))
+            or any(self._prev[k].shape != snap[k].shape for k in snap)
+        )
+        if keyframe:
+            # the config fingerprint is re-taken per KEYFRAME, not per
+            # AuditLog lifetime: a mid-run gate flip (_disable_wave after
+            # a bad lowering) must show up in later records' config or
+            # the blame report's "which knob differed" would lie. Delta
+            # records inherit their keyframe's fingerprint — staleness is
+            # bounded by keyframe_every records.
+            self._config = config_fingerprint()
+            item["keyframe"] = True
+            item["names"] = {"nodes": names[0], "groups": names[1]}
+            item["arrays"] = {k: _enc(v) for k, v in snap.items()}
+            self._since_keyframe = 0
+        else:
+            # delta-pack (the DeltaSnapshotPacker idea applied to the
+            # audit stream): churned rows of the big lane arrays only,
+            # diffed against the last RECORDED arrays so the log always
+            # reconstructs to exactly what was scored
+            item["keyframe"] = False
+            deltas = {}
+            for k in _DELTA_ARRAYS:
+                changed = np.flatnonzero((snap[k] != self._prev[k]).any(axis=1))
+                if changed.size:
+                    deltas[k] = {
+                        "rows": [int(r) for r in changed],
+                        "data": _enc(snap[k][changed]),
+                    }
+            item["deltas"] = deltas
+            item["arrays"] = {
+                k: _enc(v) for k, v in snap.items() if k not in _DELTA_ARRAYS
+            }
+            self._since_keyframe += 1
+        self._prev = snap
+        self._prev_names = (tuple(names[0]), tuple(names[1]))
+        item["config"] = self._config  # set at this (or an earlier) keyframe
+        item["result"] = {
+            k: _enc(v) for k, v in canonical_plan(result).items()
+        }
+        return json.dumps(item, default=str, sort_keys=True)
+
+    def _append(self, line: str) -> None:
+        data = line + "\n"
+        rotated = (
+            self._segment_path is None
+            or self._segment_size + len(data) > self.segment_bytes
+        )
+        if rotated:
+            self._segment_path = os.path.join(
+                self.directory, f"audit-{self._segment_index:08d}.jsonl"
+            )
+            self._segment_index += 1
+            self._segment_size = 0
+        with open(self._segment_path, "a") as f:
+            f.write(data)
+        self._segment_size += len(data)
+        self.bytes_written += len(data)
+        # cap enforcement on ROTATION only: the cap can only newly be
+        # exceeded as segments grow, and per-append glob+stat of every
+        # segment (~33 metadata syscalls/record at the default sizing)
+        # would be pure writer-thread overhead for a lag of at most one
+        # segment's worth
+        if rotated:
+            self._enforce_cap()
+
+    def _enforce_cap(self) -> None:
+        segments = sorted(glob.glob(os.path.join(self.directory, "audit-*.jsonl")))
+        total = 0
+        sizes = []
+        for path in segments:
+            try:
+                sizes.append((path, os.path.getsize(path)))
+            except OSError:
+                sizes.append((path, 0))
+        total = sum(s for _, s in sizes)
+        # delete oldest-first, never the segment currently being written
+        for path, size in sizes[:-1]:
+            if total <= self.cap_bytes:
+                break
+            try:
+                os.remove(path)
+                total -= size
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# the reader
+# ---------------------------------------------------------------------------
+
+
+class AuditReader:
+    """Iterate an audit directory's records oldest-first, materializing the
+    full input arrays per batch (applying row deltas onto the rolling
+    state). Delta records whose keyframe rotated out of the ring are
+    yielded as ``{"kind": "unreconstructable", ...}`` — the ring losing
+    its head is expected behavior, not corruption — and reconstruction
+    resumes at the next keyframe."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+
+    def segments(self) -> List[str]:
+        return sorted(glob.glob(os.path.join(self.directory, "audit-*.jsonl")))
+
+    def records(self) -> Iterator[dict]:
+        state: Optional[Dict[str, np.ndarray]] = None
+        names: Optional[dict] = None
+        for path in self.segments():
+            try:
+                with open(path) as f:
+                    lines = f.readlines()
+            except OSError:
+                continue
+            for line in lines:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    # a torn tail write (crash mid-append): skip the line,
+                    # the next keyframe resynchronizes
+                    yield {"kind": "unreconstructable",
+                           "reason": "undecodable line", "segment": path}
+                    state = None
+                    continue
+                if rec.get("kind") == "event":
+                    yield rec
+                    continue
+                if rec.get("kind") != "batch":
+                    continue
+                if rec.get("keyframe"):
+                    state = {k: _dec(v) for k, v in rec["arrays"].items()}
+                    names = rec.get("names") or {"nodes": [], "groups": []}
+                else:
+                    if state is None:
+                        yield {
+                            "kind": "unreconstructable",
+                            "seq": rec.get("seq"),
+                            "audit_id": rec.get("audit_id"),
+                            "reason": "delta record before any keyframe "
+                                      "(ring rotated past its keyframe)",
+                        }
+                        continue
+                    for k, v in rec.get("arrays", {}).items():
+                        state[k] = _dec(v)
+                    for k, delta in rec.get("deltas", {}).items():
+                        rows = delta["rows"]
+                        data = _dec(delta["data"])
+                        state[k] = state[k].copy()
+                        state[k][rows] = data
+                out = dict(rec)
+                out["batch_args"] = tuple(
+                    state[k] for k in BATCH_ARG_NAMES
+                )
+                out["progress_args"] = tuple(
+                    state[k] for k in PROGRESS_ARG_NAMES
+                )
+                out["result_arrays"] = {
+                    k: _dec(v) for k, v in rec["result"].items()
+                }
+                out["names"] = names or {"nodes": [], "groups": []}
+                yield out
+
+    def batches(self) -> tuple:
+        """(reconstructed batch records, skipped records) — the list form
+        the replay CLI and tests use."""
+        batches, skipped = [], []
+        for rec in self.records():
+            if rec.get("kind") == "batch":
+                batches.append(rec)
+            elif rec.get("kind") == "unreconstructable":
+                skipped.append(rec)
+        return batches, skipped

@@ -173,13 +173,53 @@ def resolve_platform(
     attempt = 0
     same_fast_failures = 0
     consecutive_hangs = 0
+    # Probe-noise discipline (BENCH_r05 tail postmortem: 11+ identical
+    # "backend probe hang" lines): an identical failure prints ONCE and is
+    # then counted; the count is summarized in one line at the next
+    # distinct message or at the verdict.
+    _last_key = [None]
+    _last_line = [""]
+    _suppressed = [0]
+
+    def _flush_suppressed() -> None:
+        if _suppressed[0]:
+            print(
+                f"probe failure repeated {_suppressed[0]} more time(s) "
+                f"(suppressed): {_last_line[0]}",
+                file=sys.stderr,
+            )
+            _suppressed[0] = 0
+
+    def _note_failure(key: str, line: str, attempt: int) -> None:
+        if key == _last_key[0]:
+            _suppressed[0] += 1
+            return
+        _flush_suppressed()
+        print(f"probe attempt {attempt}: {line}", file=sys.stderr)
+        _last_key[0], _last_line[0] = key, line
+
     while True:
         attempt += 1
+        # The wall-clock cap short-circuits MID-ATTEMPT too: each probe
+        # only gets the budget that remains, instead of every attempt
+        # riding its own full probe_timeout_s past the cap.
+        this_timeout = probe_timeout_s
+        if total_cap > 0:
+            remaining = total_cap - (time.monotonic() - start)
+            if remaining <= 1.0:
+                _flush_suppressed()
+                print(
+                    f"probe wall-clock cap ({total_cap:.0f}s) reached after "
+                    f"{attempt - 1} attempts; degrading to cpu now",
+                    file=sys.stderr,
+                )
+                break
+            this_timeout = min(this_timeout, remaining)
         try:
             r = subprocess.run(
                 [sys.executable, "-c",
                  "import jax; print('PLATFORM=' + jax.default_backend())"],
-                timeout=probe_timeout_s,
+                timeout=this_timeout,
                 capture_output=True,
                 text=True,
             )
@@ -187,9 +227,10 @@ def resolve_platform(
             r = None
             same_fast_failures = 0
             consecutive_hangs += 1
-            last_err = f"backend probe hang (> {probe_timeout_s}s)"
-            print(f"probe attempt {attempt}: {last_err}", file=sys.stderr)
+            last_err = f"backend probe hang (> {this_timeout:.1f}s)"
+            _note_failure("hang", last_err, attempt)
             if consecutive_hangs >= 2:
+                _flush_suppressed()
                 print(
                     "probe hung twice in a row; a wedged tunnel does not "
                     "heal inside one run — degrading to cpu now",
@@ -202,6 +243,7 @@ def resolve_platform(
                 l for l in r.stdout.splitlines() if l.startswith("PLATFORM=")
             ]
             if r.returncode == 0 and marker:
+                _flush_suppressed()
                 _resolved = (marker[-1].removeprefix("PLATFORM="), None)
                 _write_cached_verdict(*_resolved)
                 return _resolved
@@ -211,20 +253,22 @@ def resolve_platform(
             # deadline budget re-spawning the identical probe
             same_fast_failures = same_fast_failures + 1 if err == last_err else 1
             last_err = err
-            print(
-                f"probe attempt {attempt} failed: {last_err}", file=sys.stderr
-            )
+            _note_failure(err, f"failed: {err}", attempt)
             if same_fast_failures >= 3:
+                _flush_suppressed()
                 print(
                     "probe failing deterministically; degrading to cpu now",
                     file=sys.stderr,
                 )
                 break
         elapsed = time.monotonic() - start
-        if total_cap > 0 and elapsed + delay + probe_timeout_s > total_cap:
+        if total_cap > 0 and elapsed + delay >= total_cap:
             # per-invocation wall-clock ceiling, regardless of how
             # generous the caller's deadline budget is — probing cannot
-            # eat a capture stage's whole timeout window
+            # eat a capture stage's whole timeout window. (A shorter
+            # remainder still runs one last CLAMPED attempt via the
+            # top-of-loop short-circuit.)
+            _flush_suppressed()
             print(
                 f"probe wall-clock cap ({total_cap:.0f}s) reached after "
                 f"{attempt} attempts; degrading to cpu now",
@@ -244,6 +288,7 @@ def resolve_platform(
             time.sleep(delay)
             delay = min(delay * 2.0, 60.0)
 
+    _flush_suppressed()  # idempotent; covers the deadline/retry exits too
     jax.config.update("jax_platforms", "cpu")
     _resolved = (jax.default_backend(), str(last_err))
     _write_cached_verdict(*_resolved)

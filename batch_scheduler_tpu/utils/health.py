@@ -1,0 +1,356 @@
+"""Live SLO health model: rolling-window quantile verdicts over the
+existing phase histograms, plus the sampled in-production identity audit.
+
+The trace pipeline and the metrics endpoint expose *numbers*; an operator
+(or the driver's watcher) still has to know which numbers mean trouble.
+This module is the continuously-evaluated answer: a small catalog of SLO
+signals — snapshot pack, oracle batch, sidecar device time, end-to-end
+scheduling cycle — each judged ``ok | warn | breach`` against a p95 target
+over a rolling window, with the structural failure states (degraded
+conservative fallback, open circuit breaker, identity-audit mismatch)
+folded into the same verdict. Served as JSON at ``/debug/health`` on the
+metrics endpoint (utils.metrics); every transition INTO breach increments
+``bst_slo_breach_total{signal}`` so alerting needs no client-side state.
+
+Signal catalog (docs/observability.md "SLO health"):
+
+====================  ================================  ==============
+signal                source metric                     default p95
+====================  ================================  ==============
+pack                  bst_oracle_pack_seconds           1.0 s
+batch                 bst_oracle_batch_seconds          45 s (compiles)
+device                bst_oracle_device_seconds         45 s
+cycle                 bst_schedule_cycle_seconds        2.5 s
+degraded  (state)     bst_oracle_degraded               breach while 1
+breaker   (state)     bst_oracle_breaker_state          breach on open
+identity  (state)     bst_identity_audit_total          breach sticky
+====================  ================================  ==============
+
+Targets override via ``BST_SLO_<SIGNAL>_P95_S`` (read at evaluate time, so
+a CI gate can tighten them mid-run); warn fires at 80% of the target;
+``BST_SLO_WINDOW_S`` sizes the rolling window (default 300 s). A signal
+with zero observations in the window reports ``ok`` with
+``observations: 0`` — absence of traffic is not a breach.
+
+The **identity audit** closes the bit-identity gap docs/pipelining.md
+documents as CI-only: every Kth non-speculative published batch is
+re-executed on the CPU fallback rung (serial scan — the rung that is
+always believed) from its exact packed inputs on a daemon thread, and the
+resulting plan digest is compared with the served one. A mismatch is the
+strongest possible evidence of a wrong plan in production: it breaches
+health, increments ``bst_identity_audit_total{outcome="mismatch"}``, and
+flags the audit ring (utils.audit) with an ``identity_mismatch`` event
+carrying both digests.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from .metrics import DEFAULT_REGISTRY, LONG_OP_BUCKETS, Registry
+
+__all__ = ["HealthModel", "IdentityAuditor", "DEFAULT_HEALTH", "worst"]
+
+# (signal, metric, default p95 target seconds, bucket preset or None for
+# the registry default). The bucket preset MUST match what the metric's
+# observation site registers with (Registry.histogram ignores ``buckets``
+# for an existing metric): if health evaluated first and created
+# batch/device with the default 10s-ceiling buckets, every cold-compile
+# observation would clamp at 10s and the 45s breach target could never
+# fire. Defaults are sized so a healthy run — including a cold XLA
+# compile riding into batch/device — stays ok; operators and CI gates
+# tighten per deployment via env.
+QUANTILE_SIGNALS = (
+    ("pack", "bst_oracle_pack_seconds", 1.0, None),
+    ("batch", "bst_oracle_batch_seconds", 45.0, LONG_OP_BUCKETS),
+    ("device", "bst_oracle_device_seconds", 45.0, LONG_OP_BUCKETS),
+    ("cycle", "bst_schedule_cycle_seconds", 2.5, None),
+)
+
+WARN_FRACTION = 0.8
+_VERDICT_RANK = {"ok": 0, "warn": 1, "breach": 2}
+
+
+def worst(verdicts) -> str:
+    out = "ok"
+    for v in verdicts:
+        if _VERDICT_RANK.get(v, 0) > _VERDICT_RANK[out]:
+            out = v
+    return out
+
+
+def _target(signal: str, default: float) -> float:
+    raw = os.environ.get(f"BST_SLO_{signal.upper()}_P95_S", "")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return default
+
+
+class HealthModel:
+    """Continuously-evaluable SLO verdict over the process registry.
+
+    ``evaluate()`` is cheap (histogram snapshots + arithmetic) and safe to
+    call per scrape; it is what ``/debug/health`` serves. State kept here
+    is only the rolling-window snapshot baselines and the last verdict per
+    signal (for breach-transition counting) — the measurements themselves
+    live in the metrics registry, so one model can be reset (a CI gate
+    scoping a phase) without losing Prometheus history."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self._reg = registry or DEFAULT_REGISTRY
+        self._lock = threading.Lock()
+        self._snaps: Dict[str, deque] = {
+            name: deque() for name, _, _, _ in QUANTILE_SIGNALS
+        }
+        self._last_verdict: Dict[str, str] = {}
+        self._identity_mismatch: Optional[dict] = None
+        self._breaches = self._reg.counter(
+            "bst_slo_breach_total",
+            "SLO signal transitions into breach, by signal "
+            "(docs/observability.md health catalog)",
+        )
+
+    @property
+    def window_s(self) -> float:
+        try:
+            return float(os.environ.get("BST_SLO_WINDOW_S", "300"))
+        except ValueError:
+            return 300.0
+
+    # -- inputs from elsewhere ----------------------------------------------
+
+    def note_identity(self, ok: bool, **detail) -> None:
+        """Identity-audit outcome (IdentityAuditor). A mismatch is sticky
+        until reset(): a once-wrong plan is evidence, not a blip."""
+        if not ok:
+            with self._lock:
+                self._identity_mismatch = {"ts": time.time(), **detail}
+
+    def reset(self) -> None:
+        """Re-baseline every rolling window at NOW and clear sticky state —
+        scoping the next evaluations to observations from here on (CI
+        gates separating a clean phase from a chaos phase)."""
+        now = time.time()
+        with self._lock:
+            for name, metric, _, buckets in QUANTILE_SIGNALS:
+                hist = self._hist(metric, buckets)
+                self._snaps[name].clear()
+                self._snaps[name].append((now, hist.snapshot()))
+            self._last_verdict.clear()
+            self._identity_mismatch = None
+
+    # -- evaluation ----------------------------------------------------------
+
+    def _hist(self, metric: str, buckets):
+        """The signal's histogram, created with the SAME bucket preset its
+        observation site uses if health happens to touch it first."""
+        if buckets is not None:
+            return self._reg.histogram(metric, buckets=buckets)
+        return self._reg.histogram(metric)
+
+    def _note_transition(self, name: str, verdict: str) -> None:
+        if verdict == "breach" and self._last_verdict.get(name) != "breach":
+            self._breaches.inc(signal=name)
+        self._last_verdict[name] = verdict
+
+    def evaluate(self) -> dict:
+        now = time.time()
+        window = self.window_s
+        signals: Dict[str, dict] = {}
+        with self._lock:
+            for name, metric, default, buckets in QUANTILE_SIGNALS:
+                hist = self._hist(metric, buckets)
+                snaps = self._snaps[name]
+                while len(snaps) > 1 and now - snaps[0][0] > window:
+                    snaps.popleft()
+                current = hist.snapshot()
+                if not snaps:
+                    # first touch of this signal: seed the window baseline
+                    # at NOW. Evaluating against since=None would scope
+                    # the "rolling window" to the whole process history —
+                    # one cold-compile observation hours ago would breach
+                    # a first scrape that the documented window excludes.
+                    snaps.append((now, current))
+                base = snaps[0][1]
+                observations = current[2] - (base[2] if base else 0)
+                target = _target(name, default)
+                p95 = (
+                    hist.quantile(0.95, since=base) if observations else 0.0
+                )
+                if observations <= 0:
+                    verdict = "ok"
+                elif p95 > target:
+                    verdict = "breach"
+                elif p95 > WARN_FRACTION * target:
+                    verdict = "warn"
+                else:
+                    verdict = "ok"
+                self._note_transition(name, verdict)
+                signals[name] = {
+                    "kind": "quantile",
+                    "metric": metric,
+                    "p95_s": round(p95, 6),
+                    "target_p95_s": target,
+                    "observations": observations,
+                    "verdict": verdict,
+                }
+                snaps.append((now, current))
+
+            # -- structural states ------------------------------------------
+            degraded = self._reg.gauge("bst_oracle_degraded").value()
+            verdict = "breach" if degraded else "ok"
+            self._note_transition("degraded", verdict)
+            signals["degraded"] = {
+                "kind": "state",
+                "value": degraded,
+                "verdict": verdict,
+                "reason": "serving the conservative CPU fallback batch"
+                if degraded else "",
+            }
+
+            breaker_states = self._reg.gauge(
+                "bst_oracle_breaker_state"
+            ).values()
+            open_clients = sorted(
+                dict(k).get("client", "?")
+                for k, v in breaker_states.items() if v == 1
+            )
+            half_open = any(v == 2 for v in breaker_states.values())
+            verdict = (
+                "breach" if open_clients else "warn" if half_open else "ok"
+            )
+            self._note_transition("breaker", verdict)
+            signals["breaker"] = {
+                "kind": "state",
+                "open_clients": open_clients,
+                "verdict": verdict,
+                "reason": (
+                    f"circuit open: {', '.join(open_clients)}"
+                    if open_clients
+                    else "half-open probe pending" if half_open else ""
+                ),
+            }
+
+            mismatch = self._identity_mismatch
+            verdict = "breach" if mismatch else "ok"
+            self._note_transition("identity", verdict)
+            signals["identity"] = {
+                "kind": "state",
+                "verdict": verdict,
+                "mismatch": mismatch,
+                "reason": "served plan diverged from its CPU-rung replay"
+                if mismatch else "",
+            }
+
+        return {
+            "verdict": worst(s["verdict"] for s in signals.values()),
+            "ts": now,
+            "window_s": window,
+            "signals": signals,
+        }
+
+
+DEFAULT_HEALTH = HealthModel()
+
+
+class IdentityAuditor:
+    """Sampled in-production plan verification: every ``every``-th batch it
+    is shown (OracleScorer._audit_publish — non-speculative, non-degraded
+    published batches only) is re-executed on the CPU fallback rung from
+    its exact packed inputs, on a daemon thread, and the plan digest is
+    bit-compared with the served one. At most one verification is in
+    flight — under a slow rung the audit degrades to sampling less often,
+    never to queueing device work."""
+
+    def __init__(self, every: int, rung: str = "cpu-ladder",
+                 registry: Optional[Registry] = None):
+        self.every = max(1, int(every))
+        self.rung = rung
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._count = 0
+        self.audits = 0
+        self.mismatches = 0
+        self.errors = 0
+        self._counter = (registry or DEFAULT_REGISTRY).counter(
+            "bst_identity_audit_total",
+            "Sampled production identity audits by outcome (a served "
+            "plan re-verified against its digest on the CPU fallback rung)",
+        )
+
+    def note_batch(self, batch_args, progress_args, plan_digest: str,
+                   audit_id: Optional[str], audit_log=None) -> None:
+        """Hot-path entry: counts the batch and, on the Kth, hands the
+        (immutable, published) arrays to the verification thread."""
+        with self._lock:
+            self._count += 1
+            if self._count % self.every:
+                return
+            if self._thread is not None and self._thread.is_alive():
+                return  # one in flight; skip this sample
+            t = threading.Thread(
+                target=self._verify,
+                args=(batch_args, progress_args, plan_digest, audit_id,
+                      audit_log),
+                name="identity-audit",
+                daemon=True,
+            )
+            self._thread = t
+        t.start()
+
+    def _verify(self, batch_args, progress_args, plan_digest, audit_id,
+                audit_log) -> None:
+        try:
+            from ..core.oracle_scorer import replay_batch
+            from . import audit as audit_mod
+
+            host, _ = replay_batch(
+                batch_args, progress_args, against=self.rung
+            )
+            got = audit_mod.plan_digest(host)
+        except Exception:  # noqa: BLE001 — an audit error is not a mismatch
+            self.errors += 1
+            self._counter.inc(outcome="error")
+            return
+        self.audits += 1
+        if got == plan_digest:
+            self._counter.inc(outcome="ok")
+            return
+        self.mismatches += 1
+        self._counter.inc(outcome="mismatch")
+        detail = {
+            "audit_id": audit_id,
+            "expected": plan_digest,
+            "got": got,
+            "rung": self.rung,
+        }
+        DEFAULT_HEALTH.note_identity(False, **detail)
+        if audit_log is not None:
+            try:
+                audit_log.record_event("identity_mismatch", **detail)
+            except Exception:  # noqa: BLE001 — evidence best-effort
+                pass
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Wait out an in-flight verification (XLA on a daemon thread —
+        same teardown rule as OracleScorer.drain_background)."""
+        with self._lock:
+            t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+            return not t.is_alive()
+        return True
+
+    def stats(self) -> dict:
+        return {
+            "identity_audits": self.audits,
+            "identity_mismatches": self.mismatches,
+            "identity_errors": self.errors,
+        }

@@ -10,8 +10,10 @@ Prometheus text format over a tiny HTTP endpoint.
 Usage: components take a ``Registry`` (default: the process-wide
 ``DEFAULT_REGISTRY``); ``serve_metrics(registry)`` exposes ``/metrics`` and
 ``/healthz``, plus the trace/explain surfaces ``/debug/trace`` (the span
-ring as Chrome-trace JSON, utils.trace) and ``/debug/decisions`` (the gang
-decision flight recorder) — docs/observability.md has the catalog.
+ring as Chrome-trace JSON, utils.trace), ``/debug/decisions`` (the gang
+decision flight recorder), ``/debug/health`` (the live SLO health model,
+utils.health), and ``/debug/buckets`` (per-bucket compiled HLO cost
+telemetry, ops.oracle) — docs/observability.md has the catalog.
 """
 
 from __future__ import annotations
@@ -94,6 +96,12 @@ class Gauge:
     def value(self, **labels: str) -> float:
         with self._lock:
             return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def values(self) -> Dict[Tuple[Tuple[str, str], ...], float]:
+        """Every labeled series — the health model folds per-client
+        breaker states without knowing the label values up front."""
+        with self._lock:
+            return dict(self._values)
 
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
@@ -265,6 +273,29 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             q = parse_qs(urlparse(self.path).query)
             gang = (q.get("gang") or [None])[0]
             body = trace_mod.DEFAULT_FLIGHT_RECORDER.to_json(gang)
+            ctype = "application/json"
+        elif path == "/debug/health":
+            # the live SLO health model (utils.health): per-signal
+            # ok/warn/breach verdicts over the rolling window, degraded/
+            # breaker/identity state folded in — evaluated per request
+            import json
+
+            from . import health as health_mod
+
+            body = json.dumps(
+                health_mod.DEFAULT_HEALTH.evaluate(), default=str
+            ).encode()
+            ctype = "application/json"
+        elif path == "/debug/buckets":
+            # per-bucket compiled HLO cost/memory telemetry
+            # (ops.oracle.bucket_cost_report): flops, bytes, collective
+            # counts per (G, N) bucket shape — why the compile warmer
+            # warms what it warms
+            import json
+
+            from ..ops.oracle import bucket_cost_report
+
+            body = json.dumps(bucket_cost_report(), default=str).encode()
             ctype = "application/json"
         else:
             self.send_response(404)

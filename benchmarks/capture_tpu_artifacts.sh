@@ -96,6 +96,35 @@ else
     echo "trace capture failed"; fail=1
 fi
 
+echo "== batch audit log + TPU->CPU replay (divergence reporting on hardware) =="
+# records a short TPU sim into an audit ring, then replays every batch on
+# the CPU fallback rung: bit-identity here is the cross-backend
+# determinism claim proven on real recorded inputs, and a divergence is
+# exactly the structured blame report the replay subsystem exists to
+# produce — either way AUDIT_${TAG}.json is the evidence
+# (docs/observability.md "Audit log & replay")
+AUDIT_DIR="/tmp/bst-audit-${TAG}"
+rm -rf "$AUDIT_DIR"
+if timeout 900 python -m batch_scheduler_tpu sim --scenario synthetic \
+        --nodes 16 --groups 8 --members 4 --audit-dir "$AUDIT_DIR" \
+        --identity-audit-every 2 --timeout 120 \
+        > /tmp/audit_sim.out 2>&1; then
+    timeout 900 python -m batch_scheduler_tpu replay "$AUDIT_DIR" \
+        --against cpu-ladder --json "AUDIT_${TAG}.json" \
+        > /tmp/audit_replay.out 2>&1
+    replay_rc=$?
+    if [ "$replay_rc" -eq 0 ]; then
+        echo "audit replay captured (bit-identical TPU->CPU): AUDIT_${TAG}.json"
+    elif [ -f "AUDIT_${TAG}.json" ]; then
+        echo "audit replay DIVERGED — blame report kept: AUDIT_${TAG}.json"
+        tail -2 /tmp/audit_replay.out
+    else
+        echo "audit replay failed:"; tail -3 /tmp/audit_replay.out; fail=1
+    fi
+else
+    echo "audit-recorded sim failed:"; tail -3 /tmp/audit_sim.out; fail=1
+fi
+
 echo "== scale headroom probe =="
 timeout 1200 python benchmarks/scale_probe.py > "SCALE_${TAG}.json" 2>/dev/null \
     || { echo "scale probe failed"; rm -f "SCALE_${TAG}.json"; fail=1; }

@@ -1,0 +1,314 @@
+"""Audit / replay / SLO-health CI gate (the ``make replay-gate`` target).
+
+Proves the black-box flight data subsystem end-to-end on CPU
+(docs/observability.md):
+
+1. **Record + replay**: a short sim with an audit ring records every
+   published oracle batch; replaying ALL of them (steady rung) is
+   bit-identical, the CPU-ladder rung agrees, and the in-production
+   identity audit reports zero mismatches.
+2. **Divergence blame**: a deliberately tampered record produces a
+   structured blame report (field, first differing gang by name, config
+   fingerprints) — never a crash.
+3. **Health flip**: ``/debug/health`` reports ``ok`` on the clean run,
+   then flips to ``breach`` when the chaos proxy injects response latency
+   into a sidecar-backed run under a tightened batch SLO target, with the
+   matching ``bst_slo_breach_total{signal="batch"}`` increment.
+4. **Overhead**: audit recording (digest + enqueue; serialization is on
+   the daemon writer) costs <= 5% of the steady-batch wall-clock.
+
+Run from the repo root: ``JAX_PLATFORMS=cpu python benchmarks/replay_gate.py``
+— one JSON summary line; exit 1 on any failed acceptance.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("BST_BUCKET_COST", "0")  # no background compiles in CI
+
+FAILURES: list = []
+
+
+def check(ok: bool, label: str, **detail) -> bool:
+    if not ok:
+        FAILURES.append({"check": label, **detail})
+        print(f"FAIL: {label} {detail}", file=sys.stderr)
+    return ok
+
+
+def _http_json(port: int, path: str) -> dict:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as r:
+        return json.loads(r.read().decode())
+
+
+def phase_record_replay(audit_dir: str) -> dict:
+    from batch_scheduler_tpu.core.oracle_scorer import replay_audit_record
+    from batch_scheduler_tpu.sim import (
+        SimCluster,
+        make_member_pods,
+        make_sim_group,
+        make_sim_node,
+    )
+    from batch_scheduler_tpu.utils.audit import AuditLog, AuditReader
+
+    log = AuditLog(audit_dir)
+    cluster = SimCluster(audit_log=log, identity_audit_every=2)
+    try:
+        cluster.add_nodes(
+            [make_sim_node(f"n{i}", {"cpu": "8", "pods": "64"}) for i in range(6)]
+        )
+        for g in range(3):
+            cluster.create_group(make_sim_group(f"gate-{g}", 3))
+        cluster.start()
+        for g in range(3):
+            cluster.create_pods(make_member_pods(f"gate-{g}", 3, {"cpu": "1"}))
+        for g in range(3):
+            check(
+                cluster.wait_for_bound(f"gate-{g}", 3, timeout=90.0),
+                "gang bound", gang=f"gate-{g}",
+            )
+    finally:
+        cluster.stop()
+    oracle = cluster.runtime.operation.oracle
+    oracle.drain_background()
+    check(log.flush(), "audit flush")
+    batches, skipped = AuditReader(audit_dir).batches()
+    check(len(batches) >= 3, "enough audit records", records=len(batches))
+    check(not skipped, "no unreconstructable records", skipped=len(skipped))
+
+    identical = 0
+    for rec in batches:
+        rep = replay_audit_record(rec, against="steady")
+        if not check(rep["identical"], "steady replay bit-identical",
+                     seq=rec.get("seq"), report=rep.get("blame")):
+            continue
+        identical += 1
+    cross = replay_audit_record(batches[-1], against="cpu-ladder")
+    check(cross["identical"], "cpu-ladder replay bit-identical",
+          report=cross.get("blame"))
+
+    # tampered record => structured blame, not a crash
+    import copy
+
+    tampered = copy.deepcopy(batches[0])
+    tampered["result_arrays"]["placed"] = 1 - tampered["result_arrays"]["placed"]
+    tampered["plan_digest"] = "0" * 64
+    rep = replay_audit_record(tampered, against="steady")
+    blame = rep.get("blame") or {}
+    check(
+        not rep["identical"]
+        and blame.get("field") == "placed"
+        and "gang" in blame
+        and "replay_config" in blame,
+        "tampered record produces structured blame", blame=blame,
+    )
+
+    stats = oracle.stats()
+    check(stats.get("identity_mismatches", 0) == 0,
+          "identity audit clean", stats=stats)
+    log.stop()
+    return {
+        "records": len(batches),
+        "replayed_identical": identical,
+        "identity_audits": stats.get("identity_audits", 0),
+        "blame_fields": sorted(blame),
+    }
+
+
+def phase_health_flip() -> dict:
+    from batch_scheduler_tpu.service.client import (
+        RemoteScorer,
+        ResilientOracleClient,
+    )
+    from batch_scheduler_tpu.service.server import serve_background
+    from batch_scheduler_tpu.sim import (
+        SimCluster,
+        make_member_pods,
+        make_sim_group,
+        make_sim_node,
+    )
+    from batch_scheduler_tpu.sim.chaos import ChaosProxy
+    from batch_scheduler_tpu.utils.health import DEFAULT_HEALTH
+    from batch_scheduler_tpu.utils.metrics import DEFAULT_REGISTRY, serve_metrics
+
+    metrics_srv = serve_metrics(port=0)
+    port = metrics_srv.server_address[1]
+
+    # clean window: only observations from here on count
+    DEFAULT_HEALTH.reset()
+    clean = _http_json(port, "/debug/health")
+    check(clean["verdict"] == "ok", "clean health ok", health=clean)
+
+    breach_before = DEFAULT_REGISTRY.counter("bst_slo_breach_total").value(
+        signal="batch"
+    )
+
+    srv = serve_background()
+    proxy = ChaosProxy(*srv.address)
+    # every response frame arrives 0.6s late: a congested link, exactly
+    # the latency class the batch SLO watches
+    proxy.set_fault("delay", probability=1.0, delay_s=0.6)
+    client = ResilientOracleClient(*proxy.address, name="replay-gate")
+    scorer = RemoteScorer(client)
+    cluster = SimCluster(scorer=scorer)
+    os.environ["BST_SLO_BATCH_P95_S"] = "0.2"
+    try:
+        cluster.add_nodes(
+            [make_sim_node(f"c{i}", {"cpu": "8", "pods": "64"}) for i in range(4)]
+        )
+        cluster.create_group(make_sim_group("chaosed", 3))
+        cluster.start()
+        cluster.create_pods(make_member_pods("chaosed", 3, {"cpu": "1"}))
+        check(
+            cluster.wait_for_bound("chaosed", 3, timeout=120.0),
+            "chaos-delayed gang still binds",
+        )
+        chaos = _http_json(port, "/debug/health")
+        check(chaos["verdict"] == "breach", "chaos health breach",
+              health=chaos)
+        check(
+            chaos["signals"]["batch"]["verdict"] == "breach",
+            "batch signal breaches under injected latency",
+            signal=chaos["signals"]["batch"],
+        )
+        breach_after = DEFAULT_REGISTRY.counter("bst_slo_breach_total").value(
+            signal="batch"
+        )
+        check(breach_after >= breach_before + 1,
+              "bst_slo_breach_total incremented",
+              before=breach_before, after=breach_after)
+        out = {
+            "clean_verdict": clean["verdict"],
+            "chaos_verdict": chaos["verdict"],
+            "chaos_batch_p95_s": chaos["signals"]["batch"]["p95_s"],
+            "breach_increment": breach_after - breach_before,
+            "faults_injected": dict(proxy.injected),
+        }
+    finally:
+        del os.environ["BST_SLO_BATCH_P95_S"]
+        cluster.stop()
+        scorer.close()
+        proxy.stop()
+        srv.shutdown()
+        srv.server_close()
+        metrics_srv.shutdown()
+        DEFAULT_HEALTH.reset()
+    return out
+
+
+def phase_overhead(audit_dir: str) -> dict:
+    """Median steady-batch wall-clock with vs without audit recording.
+    The hot-path cost is one plan digest + one bounded-queue enqueue; the
+    writer thread owns serialization/disk, so <= 5% (or <= 2ms absolute —
+    timing noise floor at CI batch sizes) is the acceptance."""
+    from batch_scheduler_tpu.ops.oracle import execute_batch_host
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot, GroupDemand
+    from batch_scheduler_tpu.sim.scenarios import make_sim_node
+    from batch_scheduler_tpu.utils import audit as audit_mod
+    from batch_scheduler_tpu.utils.audit import AuditLog
+
+    # big enough that the batch is device-dominated (the steady-batch
+    # regime the 5% acceptance is written against); a toy shape would
+    # measure GIL contention with the writer thread, not the hot path
+    nodes = [
+        make_sim_node(f"b{i:04d}", {"cpu": "64", "memory": "256Gi", "pods": "110"})
+        for i in range(1024)
+    ]
+    groups = [
+        GroupDemand(f"default/bg-{g}", 8,
+                    member_request={"cpu": 4000, "memory": 8 * 1024**3},
+                    creation_ts=float(g))
+        for g in range(128)
+    ]
+    snap = ClusterSnapshot(nodes, {}, groups)
+    args, progress = snap.device_args(), snap.progress_args()
+    execute_batch_host(args, progress)  # compile outside the clock
+
+    log = AuditLog(audit_dir, queue_max=256)
+    # prime one record so the timed audited iterations are the steady
+    # state (delta records with ~no churned rows), not the keyframe
+    host0, _ = execute_batch_host(args, progress)
+    log.record_batch(
+        batch_args=args, progress_args=progress, result=host0,
+        plan_digest=audit_mod.plan_digest(host0),
+        node_names=snap.node_names, group_names=snap.group_names,
+    )
+
+    # The serving-path cost of auditing is exactly two things: the plan
+    # digest and the bounded-queue enqueue (serialization + disk live on
+    # the daemon writer, overlapping device compute, which releases the
+    # GIL). Measure that hot-path cost DIRECTLY against the steady batch:
+    # an A/B difference of two ~50ms batch medians is noise an order of
+    # magnitude above the µs-scale signal on a shared CI box (observed
+    # -24%..+17% run to run), while the direct ratio is well-conditioned.
+    bare_times = []
+    for _ in range(9):
+        t0 = time.perf_counter()
+        execute_batch_host(args, progress)
+        bare_times.append(time.perf_counter() - t0)
+    bare = float(np.median(bare_times))
+
+    host, _ = execute_batch_host(args, progress)
+    audit_times = []
+    for i in range(50):
+        if i % 16 == 0:
+            log.flush(10.0)  # untimed: keep the bounded queue drained
+        t0 = time.perf_counter()
+        log.record_batch(
+            batch_args=args, progress_args=progress, result=host,
+            plan_digest=audit_mod.plan_digest(host),
+            node_names=snap.node_names, group_names=snap.group_names,
+        )
+        audit_times.append(time.perf_counter() - t0)
+    hot_path = float(np.median(audit_times))
+    check(log.flush() and log.records_dropped == 0, "overhead run recorded",
+          dropped=log.records_dropped)
+    log.stop()
+    overhead = hot_path / max(bare, 1e-9)
+    check(
+        # the 2ms absolute floor keeps a very fast host (tiny bare batch)
+        # from failing the ratio on a hot path that is microseconds
+        overhead <= 0.05 or hot_path <= 0.002,
+        "audit overhead <= 5%",
+        steady_batch_s=round(bare, 5),
+        audit_hot_path_s=round(hot_path, 6),
+        overhead_pct=round(overhead * 100, 2),
+    )
+    return {
+        "steady_batch_s": round(bare, 5),
+        "audit_hot_path_s": round(hot_path, 6),
+        "audit_overhead_pct": round(overhead * 100, 2),
+    }
+
+
+def main() -> int:
+    base = tempfile.mkdtemp(prefix="bst-replay-gate-")
+    try:
+        summary = {"ok": True}
+        summary.update(phase_record_replay(os.path.join(base, "ring")))
+        summary.update(phase_health_flip())
+        summary.update(phase_overhead(os.path.join(base, "overhead-ring")))
+        if FAILURES:
+            summary["ok"] = False
+            summary["failures"] = FAILURES
+        print(json.dumps(summary, default=str))
+        return 0 if summary["ok"] else 1
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

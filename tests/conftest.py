@@ -14,6 +14,11 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The per-bucket compiled-cost analysis (ops.oracle._maybe_analyze_bucket)
+# re-lowers every freshly-built blob signature on a daemon thread — pure
+# background compile load across a suite that builds hundreds of tiny
+# shapes. Tests that exercise it re-enable via monkeypatch.
+os.environ.setdefault("BST_BUCKET_COST", "0")
 
 import jax  # noqa: E402
 
