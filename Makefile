@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all build vet test test-cpu bench bench-scan bench-pipeline native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
+.PHONY: all build vet test test-cpu bench bench-scan bench-pipeline bench-sharding native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
 
 all: vet native test
 
@@ -89,10 +89,17 @@ tpu-artifacts:
 tpu-refresh:
 	bash benchmarks/capture_tpu_refresh_r05.sh
 
-# GSPMD layout measurement on the 8-device virtual CPU mesh (collective
-# counts per layout; see README "Measured layout choice")
-sharding:
+# sharded-scan scaling measurement (the SHARDING artifact): the
+# node-sharded wavefront merge vs the replicated/partitioned layouts —
+# wall-clock sweep over device counts, per-wave collective budget, and
+# the winning (N, devices) point; fails if the partitioned scan cannot
+# beat single-device on the virtual CPU mesh (the r05 regression).
+# BST_SHARDING_PLATFORM=default runs on the real backend (TPU capture).
+bench-sharding:
 	$(PY) benchmarks/sharding_scaling.py
+
+# back-compat alias (pre-r06 name)
+sharding: bench-sharding
 
 # the reference's serial hot loop in C++ — bench.py's vs_baseline denominator
 serial-baseline:

@@ -59,6 +59,14 @@ __all__ = [
 #               (utils.health.IdentityAuditor) re-verifies against, and
 #               the cross-backend divergence probe for TPU-recorded
 #               audit logs.
+#
+# The node-sharded mesh rung (ops.oracle.assign_gangs_sharded) is
+# deliberately NOT a replay rung: replays run single-process and a rung
+# pin must never depend on mesh availability. Batches recorded on the
+# sharded path are instead verified by CROSS-rung identity — their audit
+# records replay bit-identically on cpu-ladder (gated by
+# benchmarks/replay_gate.py), which is exactly the claim that matters:
+# the sharded merge computes the same plan the serial scan would.
 REPLAY_RUNGS = ("steady", "wavefront", "cpu-ladder")
 
 
@@ -558,6 +566,24 @@ class OracleScorer:
         # (same contract as record_remote_spans: malformed peer data
         # never breaks the caller).
         telemetry = host.get("telemetry") if isinstance(host, dict) else None
+        waves = (
+            telemetry.get("waves_per_batch")
+            if isinstance(telemetry, dict)
+            else None
+        )
+        if (
+            isinstance(waves, (int, float))
+            and not isinstance(waves, bool)
+            and waves > 0
+            and "per_wave_device_seconds" not in telemetry
+        ):
+            # per-wave merge cost for the flight recorder: on the sharded
+            # rung this is the summary all-gather + verify-reduce cadence
+            # (the remote path computes the same field sidecar-side from
+            # its own device clock and it arrives via TRACE_INFO)
+            telemetry["per_wave_device_seconds"] = round(
+                batch_s / waves, 6
+            )
         if self._warmer is not None:
             try:
                 # donate matches what _execute dispatched with, so the

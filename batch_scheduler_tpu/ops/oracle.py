@@ -48,6 +48,8 @@ __all__ = [
     "score_nodes",
     "assign_gangs",
     "assign_gangs_wavefront",
+    "assign_gangs_sharded",
+    "scan_sharded_active",
     "schedule_batch",
     "execute_batch_host",
     "dispatch_batch",
@@ -162,8 +164,11 @@ def _select_best_fit(cap, capc, need):
     """Tightest-first take vector for one gang: the histogram threshold
     selection documented in assign_gangs. Shapes are [1, N] (2-D so the iota
     lowers on TPU inside pallas kernels too); returns (take[1,N], feasible).
-    THE single definition of the selection — shared by the lax.scan path and
-    the fused pallas kernel (ops.pallas_assign)."""
+    Shared by the lax.scan path and the fused pallas kernel
+    (ops.pallas_assign). The node-sharded rung re-derives these exact
+    threshold/remainder formulas from summary histograms (``_hist_select``
+    and the sharded mega path below) — its bit-identity guarantee holds
+    only while the formulas match, so change all of them together."""
     feasible = jnp.sum(capc) >= need
     key = jnp.minimum(cap, _BINS - 1)  # tightness bucket (0 = no fit)
     bins = jax.lax.broadcasted_iota(jnp.int32, (_BINS, 1), 0)
@@ -584,6 +589,350 @@ def assign_gangs_wavefront(left0, group_req, remaining, fit_mask, order,
     return alloc, placed_full, left
 
 
+def _shard_axes(mesh) -> tuple:
+    """All of a mesh's axis names, major-to-minor — the flattened shard
+    axis the node-sharded scan runs over. The 2-D ("groups", "nodes") grid
+    exists for the O(G·N·R) scoring; the scan has no group parallelism to
+    spend, so it splits the NODE axis over every device."""
+    return tuple(mesh.axis_names)
+
+
+def _hist_select(bin_tot, shard_off, key_l, capc_l, need):
+    """Per-shard take vector from GLOBAL tightness histograms: the
+    ``_select_best_fit`` selection recomputed from summary data.
+
+    ``bin_tot[W, _BINS]`` is the global per-bucket capacity histogram (the
+    psum of every shard's local histogram), ``shard_off[W, _BINS]`` this
+    shard's exclusive prefix within each bucket (sum of EARLIER shards'
+    local histograms — global node order is shard-major, so bucket-internal
+    node-index order decomposes into (earlier shards' total, local
+    prefix)), ``key_l``/``capc_l`` ``[W, n_local]`` the local tightness
+    buckets and need-clipped capacities, ``need[W]`` the gang demands.
+
+    Bit-identity with the serial selection: every quantity here is an int32
+    sum over a permutation of the same addends the serial cumsum folds
+    (int32 addition is associative/commutative, wraparound included), and
+    the threshold/remainder formulas are copied verbatim — so
+    ``shard_off + local prefix`` IS the serial ``prefix_t`` restricted to
+    this shard's rows, and the local takes concatenate (in shard order) to
+    exactly the serial take vector. Returns (take_l[W, n_local], feas[W]).
+    """
+    cum = _cumsum(bin_tot, axis=1)  # [W, _BINS] inclusive
+    total = cum[:, _BINS - 1]
+    feas = total >= need
+    thresh = jnp.minimum(
+        jnp.sum((cum < need[:, None]).astype(jnp.int32), axis=1), _BINS - 1
+    )  # [W]
+    tot_at = jnp.take_along_axis(bin_tot, thresh[:, None], axis=1)[:, 0]
+    cum_at = jnp.take_along_axis(cum, thresh[:, None], axis=1)[:, 0]
+    rem_t = need - (cum_at - tot_at)  # members still needed in thresh bucket
+    off = jnp.take_along_axis(shard_off, thresh[:, None], axis=1)  # [W, 1]
+    in_t = key_l == thresh[:, None]
+    capc_t = jnp.where(in_t, capc_l, 0)
+    prefix_l = _cumsum(capc_t, axis=1) - capc_t
+    take_l = jnp.where(
+        key_l < thresh[:, None],
+        capc_l,
+        jnp.where(
+            in_t, jnp.clip(rem_t[:, None] - off - prefix_l, 0, capc_l), 0
+        ),
+    )
+    return take_l * feas.astype(jnp.int32)[:, None], feas
+
+
+def assign_gangs_sharded(left0, group_req, remaining, fit_mask, order, mesh,
+                         wave: int = 8, with_stats: bool = False):
+    """Node-sharded wavefront gang placement: same inputs and outputs as
+    ``assign_gangs_wavefront`` (bit-identical to the serial scan), but the
+    carried ``[N, R]`` leftover stays PARTITIONED over the whole mesh and
+    the per-wave merge moves only O(S·W·_BINS) summary ints.
+
+    The partitioned-scan failure mode this replaces (SHARDING_r05.json) was
+    GSPMD dragging full node state through every step: ~50 collective
+    sites (all-gathers of ``left``, collective-permute chains) inside the
+    G-step loop, 6x slower than one device. Here the collectives are
+    chosen by hand inside a ``shard_map``:
+
+    1. Every shard scores ONLY its contiguous node slice: local member
+       capacities, local tightness histogram ``[W, _BINS]`` (need-clipped
+       capacity per bucket — the complete sufficient statistic for the
+       serial tightest-first selection).
+    2. ONE ``all_gather`` per wave merges the per-shard histograms
+       (``[S, W, _BINS]`` ints — summary data, never node state). Every
+       shard then derives the identical global threshold buckets, and its
+       own within-bucket offset = sum of earlier shards' histograms, so
+       each shard applies exactly its slice of the serial take vector —
+       the "winner applies locally" rule: no leftover ever crosses shards.
+    3. ONE ``psum`` per wave verifies the speculative wave (the exclusive-
+       prefix conflict check of the wavefront scan, evaluated shard-local
+       and reduced as a single bit) or, on the uniform aggregate path, the
+       batched gang-boundary feasibilities. A conflicted wave demotes to a
+       gang-at-a-time replay (W summary all-gathers — still never node
+       state), preserving the wavefront's demotion ladder semantics.
+
+    Tie-breaks stay deterministic on the GLOBAL node index because shards
+    hold contiguous node blocks in mesh-major order and every within-
+    bucket remainder is resolved as (earlier-shard total, local prefix).
+
+    The node axis is padded to a shard multiple with zero rows (zero
+    leftover + zero mask ⇒ zero capacity in every histogram), so uneven
+    node counts shard cleanly and padded rows can never win a member.
+
+    Returns ``(alloc[G,N], placed[G], left[N,R])`` (+ per-wave
+    ``(conflicts, megas)`` stats when ``with_stats``), exactly like the
+    wavefront scan.
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec
+
+    n, r = left0.shape
+    g = group_req.shape[0]
+    w = max(int(wave), 2)
+    axes = _shard_axes(mesh)
+    s = int(np.prod([mesh.shape[a] for a in axes]))
+    per_group_mask = fit_mask.shape[0] != 1
+    if per_group_mask and fit_mask.shape[0] != g:
+        raise ValueError(
+            f"fit_mask rows {fit_mask.shape[0]} must be 1 or match "
+            f"group count {g}"
+        )
+
+    # -- node-axis shard padding (zero rows: capacity 0 under any mask) --
+    n_pad = -(-n // s) * s
+    left_p = left0
+    mask = fit_mask.astype(jnp.int32)
+    if n_pad != n:
+        left_p = jnp.pad(left_p, ((0, n_pad - n), (0, 0)))
+        mask = jnp.pad(mask, ((0, 0), (0, n_pad - n)))
+
+    # -- gang-axis wave chunking, identical to assign_gangs_wavefront --
+    steps = -(-g // w)
+    g_pad = steps * w
+    gr = jnp.take(group_req, order, axis=0)
+    rem = jnp.take(remaining, order, axis=0)
+    if per_group_mask:
+        mask = jnp.take(mask, order, axis=0)
+    if g_pad != g:
+        gr = jnp.pad(gr, ((0, g_pad - g), (0, 0)))
+        rem = jnp.pad(rem, ((0, g_pad - g),))
+        if per_group_mask:
+            mask = jnp.pad(mask, ((0, g_pad - g), (0, 0)))
+    gr_w = gr.reshape(steps, w, r)
+    rem_w = rem.reshape(steps, w)
+    # Mask uniformity per wave, computed ONCE outside the scan (a global
+    # reduction over sharded mask rows) so the in-scan mega/speculative
+    # branch choice needs no extra collective. Broadcast masks are
+    # uniform by definition.
+    if per_group_mask:
+        mask_w = mask.reshape(steps, w, n_pad)
+        mask_uni = jnp.all(mask_w == mask_w[:, :1], axis=(1, 2))
+    else:
+        mask_w = mask  # [1, n_pad]
+        mask_uni = jnp.ones((steps,), bool)
+    mega_need_max = (2**31 - 1) // max(n_pad, 1)
+
+    def shard_body(left_l, gr_w, rem_w, mask_l, mask_uni):
+        # left_l: [n_pad/S, R] — this shard's contiguous node block.
+        # mask_l: [1, nl] broadcast or [steps, w, nl] per-group slice.
+        sid = jnp.int32(0)
+        for name in axes:
+            sid = sid * mesh.shape[name] + jax.lax.axis_index(name)
+        earlier = (
+            jax.lax.broadcasted_iota(jnp.int32, (s, 1, 1), 0) < sid
+        )  # [S,1,1] — mask selecting shards before this one
+
+        bins3 = jax.lax.broadcasted_iota(jnp.int32, (1, _BINS, 1), 1)
+
+        def local_hist(key_l, capc_l):
+            """[W?, _BINS] need-clipped capacity histogram of the local
+            node slice (W leading axis optional via broadcasting)."""
+            return jnp.sum(
+                jnp.where(key_l[:, None, :] == bins3, capc_l[:, None, :], 0),
+                axis=2,
+            )  # [W?, _BINS]
+
+        def merge(hist_l):
+            """The per-wave summary merge: one all-gather of every
+            shard's histogram; returns (global totals, this shard's
+            exclusive within-bucket offsets)."""
+            hists = jax.lax.all_gather(hist_l, axes)  # [S, W?, _BINS]
+            bin_tot = jnp.sum(hists, axis=0)
+            shard_off = jnp.sum(jnp.where(earlier, hists, 0), axis=0)
+            return bin_tot, shard_off
+
+        def step(left, chunk):
+            if per_group_mask:
+                req_c, need_c, uni_mask, mask_c = chunk  # mask_c: [w, nl]
+            else:
+                req_c, need_c, uni_mask = chunk
+                mask_c = mask_l  # [1, nl] broadcasts over the wave
+            total_need = jnp.sum(need_c)
+            uniform = jnp.all(req_c == req_c[0:1]) & uni_mask
+            mega_ok = uniform & (total_need <= mega_need_max)
+
+            def replay_wave(left):
+                # gang-at-a-time demotion target: exact serial order, one
+                # summary all-gather per gang (never node state)
+                takes, feats = [], []
+                for j in range(w):
+                    row = mask_c[j] if per_group_mask else mask_c[0]
+                    cap_j = (
+                        _member_capacity(left, req_c[j][None, :]) * row
+                    )  # [nl]
+                    capc_j = jnp.minimum(cap_j, need_c[j])
+                    key_j = jnp.minimum(cap_j, _BINS - 1)
+                    bin_tot, shard_off = merge(
+                        local_hist(key_j[None, :], capc_j[None, :])
+                    )
+                    t, f = _hist_select(
+                        bin_tot, shard_off, key_j[None, :], capc_j[None, :],
+                        need_c[j][None],
+                    )
+                    left = left - t[0][:, None] * req_c[j][None, :]
+                    takes.append(t[0])
+                    feats.append(f[0])
+                return (
+                    jnp.stack(takes), jnp.stack(feats), left, jnp.bool_(True)
+                )
+
+            def mega(left):
+                # uniform-wave aggregate: ONE member stream split at gang
+                # boundaries (assign_gangs_wavefront's fast path), with the
+                # stream histogram merged once and boundary feasibility
+                # verified by one psum.
+                req0 = req_c[0]
+                row = mask_c[0]
+                cap0 = _member_capacity(left, req0[None, :]) * row  # [nl]
+                key = jnp.minimum(cap0, _BINS - 1)
+                capc_t = jnp.minimum(cap0, total_need)  # stream units
+                bin_tot, shard_off = merge(
+                    local_hist(key[None, :], capc_t[None, :])
+                )  # [1, _BINS] each
+                cum_incl = _cumsum(bin_tot, axis=1)[0]  # [_BINS]
+                cum_excl = cum_incl - bin_tot[0]
+                bounds = jnp.concatenate(
+                    [jnp.zeros((1,), jnp.int32), jnp.cumsum(need_c)]
+                )  # [W+1]
+                bbkt = jnp.sum(
+                    (cum_incl[None, :] <= bounds[:, None]).astype(jnp.int32),
+                    axis=1,
+                )  # [W+1]; == _BINS past the stream end
+                bmask = key[None, :] == bbkt[:, None]  # [W+1, nl]
+                bvals = jnp.where(bmask, capc_t[None, :], 0)
+                bwithin = _cumsum(bvals, axis=1) - bvals
+                boffs = (
+                    bounds - jnp.take(cum_excl, bbkt, mode="clip")
+                )[:, None]
+                soffs = jnp.take(shard_off[0], bbkt, mode="clip")[:, None]
+                taken = jnp.where(
+                    key[None, :] < bbkt[:, None],
+                    capc_t[None, :],
+                    jnp.where(
+                        bmask,
+                        jnp.clip(boffs - soffs - bwithin, 0, capc_t[None, :]),
+                        0,
+                    ),
+                )  # [W+1, nl] — this shard's slice of the stream prefix
+                feas_part = jnp.sum(
+                    jnp.minimum(cap0[None, :] - taken[:-1], need_c[:, None]),
+                    axis=1,
+                )  # [W] local partial feasibility sums
+                feas = jax.lax.psum(feas_part, axes) >= need_c
+                all_ok = jnp.all(feas)
+
+                def commit(left):
+                    takes_m = taken[1:] - taken[:-1]
+                    left_after = left - taken[-1][:, None] * req0[None, :]
+                    return (
+                        takes_m,
+                        jnp.ones((w,), bool),
+                        left_after,
+                        jnp.bool_(False),
+                    )
+
+                return jax.lax.cond(all_ok, commit, replay_wave, left)
+
+            def speculative(left):
+                # every gang scores the wave-start LOCAL slice as if first
+                cap = (
+                    _member_capacity(left[None, :, :], req_c[:, None, :])
+                    * mask_c
+                )  # [w, nl]
+                capc = jnp.minimum(cap, need_c[:, None])
+                key = jnp.minimum(cap, _BINS - 1)
+                bin_tot, shard_off = merge(local_hist(key, capc))
+                takes_w, feas_w = _hist_select(
+                    bin_tot, shard_off, key, capc, need_c
+                )
+                deltas = takes_w[:, :, None] * req_c[:, None, :]
+                # exclusive-prefix conflict check, shard-local (same clamp
+                # discipline as assign_gangs_wavefront), reduced to one bit
+                acc = left
+                prefixed = []
+                for j in range(w):
+                    prefixed.append(acc)
+                    acc = jnp.maximum(acc - deltas[j], -_BIG)
+                cap_pref = (
+                    _member_capacity(jnp.stack(prefixed), req_c[:, None, :])
+                    * mask_c
+                )
+                conflict_l = jnp.any(cap_pref != cap).astype(jnp.int32)
+                conflict = jax.lax.psum(conflict_l, axes) > 0
+
+                def fast(left):
+                    return takes_w, feas_w, acc, jnp.bool_(False)
+
+                return jax.lax.cond(conflict, replay_wave, fast, left)
+
+            takes_out, feas_out, left, conflict = jax.lax.cond(
+                mega_ok, mega, speculative, left
+            )
+            return left, (takes_out, feas_out, conflict, mega_ok)
+
+        xs = (gr_w, rem_w, mask_uni)
+        if per_group_mask:
+            xs = xs + (mask_l,)
+        left_l, (takes, placed, conflicts, megas) = jax.lax.scan(
+            step, left_l, xs
+        )
+        return left_l, takes, placed, conflicts, megas
+
+    P = PartitionSpec
+    mask_in_spec = (
+        P(None, None, axes) if per_group_mask else P(None, axes)
+    )
+    sharded = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(
+            P(axes, None),            # left: node-blocked over every device
+            P(None, None, None),      # per-wave demand rows (replicated)
+            P(None, None),            # per-wave remaining (replicated)
+            mask_in_spec,             # fit mask: node axis sharded
+            P(None),                  # per-wave mask uniformity (replicated)
+        ),
+        out_specs=(
+            P(axes, None),            # left_after stays node-sharded
+            P(None, None, axes),      # takes: node axis sharded
+            P(None, None),            # placed flags (replicated)
+            P(None),                  # per-wave conflict stats (replicated)
+            P(None),                  # per-wave mega stats (replicated)
+        ),
+        check_rep=False,
+    )
+    left_after, takes, placed, conflicts, megas = sharded(
+        left_p, gr_w, rem_w, mask_w, mask_uni
+    )
+    takes = takes.reshape(g_pad, n_pad)[:g, :n]
+    placed = placed.reshape(g_pad)[:g]
+    alloc = jnp.zeros((g, n), jnp.int32).at[order].set(takes)
+    placed_full = jnp.zeros((g,), bool).at[order].set(placed)
+    left_after = left_after[:n]
+    if with_stats:
+        return alloc, placed_full, left_after, (conflicts, megas)
+    return alloc, placed_full, left_after
+
+
 # Process-wide gate for the wavefront scan (mirrors _pallas_enabled): a
 # compile/runtime failure on the wavefront path disables it for the process
 # and batches fall back to the serial scan. List-wrapped for lock-free
@@ -633,6 +982,50 @@ def _disable_wave(e: Exception) -> None:
     )
 
 
+# Process-wide gate for the node-sharded scan rung (mirrors _wave_enabled):
+# a compile/runtime failure on the sharded merge path demotes mesh batches
+# to the replicated-scan layout for the process, without touching the
+# wave/pallas gates (the rungs are independent features). Same lock-free
+# benign-race contract as the other gates.
+_sharded_enabled = [True]
+
+_SHARD_ENV = "BST_SCAN_SHARDED"
+
+# Wave width the sharded scan runs when BST_SCAN_WAVE is unset: the merge
+# collective count is G/W per batch, so the sharded rung never runs
+# serial-width (W<2 would spend one collective per gang for no batching).
+_SHARD_DEFAULT_WAVE = 8
+
+
+def _scan_sharded_from_env() -> bool:
+    """BST_SCAN_SHARDED: default ON (the sharded merge is bit-identical by
+    construction and mesh batches fall back to the replicated rung on any
+    failure); "0"/"false"/"off" pins mesh batches to the replicated scan."""
+    return os.environ.get(_SHARD_ENV, "").strip().lower() not in (
+        "0", "false", "off", "no",
+    )
+
+
+def _disable_sharded(e: Exception) -> None:
+    _sharded_enabled[0] = False
+    import warnings
+
+    warnings.warn(
+        f"node-sharded assignment scan disabled after failure: {e!r}; "
+        "mesh batches fall back to the replicated-scan layout"
+    )
+
+
+def scan_sharded_active() -> bool:
+    """True when the next mesh batch will take the node-sharded scan rung
+    (env knob + process gate). Input-placement call sites use this to pick
+    the matching layout (``shard_snapshot_args(..., flat_nodes=...)``) —
+    placing node state in the 2-D scoring layout while the scan runs the
+    sharded rung makes GSPMD reshard the [N,R] lanes at the shard_map
+    boundary, exactly the node-state movement the rung exists to avoid."""
+    return _sharded_enabled[0] and _scan_sharded_from_env()
+
+
 # Max distinct nodes one gang's compact assignment can report; a gang of M
 # members spans <= M nodes, so this only truncates gangs wider than 128
 # nodes (the dense `assignment` matrix remains authoritative on device).
@@ -640,12 +1033,15 @@ ASSIGNMENT_TOP_K = 128
 
 
 @partial(
-    jax.jit, static_argnames=("use_pallas", "top_k", "scan_mesh", "scan_wave")
+    jax.jit,
+    static_argnames=(
+        "use_pallas", "top_k", "scan_mesh", "scan_wave", "scan_shard",
+    ),
 )
 def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
                    group_valid, order, use_pallas: bool = False,
                    top_k: int = ASSIGNMENT_TOP_K, scan_mesh=None,
-                   scan_wave: int = 0):
+                   scan_wave: int = 0, scan_shard: bool = False):
     """Fused full-batch oracle: leftover -> capacity -> feasibility -> scores
     -> greedy gang assignment, one XLA computation.
 
@@ -680,7 +1076,7 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
     cap = group_capacity(left, group_req, fit_mask)
     feasible = gang_feasible(cap, remaining, group_valid)
     scores = score_nodes(cap)
-    if scan_mesh is not None:
+    if scan_mesh is not None and not scan_shard:
         # GSPMD layout for multi-chip batches: the O(G*N*R) scoring above
         # runs sharded, but the greedy gang scan is SEQUENTIAL over groups
         # with a carried [N,R] leftover — partitioned inputs drag
@@ -701,7 +1097,18 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
             left, group_req, remaining, fit_mask,
         )
     wave_stats = None
-    if use_pallas:
+    if scan_mesh is not None and scan_shard:
+        # Node-sharded wavefront scan (the partitioned path that finally
+        # wins): each shard scores only its node slice and the per-wave
+        # merge moves [S, W, _BINS] summary ints — never node state. The
+        # replicated layout above stays the fallback rung
+        # (docs/scan_parallelism.md "Sharded merge").
+        assignment, placed, left_after, wave_stats = assign_gangs_sharded(
+            scan_left, scan_gr, scan_rem, scan_fm, order, mesh=scan_mesh,
+            wave=scan_wave if scan_wave > 1 else _SHARD_DEFAULT_WAVE,
+            with_stats=True,
+        )
+    elif use_pallas:
         from .pallas_assign import assign_gangs_pallas
 
         assignment, placed, left_after = assign_gangs_pallas(
@@ -773,7 +1180,7 @@ def _batch_blob_impl(alloc_lanes, requested, group_req, remaining, fit_mask,
                      ineligible, creation_rank, use_pallas: bool = False,
                      pack_assignment: bool = True,
                      top_k: int = ASSIGNMENT_TOP_K, scan_mesh=None,
-                     scan_wave: int = 0):
+                     scan_wave: int = 0, scan_shard: bool = False):
     """One device computation for a whole control-plane batch: the fused
     oracle + findMaxPG, with every O(G) host-needed output concatenated into
     a single int32 blob. On a high-latency host<->device link (the axon
@@ -789,7 +1196,8 @@ def _batch_blob_impl(alloc_lanes, requested, group_req, remaining, fit_mask,
       [3G+2:...]   assignment top-K: packed (node<<16|count), G*K — or, when
                    ``pack_assignment=False``, nodes then counts, 2*G*K
       [tail..]     wavefront scan stats, ONLY when the lax wavefront scan
-                   ran (scan_wave > 1 and not use_pallas): 3 int32 —
+                   ran (scan_wave > 1 and not use_pallas) or the node-
+                   sharded scan did (scan_shard): 3 int32 —
                    waves-per-batch (sequential steps), conflict-demoted
                    waves (serial replays), uniform-fastpath waves. Static
                    per jit signature, so collect_batch slices by the same
@@ -798,7 +1206,7 @@ def _batch_blob_impl(alloc_lanes, requested, group_req, remaining, fit_mask,
     out = schedule_batch(alloc_lanes, requested, group_req, remaining,
                          fit_mask, group_valid, order, use_pallas=use_pallas,
                          top_k=top_k, scan_mesh=scan_mesh,
-                         scan_wave=scan_wave)
+                         scan_wave=scan_wave, scan_shard=scan_shard)
     best, exists, progress = find_max_group(min_member, scheduled, matched,
                                             ineligible, creation_rank)
     if pack_assignment:
@@ -846,7 +1254,7 @@ def _batch_blob_impl(alloc_lanes, requested, group_req, remaining, fit_mask,
 
 
 _BLOB_STATICS = ("use_pallas", "pack_assignment", "top_k", "scan_mesh",
-                 "scan_wave")
+                 "scan_wave", "scan_shard")
 _batch_blob = jax.jit(_batch_blob_impl, static_argnames=_BLOB_STATICS)
 # Donated variant for the double-buffered dispatch-ahead pipeline: the two
 # [N, R] inputs (alloc, requested) are donated so XLA can reuse their
@@ -901,13 +1309,14 @@ class PendingBatch:
     __slots__ = (
         "blob", "out", "pack", "used_pallas", "_rerun", "blob_np",
         "mask_mode", "used_wave", "compiled", "n_bucket", "g_bucket",
-        "pinned",
+        "pinned", "used_shard", "shard_count",
     )
 
     def __init__(
         self, blob, out, pack, used_pallas, rerun, blob_np=None,
         mask_mode="broadcast", used_wave=0, compiled=None,
-        n_bucket=0, g_bucket=0, pinned=False,
+        n_bucket=0, g_bucket=0, pinned=False, used_shard=False,
+        shard_count=0,
     ):
         self.blob = blob
         self.out = out
@@ -930,6 +1339,10 @@ class PendingBatch:
         # dispatched under a forced_scan_rung pin (replay/identity audit):
         # collect-side failures never permanently disable serving features
         self.pinned = pinned
+        # node-sharded scan rung (assign_gangs_sharded) + the mesh's
+        # device count: collect's blame policy and telemetry need both
+        self.used_shard = used_shard
+        self.shard_count = shard_count
 
 
 def dispatch_batch(
@@ -961,10 +1374,16 @@ def dispatch_batch(
     # the process-wide gate so one bad lowering degrades to the serial
     # scan instead of failing every batch.
     scan_wave = _scan_wave_from_env() if _wave_enabled[0] else 0
+    # Node-sharded scan rung: mesh batches only, env + process gate. Runs
+    # at the wavefront width when one is set, else its own default — the
+    # per-wave merge collective is the whole point of the rung.
+    scan_sharded = scan_mesh is not None and scan_sharded_active()
     # replay/identity-audit rung pin (forced_scan_rung): this thread runs
     # the requested rung, with the pallas gates still honored (a pinned
     # pallas rung off-TPU would fail every batch) and the permanent
-    # disable-on-failure policy suppressed below.
+    # disable-on-failure policy suppressed below. Pins name explicit
+    # (pallas, wave) rungs — the sharded rung is never pinned; its
+    # recorded batches are verified by CROSS-rung replay identity.
     forced = getattr(_rung_override, "value", None)
     if forced is not None:
         use_pallas = (
@@ -972,6 +1391,7 @@ def dispatch_batch(
             and jax.default_backend() == "tpu"
         )
         scan_wave = forced[1]
+        scan_sharded = False
     # The packed form saturates per-node counts at 65535; a take can reach
     # the gang's full remaining count on one node, so gate the compact form
     # on the host-side remaining bound and fall back to the exact
@@ -997,11 +1417,11 @@ def dispatch_batch(
     except Exception:  # noqa: BLE001 — telemetry only
         cache_before = None
 
-    def run(up: bool, wave: int = 0, dn: bool = False):
+    def run(up: bool, wave: int = 0, dn: bool = False, sh: bool = False):
         fn = _batch_blob_donated if dn else _batch_blob
         return fn(
             *batch_args, *progress_args, use_pallas=up, pack_assignment=pack,
-            top_k=top_k, scan_mesh=scan_mesh, scan_wave=wave,
+            top_k=top_k, scan_mesh=scan_mesh, scan_wave=wave, scan_shard=sh,
         )
 
     # Fallback ladder, most-capable first. Each downgrade drops exactly
@@ -1010,23 +1430,30 @@ def dispatch_batch(
     # (a cache-hit dispatch alone proves nothing, so the fallback forces
     # the device round-trip; the fetched copy is kept for collect). If
     # every rung fails, the problem is the batch/link, not the feature —
-    # the original error surfaces.
-    attempts = [(use_pallas, scan_wave)]
+    # the original error surfaces. Rungs are (use_pallas, wave, sharded);
+    # the sharded merge rung (mesh batches) sits on top and demotes to
+    # the replicated-scan layout, which keeps its own wave/pallas ladder.
+    attempts = []
+    if scan_sharded:
+        attempts.append(
+            (False, scan_wave if scan_wave > 1 else _SHARD_DEFAULT_WAVE, True)
+        )
+    attempts.append((use_pallas, scan_wave, False))
     if scan_wave:
-        attempts.append((use_pallas, 0))
+        attempts.append((use_pallas, 0, False))
     if use_pallas:
-        attempts.append((False, 0))
+        attempts.append((False, 0, False))
 
     blob_np = None
     blob = out = None
     errors: list = []
-    used_pallas, used_wave = attempts[0]
-    for i, (up, wave) in enumerate(attempts):
+    used_pallas, used_wave, used_shard = attempts[0]
+    for i, (up, wave, sh) in enumerate(attempts):
         try:
             # only the first rung donates: a fallback rung re-runs from the
             # same caller args, which a donated first attempt may already
             # have consumed on-device — the ladder must stay replayable
-            blob, out = run(up, wave, dn=donate and i == 0)
+            blob, out = run(up, wave, dn=donate and i == 0, sh=sh)
             if i > 0:
                 blob_np = np.asarray(jax.device_get(blob))
         except Exception as e:  # noqa: BLE001 — lowering/compile failure
@@ -1034,14 +1461,16 @@ def dispatch_batch(
             if i == len(attempts) - 1:
                 raise errors[0] from None
             continue
-        used_pallas, used_wave = up, wave
+        used_pallas, used_wave, used_shard = up, wave, sh
         if i > 0 and forced is None:
             # this rung executed where the one above it failed: the single
             # feature dropped between the two is provably at fault. A
             # PINNED (replay) thread skips the permanent disable: its
             # failure is replay evidence, not a serving-path verdict.
-            prev_up, prev_wave = attempts[i - 1]
-            if prev_wave and not wave and prev_up == up:
+            prev_up, prev_wave, prev_sh = attempts[i - 1]
+            if prev_sh and not sh:
+                _disable_sharded(errors[-1])
+            elif prev_wave and not wave and prev_up == up:
                 _disable_wave(errors[-1])
             else:
                 _disable_pallas(errors[-1], mask_mode)
@@ -1082,6 +1511,10 @@ def dispatch_batch(
         blob, out, pack, used_pallas, run, blob_np, mask_mode,
         used_wave=used_wave, compiled=compiled,
         n_bucket=n_bucket, g_bucket=g_bucket, pinned=forced is not None,
+        used_shard=used_shard,
+        shard_count=(
+            int(np.prod(scan_mesh.devices.shape)) if used_shard else 0
+        ),
     )
 
 
@@ -1110,6 +1543,7 @@ def collect_batch(pending: PendingBatch):
 
 def _collect_batch_inner(pending: PendingBatch):
     used_pallas, used_wave = pending.used_pallas, pending.used_wave
+    used_shard = pending.used_shard
     try:
         blob_np = (
             pending.blob_np
@@ -1118,33 +1552,45 @@ def _collect_batch_inner(pending: PendingBatch):
         )
         out = pending.out
     except Exception as e:  # noqa: BLE001 — device-side runtime failure
-        if not pending.used_pallas and not pending.used_wave:
+        if (
+            not pending.used_pallas
+            and not pending.used_wave
+            and not pending.used_shard
+        ):
             raise
         # Only blame (and permanently disable) the optional path — the
-        # pallas kernel and/or the wavefront scan — if the plain serial
-        # scan succeeds where it failed; if that fails too, the problem is
-        # the batch/link, not the feature — surface it. When both were
-        # live, the single rerun cannot separate them; disabling both errs
-        # toward the always-working path (each re-proves itself never).
+        # pallas kernel, the wavefront scan, or the sharded merge — if the
+        # plain serial scan succeeds where it failed; if that fails too,
+        # the problem is the batch/link, not the feature — surface it.
+        # When several were live, the single rerun cannot separate them;
+        # disabling errs toward the always-working path (each re-proves
+        # itself never).
         try:
             blob, out = pending._rerun(False)
             blob_np = np.asarray(jax.device_get(blob))
         except Exception:
             raise e from None
         if not pending.pinned:
-            if pending.used_pallas:
-                _disable_pallas(e, pending.mask_mode)
-            if pending.used_wave:
-                _disable_wave(e)
-        used_pallas, used_wave = False, 0  # the blob in hand is serial
+            if pending.used_shard:
+                # the sharded rung owns its whole wave machinery; its
+                # failure says nothing about the replicated wavefront path
+                _disable_sharded(e)
+            else:
+                if pending.used_pallas:
+                    _disable_pallas(e, pending.mask_mode)
+                if pending.used_wave:
+                    _disable_wave(e)
+        # the blob in hand is the serial replicated rerun
+        used_pallas, used_wave, used_shard = False, 0, False
 
     g = out["assignment_nodes"].shape[0]
     k = out["assignment_nodes"].shape[1]
     pack = pending.pack
     # the wave-stat triple rides at the very end of the blob, only when the
-    # lax wavefront scan produced THIS blob (a collect-side serial rerun
-    # has none) — slice the assignment tail by its exact static length
-    has_wave_stats = used_wave > 1 and not used_pallas
+    # lax wavefront scan (replicated or sharded) produced THIS blob (a
+    # collect-side serial rerun has none) — slice the assignment tail by
+    # its exact static length
+    has_wave_stats = (used_wave > 1 and not used_pallas) or used_shard
     tail_len = g * k if pack else 2 * g * k
     tail = blob_np[3 * g + 2: 3 * g + 2 + tail_len]
     if pack:
@@ -1161,7 +1607,10 @@ def _collect_batch_inner(pending: PendingBatch):
         "compiled": pending.compiled,
         "n_bucket": int(pending.n_bucket),
         "g_bucket": int(pending.g_bucket),
+        "scan_sharded": bool(used_shard),
     }
+    if used_shard:
+        telemetry["shard_count"] = int(pending.shard_count)
     if has_wave_stats:
         stats_np = blob_np[3 * g + 2 + tail_len:]
         if stats_np.shape[0] >= 3:
@@ -1202,11 +1651,24 @@ def _fold_batch_metrics(telemetry: dict) -> None:
     path = (
         "pallas"
         if telemetry["used_pallas"]
+        else "sharded"
+        if telemetry.get("scan_sharded")
         else "wavefront" if telemetry["wave_width"] > 1 else "serial"
     )
     reg.counter(
         "bst_scan_batches_total", "Oracle batches by assignment-scan path"
     ).inc(path=path)
+    if telemetry.get("scan_sharded"):
+        reg.gauge(
+            "bst_scan_shard_count",
+            "Devices the node-sharded assignment scan split the node axis "
+            "over (last sharded batch)",
+        ).set(float(telemetry.get("shard_count", 0)))
+    reg.gauge(
+        "bst_scan_sharded_enabled",
+        "1 while the node-sharded scan rung is enabled (0 after a failure "
+        "permanently demoted mesh batches to the replicated-scan layout)",
+    ).set(1.0 if _sharded_enabled[0] else 0.0)
     if telemetry.get("compiled"):
         reg.counter(
             "bst_oracle_compiles_total",

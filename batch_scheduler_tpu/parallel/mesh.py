@@ -29,7 +29,9 @@ __all__ = [
     "shard_snapshot_args",
     "sharded_schedule_batch",
     "sharded_collective_counts",
+    "sharded_scan_collective_counts",
     "count_collective_instructions",
+    "collective_instruction_bytes",
     "compiled_cost_summary",
     "COLLECTIVES",
 ]
@@ -109,6 +111,54 @@ def compiled_cost_summary(compiled) -> dict:
     return out
 
 
+# HLO shape tokens like "s32[8,8,128]{2,1,0}" ahead of a collective op name
+_SHAPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8,
+}
+_SHAPE_RE = None  # compiled lazily (re import kept local)
+
+
+def collective_instruction_bytes(hlo_text: str) -> list:
+    """``(op, bytes)`` for every collective INSTRUCTION site in compiled
+    HLO, sized as the LARGEST shape on the line's left-hand side — async
+    forms (``<op>-start``) put a tuple of (aliased operand, result) there,
+    so summing would double-count; the max is the buffer the collective
+    actually materializes. The budget signal for the node-sharded scan:
+    every collective it issues moves an [S, W, BINS] summary (a few KB),
+    never the [N, R] node state — a node-state-sized entry here is the
+    partitioned-scan regression (SHARDING_r05's 54 all-gathers) coming
+    back."""
+    import re
+
+    global _SHAPE_RE
+    if _SHAPE_RE is None:
+        _SHAPE_RE = re.compile(r"\b(\w+)\[([0-9,]*)\]")
+    out = []
+    for line in hlo_text.splitlines():
+        for op in COLLECTIVES:
+            token = f" {op}(" if f" {op}(" in line else (
+                f"{op}-start(" if f"{op}-start(" in line else None
+            )
+            if token is None:
+                continue
+            lhs = line.split(token, 1)[0]
+            largest = 0
+            for dtype, dims in _SHAPE_RE.findall(lhs):
+                unit = _SHAPE_BYTES.get(dtype)
+                if unit is None:
+                    continue
+                count = 1
+                for d in filter(None, dims.split(",")):
+                    count *= int(d)
+                largest = max(largest, unit * count)
+            out.append((op, largest))
+            break
+    return out
+
+
 def _factor_devices(n: int) -> tuple:
     """Split n devices into a (groups, nodes) grid, nodes-major — node-axis
     parallelism carries the heavy lanes (N is the big dimension)."""
@@ -128,21 +178,34 @@ def make_mesh(
     return Mesh(np.asarray(devs).reshape(grid), axis_names=("groups", "nodes"))
 
 
-def shard_snapshot_args(mesh: Mesh, args: tuple) -> tuple:
+def shard_snapshot_args(
+    mesh: Mesh, args: tuple, flat_nodes: bool = False
+) -> tuple:
     """Place ClusterSnapshot.device_args() onto the mesh.
 
     Layout: node-major arrays split over "nodes"; group-major over "groups";
     the (G, N) fit mask over both; the scan order replicated.
+
+    ``flat_nodes`` (the node-sharded scan layout, ops.oracle
+    ``assign_gangs_sharded``): the node axis of every node-major array is
+    split over ALL mesh devices — the scan has no group parallelism to
+    spend, so its inputs stay node-sharded end-to-end instead of being
+    replicated across the group axis, and the shard_map entry needs no
+    resharding collective for the leftover lanes.
     """
     (alloc, requested, group_req, remaining, fit_mask, group_valid, order) = args
+    nodes_axes = tuple(mesh.axis_names) if flat_nodes else "nodes"
     # A broadcast [1,N] fit mask (uniform-feasibility fast path) has no
     # group extent to split — shard its node axis only.
-    mask_spec = (
-        P(None, "nodes") if fit_mask.shape[0] == 1 else P("groups", "nodes")
-    )
+    if fit_mask.shape[0] == 1:
+        mask_spec = P(None, nodes_axes)
+    else:
+        mask_spec = (
+            P(None, nodes_axes) if flat_nodes else P("groups", "nodes")
+        )
     spec = {
-        "alloc": P("nodes", None),
-        "requested": P("nodes", None),
+        "alloc": P(nodes_axes, None),
+        "requested": P(nodes_axes, None),
         "group_req": P("groups", None),
         "remaining": P("groups"),
         "fit_mask": mask_spec,
@@ -185,23 +248,91 @@ def shard_snapshot_args(mesh: Mesh, args: tuple) -> tuple:
     )
 
 
-def sharded_schedule_batch(mesh: Mesh, args: tuple, replicated_scan: bool = True):
+def sharded_schedule_batch(mesh: Mesh, args: tuple,
+                           replicated_scan: bool = True,
+                           sharded_scan: bool = False,
+                           scan_wave: int = 0):
     """One fused oracle batch with inputs sharded over the mesh; XLA/GSPMD
     partitions the kernels and inserts the cross-chip collectives.
 
-    ``replicated_scan`` (default, the production layout): the O(G·N·R)
-    scoring runs sharded, then the sequential gang scan's inputs are
-    replicated up front so its G steps run collective-free on every chip —
-    the measured compiled module carries 5 one-time collectives total,
-    versus ~50 collective sites INSIDE the scan loop (executed per step)
-    when the scan state is partitioned, which ran 6x slower than a single
-    device on the 8-way virtual mesh (benchmarks/sharding_scaling.py,
-    SHARDING_r03.json; virtual-mesh caveats in the README scaling note).
-    Pass False to measure the naive fully-partitioned layout."""
-    sharded = shard_snapshot_args(mesh, args)
+    Scan layouts, most- to least-partitioned:
+
+    - ``sharded_scan=True`` — the node-sharded wavefront merge
+      (ops.oracle.assign_gangs_sharded): every shard keeps only its node
+      slice of the leftover lanes end-to-end and each wave merges an
+      [S, W, BINS] summary with one all-gather + one reduce — the layout
+      that makes "add chips" mean "go faster" (SHARDING_r06).
+    - ``replicated_scan`` (default without ``sharded_scan``; also the
+      fallback rung the dispatch ladder demotes to): scoring runs sharded,
+      then the scan's inputs are replicated up front so its G steps run
+      collective-free on every chip — a one-time handful of collectives
+      (5 in the measured module) versus ~50 collective sites INSIDE the
+      scan loop when GSPMD partitions the scan state, which ran 6x slower
+      than a single device on the 8-way virtual mesh
+      (benchmarks/sharding_scaling.py, SHARDING_r03.json; virtual-mesh
+      caveats in the README scaling note).
+    - Both False — the naive fully-partitioned GSPMD layout, kept
+      measurable as the cautionary baseline."""
+    sharded = shard_snapshot_args(mesh, args, flat_nodes=sharded_scan)
     return okern.schedule_batch(
-        *sharded, scan_mesh=mesh if replicated_scan else None
+        *sharded,
+        scan_mesh=mesh if (replicated_scan or sharded_scan) else None,
+        scan_shard=sharded_scan,
+        scan_wave=scan_wave,
     )
+
+
+def sharded_scan_collective_counts(
+    mesh: Mesh, args: tuple, wave: int = 8
+) -> dict:
+    """Collective budget of the node-sharded assignment SCAN alone.
+
+    ``sharded_collective_counts`` compiles the whole fused batch, so the
+    scoring phase's one-time collectives drown the signal the scan's
+    budget gate actually needs. This lowers ONLY ``left_resources`` + the
+    sharded scan (the exact computation the gang loop runs) and reports:
+
+    - ``counts`` — per-op collective instruction sites in the compiled
+      module (static sites: the scan body compiles once; the demotion
+      replay contributes its gang-at-a-time sites whether or not a batch
+      ever demotes);
+    - ``max_collective_bytes`` — the largest result any collective site
+      moves. The budget contract: every site is summary-sized
+      (≤ ``summary_bytes`` ≈ S·W·BINS ints, plus slop for stacked wave
+      outputs), never ``node_state_bytes`` (N·R lanes) — the dynamic
+      fast-path cost is ≤ 2 collectives per wave (one summary all-gather,
+      one verify reduce) by construction;
+    - ``waves`` — sequential steps per batch at this (G, wave).
+    """
+    (alloc, requested, group_req, remaining, fit_mask, _gv, order) = tuple(
+        np.asarray(a) for a in args
+    )
+
+    def scan_only(alloc, requested, group_req, remaining, fit_mask, order):
+        left = okern.left_resources(alloc, requested)
+        return okern.assign_gangs_sharded(
+            left, group_req, remaining, fit_mask, order, mesh=mesh,
+            wave=wave, with_stats=True,
+        )
+
+    hlo = (
+        jax.jit(scan_only)
+        .lower(alloc, requested, group_req, remaining, fit_mask, order)
+        .compile()
+        .as_text()
+    )
+    sizes = collective_instruction_bytes(hlo)
+    s = int(mesh.devices.size)
+    w = max(int(wave), 2)
+    g = int(group_req.shape[0])
+    return {
+        "counts": count_collective_instructions(hlo),
+        "max_collective_bytes": max((b for _, b in sizes), default=0),
+        "summary_bytes": s * w * okern._BINS * 4,
+        "node_state_bytes": int(alloc.shape[0]) * int(alloc.shape[1]) * 4,
+        "waves": -(-g // w),
+        "fastpath_collectives_per_wave": 2,
+    }
 
 
 def sharded_collective_counts(

@@ -411,9 +411,18 @@ class _Handler(socketserver.BaseRequestHandler):
                                 else None
                             )
                             if mesh is not None:
+                                from ..ops.oracle import scan_sharded_active
                                 from ..parallel.mesh import shard_snapshot_args
 
-                                args = shard_snapshot_args(mesh, args)
+                                # layout must match the rung dispatch will
+                                # pick: the sharded scan wants the node
+                                # axis split over EVERY device end-to-end,
+                                # or GSPMD reshards the [N,R] lanes at the
+                                # shard_map boundary
+                                args = shard_snapshot_args(
+                                    mesh, args,
+                                    flat_nodes=scan_sharded_active(),
+                                )
                             t1 = time.perf_counter()
                             # All device work goes through the single-owner
                             # executor queue (DeviceExecutor): one issuing
@@ -522,6 +531,16 @@ class _Handler(socketserver.BaseRequestHandler):
                                     ).value()
                                 ),
                             )
+                            if telemetry.get("waves_per_batch"):
+                                # per-wave merge cost: on the sharded scan
+                                # rung this is the tree-reduce cadence the
+                                # collective budget is written against
+                                # (docs/scan_parallelism.md)
+                                telemetry["per_wave_device_seconds"] = round(
+                                    timings["device"]
+                                    / telemetry["waves_per_batch"],
+                                    6,
+                                )
                             if req_audit is not None:
                                 telemetry["audit_id"] = req_audit
                             if self.server.warmer is not None:

@@ -39,6 +39,12 @@ import jax  # noqa: E402
 if os.environ.get("BST_PIPELINE_GATE_PLATFORM", "cpu") == "cpu":
     jax.config.update("jax_platforms", "cpu")
 
+# No background bucket-cost compiles in CI (same pin as tests/conftest and
+# replay_gate): the warmer phase compiles fresh shapes right before the
+# gate exits, and a telemetry-only cost analysis still inside a native XLA
+# compile at interpreter teardown segfaults the daemon thread.
+os.environ.setdefault("BST_BUCKET_COST", "0")
+
 import numpy as np  # noqa: E402
 
 PIPELINE_TOLERANCE = 1.05

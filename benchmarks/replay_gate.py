@@ -16,6 +16,18 @@ Proves the black-box flight data subsystem end-to-end on CPU
    matching ``bst_slo_breach_total{signal="batch"}`` increment.
 4. **Overhead**: audit recording (digest + enqueue; serialization is on
    the daemon writer) costs <= 5% of the steady-batch wall-clock.
+5. **Cross-rung identity for the sharded mesh rung**: a batch executed on
+   the node-sharded merge path (ops.oracle.assign_gangs_sharded, 8-way
+   virtual mesh) and recorded to an audit ring replays bit-identically on
+   the ``cpu-ladder`` rung — the MULTICHIP-harness claim that "sharded"
+   is a layout, never a semantic, proven on recorded inputs. Device count
+   is process-global in JAX, and forcing 8 virtual devices flips the
+   in-process sidecar of phase 3 onto the mesh path (whose cold compile
+   blows the client deadline under the chaos proxy's injected latency —
+   the phase would measure mesh compile time, not SLO plumbing), so this
+   phase alone re-execs as a subprocess with the virtual-mesh forcing
+   (``--phase-sharded``); phases 1-4 keep the single-device environment
+   they were written against.
 
 Run from the repo root: ``JAX_PLATFORMS=cpu python benchmarks/replay_gate.py``
 — one JSON summary line; exit 1 on any failed acceptance.
@@ -26,12 +38,32 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import subprocess
 import sys
 import tempfile
 import time
 import urllib.request
 
-import numpy as np
+# The sharded-phase subprocess needs the 8-device virtual CPU mesh (same
+# forcing as tests/conftest.py — env var alone does not win over this
+# environment's sitecustomize, so the jax config is updated back below).
+# The main gate process stays single-device: its phases exercise
+# single-device scorers and an in-process sidecar whose behavior the
+# device count would change.
+_SHARDED_PHASE = "--phase-sharded" in sys.argv
+if _SHARDED_PHASE:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -294,6 +326,104 @@ def phase_overhead(audit_dir: str) -> dict:
     }
 
 
+def phase_sharded_cross_rung(audit_dir: str) -> dict:
+    """Parent-side wrapper: run the sharded cross-rung phase in a
+    subprocess that forces the 8-device virtual mesh (see module
+    docstring — the forcing is process-global and must not leak into the
+    single-device phases). The child prints one JSON line with the phase
+    summary + its own failure list; a crash or a failed check in the
+    child is a failed check here."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--phase-sharded",
+           audit_dir]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=900,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+    except subprocess.TimeoutExpired:
+        check(False, "sharded-phase subprocess completed", error="timeout")
+        return {}
+    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    try:
+        child = json.loads(line)
+    except ValueError:
+        check(False, "sharded-phase subprocess completed",
+              rc=proc.returncode, stderr=proc.stderr[-2000:])
+        return {}
+    for failure in child.pop("failures", []):
+        FAILURES.append(failure)
+        print(f"FAIL (sharded subprocess): {failure}", file=sys.stderr)
+    check(proc.returncode == 0, "sharded-phase subprocess exit 0",
+          rc=proc.returncode)
+    return child
+
+
+def _phase_sharded_body(audit_dir: str) -> dict:
+    """Cross-rung identity for the node-sharded mesh rung: a batch that
+    RAN on the sharded merge path (assign_gangs_sharded over the 8-way
+    virtual mesh), recorded with its plan digest, must replay
+    bit-identically on the single-device cpu-ladder rung. This is the
+    identity gate for the rung the replay machinery deliberately does not
+    pin (REPLAY_RUNGS excludes mesh rungs — replays are single-process)."""
+    from batch_scheduler_tpu.core.oracle_scorer import replay_audit_record
+    from batch_scheduler_tpu.ops.oracle import execute_batch_host
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot, GroupDemand
+    from batch_scheduler_tpu.parallel.mesh import (
+        make_mesh,
+        shard_snapshot_args,
+    )
+    from batch_scheduler_tpu.sim.scenarios import make_sim_node
+    from batch_scheduler_tpu.utils import audit as audit_mod
+    from batch_scheduler_tpu.utils.audit import AuditLog, AuditReader
+
+    n_dev = len(jax.devices())
+    check(n_dev == 8, "virtual mesh available", devices=n_dev)
+    mesh = make_mesh(n_dev)
+    nodes = [
+        make_sim_node(f"s{i:02d}", {"cpu": "16", "memory": "64Gi", "pods": "64"})
+        for i in range(24)
+    ]
+    groups = [
+        GroupDemand(f"default/sh-{g}", 3 + (g % 2),
+                    member_request={"cpu": 1500, "memory": 2 * 1024**3},
+                    creation_ts=float(g))
+        for g in range(6)
+    ]
+    snap = ClusterSnapshot(nodes, {}, groups)
+    args, progress = snap.device_args(), snap.progress_args()
+    placed = shard_snapshot_args(mesh, args, flat_nodes=True)
+
+    host, _ = execute_batch_host(placed, progress, scan_mesh=mesh)
+    tel = host.get("telemetry") or {}
+    check(tel.get("scan_sharded") is True,
+          "batch executed on the sharded rung", telemetry=tel)
+    check(tel.get("shard_count") == n_dev,
+          "all shards participated", telemetry=tel)
+
+    log = AuditLog(audit_dir)
+    log.record_batch(
+        batch_args=args, progress_args=progress, result=host,
+        plan_digest=audit_mod.plan_digest(host),
+        node_names=snap.node_names, group_names=snap.group_names,
+    )
+    check(log.flush(), "sharded audit flush")
+    batches, skipped = AuditReader(audit_dir).batches()
+    check(len(batches) == 1 and not skipped,
+          "sharded record readable", records=len(batches))
+    rep = replay_audit_record(batches[0], against="cpu-ladder")
+    check(
+        rep["identical"],
+        "sharded-path record replays bit-identically on cpu-ladder",
+        report=rep.get("blame"),
+    )
+    log.stop()
+    return {
+        "sharded_cross_rung_identical": bool(rep["identical"]),
+        "sharded_shard_count": tel.get("shard_count"),
+        "sharded_waves_per_batch": tel.get("waves_per_batch"),
+    }
+
+
 def main() -> int:
     base = tempfile.mkdtemp(prefix="bst-replay-gate-")
     try:
@@ -301,6 +431,7 @@ def main() -> int:
         summary.update(phase_record_replay(os.path.join(base, "ring")))
         summary.update(phase_health_flip())
         summary.update(phase_overhead(os.path.join(base, "overhead-ring")))
+        summary.update(phase_sharded_cross_rung(os.path.join(base, "sharded")))
         if FAILURES:
             summary["ok"] = False
             summary["failures"] = FAILURES
@@ -310,5 +441,16 @@ def main() -> int:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def _sharded_phase_main() -> int:
+    """Subprocess entry (``--phase-sharded <audit_dir>``): run the
+    sharded cross-rung phase under the 8-device forcing and report one
+    JSON line the parent folds into its summary."""
+    audit_dir = sys.argv[sys.argv.index("--phase-sharded") + 1]
+    out = _phase_sharded_body(audit_dir)
+    out["failures"] = FAILURES
+    print(json.dumps(out, default=str))
+    return 0 if not FAILURES else 1
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(_sharded_phase_main() if _SHARDED_PHASE else main())
