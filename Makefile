@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all build vet test test-cpu bench bench-scan bench-pipeline bench-sharding native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
+.PHONY: all build vet test test-cpu test-tier1 bench bench-scan bench-pipeline bench-sharding bench-xl native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
 
 all: vet native test
 
@@ -19,12 +19,19 @@ vet:
 native:
 	$(MAKE) -C native
 
-# full suite (CPU-mesh conftest handles multi-device paths)
+# full suite (CPU-mesh conftest handles multi-device paths), slow
+# widening matrices included
 test:
 	$(PY) -m pytest tests/ -q
 
 test-cpu:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q
+
+# the tier-1 gate filter: excludes @pytest.mark.slow (compile-heavy
+# shard_map widening matrices) so the suite fits the CI wall-clock
+# budget; run `pytest -m slow` for the excluded set
+test-tier1:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
 
 # headline benchmark on the default platform (one JSON line)
 bench:
@@ -100,6 +107,15 @@ bench-sharding:
 
 # back-compat alias (pre-r06 name)
 sharding: bench-sharding
+
+# hierarchical top-K CI gate (CPU): at a small XL bucket the top-K scan
+# must be bit-identical to the dense wavefront scan at every K, clear a
+# speedup floor, and a batch recorded on the top-K rung must replay
+# bit-identically on the cpu-ladder rung through the audit log. The full
+# XL measurement (the BENCH_XL artifact, [G=2048, N=65536] acceptance
+# bucket) is `python benchmarks/xl_scaling.py` without --gate.
+bench-xl:
+	$(PY) benchmarks/xl_scaling.py --gate
 
 # the reference's serial hot loop in C++ — bench.py's vs_baseline denominator
 serial-baseline:

@@ -67,6 +67,18 @@ class APIServer:
         # keeps selector lists (the controller's per-group member listing,
         # reference controller.go:235-241) O(matches), not O(all objects)
         self._label_idx: Dict[str, Dict[Tuple[str, str], Set[Tuple[str, str]]]] = {}
+        # bind fencing token: each gateway generation advances it at
+        # startup (serve_gateway) and stamps its binds with the epoch it
+        # was born under. A handler thread that outlives its gateway's
+        # death (shutdown/server_close stop the accept loop and sever
+        # sockets, but cannot kill a thread already past the read) would
+        # otherwise apply its bind against this shared store AFTER a
+        # restarted gateway served the scheduler a fresh liveness read —
+        # the zombie-bind over-commit (test_fuzz_combo_selector_churn_
+        # outage). Fenced binds are dropped, making "unbound on a read
+        # through the NEW gateway" conclusive evidence the lost request
+        # will never apply.
+        self._bind_epoch = 0
 
     # -- helpers -----------------------------------------------------------
 
@@ -340,7 +352,16 @@ class APIServer:
             self._notify(kind, WatchEvent(WatchEvent.MODIFIED, kind, merged))
             return json_deepcopy(merged)
 
-    def bind_pods(self, namespace: str, pairs: List[Tuple[str, str]]) -> List[str]:
+    def advance_bind_epoch(self) -> int:
+        """Advance the bind fencing token and return the new epoch (see
+        ``_bind_epoch``). Called by each gateway generation at startup;
+        binds stamped with an older epoch are dropped from then on."""
+        with self._lock:
+            self._bind_epoch += 1
+            return self._bind_epoch
+
+    def bind_pods(self, namespace: str, pairs: List[Tuple[str, str]],
+                  epoch: int | None = None) -> List[str]:
         """Batched bind subresource: one lock pass, one merge patch + one
         MODIFIED event per pod. The whole-gang choreography binds a
         released gang as a unit (reference StartBatchSchedule releases a
@@ -348,11 +369,20 @@ class APIServer:
         bind itself is batched too). Missing pods are skipped — the caller
         forgets their assumed capacity. A bind patch touches only
         ``spec.node_name``, so the label index needs no maintenance.
-        Returns the names actually bound."""
+        Returns the names actually bound.
+
+        ``epoch`` (gateway binds) fences zombie writers: a request born
+        under an epoch older than the store's current one applies NOTHING
+        (checked per chunk, so a fence racing a long bind stops it at the
+        next chunk boundary). In-process callers pass no epoch and are
+        never fenced."""
         bound: List[str] = []
         chunk = 64  # bounded lock hold: a whole-flush bind (10s of pods)
         for start in range(0, len(pairs), chunk):
             with self._lock:
+                if epoch is not None and epoch < self._bind_epoch:
+                    # fenced: a newer gateway generation owns binding now
+                    return bound
                 store = self._kind_store("Pod")
                 events = []
                 for name, node_name in pairs[start : start + chunk]:

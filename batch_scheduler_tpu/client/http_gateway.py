@@ -266,7 +266,18 @@ class _Handler(BaseHTTPRequestHandler):
             if bind_pods is None:
                 self._send_error_json(404, "bind batch unsupported")
                 return
-            self._send_json(200, {"bound": bind_pods(ns, pairs)})
+            # fencing: stamp the bind with this gateway generation's
+            # epoch so a handler thread outliving a "restart" (severed
+            # socket, thread already past the read) cannot apply a stale
+            # bind against the shared backing store after a newer
+            # gateway took over (the zombie-bind over-commit)
+            epoch = getattr(self, "bind_epoch", None)
+            if epoch is not None:
+                self._send_json(
+                    200, {"bound": bind_pods(ns, pairs, epoch=epoch)}
+                )
+            else:
+                self._send_json(200, {"bound": bind_pods(ns, pairs)})
             return
         if url.path == CRD_PATH:
             body = self._read_body()
@@ -341,9 +352,13 @@ class GatewayServer(ThreadingHTTPServer):
     # tests (and leaking zombie handlers). Track live connections and
     # sever them at close, like a real server death would.
     def __init__(self, *args, **kwargs):
-        super().__init__(*args, **kwargs)
+        # before super().__init__: a failed bind (busy port on a restart
+        # attempt) makes the base class call self.server_close(), which
+        # needs these — assigning after would turn the OSError into an
+        # AttributeError
         self._live_conns: set = set()
         self._conn_lock = threading.Lock()
+        super().__init__(*args, **kwargs)
 
     def process_request(self, request, client_address):
         with self._conn_lock:
@@ -376,9 +391,25 @@ def serve_gateway(
 ) -> GatewayServer:
     """Serve ``api`` on (host, port) in a background thread; returns the
     server (``server.server_address`` has the bound port; ``shutdown()`` +
-    ``server_close()`` stops it)."""
-    handler = type("BoundHandler", (_Handler,), {"api": api})
+    ``server_close()`` stops it).
+
+    Each gateway generation advances the backing store's bind epoch at
+    startup and stamps its binds with it: handler threads from a PREVIOUS
+    generation (zombies a severed socket could not kill) are fenced out
+    of the shared store, so a liveness read served by this generation is
+    conclusive about lost binds (APIServer.bind_pods)."""
+    handler = type(
+        "BoundHandler", (_Handler,), {"api": api, "bind_epoch": None}
+    )
+    # bind the listening socket FIRST, then advance the fence: if the
+    # port is still held (failed restart) the constructor raises before
+    # the epoch moves, so the surviving previous generation keeps
+    # binding — advancing first would silently fence a gateway that
+    # never got replaced. Handlers only run once serve_forever starts,
+    # after the epoch is stamped below.
     server = GatewayServer((host, port), handler)
+    advance = getattr(api, "advance_bind_epoch", None)
+    handler.bind_epoch = advance() if advance is not None else None
     threading.Thread(
         target=server.serve_forever, name="apiserver-gateway", daemon=True
     ).start()

@@ -247,12 +247,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     rep.add_argument(
         "--against", default="steady",
-        choices=("steady", "wavefront", "cpu-ladder"),
+        choices=("steady", "wavefront", "cpu-ladder", "topk"),
         help="the rung to re-execute on: 'steady' = exactly what this "
              "process would dispatch now (same-backend bit-identity); "
              "'wavefront' = the wavefront scan forced on; 'cpu-ladder' = "
              "the serial fallback rung pinned to a CPU device (the "
-             "cross-backend divergence probe)",
+             "cross-backend divergence probe); 'topk' = the hierarchical "
+             "top-K scan forced on (the XL-tier rung)",
     )
     rep.add_argument(
         "--json", default=None, metavar="PATH",

@@ -59,19 +59,24 @@ __all__ = [
 #               (utils.health.IdentityAuditor) re-verifies against, and
 #               the cross-backend divergence probe for TPU-recorded
 #               audit logs.
+#   topk      — the hierarchical top-K scan forced on (width-8 wave,
+#               K=16 candidate bucket by default), pallas off: exercises
+#               the demotion-backed bit-identity claim of
+#               ops.oracle.assign_gangs_topk on real recorded inputs.
 #
-# The node-sharded mesh rung (ops.oracle.assign_gangs_sharded) is
-# deliberately NOT a replay rung: replays run single-process and a rung
-# pin must never depend on mesh availability. Batches recorded on the
-# sharded path are instead verified by CROSS-rung identity — their audit
-# records replay bit-identically on cpu-ladder (gated by
-# benchmarks/replay_gate.py), which is exactly the claim that matters:
-# the sharded merge computes the same plan the serial scan would.
-REPLAY_RUNGS = ("steady", "wavefront", "cpu-ladder")
+# The node-sharded mesh rungs (ops.oracle.assign_gangs_sharded and the
+# sharded top-K variant) are deliberately NOT replay rungs: replays run
+# single-process and a rung pin must never depend on mesh availability.
+# Batches recorded on the sharded paths are instead verified by
+# CROSS-rung identity — their audit records replay bit-identically on
+# cpu-ladder (gated by benchmarks/replay_gate.py and make bench-xl),
+# which is exactly the claim that matters: the sharded merges compute
+# the same plan the serial scan would.
+REPLAY_RUNGS = ("steady", "wavefront", "cpu-ladder", "topk")
 
 
 def replay_batch(batch_args, progress_args, against: str = "steady",
-                 scan_mesh=None, wave: int = 8):
+                 scan_mesh=None, wave: int = 8, topk: int = 16):
     """Re-entry API for deterministic replay: re-execute one recorded
     oracle batch's EXACT packed inputs on the requested rung and return
     ``(host, device_result)`` like ``execute_batch_host``. The rung pin is
@@ -96,6 +101,14 @@ def replay_batch(batch_args, progress_args, against: str = "steady",
         cpu = jax.local_devices(backend="cpu")[0]
         with forced_scan_rung(False, 0), jax.default_device(cpu):
             return execute_batch_host(batch_args, progress_args)
+    if against == "topk":
+        from ..ops.bucketing import topk_bucket, wave_width_bucket
+
+        with forced_scan_rung(
+            False, wave_width_bucket(wave), topk_bucket(topk)
+        ):
+            return execute_batch_host(batch_args, progress_args,
+                                      scan_mesh=scan_mesh)
     raise ValueError(
         f"unknown replay rung {against!r} (use one of {REPLAY_RUNGS})"
     )
@@ -146,9 +159,12 @@ def replay_audit_record(record: dict, against: str = "steady") -> dict:
         "executed_rung": {
             "used_pallas": exec_telemetry.get("used_pallas"),
             "wave_width": exec_telemetry.get("wave_width"),
+            "scan_topk": exec_telemetry.get("scan_topk"),
         },
     }
     if against == "wavefront" and exec_telemetry.get("wave_width", 0) <= 1:
+        out["rung_fell_back"] = True
+    if against == "topk" and exec_telemetry.get("scan_topk", 0) <= 0:
         out["rung_fell_back"] = True
     if not identical:
         names = record.get("names") or {}

@@ -566,8 +566,13 @@ class Scheduler:
             # a kept assume from an ambiguous bind failure (the worker/
             # flush keep-capacity policy): the liveness read above just
             # showed the pod UNBOUND, which resolves the ambiguity — the
-            # lost request never applied — so release the ghost
-            # reservation before planning. Without this the pod competes
+            # lost request never applied AND never will (a read can only
+            # be served by the live gateway generation, whose startup
+            # fenced every older generation's in-flight binds out of the
+            # backing store — serve_gateway/APIServer.bind_pods; without
+            # that fence a zombie handler could land the "lost" bind
+            # after this forget and over-commit the node) — so release
+            # the ghost reservation before planning. Without this the pod competes
             # against its own charge and a gang that exactly fills a node
             # livelocks on it forever. Two gates protect LIVE reservations
             # from this forget: the _kept_assumes marker (only the

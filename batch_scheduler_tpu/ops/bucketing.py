@@ -18,6 +18,7 @@ import numpy as np
 __all__ = [
     "bucket_size",
     "wave_width_bucket",
+    "topk_bucket",
     "pad_to",
     "pad_rows",
     "pad_oracle_batch",
@@ -54,6 +55,30 @@ def wave_width_bucket(w: int) -> int:
         return 0
     b = _WAVE_MIN
     while b < w and b < _WAVE_MAX:
+        b <<= 1
+    return b
+
+
+# Static candidate widths the hierarchical top-K scan compiles for
+# (ops.oracle.assign_gangs_topk / the BST_SCAN_TOPK knob). Powers of two
+# between 4 and 128: K must at least cover a small gang's node span to be
+# useful, and past 128 the candidate slices stop being "K << N" at any
+# bucket where the coarse pass pays for itself (a gang of M members spans
+# <= M nodes, and ASSIGNMENT_TOP_K already caps the readback at 128).
+_TOPK_MIN, _TOPK_MAX = 4, 128
+
+
+def topk_bucket(k: int) -> int:
+    """Static candidate-count bucket for the hierarchical top-K scan.
+
+    <= 0 means "top-K scoring off" and maps to 0; anything else snaps to
+    the nearest power of two in [4, 128] so the jitted scan compiles for a
+    bounded set of candidate widths no matter what the knob says (the
+    wave_width_bucket discipline applied to K)."""
+    if k <= 0:
+        return 0
+    b = _TOPK_MIN
+    while b < k and b < _TOPK_MAX:
         b <<= 1
     return b
 

@@ -49,7 +49,10 @@ __all__ = [
     "assign_gangs",
     "assign_gangs_wavefront",
     "assign_gangs_sharded",
+    "assign_gangs_topk",
+    "assign_gangs_topk_sharded",
     "scan_sharded_active",
+    "scan_topk_active",
     "schedule_batch",
     "execute_batch_host",
     "dispatch_batch",
@@ -103,10 +106,15 @@ _rung_override = threading.local()
 
 
 class forced_scan_rung:
-    """Context manager pinning this thread's batches to one scan rung."""
+    """Context manager pinning this thread's batches to one scan rung.
 
-    def __init__(self, use_pallas: bool, scan_wave: int):
-        self._rung = (bool(use_pallas), int(scan_wave))
+    ``scan_topk`` > 0 pins the hierarchical top-K rung
+    (``assign_gangs_topk``) at that candidate width — single-process only,
+    like every pin; the sharded mesh variants are never pinned (their
+    recorded batches are verified by CROSS-rung replay identity)."""
+
+    def __init__(self, use_pallas: bool, scan_wave: int, scan_topk: int = 0):
+        self._rung = (bool(use_pallas), int(scan_wave), int(scan_topk))
 
     def __enter__(self):
         self._prev = getattr(_rung_override, "value", None)
@@ -933,6 +941,790 @@ def assign_gangs_sharded(left0, group_req, remaining, fit_mask, order, mesh,
     return alloc, placed_full, left_after
 
 
+# Block width of the two-level coarse rank. A straight lax.top_k over N
+# lowers to a comparator sort on CPU (~30x the cost of the arithmetic in
+# a top-K wave — measured 156ms vs 5ms at [W=8, N=65536]); the two-level
+# form reduces N to N/32 block minima first (vectorized min), picks the
+# k best BLOCKS, and sorts only the gathered k·32 pool. Exact for any
+# block width: composites are unique, so a block holding a true top-k
+# element has a block-min at most that element and must itself rank in
+# the top-k blocks.
+_COARSE_BLOCK = 32
+
+
+def _coarse_rank(cap, k: int, span: int, pos=None):
+    """Coarse pass: the top-``k`` candidate columns of a ``[..., N]``
+    capacity row, ordered by (tightness bucket, node index) — exactly the
+    order the exact tightest-first selection consumes nodes in.
+
+    ``span`` is the GLOBAL node extent the composite rank key is built
+    over (``N`` locally; the padded global N on a shard, where ``pos``
+    carries the shard's global index offset — see the sharded body).
+    Returns ``(idx[..., k], v[..., k])`` where ``v = key·(span+1) + index``
+    ascends over the candidates; slots past the last fitting node carry a
+    ``_BIG`` sentinel value, and CALLERS MUST MASK capacities gathered at
+    sentinel slots by ``v < _BIG`` (a sentinel's index may alias a real
+    node: the two-level pool pads to a block multiple and clamps).
+    Exact composite: ``key ≤ _BINS-1`` and ``span < 2**23`` keep ``v``
+    far inside int32 (the 8M-node ceiling is documented in
+    docs/scan_parallelism.md)."""
+    n = cap.shape[-1]
+    key = jnp.minimum(cap, _BINS - 1)
+    if pos is None:
+        pos = jax.lax.broadcasted_iota(jnp.int32, cap.shape, cap.ndim - 1)
+    v = jnp.where(key > 0, key * (span + 1) + pos, _BIG)
+    c = _COARSE_BLOCK
+    if n <= max(1024, c * k):
+        # small rows (or k too close to the block count): the direct
+        # top_k costs less than the two-level plumbing
+        neg, idx = jax.lax.top_k(-v, k)
+        return idx, -neg
+    lead = cap.shape[:-1]
+    nb = -(-n // c)
+    if nb * c != n:
+        v_pad = jnp.pad(
+            v, [(0, 0)] * (cap.ndim - 1) + [(0, nb * c - n)],
+            constant_values=_BIG,
+        )
+    else:
+        v_pad = v
+    bmin = jnp.min(v_pad.reshape(lead + (nb, c)), axis=-1)
+    _, bidx = jax.lax.top_k(-bmin, k)
+    pool_idx = (
+        bidx[..., None] * c + jnp.arange(c, dtype=jnp.int32)
+    ).reshape(lead + (k * c,))
+    v_pool = jnp.take_along_axis(v_pad, pool_idx, axis=-1)
+    neg, p = jax.lax.top_k(-v_pool, k)
+    idx = jnp.take_along_axis(pool_idx, p, axis=-1)
+    # clamp pad-phantom sentinels into range; their v stays _BIG, which
+    # is what downstream masking keys on
+    return jnp.minimum(idx, n - 1), -neg
+
+
+@partial(jax.jit, static_argnames=("wave", "k", "with_stats"))
+def assign_gangs_topk(left0, group_req, remaining, fit_mask, order,
+                      wave: int = 8, k: int = 16, with_stats: bool = False):
+    """Hierarchical top-K form of ``assign_gangs_wavefront``: same inputs,
+    same outputs, bit-identical to the serial scan, but each wave's exact
+    selection machinery runs on ``[W, K]`` GATHERED candidate slices
+    instead of the full ``[W, N]`` row — the two-level device pipeline of
+    the 100k-node scale tier (docs/scan_parallelism.md "Hierarchical
+    top-K").
+
+    Per wave, against the wave-entry leftover:
+
+    1. **Coarse pass** — one ``[W, N, R]`` member-capacity sweep (the only
+       O(N) work in the step) ranks every node per gang by the SAME
+       need-clipped tightness score the exact scan uses, and keeps the
+       top-K candidate columns in (tightness bucket, node index) order.
+    2. **Exact pass on candidates** — ``_select_best_fit`` runs verbatim
+       on the gathered ``[W, K]`` slices. The candidate set is the first K
+       nodes in the exact selection's own consumption order, so whenever
+       the K candidates' need-clipped capacity covers the gang
+       (``covered``), the restricted selection IS the dense selection:
+       every tightness bucket below the K-th candidate's bucket (the
+       per-gang **bound**) is complete in the slice, the bound bucket's
+       included nodes are its node-index prefix, and coverage pins the
+       threshold at or inside the bound — so threshold, remainder, and
+       within-bucket fill all coincide with the dense formulas.
+    3. **Demotion, not hope** — exactness never rests on K being "big
+       enough". A gang whose candidates cannot cover its need while the
+       pooled (full-N) capacity says placement may exist demotes to a
+       **dense-column replay**: the full-N selection for that one gang
+       (``bst_topk_demotions`` counts these — the K-mistuned signal). A
+       gang that is pooled-infeasible needs no demotion: capacities only
+       decrease within a batch, so the wave-entry pooled bound is already
+       an upper bound on its turn-time capacity.
+    4. **Conflict check on the candidate union** — the speculative wave
+       commits only if no gang's capacities changed on the union of the
+       wave's candidate columns under the exclusive prefix of earlier
+       takes (the wavefront conflict check, evaluated on ≤ W·K columns).
+       Takes land only on candidate columns, so untouched non-candidates
+       keep their wave-entry tightness and the per-gang bound covers
+       them; touched columns are all in the union and checked directly.
+       Any violation demotes the wave to the gang-at-a-time replay, where
+       each gang re-ranks FRESH at its turn (staleness-free) and applies
+       rule 3.
+
+    The uniform wave (mega) path restricts the aggregate member stream
+    the same way: the stream consumes nodes in exactly (tightness, index)
+    order, so when the K candidates cover the wave's total need the
+    candidate-restricted stream is the dense stream, boundary
+    feasibilities are recovered exactly as ``pooled − candidate-entry +
+    candidate-post-take`` sums, and anything else demotes.
+
+    Outputs match ``assign_gangs_wavefront``; ``with_stats`` returns
+    ``(conflicts[S], megas[S], dense_demotions[S])`` — the third series
+    is new: dense-column replays per wave (the bst_topk_demotions feed).
+    """
+    n = left0.shape[0]
+    g = group_req.shape[0]
+    w = max(int(wave), 2)
+    kk = max(2, min(int(k), n))
+    per_group_mask = fit_mask.shape[0] != 1
+    if per_group_mask and fit_mask.shape[0] != g:
+        raise ValueError(
+            f"fit_mask rows {fit_mask.shape[0]} must be 1 or match "
+            f"group count {g}"
+        )
+
+    steps = -(-g // w)
+    g_pad = steps * w
+    gr = jnp.take(group_req, order, axis=0)
+    rem = jnp.take(remaining, order, axis=0)
+    mask = fit_mask.astype(jnp.int32)
+    if per_group_mask:
+        mask = jnp.take(mask, order, axis=0)
+    if g_pad != g:
+        gr = jnp.pad(gr, ((0, g_pad - g), (0, 0)))
+        rem = jnp.pad(rem, ((0, g_pad - g),))
+        if per_group_mask:
+            mask = jnp.pad(mask, ((0, g_pad - g), (0, 0)))
+    r = gr.shape[1]
+    gr_w = gr.reshape(steps, w, r)
+    rem_w = rem.reshape(steps, w)
+    xs = (gr_w, rem_w, mask.reshape(steps, w, n)) if per_group_mask else (
+        gr_w, rem_w,
+    )
+    bcast_row = None if per_group_mask else mask  # [1, N]
+
+    def _one(cap, capc, need):
+        take2d, feas = _select_best_fit(cap[None, :], capc[None, :], need)
+        return take2d[0], feas
+
+    select_wave = jax.vmap(_one)
+    mega_need_max = (2**31 - 1) // max(n, 1)
+
+    def step(left, chunk):
+        if per_group_mask:
+            req_c, need_c, mask_c = chunk  # [W,R], [W], [W,N]
+        else:
+            req_c, need_c = chunk
+            mask_c = bcast_row  # [1,N] broadcasts over the wave
+        total_need = jnp.sum(need_c)
+        uniform = jnp.all(req_c == req_c[0:1])
+        if per_group_mask:
+            uniform = uniform & jnp.all(mask_c == mask_c[0:1])
+        mega_ok = uniform & (total_need <= mega_need_max)
+
+        def replay_wave(left):
+            # gang-at-a-time demotion target: each gang coarse-ranks FRESH
+            # at its own turn, so the restricted selection is exact
+            # whenever its candidates cover the need; otherwise the gang
+            # demotes to the dense-column replay (full-N selection) and
+            # is counted
+            takes, feats = [], []
+            dense_n = jnp.int32(0)
+            for j in range(w):
+                row = mask_c[j] if per_group_mask else mask_c[0]
+                cap_j = _member_capacity(left, req_c[j][None, :]) * row
+                capc_j = jnp.minimum(cap_j, need_c[j])
+                pooled_j = jnp.sum(capc_j)
+                idx_j, vals_j = _coarse_rank(cap_j, kk, n)
+                live_j = (vals_j < _BIG).astype(jnp.int32)
+                cap_jk = jnp.take(cap_j, idx_j) * live_j
+                capc_jk = jnp.take(capc_j, idx_j) * live_j
+                covered = jnp.sum(capc_jk) >= need_c[j]
+                use_restricted = covered | (pooled_j < need_c[j])
+
+                def restricted(_):
+                    t_k, f = _one(cap_jk, capc_jk, need_c[j])
+                    # .add, not .set: sentinel slots may alias a real
+                    # node's index (their take is 0 — capc masked)
+                    take = jnp.zeros((n,), jnp.int32).at[idx_j].add(t_k)
+                    return take, f
+
+                def dense_col(_):
+                    return _one(cap_j, capc_j, need_c[j])
+
+                take_j, feas_j = jax.lax.cond(
+                    use_restricted, restricted, dense_col, None
+                )
+                left = left - take_j[:, None] * req_c[j][None, :]
+                dense_n = dense_n + (~use_restricted).astype(jnp.int32)
+                takes.append(take_j)
+                feats.append(feas_j)
+            return (
+                jnp.stack(takes), jnp.stack(feats), left, jnp.bool_(True),
+                dense_n,
+            )
+
+        def mega(left):
+            # uniform-wave aggregate stream, restricted to K candidates:
+            # the stream consumes nodes in (tightness, index) order, i.e.
+            # exactly the candidate order, so a covering candidate set
+            # makes the plain exclusive cumsum over candidates the whole
+            # boundary machinery — no [_BINS, N] histogram, no [W+1, N]
+            # masked cumsums
+            req0 = req_c[0]
+            row = mask_c[0]
+            cap0 = _member_capacity(left, req0[None, :]) * row  # [N]
+            capc_t = jnp.minimum(cap0, total_need)  # stream units per node
+            idx, vals = _coarse_rank(cap0, kk, n)
+            live = (vals < _BIG).astype(jnp.int32)
+            cap_k = jnp.take(cap0, idx) * live
+            capc_k = jnp.take(capc_t, idx) * live
+            covered = jnp.sum(capc_k) >= total_need
+            # exact boundary feasibility: dense sums split into pooled
+            # full-N terms (wave-entry, no stream) + candidate-only
+            # corrections — non-candidates take nothing from the stream
+            pooled_need = jnp.sum(
+                jnp.minimum(cap0[None, :], need_c[:, None]), axis=1
+            )  # [W]
+            prefix = _cumsum(capc_k[None, :], axis=1)[0] - capc_k  # excl
+            bounds = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32), jnp.cumsum(need_c)]
+            )  # [W+1]
+            taken = jnp.clip(
+                bounds[:, None] - prefix[None, :], 0, capc_k[None, :]
+            )  # [W+1, kk]
+            cand_entry = jnp.minimum(cap_k[None, :], need_c[:, None])
+            cand_post = jnp.minimum(
+                cap_k[None, :] - taken[:-1], need_c[:, None]
+            )
+            feas = (
+                pooled_need
+                - jnp.sum(cand_entry, axis=1)
+                + jnp.sum(cand_post, axis=1)
+            ) >= need_c
+            all_ok = covered & jnp.all(feas)
+
+            def commit(left):
+                takes_m = taken[1:] - taken[:-1]  # [W, kk]
+                # .add, not .set: sentinel slots may alias a real node's
+                # index (their take is 0 — capc masked at the gather)
+                takes_full = (
+                    jnp.zeros((w, n), jnp.int32).at[:, idx].add(takes_m)
+                )
+                left_after = left.at[idx].add(
+                    -(taken[-1][:, None] * req0[None, :])
+                )
+                return (
+                    takes_full,
+                    jnp.ones((w,), bool),
+                    left_after,
+                    jnp.bool_(False),
+                    jnp.int32(0),
+                )
+
+            return jax.lax.cond(all_ok, commit, replay_wave, left)
+
+        def speculative(left):
+            cap = (
+                _member_capacity(left[None, :, :], req_c[:, None, :]) * mask_c
+            )  # [W, N]
+            capc = jnp.minimum(cap, need_c[:, None])
+            pooled = jnp.sum(capc, axis=1)
+            idx, vals = _coarse_rank(cap, kk, n)  # [W, kk]
+            live = (vals < _BIG).astype(jnp.int32)
+            cap_k = jnp.take_along_axis(cap, idx, axis=1) * live
+            capc_k = jnp.take_along_axis(capc, idx, axis=1) * live
+            covered = jnp.sum(capc_k, axis=1) >= need_c
+            ok_gang = covered | (pooled < need_c)
+            takes_k, feas_k = select_wave(cap_k, capc_k, need_c)
+            # conflict check on the union of the wave's candidate columns
+            # (every take lands inside it; untouched non-candidates are
+            # covered by the per-gang bound — see docstring)
+            ucols = idx.reshape(-1)  # [U]
+            left_u = jnp.take(left, ucols, axis=0)  # [U, R]
+            mask_u = jnp.take(mask_c, ucols, axis=1)  # [W?, U]
+            cap0_u = jnp.take(cap, ucols, axis=1)  # [W, U]
+            eq = (idx[:, :, None] == ucols[None, None, :]).astype(jnp.int32)
+            t_u = jnp.sum(takes_k[:, :, None] * eq, axis=1)  # [W, U]
+            deltas_u = t_u[:, :, None] * req_c[:, None, :]  # [W, U, R]
+            acc = left_u
+            prefixed = []
+            for j in range(w):
+                prefixed.append(acc)
+                acc = jnp.maximum(acc - deltas_u[j], -_BIG)
+            cap_pref_u = _member_capacity(
+                jnp.stack(prefixed), req_c[:, None, :]
+            ) * mask_u
+            conflict = jnp.any(cap_pref_u != cap0_u) | ~jnp.all(ok_gang)
+
+            def fast(left):
+                gang_rows = jax.lax.broadcasted_iota(
+                    jnp.int32, (w, kk), 0
+                )
+                # .add, not .set: sentinel slots may alias a real node's
+                # index (their take is 0 — capc masked at the gather)
+                takes_full = (
+                    jnp.zeros((w, n), jnp.int32)
+                    .at[gang_rows, idx]
+                    .add(takes_k)
+                )
+                flat = (takes_k[:, :, None] * req_c[:, None, :]).reshape(
+                    w * kk, r
+                )
+                left_after = left.at[ucols].add(-flat)
+                return (
+                    takes_full, feas_k, left_after, jnp.bool_(False),
+                    jnp.int32(0),
+                )
+
+            return jax.lax.cond(conflict, replay_wave, fast, left)
+
+        takes_out, feas_out, left, conflict, dense_n = jax.lax.cond(
+            mega_ok, mega, speculative, left
+        )
+        return left, (takes_out, feas_out, conflict, mega_ok, dense_n)
+
+    left, (takes, placed, conflicts, megas, dense_ns) = jax.lax.scan(
+        step, left0, xs
+    )
+    takes = takes.reshape(g_pad, n)[:g]
+    placed = placed.reshape(g_pad)[:g]
+    alloc = jnp.zeros((g, n), jnp.int32).at[order].set(takes)
+    placed_full = jnp.zeros((g,), bool).at[order].set(placed)
+    if with_stats:
+        return alloc, placed_full, left, (conflicts, megas, dense_ns)
+    return alloc, placed_full, left
+
+
+def assign_gangs_topk_sharded(left0, group_req, remaining, fit_mask, order,
+                              mesh, wave: int = 8, k: int = 16,
+                              with_stats: bool = False):
+    """Node-sharded hierarchical top-K scan: ``assign_gangs_topk``
+    composed with the PR-6 sharding discipline (``assign_gangs_sharded``).
+    Same inputs/outputs as the wavefront scan, bit-identical to the serial
+    scan, with the carried ``[N, R]`` leftover partitioned over the mesh.
+
+    Each shard coarse-ranks ONLY its contiguous node slice (its local
+    top-K by the global composite (tightness, global index) key); the
+    per-wave merge all-gathers one ``[S, W, payload]`` summary — the
+    local candidates' composite keys + need-clipped capacities + pooled
+    sums, a few KB, never node state — and every shard derives the
+    identical global top-K (the K smallest composites of the S·K gathered
+    candidates: each shard's members of the global top-K are necessarily
+    in its local top-K). The exact selection then runs REPLICATED on the
+    merged ``[W, K]`` summary slices, and each shard applies only the
+    takes landing in its own global-index range (winner-applies-locally —
+    no leftover ever crosses shards). The wavefront conflict check runs
+    shard-local on the union columns each shard owns and reduces to one
+    psum bit, so the fast-path budget is ≤ 2 summary-sized collectives
+    per wave (mega waves: 1 — the commit decision is replicated summary
+    arithmetic). Demoted waves replay gang-at-a-time with one summary
+    all-gather per gang whose payload also carries the full ``[_BINS]``
+    tightness histogram, so the dense-column replay (a gang whose
+    candidates cannot cover its need) is served by ``_hist_select`` from
+    the SAME gather — no conditional collectives anywhere: every branch
+    decision is computed from replicated summary data, identical on all
+    shards.
+
+    Stats and demotion semantics match ``assign_gangs_topk``.
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec
+
+    n, r = left0.shape
+    g = group_req.shape[0]
+    w = max(int(wave), 2)
+    axes = _shard_axes(mesh)
+    s = int(np.prod([mesh.shape[a] for a in axes]))
+    per_group_mask = fit_mask.shape[0] != 1
+    if per_group_mask and fit_mask.shape[0] != g:
+        raise ValueError(
+            f"fit_mask rows {fit_mask.shape[0]} must be 1 or match "
+            f"group count {g}"
+        )
+
+    # node-axis shard padding (zero rows: capacity 0 under any mask)
+    n_pad = -(-n // s) * s
+    nl = n_pad // s
+    kk_l = max(1, min(int(k), nl))      # local candidates per shard
+    kk = max(2, min(int(k), s * kk_l))  # merged global candidate width
+    left_p = left0
+    mask = fit_mask.astype(jnp.int32)
+    if n_pad != n:
+        left_p = jnp.pad(left_p, ((0, n_pad - n), (0, 0)))
+        mask = jnp.pad(mask, ((0, 0), (0, n_pad - n)))
+
+    # gang-axis wave chunking, identical to assign_gangs_wavefront
+    steps = -(-g // w)
+    g_pad = steps * w
+    gr = jnp.take(group_req, order, axis=0)
+    rem = jnp.take(remaining, order, axis=0)
+    if per_group_mask:
+        mask = jnp.take(mask, order, axis=0)
+    if g_pad != g:
+        gr = jnp.pad(gr, ((0, g_pad - g), (0, 0)))
+        rem = jnp.pad(rem, ((0, g_pad - g),))
+        if per_group_mask:
+            mask = jnp.pad(mask, ((0, g_pad - g), (0, 0)))
+    gr_w = gr.reshape(steps, w, r)
+    rem_w = rem.reshape(steps, w)
+    if per_group_mask:
+        mask_w = mask.reshape(steps, w, n_pad)
+        mask_uni = jnp.all(mask_w == mask_w[:, :1], axis=(1, 2))
+    else:
+        mask_w = mask  # [1, n_pad]
+        mask_uni = jnp.ones((steps,), bool)
+    mega_need_max = (2**31 - 1) // max(n_pad, 1)
+
+    def shard_body(left_l, gr_w, rem_w, mask_l, mask_uni):
+        sid = jnp.int32(0)
+        for name in axes:
+            sid = sid * mesh.shape[name] + jax.lax.axis_index(name)
+        off = sid * nl
+        earlier = (
+            jax.lax.broadcasted_iota(jnp.int32, (s, 1, 1), 0) < sid
+        )  # [S,1,1]
+        bins3 = jax.lax.broadcasted_iota(jnp.int32, (1, _BINS, 1), 1)
+
+        def local_hist(key_l, capc_l):
+            return jnp.sum(
+                jnp.where(key_l[:, None, :] == bins3, capc_l[:, None, :], 0),
+                axis=2,
+            )  # [W?, _BINS]
+
+        def local_rank(cap_l):
+            """Local coarse pass with GLOBAL composite keys: cap_l is
+            [..., nl]; the composite uses off+pos so merged candidates
+            order by (tightness, global node index). Same two-level
+            block rank (and the same caller-must-mask sentinel contract)
+            as the single-device coarse pass."""
+            pos = off + jax.lax.broadcasted_iota(
+                jnp.int32, cap_l.shape, cap_l.ndim - 1
+            )
+            return _coarse_rank(cap_l, kk_l, n_pad, pos=pos)
+
+        def merge_topk(vals_l, extra_l):
+            """ONE summary all-gather per wave: local candidate
+            composites + their payload columns + trailing pooled scalars.
+            Returns (merged composite [.., kk], merged payload columns
+            gathered at the same positions, summed pooled scalars)."""
+            packed = jnp.concatenate(
+                [vals_l] + extra_l["cols"] + [extra_l["sums"]], axis=-1
+            )
+            gathered = jax.lax.all_gather(packed, axes)  # [S, ..., P]
+            lead = gathered.shape[1:-1]
+            vals_all = jnp.moveaxis(
+                gathered[..., :kk_l], 0, -2
+            ).reshape(lead + (s * kk_l,))
+            ncols = len(extra_l["cols"])
+            cols_all = [
+                jnp.moveaxis(
+                    gathered[..., (i + 1) * kk_l:(i + 2) * kk_l], 0, -2
+                ).reshape(lead + (s * kk_l,))
+                for i in range(ncols)
+            ]
+            sums = jnp.sum(gathered[..., (ncols + 1) * kk_l:], axis=0)
+            neg, pos = jax.lax.top_k(-vals_all, kk)
+            vals_m = -neg
+            cols_m = [
+                jnp.take_along_axis(c, pos, axis=-1) for c in cols_all
+            ]
+            return vals_m, cols_m, sums
+
+        def decode(vals_m):
+            """(key, global idx, owned-local idx, owned mask) from merged
+            composites; sentinel entries decode to harmless masked-out
+            rows (their need-clipped capacity is 0)."""
+            key = jnp.minimum(vals_m // (n_pad + 1), _BINS - 1)
+            gidx = vals_m - (vals_m // (n_pad + 1)) * (n_pad + 1)
+            own = (vals_m < _BIG) & (gidx >= off) & (gidx < off + nl)
+            lidx = jnp.clip(gidx - off, 0, nl - 1)
+            return key, gidx, own, lidx
+
+        def step(left, chunk):
+            if per_group_mask:
+                req_c, need_c, uni_mask, mask_c = chunk  # mask_c: [w, nl]
+            else:
+                req_c, need_c, uni_mask = chunk
+                mask_c = mask_l  # [1, nl]
+            total_need = jnp.sum(need_c)
+            uniform = jnp.all(req_c == req_c[0:1]) & uni_mask
+            mega_ok = uniform & (total_need <= mega_need_max)
+
+            def replay_wave(left):
+                # gang-at-a-time: one all-gather per gang whose payload
+                # carries the fresh local top-K AND the [_BINS] histogram,
+                # so both the restricted fill and the dense-column
+                # (_hist_select) branch run from the same summary
+                takes, feats = [], []
+                dense_n = jnp.int32(0)
+                for j in range(w):
+                    row = mask_c[j] if per_group_mask else mask_c[0]
+                    cap_j = (
+                        _member_capacity(left, req_c[j][None, :]) * row
+                    )  # [nl]
+                    capc_j = jnp.minimum(cap_j, need_c[j])
+                    key_j = jnp.minimum(cap_j, _BINS - 1)
+                    lidx_j, vals_j = local_rank(cap_j[None, :])
+                    # sentinel slots may alias a real node: mask their
+                    # capacity out of the summary (_coarse_rank contract)
+                    live_j = (vals_j[0] < _BIG).astype(jnp.int32)
+                    capc_jk = (jnp.take(capc_j, lidx_j[0]) * live_j)[None, :]
+                    hist_j = local_hist(key_j[None, :], capc_j[None, :])
+                    packed = jnp.concatenate(
+                        [
+                            vals_j,
+                            capc_jk,
+                            jnp.sum(capc_j)[None, None],
+                            hist_j,
+                        ],
+                        axis=-1,
+                    )  # [1, 2*kk_l + 1 + _BINS]
+                    gathered = jax.lax.all_gather(packed, axes)
+                    vals_all = gathered[:, 0, :kk_l].reshape(-1)
+                    capc_all = gathered[:, 0, kk_l:2 * kk_l].reshape(-1)
+                    pooled_j = jnp.sum(gathered[:, 0, 2 * kk_l])
+                    hists = gathered[:, :, 2 * kk_l + 1:]  # [S, 1, _BINS]
+                    neg, pos = jax.lax.top_k(-vals_all, kk)
+                    vals_m = -neg
+                    capc_m = jnp.take(capc_all, pos)
+                    key_m, gidx_m, own_m, l_m = decode(vals_m)
+                    covered = jnp.sum(capc_m) >= need_c[j]
+                    use_restricted = covered | (pooled_j < need_c[j])
+
+                    def restricted(_):
+                        t_k, f = _select_best_fit(
+                            key_m[None, :], capc_m[None, :], need_c[j]
+                        )
+                        take = (
+                            jnp.zeros((nl,), jnp.int32)
+                            .at[l_m]
+                            .add(jnp.where(own_m, t_k[0], 0))
+                        )
+                        return take, f
+
+                    def dense_col(_):
+                        bin_tot = jnp.sum(hists, axis=0)  # [1, _BINS]
+                        shard_off = jnp.sum(
+                            jnp.where(earlier, hists, 0), axis=0
+                        )
+                        t, f = _hist_select(
+                            bin_tot, shard_off, key_j[None, :],
+                            capc_j[None, :], need_c[j][None],
+                        )
+                        return t[0], f[0]
+
+                    take_j, feas_j = jax.lax.cond(
+                        use_restricted, restricted, dense_col, None
+                    )
+                    left = left - take_j[:, None] * req_c[j][None, :]
+                    dense_n = dense_n + (~use_restricted).astype(jnp.int32)
+                    takes.append(take_j)
+                    feats.append(feas_j)
+                return (
+                    jnp.stack(takes), jnp.stack(feats), left,
+                    jnp.bool_(True), dense_n,
+                )
+
+            def mega(left):
+                # uniform-wave aggregate stream on merged candidates;
+                # the commit decision is replicated summary arithmetic —
+                # ONE collective for the whole wave
+                req0 = req_c[0]
+                row = mask_c[0]
+                cap0 = _member_capacity(left, req0[None, :]) * row  # [nl]
+                capc_t = jnp.minimum(cap0, total_need)
+                # raw capacities capped high enough that every min() in
+                # the feasibility algebra is unchanged (see local mega)
+                need_max = jnp.max(need_c)
+                capx = jnp.minimum(cap0, total_need + need_max)
+                lidx, vals_l = local_rank(cap0[None, :])
+                # sentinel slots may alias a real node: mask their
+                # capacities out of the summary (_coarse_rank contract)
+                live_l = (vals_l[0] < _BIG).astype(jnp.int32)
+                capc_lk = (jnp.take(capc_t, lidx[0]) * live_l)[None, :]
+                capx_lk = (jnp.take(capx, lidx[0]) * live_l)[None, :]
+                pooled_need = jnp.sum(
+                    jnp.minimum(cap0[None, :], need_c[:, None]), axis=1
+                )  # [W] local
+                vals_m, (capc_m, capx_m), sums = merge_topk(
+                    vals_l,
+                    {"cols": [capc_lk, capx_lk],
+                     "sums": pooled_need[None, :]},
+                )
+                vals_m, capc_m, capx_m = vals_m[0], capc_m[0], capx_m[0]
+                pooled_need_g = sums[0]  # [W] global
+                key_m, gidx_m, own_m, l_m = decode(vals_m)
+                covered = jnp.sum(capc_m) >= total_need
+                prefix = _cumsum(capc_m[None, :], axis=1)[0] - capc_m
+                bounds = jnp.concatenate(
+                    [jnp.zeros((1,), jnp.int32), jnp.cumsum(need_c)]
+                )
+                taken = jnp.clip(
+                    bounds[:, None] - prefix[None, :], 0, capc_m[None, :]
+                )  # [W+1, kk]
+                cand_entry = jnp.minimum(capx_m[None, :], need_c[:, None])
+                cand_post = jnp.minimum(
+                    capx_m[None, :] - taken[:-1], need_c[:, None]
+                )
+                feas = (
+                    pooled_need_g
+                    - jnp.sum(cand_entry, axis=1)
+                    + jnp.sum(cand_post, axis=1)
+                ) >= need_c
+                all_ok = covered & jnp.all(feas)
+
+                def commit(left):
+                    takes_m = taken[1:] - taken[:-1]  # [W, kk]
+                    owned_takes = jnp.where(own_m[None, :], takes_m, 0)
+                    takes_full = (
+                        jnp.zeros((w, nl), jnp.int32)
+                        .at[:, l_m]
+                        .add(owned_takes)
+                    )
+                    stream_take = jnp.where(own_m, taken[-1], 0)
+                    left_after = left.at[l_m].add(
+                        -(stream_take[:, None] * req0[None, :])
+                    )
+                    return (
+                        takes_full,
+                        jnp.ones((w,), bool),
+                        left_after,
+                        jnp.bool_(False),
+                        jnp.int32(0),
+                    )
+
+                return jax.lax.cond(all_ok, commit, replay_wave, left)
+
+            def speculative(left):
+                cap = (
+                    _member_capacity(left[None, :, :], req_c[:, None, :])
+                    * mask_c
+                )  # [w, nl]
+                capc = jnp.minimum(cap, need_c[:, None])
+                pooled_l = jnp.sum(capc, axis=1)  # [w] local
+                lidx, vals_l = local_rank(cap)  # [w, kk_l]
+                # sentinel slots may alias a real node: mask their
+                # capacity out of the summary (_coarse_rank contract)
+                capc_lk = jnp.take_along_axis(capc, lidx, axis=1) * (
+                    vals_l < _BIG
+                ).astype(jnp.int32)
+                vals_m, (capc_m,), sums = merge_topk(
+                    vals_l,
+                    {"cols": [capc_lk], "sums": pooled_l[:, None]},
+                )  # vals_m/capc_m: [w, kk]
+                pooled = sums[:, 0]  # [w] global
+                key_m, gidx_m, own_m, l_m = decode(vals_m)
+                covered = jnp.sum(capc_m, axis=1) >= need_c
+                ok_gang = covered | (pooled < need_c)
+                takes_k, feas_k = _select_best_fit_wave(
+                    key_m, capc_m, need_c
+                )
+                # conflict check: each shard verifies the union columns
+                # IT OWNS under the exclusive prefix of replicated takes,
+                # reduced to one bit
+                ucols_g = gidx_m.reshape(-1)  # [U] global
+                own_u = own_m.reshape(-1)
+                l_u = l_m.reshape(-1)
+                left_u = jnp.take(left, l_u, axis=0)  # [U, R]
+                mask_u = jnp.take(mask_c, l_u, axis=1)  # [W?, U]
+                cap0_u = (
+                    _member_capacity(
+                        left_u[None, :, :], req_c[:, None, :]
+                    ) * mask_u
+                )  # [w, U] — wave-entry capacities of the union columns
+                eq = (
+                    gidx_m[:, :, None] == ucols_g[None, None, :]
+                ).astype(jnp.int32) * own_m[:, :, None].astype(jnp.int32)
+                t_u = jnp.sum(
+                    (takes_k * own_m.astype(jnp.int32))[:, :, None] * eq,
+                    axis=1,
+                )  # [w, U] — owned take mass per union column
+                deltas_u = t_u[:, :, None] * req_c[:, None, :]
+                acc = left_u
+                prefixed = []
+                for j in range(w):
+                    prefixed.append(acc)
+                    acc = jnp.maximum(acc - deltas_u[j], -_BIG)
+                cap_pref_u = _member_capacity(
+                    jnp.stack(prefixed), req_c[:, None, :]
+                ) * mask_u
+                conflict_l = jnp.any(
+                    (cap_pref_u != cap0_u) & own_u[None, :]
+                ).astype(jnp.int32)
+                bad = conflict_l + (~jnp.all(ok_gang)).astype(jnp.int32)
+                conflict = jax.lax.psum(bad, axes) > 0
+
+                def fast(left):
+                    gang_rows = jax.lax.broadcasted_iota(
+                        jnp.int32, (w, kk), 0
+                    )
+                    owned_takes = jnp.where(own_m, takes_k, 0)
+                    takes_full = (
+                        jnp.zeros((w, nl), jnp.int32)
+                        .at[gang_rows, l_m]
+                        .add(owned_takes)
+                    )
+                    flat = (
+                        owned_takes[:, :, None] * req_c[:, None, :]
+                    ).reshape(w * kk, r)
+                    left_after = left.at[l_u].add(-flat)
+                    return (
+                        takes_full, feas_k, left_after, jnp.bool_(False),
+                        jnp.int32(0),
+                    )
+
+                return jax.lax.cond(conflict, replay_wave, fast, left)
+
+            takes_out, feas_out, left, conflict, dense_n = jax.lax.cond(
+                mega_ok, mega, speculative, left
+            )
+            return left, (takes_out, feas_out, conflict, mega_ok, dense_n)
+
+        xs = (gr_w, rem_w, mask_uni)
+        if per_group_mask:
+            xs = xs + (mask_l,)
+        left_l, (takes, placed, conflicts, megas, dense_ns) = jax.lax.scan(
+            step, left_l, xs
+        )
+        return left_l, takes, placed, conflicts, megas, dense_ns
+
+    P = PartitionSpec
+    mask_in_spec = (
+        P(None, None, axes) if per_group_mask else P(None, axes)
+    )
+    sharded = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(
+            P(axes, None),
+            P(None, None, None),
+            P(None, None),
+            mask_in_spec,
+            P(None),
+        ),
+        out_specs=(
+            P(axes, None),
+            P(None, None, axes),
+            P(None, None),
+            P(None),
+            P(None),
+            P(None),
+        ),
+        check_rep=False,
+    )
+    left_after, takes, placed, conflicts, megas, dense_ns = sharded(
+        left_p, gr_w, rem_w, mask_w, mask_uni
+    )
+    takes = takes.reshape(g_pad, n_pad)[:g, :n]
+    placed = placed.reshape(g_pad)[:g]
+    alloc = jnp.zeros((g, n), jnp.int32).at[order].set(takes)
+    placed_full = jnp.zeros((g,), bool).at[order].set(placed)
+    left_after = left_after[:n]
+    if with_stats:
+        return alloc, placed_full, left_after, (conflicts, megas, dense_ns)
+    return alloc, placed_full, left_after
+
+
+def _select_best_fit_wave(key_rows, capc_rows, need):
+    """Vmapped ``_select_best_fit`` over summary candidate rows: ``cap``
+    is passed as the (already clamped) tightness key — the selection only
+    ever consumes ``min(cap, _BINS-1)``, so the key is a sufficient
+    stand-in when raw capacities did not ride the summary."""
+    def _one(key_r, capc_r, nd):
+        take2d, feas = _select_best_fit(
+            key_r[None, :], capc_r[None, :], nd
+        )
+        return take2d[0], feas
+
+    return jax.vmap(_one)(key_rows, capc_rows, need)
+
+
 # Process-wide gate for the wavefront scan (mirrors _pallas_enabled): a
 # compile/runtime failure on the wavefront path disables it for the process
 # and batches fall back to the serial scan. List-wrapped for lock-free
@@ -1022,8 +1814,63 @@ def scan_sharded_active() -> bool:
     the matching layout (``shard_snapshot_args(..., flat_nodes=...)``) —
     placing node state in the 2-D scoring layout while the scan runs the
     sharded rung makes GSPMD reshard the [N,R] lanes at the shard_map
-    boundary, exactly the node-state movement the rung exists to avoid."""
+    boundary, exactly the node-state movement the rung exists to avoid.
+    The sharded top-K rung composes with (and rides) the same layout."""
     return _sharded_enabled[0] and _scan_sharded_from_env()
+
+
+# Process-wide gate for the hierarchical top-K scan rung (mirrors
+# _sharded_enabled): a compile/runtime failure on the top-K path demotes
+# batches to the next ladder rung (sharded on a mesh, else the wavefront/
+# serial ladder) for the process, without touching the other gates. Same
+# lock-free benign-race contract as every gate here.
+_topk_enabled = [True]
+
+_TOPK_ENV = "BST_SCAN_TOPK"
+_topk_env_warned = [False]
+
+
+def _scan_topk_from_env() -> int:
+    """Parse the env-gated candidate width for the hierarchical top-K
+    scan: 0/unset = rung off (the dense ladder below it), anything else
+    bucketed to a static width (ops.bucketing.topk_bucket) so jit
+    signatures stay bounded. Same parse-guard idiom as BST_SCAN_WAVE: a
+    typo'd knob degrades to the dense ladder, never crashes a batch."""
+    raw = os.environ.get(_TOPK_ENV, "")
+    if not raw:
+        return 0
+    try:
+        requested = int(raw)
+    except ValueError:
+        if not _topk_env_warned[0]:
+            _topk_env_warned[0] = True
+            import sys
+
+            print(
+                f"ignoring unparseable {_TOPK_ENV}={raw!r}; "
+                "using the dense assignment-scan ladder",
+                file=sys.stderr,
+            )
+        return 0
+    from .bucketing import topk_bucket
+
+    return topk_bucket(requested)
+
+
+def _disable_topk(e: Exception) -> None:
+    _topk_enabled[0] = False
+    import warnings
+
+    warnings.warn(
+        f"hierarchical top-K assignment scan disabled after failure: "
+        f"{e!r}; batches fall back to the dense scan ladder"
+    )
+
+
+def scan_topk_active() -> bool:
+    """True when the next batch will attempt the hierarchical top-K rung
+    (env knob + process gate)."""
+    return _topk_enabled[0] and _scan_topk_from_env() > 0
 
 
 # Max distinct nodes one gang's compact assignment can report; a gang of M
@@ -1036,12 +1883,14 @@ ASSIGNMENT_TOP_K = 128
     jax.jit,
     static_argnames=(
         "use_pallas", "top_k", "scan_mesh", "scan_wave", "scan_shard",
+        "scan_topk",
     ),
 )
 def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
                    group_valid, order, use_pallas: bool = False,
                    top_k: int = ASSIGNMENT_TOP_K, scan_mesh=None,
-                   scan_wave: int = 0, scan_shard: bool = False):
+                   scan_wave: int = 0, scan_shard: bool = False,
+                   scan_topk: int = 0):
     """Fused full-batch oracle: leftover -> capacity -> feasibility -> scores
     -> greedy gang assignment, one XLA computation.
 
@@ -1062,6 +1911,13 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
     bit-identical to the serial scan (``assign_gangs_wavefront``; the
     pallas path uses its chunked-grid wavefront kernel variant). 0 = the
     serial scan, the always-working fallback.
+
+    ``scan_topk`` > 0 (the BST_SCAN_TOPK knob, bucketed —
+    ops.bucketing.topk_bucket) selects the hierarchical top-K scan: each
+    wave's exact selection runs on gathered [W, K] candidate slices with
+    demotion-backed bit-identity (``assign_gangs_topk``); on a mesh with
+    ``scan_shard`` it composes with the node-sharded merge
+    (``assign_gangs_topk_sharded``). The XL-tier rung.
 
     This is the ``fit()`` of SURVEY.md §7: everything the control plane needs
     for one scheduling batch in a single device round-trip.
@@ -1097,7 +1953,29 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
             left, group_req, remaining, fit_mask,
         )
     wave_stats = None
-    if scan_mesh is not None and scan_shard:
+    topk_stats = None
+    if scan_topk > 0:
+        # Hierarchical top-K rung (the XL tier): coarse-rank candidates,
+        # exact selection on [G, K] gathered slices, demotion-backed
+        # bit-identity (docs/scan_parallelism.md "Hierarchical top-K").
+        # Composes with the node-sharded merge when the mesh layout is
+        # live; otherwise runs on the (replicated) single-device layout.
+        topk_wave = scan_wave if scan_wave > 1 else _SHARD_DEFAULT_WAVE
+        if scan_mesh is not None and scan_shard:
+            assignment, placed, left_after, topk_stats = (
+                assign_gangs_topk_sharded(
+                    scan_left, scan_gr, scan_rem, scan_fm, order,
+                    mesh=scan_mesh, wave=topk_wave, k=scan_topk,
+                    with_stats=True,
+                )
+            )
+        else:
+            assignment, placed, left_after, topk_stats = assign_gangs_topk(
+                scan_left, scan_gr, scan_rem, scan_fm, order,
+                wave=topk_wave, k=scan_topk, with_stats=True,
+            )
+        wave_stats = topk_stats[:2]
+    elif scan_mesh is not None and scan_shard:
         # Node-sharded wavefront scan (the partitioned path that finally
         # wins): each shard scores only its node slice and the per-wave
         # merge moves [S, W, _BINS] summary ints — never node state. The
@@ -1147,6 +2025,8 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
     }
     if wave_stats is not None:
         out["wave_conflicts"], out["wave_megas"] = wave_stats
+    if topk_stats is not None:
+        out["topk_demotions"] = topk_stats[2]
     if assignment.shape[1] <= 2**15:
         # Compact fetch: (node << 16 | count) halves the host-link bytes for
         # the top-K assignment — the bulk of the per-batch result transfer.
@@ -1180,7 +2060,8 @@ def _batch_blob_impl(alloc_lanes, requested, group_req, remaining, fit_mask,
                      ineligible, creation_rank, use_pallas: bool = False,
                      pack_assignment: bool = True,
                      top_k: int = ASSIGNMENT_TOP_K, scan_mesh=None,
-                     scan_wave: int = 0, scan_shard: bool = False):
+                     scan_wave: int = 0, scan_shard: bool = False,
+                     scan_topk: int = 0):
     """One device computation for a whole control-plane batch: the fused
     oracle + findMaxPG, with every O(G) host-needed output concatenated into
     a single int32 blob. On a high-latency host<->device link (the axon
@@ -1196,17 +2077,20 @@ def _batch_blob_impl(alloc_lanes, requested, group_req, remaining, fit_mask,
       [3G+2:...]   assignment top-K: packed (node<<16|count), G*K — or, when
                    ``pack_assignment=False``, nodes then counts, 2*G*K
       [tail..]     wavefront scan stats, ONLY when the lax wavefront scan
-                   ran (scan_wave > 1 and not use_pallas) or the node-
-                   sharded scan did (scan_shard): 3 int32 —
-                   waves-per-batch (sequential steps), conflict-demoted
-                   waves (serial replays), uniform-fastpath waves. Static
-                   per jit signature, so collect_batch slices by the same
+                   ran (scan_wave > 1 and not use_pallas), the node-
+                   sharded scan did (scan_shard), or the top-K scan did
+                   (scan_topk): 3 int32 — waves-per-batch (sequential
+                   steps), conflict-demoted waves (serial replays),
+                   uniform-fastpath waves — plus a 4th int32 (dense-
+                   column demotions) on the top-K rung only. Static per
+                   jit signature, so collect_batch slices by the same
                    predicate.
     """
     out = schedule_batch(alloc_lanes, requested, group_req, remaining,
                          fit_mask, group_valid, order, use_pallas=use_pallas,
                          top_k=top_k, scan_mesh=scan_mesh,
-                         scan_wave=scan_wave, scan_shard=scan_shard)
+                         scan_wave=scan_wave, scan_shard=scan_shard,
+                         scan_topk=scan_topk)
     best, exists, progress = find_max_group(min_member, scheduled, matched,
                                             ineligible, creation_rank)
     if pack_assignment:
@@ -1225,15 +2109,16 @@ def _batch_blob_impl(alloc_lanes, requested, group_req, remaining, fit_mask,
     ]
     if "wave_conflicts" in out:
         conflicts, megas = out["wave_conflicts"], out["wave_megas"]
-        parts.append(
-            jnp.concatenate(
-                [
-                    jnp.full((1,), conflicts.shape[0], jnp.int32),
-                    conflicts.astype(jnp.int32).sum(keepdims=True),
-                    megas.astype(jnp.int32).sum(keepdims=True),
-                ]
+        stat_parts = [
+            jnp.full((1,), conflicts.shape[0], jnp.int32),
+            conflicts.astype(jnp.int32).sum(keepdims=True),
+            megas.astype(jnp.int32).sum(keepdims=True),
+        ]
+        if "topk_demotions" in out:
+            stat_parts.append(
+                out["topk_demotions"].astype(jnp.int32).sum(keepdims=True)
             )
-        )
+        parts.append(jnp.concatenate(stat_parts))
     blob = jnp.concatenate(parts)
     if scan_mesh is not None:
         # The blob concatenates pieces with MIXED shardings (gang_feasible
@@ -1254,7 +2139,7 @@ def _batch_blob_impl(alloc_lanes, requested, group_req, remaining, fit_mask,
 
 
 _BLOB_STATICS = ("use_pallas", "pack_assignment", "top_k", "scan_mesh",
-                 "scan_wave", "scan_shard")
+                 "scan_wave", "scan_shard", "scan_topk")
 _batch_blob = jax.jit(_batch_blob_impl, static_argnames=_BLOB_STATICS)
 # Donated variant for the double-buffered dispatch-ahead pipeline: the two
 # [N, R] inputs (alloc, requested) are donated so XLA can reuse their
@@ -1309,14 +2194,14 @@ class PendingBatch:
     __slots__ = (
         "blob", "out", "pack", "used_pallas", "_rerun", "blob_np",
         "mask_mode", "used_wave", "compiled", "n_bucket", "g_bucket",
-        "pinned", "used_shard", "shard_count",
+        "pinned", "used_shard", "shard_count", "used_topk",
     )
 
     def __init__(
         self, blob, out, pack, used_pallas, rerun, blob_np=None,
         mask_mode="broadcast", used_wave=0, compiled=None,
         n_bucket=0, g_bucket=0, pinned=False, used_shard=False,
-        shard_count=0,
+        shard_count=0, used_topk=0,
     ):
         self.blob = blob
         self.out = out
@@ -1343,6 +2228,9 @@ class PendingBatch:
         # device count: collect's blame policy and telemetry need both
         self.used_shard = used_shard
         self.shard_count = shard_count
+        # hierarchical top-K rung: the candidate width this batch ran
+        # with (0 = rung off); collect's blame + tail slicing need it
+        self.used_topk = used_topk
 
 
 def dispatch_batch(
@@ -1378,12 +2266,17 @@ def dispatch_batch(
     # at the wavefront width when one is set, else its own default — the
     # per-wave merge collective is the whole point of the rung.
     scan_sharded = scan_mesh is not None and scan_sharded_active()
+    # Hierarchical top-K rung (the XL tier): env + process gate; on a
+    # mesh it composes with the sharded layout, single-device it runs
+    # the local variant. Sits ABOVE the sharded rung in the ladder.
+    scan_topk = _scan_topk_from_env() if _topk_enabled[0] else 0
     # replay/identity-audit rung pin (forced_scan_rung): this thread runs
     # the requested rung, with the pallas gates still honored (a pinned
     # pallas rung off-TPU would fail every batch) and the permanent
     # disable-on-failure policy suppressed below. Pins name explicit
-    # (pallas, wave) rungs — the sharded rung is never pinned; its
-    # recorded batches are verified by CROSS-rung replay identity.
+    # (pallas, wave, topk) rungs — the sharded mesh variants are never
+    # pinned; their recorded batches are verified by CROSS-rung replay
+    # identity.
     forced = getattr(_rung_override, "value", None)
     if forced is not None:
         use_pallas = (
@@ -1391,6 +2284,7 @@ def dispatch_batch(
             and jax.default_backend() == "tpu"
         )
         scan_wave = forced[1]
+        scan_topk = forced[2] if len(forced) > 2 else 0
         scan_sharded = False
     # The packed form saturates per-node counts at 65535; a take can reach
     # the gang's full remaining count on one node, so gate the compact form
@@ -1417,11 +2311,13 @@ def dispatch_batch(
     except Exception:  # noqa: BLE001 — telemetry only
         cache_before = None
 
-    def run(up: bool, wave: int = 0, dn: bool = False, sh: bool = False):
+    def run(up: bool, wave: int = 0, dn: bool = False, sh: bool = False,
+            tk: int = 0):
         fn = _batch_blob_donated if dn else _batch_blob
         return fn(
             *batch_args, *progress_args, use_pallas=up, pack_assignment=pack,
             top_k=top_k, scan_mesh=scan_mesh, scan_wave=wave, scan_shard=sh,
+            scan_topk=tk,
         )
 
     # Fallback ladder, most-capable first. Each downgrade drops exactly
@@ -1430,30 +2326,33 @@ def dispatch_batch(
     # (a cache-hit dispatch alone proves nothing, so the fallback forces
     # the device round-trip; the fetched copy is kept for collect). If
     # every rung fails, the problem is the batch/link, not the feature —
-    # the original error surfaces. Rungs are (use_pallas, wave, sharded);
-    # the sharded merge rung (mesh batches) sits on top and demotes to
-    # the replicated-scan layout, which keeps its own wave/pallas ladder.
+    # the original error surfaces. Rungs are (use_pallas, wave, sharded,
+    # topk); the hierarchical top-K rung sits on TOP (composing with the
+    # sharded layout on a mesh) and demotes to the sharded merge rung,
+    # which demotes to the replicated-scan layout with its own
+    # wave/pallas ladder.
+    ladder_wave = scan_wave if scan_wave > 1 else _SHARD_DEFAULT_WAVE
     attempts = []
+    if scan_topk:
+        attempts.append((False, ladder_wave, scan_sharded, scan_topk))
     if scan_sharded:
-        attempts.append(
-            (False, scan_wave if scan_wave > 1 else _SHARD_DEFAULT_WAVE, True)
-        )
-    attempts.append((use_pallas, scan_wave, False))
+        attempts.append((False, ladder_wave, True, 0))
+    attempts.append((use_pallas, scan_wave, False, 0))
     if scan_wave:
-        attempts.append((use_pallas, 0, False))
+        attempts.append((use_pallas, 0, False, 0))
     if use_pallas:
-        attempts.append((False, 0, False))
+        attempts.append((False, 0, False, 0))
 
     blob_np = None
     blob = out = None
     errors: list = []
-    used_pallas, used_wave, used_shard = attempts[0]
-    for i, (up, wave, sh) in enumerate(attempts):
+    used_pallas, used_wave, used_shard, used_topk = attempts[0]
+    for i, (up, wave, sh, tk) in enumerate(attempts):
         try:
             # only the first rung donates: a fallback rung re-runs from the
             # same caller args, which a donated first attempt may already
             # have consumed on-device — the ladder must stay replayable
-            blob, out = run(up, wave, dn=donate and i == 0, sh=sh)
+            blob, out = run(up, wave, dn=donate and i == 0, sh=sh, tk=tk)
             if i > 0:
                 blob_np = np.asarray(jax.device_get(blob))
         except Exception as e:  # noqa: BLE001 — lowering/compile failure
@@ -1461,14 +2360,19 @@ def dispatch_batch(
             if i == len(attempts) - 1:
                 raise errors[0] from None
             continue
-        used_pallas, used_wave, used_shard = up, wave, sh
+        used_pallas, used_wave, used_shard, used_topk = up, wave, sh, tk
         if i > 0 and forced is None:
             # this rung executed where the one above it failed: the single
-            # feature dropped between the two is provably at fault. A
-            # PINNED (replay) thread skips the permanent disable: its
-            # failure is replay evidence, not a serving-path verdict.
-            prev_up, prev_wave, prev_sh = attempts[i - 1]
-            if prev_sh and not sh:
+            # feature dropped between the two is provably at fault (the
+            # top-K rung owns its whole coarse/gather machinery, so its
+            # failure blames top-K even when the next rung also changes
+            # layout). A PINNED (replay) thread skips the permanent
+            # disable: its failure is replay evidence, not a serving-path
+            # verdict.
+            prev_up, prev_wave, prev_sh, prev_tk = attempts[i - 1]
+            if prev_tk and not tk:
+                _disable_topk(errors[-1])
+            elif prev_sh and not sh:
                 _disable_sharded(errors[-1])
             elif prev_wave and not wave and prev_up == up:
                 _disable_wave(errors[-1])
@@ -1494,7 +2398,7 @@ def dispatch_batch(
         try:
             _maybe_analyze_bucket(
                 batch_args, progress_args, used_pallas, pack, top_k,
-                used_wave, donated=donate and i == 0,
+                used_wave, donated=donate and i == 0, scan_topk=used_topk,
             )
         except Exception:  # noqa: BLE001 — telemetry only
             pass
@@ -1515,6 +2419,7 @@ def dispatch_batch(
         shard_count=(
             int(np.prod(scan_mesh.devices.shape)) if used_shard else 0
         ),
+        used_topk=used_topk,
     )
 
 
@@ -1543,7 +2448,7 @@ def collect_batch(pending: PendingBatch):
 
 def _collect_batch_inner(pending: PendingBatch):
     used_pallas, used_wave = pending.used_pallas, pending.used_wave
-    used_shard = pending.used_shard
+    used_shard, used_topk = pending.used_shard, pending.used_topk
     try:
         blob_np = (
             pending.blob_np
@@ -1556,22 +2461,27 @@ def _collect_batch_inner(pending: PendingBatch):
             not pending.used_pallas
             and not pending.used_wave
             and not pending.used_shard
+            and not pending.used_topk
         ):
             raise
         # Only blame (and permanently disable) the optional path — the
-        # pallas kernel, the wavefront scan, or the sharded merge — if the
-        # plain serial scan succeeds where it failed; if that fails too,
-        # the problem is the batch/link, not the feature — surface it.
-        # When several were live, the single rerun cannot separate them;
-        # disabling errs toward the always-working path (each re-proves
-        # itself never).
+        # pallas kernel, the wavefront scan, the sharded merge, or the
+        # top-K scan — if the plain serial scan succeeds where it failed;
+        # if that fails too, the problem is the batch/link, not the
+        # feature — surface it. When several were live, the single rerun
+        # cannot separate them; disabling errs toward the always-working
+        # path (each re-proves itself never).
         try:
             blob, out = pending._rerun(False)
             blob_np = np.asarray(jax.device_get(blob))
         except Exception:
             raise e from None
         if not pending.pinned:
-            if pending.used_shard:
+            if pending.used_topk:
+                # the top-K rung owns its whole coarse/gather machinery;
+                # its failure says nothing about the dense ladder below
+                _disable_topk(e)
+            elif pending.used_shard:
                 # the sharded rung owns its whole wave machinery; its
                 # failure says nothing about the replicated wavefront path
                 _disable_sharded(e)
@@ -1581,16 +2491,18 @@ def _collect_batch_inner(pending: PendingBatch):
                 if pending.used_wave:
                     _disable_wave(e)
         # the blob in hand is the serial replicated rerun
-        used_pallas, used_wave, used_shard = False, 0, False
+        used_pallas, used_wave, used_shard, used_topk = False, 0, False, 0
 
     g = out["assignment_nodes"].shape[0]
     k = out["assignment_nodes"].shape[1]
     pack = pending.pack
-    # the wave-stat triple rides at the very end of the blob, only when the
-    # lax wavefront scan (replicated or sharded) produced THIS blob (a
-    # collect-side serial rerun has none) — slice the assignment tail by
-    # its exact static length
-    has_wave_stats = (used_wave > 1 and not used_pallas) or used_shard
+    # the wave-stat triple (plus the top-K demotion count) rides at the
+    # very end of the blob, only when the lax wavefront scan (replicated,
+    # sharded, or top-K) produced THIS blob (a collect-side serial rerun
+    # has none) — slice the assignment tail by its exact static length
+    has_wave_stats = (
+        (used_wave > 1 and not used_pallas) or used_shard or used_topk > 0
+    )
     tail_len = g * k if pack else 2 * g * k
     tail = blob_np[3 * g + 2: 3 * g + 2 + tail_len]
     if pack:
@@ -1608,6 +2520,7 @@ def _collect_batch_inner(pending: PendingBatch):
         "n_bucket": int(pending.n_bucket),
         "g_bucket": int(pending.g_bucket),
         "scan_sharded": bool(used_shard),
+        "scan_topk": int(used_topk),
     }
     if used_shard:
         telemetry["shard_count"] = int(pending.shard_count)
@@ -1617,6 +2530,19 @@ def _collect_batch_inner(pending: PendingBatch):
             telemetry["waves_per_batch"] = int(stats_np[0])
             telemetry["wave_demotions"] = int(stats_np[1])
             telemetry["wave_uniform"] = int(stats_np[2])
+        if used_topk > 0 and stats_np.shape[0] >= 4:
+            telemetry["topk_demotions"] = int(stats_np[3])
+    if used_topk > 0:
+        # coarse-pass cost for TRACE_INFO + the flight recorder: measured
+        # once per (bucket, K) on a standalone jitted coarse pass (the
+        # per-wave capacity sweep + rank), background-landed like the
+        # bucket-cost analysis — None until the probe completes
+        coarse_s = _coarse_pass_seconds(
+            pending.n_bucket, int(out["left"].shape[1]),
+            used_wave if used_wave > 1 else _SHARD_DEFAULT_WAVE, used_topk,
+        )
+        if coarse_s is not None:
+            telemetry["coarse_pass_device_seconds"] = coarse_s
     # per-bucket compiled-cost evidence (flops/bytes/collectives), once the
     # background analysis for this shape has landed — rides to the flight
     # recorder and, on the sidecar, back to the client in TRACE_INFO
@@ -1649,7 +2575,9 @@ def _fold_batch_metrics(telemetry: dict) -> None:
     from ..utils.metrics import DEFAULT_REGISTRY as reg
 
     path = (
-        "pallas"
+        "topk"
+        if telemetry.get("scan_topk", 0) > 0
+        else "pallas"
         if telemetry["used_pallas"]
         else "sharded"
         if telemetry.get("scan_sharded")
@@ -1658,6 +2586,18 @@ def _fold_batch_metrics(telemetry: dict) -> None:
     reg.counter(
         "bst_scan_batches_total", "Oracle batches by assignment-scan path"
     ).inc(path=path)
+    if telemetry.get("scan_topk", 0) > 0:
+        reg.gauge(
+            "bst_scan_topk_k",
+            "Candidate width K of the hierarchical top-K scan (last top-K "
+            "batch)",
+        ).set(float(telemetry["scan_topk"]))
+        reg.counter(
+            "bst_topk_demotions_total",
+            "Gangs demoted to the dense-column replay because their top-K "
+            "candidates could not cover the need while pooled capacity "
+            "remained (the K-mistuned signal)",
+        ).inc(telemetry.get("topk_demotions", 0))
     if telemetry.get("scan_sharded"):
         reg.gauge(
             "bst_scan_shard_count",
@@ -1699,6 +2639,70 @@ def _fold_batch_metrics(telemetry: dict) -> None:
         ).inc(telemetry["wave_uniform"])
 
 
+# -- standalone coarse-pass cost probe (hierarchical top-K telemetry) -------
+#
+# The coarse pass runs fused inside the jitted scan, so its per-batch cost
+# cannot be clocked in-line; instead a daemon thread times ONE standalone
+# jitted coarse step (the [W, N, R] capacity sweep + top-K rank — the only
+# O(N) work in a top-K wave) per (n_bucket, lanes, wave, K) shape, and
+# collect_batch folds the landed figure into batch telemetry /
+# TRACE_INFO as ``coarse_pass_device_seconds``. Same background-landing
+# discipline as the bucket-cost analysis below.
+
+_coarse_probe: dict = {}
+_coarse_probe_lock = threading.Lock()
+_coarse_probe_inflight: set = set()
+
+
+def _coarse_pass_seconds(n_bucket: int, lanes: int, wave: int, k: int):
+    """Measured per-wave coarse-pass seconds for a shape, or None while
+    the background probe has not landed. BST_BUCKET_COST=0 disables (the
+    probe is a compile, same load class as the bucket-cost analysis)."""
+    if os.environ.get("BST_BUCKET_COST", "").strip() == "0":
+        return None
+    key = (int(n_bucket), int(lanes), int(wave), int(k))
+    with _coarse_probe_lock:
+        if key in _coarse_probe:
+            return _coarse_probe[key]
+        if key in _coarse_probe_inflight:
+            return None
+        _coarse_probe_inflight.add(key)
+
+    def _run() -> None:
+        import time
+
+        value = None
+        try:
+            kk = max(2, min(key[3], key[0]))
+
+            @jax.jit
+            def coarse(left, req):
+                cap = _member_capacity(
+                    left[None, :, :], req[:, None, :]
+                )
+                return _coarse_rank(cap, kk, key[0])
+
+            left = jnp.ones((key[0], key[1]), jnp.int32)
+            req = jnp.ones((key[2], key[1]), jnp.int32)
+            jax.block_until_ready(coarse(left, req))
+            times = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                jax.block_until_ready(coarse(left, req))
+                times.append(time.perf_counter() - t0)
+            value = round(float(np.median(times)), 6)
+        except Exception:  # noqa: BLE001 — telemetry only
+            value = None
+        with _coarse_probe_lock:
+            _coarse_probe[key] = value
+            _coarse_probe_inflight.discard(key)
+
+    threading.Thread(
+        target=_run, name="coarse-pass-probe", daemon=True
+    ).start()
+    return None
+
+
 # -- per-bucket HLO cost/memory telemetry (docs/observability.md) -----------
 #
 # When a dispatch BUILDS a new executable (jit-cache miss), a daemon thread
@@ -1738,7 +2742,8 @@ def bucket_cost_for(g_bucket: int, n_bucket: int):
 
 def _maybe_analyze_bucket(batch_args, progress_args, use_pallas: bool,
                           pack: bool, top_k: int, scan_wave: int,
-                          donated: bool = False) -> None:
+                          donated: bool = False,
+                          scan_topk: int = 0) -> None:
     """Kick one background cost analysis for a bucket shape that just
     compiled on the serving path (at most one per (G, N) shape per
     process). Telemetry only: every failure is recorded, never raised."""
@@ -1751,6 +2756,7 @@ def _maybe_analyze_bucket(batch_args, progress_args, use_pallas: bool,
             existing.get("used_pallas") == bool(use_pallas)
             and existing.get("wave_width") == int(scan_wave)
             and existing.get("donated", False) == bool(donated)
+            and existing.get("scan_topk", 0) == int(scan_topk)
         ):
             return
         # a DIFFERENT variant compiled for this shape (e.g. the wave gate
@@ -1781,6 +2787,7 @@ def _maybe_analyze_bucket(batch_args, progress_args, use_pallas: bool,
             compiled = fn.lower(
                 *shapes, use_pallas=use_pallas, pack_assignment=pack,
                 top_k=top_k, scan_mesh=None, scan_wave=scan_wave,
+                scan_topk=scan_topk,
             ).compile()
             entry = {
                 "g_bucket": key[0],
@@ -1790,6 +2797,7 @@ def _maybe_analyze_bucket(batch_args, progress_args, use_pallas: bool,
                 "wave_width": int(scan_wave),
                 "used_pallas": bool(use_pallas),
                 "donated": bool(donated),
+                "scan_topk": int(scan_topk),
                 **compiled_cost_summary(compiled),
             }
         except Exception as e:  # noqa: BLE001 — telemetry only

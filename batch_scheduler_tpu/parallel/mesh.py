@@ -251,7 +251,8 @@ def shard_snapshot_args(
 def sharded_schedule_batch(mesh: Mesh, args: tuple,
                            replicated_scan: bool = True,
                            sharded_scan: bool = False,
-                           scan_wave: int = 0):
+                           scan_wave: int = 0,
+                           scan_topk: int = 0):
     """One fused oracle batch with inputs sharded over the mesh; XLA/GSPMD
     partitions the kernels and inserts the cross-chip collectives.
 
@@ -272,18 +273,25 @@ def sharded_schedule_batch(mesh: Mesh, args: tuple,
       (benchmarks/sharding_scaling.py, SHARDING_r03.json; virtual-mesh
       caveats in the README scaling note).
     - Both False — the naive fully-partitioned GSPMD layout, kept
-      measurable as the cautionary baseline."""
+      measurable as the cautionary baseline.
+
+    ``scan_topk`` > 0 selects the hierarchical top-K scan on whichever
+    layout is live (the XL-tier rung, docs/scan_parallelism.md
+    "Hierarchical top-K"): with ``sharded_scan`` each shard coarse-ranks
+    only its node slice and the per-wave merge moves candidate summaries
+    instead of histograms."""
     sharded = shard_snapshot_args(mesh, args, flat_nodes=sharded_scan)
     return okern.schedule_batch(
         *sharded,
         scan_mesh=mesh if (replicated_scan or sharded_scan) else None,
         scan_shard=sharded_scan,
         scan_wave=scan_wave,
+        scan_topk=scan_topk,
     )
 
 
 def sharded_scan_collective_counts(
-    mesh: Mesh, args: tuple, wave: int = 8
+    mesh: Mesh, args: tuple, wave: int = 8, topk: int = 0
 ) -> dict:
     """Collective budget of the node-sharded assignment SCAN alone.
 
@@ -303,6 +311,13 @@ def sharded_scan_collective_counts(
       fast-path cost is ≤ 2 collectives per wave (one summary all-gather,
       one verify reduce) by construction;
     - ``waves`` — sequential steps per batch at this (G, wave).
+
+    ``topk`` > 0 lowers the hierarchical top-K sharded scan instead
+    (ops.oracle.assign_gangs_topk_sharded): the per-wave summary is then
+    the merged candidate payload (composites + clipped capacities +
+    pooled scalars; the gang-at-a-time replay adds a [_BINS] histogram
+    per gang), still never node state — same ≤2-per-wave fast-path
+    budget.
     """
     (alloc, requested, group_req, remaining, fit_mask, _gv, order) = tuple(
         np.asarray(a) for a in args
@@ -310,6 +325,11 @@ def sharded_scan_collective_counts(
 
     def scan_only(alloc, requested, group_req, remaining, fit_mask, order):
         left = okern.left_resources(alloc, requested)
+        if topk > 0:
+            return okern.assign_gangs_topk_sharded(
+                left, group_req, remaining, fit_mask, order, mesh=mesh,
+                wave=wave, k=topk, with_stats=True,
+            )
         return okern.assign_gangs_sharded(
             left, group_req, remaining, fit_mask, order, mesh=mesh,
             wave=wave, with_stats=True,
@@ -325,10 +345,21 @@ def sharded_scan_collective_counts(
     s = int(mesh.devices.size)
     w = max(int(wave), 2)
     g = int(group_req.shape[0])
+    if topk > 0:
+        n_pad = -(-int(alloc.shape[0]) // s) * s
+        kk_l = max(1, min(int(topk), n_pad // s))
+        # largest per-wave payload across the three paths: speculative
+        # [S, W, 2K_l+1], mega [S, 1, 3K_l+W], replay [S, 1, 2K_l+1+_BINS]
+        payload = max(
+            w * (2 * kk_l + 1), 3 * kk_l + w, 2 * kk_l + 1 + okern._BINS
+        )
+        summary_bytes = s * payload * 4
+    else:
+        summary_bytes = s * w * okern._BINS * 4
     return {
         "counts": count_collective_instructions(hlo),
         "max_collective_bytes": max((b for _, b in sizes), default=0),
-        "summary_bytes": s * w * okern._BINS * 4,
+        "summary_bytes": summary_bytes,
         "node_state_bytes": int(alloc.shape[0]) * int(alloc.shape[1]) * 4,
         "waves": -(-g // w),
         "fastpath_collectives_per_wave": 2,

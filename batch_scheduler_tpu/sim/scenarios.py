@@ -35,6 +35,9 @@ __all__ = [
     "race_scenario",
     "readback_tail_scenarios",
     "synthetic_cluster",
+    "XLClusterSpec",
+    "xl_scan_operands",
+    "xl_churn_burst",
 ]
 
 
@@ -160,6 +163,116 @@ def readback_tail_scenarios():
         )
     ]
     return (wide_nodes, wide_groups), (big_nodes, big_groups)
+
+
+@dataclass
+class XLClusterSpec:
+    """The 100k-node / 1M-pod XL scale tier (ROADMAP "hierarchical
+    scoring"): packed scan operands, not API objects — at this size the
+    interesting load lives on the device, and the delta snapshot packer
+    (PR 4) already made the host-side pack O(churn).
+
+    Shape knobs model the three things that make an XL control plane hard
+    for a dense O(G·N) scan:
+
+    - **zipf-sized gangs** (``zipf_a``): a heavy-tailed gang-size mix —
+      most gangs are small (place on a handful of nodes), a few are huge
+      (span hundreds) — the regime where per-gang candidate sets K ≪ N.
+    - **hot-pool skew** (``hot_fraction`` / ``hot_load``): a slice of the
+      cluster runs near-full while the rest idles, so the tightest-first
+      selection's winners concentrate in the hot pool and a coarse rank
+      finds them without walking the cold tail.
+    - **churn bursts** (``churn_fraction``, ``xl_churn_burst``): batched
+      release/consume rewrites of a node cohort between scans — the
+      steady-state input mutation a control plane at this size sees
+      every tick.
+
+    ``request_profiles`` > 1 mixes distinct member-request rows so waves
+    stop being uniform and the speculative (non-mega) scan path carries
+    load too; the default models the bulk-submission north-star workload.
+    """
+
+    num_nodes: int = 100_000
+    num_groups: int = 4096
+    lanes: int = 6
+    zipf_a: float = 1.4
+    gang_min: int = 2
+    gang_cap: int = 512
+    hot_fraction: float = 0.125
+    hot_load: float = 0.85
+    cold_load: float = 0.25
+    churn_fraction: float = 0.02
+    request_profiles: int = 1
+    seed: int = 0
+
+
+def xl_scan_operands(spec: XLClusterSpec):
+    """Packed assignment-scan operands for one XL batch:
+    ``(left[N, R], group_req[G, R], remaining[G], fit_mask[1, N],
+    order[G])`` — int32 numpy, ready for ``ops.oracle.assign_gangs*`` or
+    a jitted wrapper (benchmarks/xl_scaling.py). Lane 0 is cpu-like
+    (millicores), lane 1 memory-like (MiB), lane 2 a pod-slot lane, the
+    rest extended-resource lanes (sparse: most nodes saturate them)."""
+    import numpy as np
+
+    rng = np.random.default_rng(spec.seed)
+    n, g, r = spec.num_nodes, spec.num_groups, spec.lanes
+    # node capacity lanes: 64-cpu-class boxes with mild heterogeneity
+    cpu = rng.choice([32_000, 64_000, 96_000], size=n, p=[0.2, 0.6, 0.2])
+    mem = cpu * 4  # MiB-class numbers, same int32 domain
+    pods = np.full(n, 110)
+    lanes = [cpu, mem, pods]
+    for _ in range(r - 3):
+        # sparse extended lanes: a small slice of nodes expose capacity
+        ext = np.where(rng.random(n) < 0.05, 8, 0)
+        lanes.append(ext)
+    capacity = np.stack(lanes[:r], axis=1).astype(np.int64)
+    # hot-pool skew: a contiguous-by-shuffle cohort runs near-full
+    hot = rng.random(n) < spec.hot_fraction
+    load = np.where(hot, spec.hot_load, spec.cold_load)
+    load = load * rng.uniform(0.85, 1.15, size=n)
+    used = (capacity.astype(np.float64) * load[:, None]).astype(np.int64)
+    left = np.clip(capacity - used, 0, None).astype(np.int32)
+
+    # zipf gang sizes, clipped to [gang_min, gang_cap]
+    sizes = rng.zipf(spec.zipf_a, size=g)
+    remaining = np.clip(
+        sizes + spec.gang_min - 1, spec.gang_min, spec.gang_cap
+    ).astype(np.int32)
+    # member-request profiles: 4-cpu-class members; profile > 0 varies
+    # the ratio so waves mixing profiles exercise the speculative path
+    profiles = []
+    for p in range(max(1, spec.request_profiles)):
+        row = np.zeros(r, np.int32)
+        row[0] = 4_000 + 1_000 * p
+        row[1] = 8_192 + 2_048 * p
+        row[2] = 1
+        profiles.append(row)
+    which = rng.integers(0, len(profiles), size=g)
+    if len(profiles) == 1:
+        which[:] = 0
+    group_req = np.stack([profiles[i] for i in which]).astype(np.int32)
+    fit_mask = np.ones((1, n), np.int32)
+    order = rng.permutation(g).astype(np.int32)
+    return left, group_req, remaining, fit_mask, order
+
+
+def xl_churn_burst(spec: XLClusterSpec, left, step: int):
+    """One churn burst: a ``churn_fraction`` cohort of nodes releases or
+    consumes capacity (gangs finishing / landing between scans). Pure
+    numpy on the packed leftover — the device-side input mutation an XL
+    tick loop feeds the scan; deterministic in ``(spec.seed, step)``."""
+    import numpy as np
+
+    rng = np.random.default_rng((spec.seed << 16) ^ (step + 1))
+    n = left.shape[0]
+    cohort = rng.random(n) < spec.churn_fraction
+    scale = rng.uniform(0.5, 1.5, size=(int(cohort.sum()), 1))
+    out = np.array(left, copy=True)
+    out[cohort] = np.clip(
+        out[cohort].astype(np.float64) * scale, 0, 2**30 - 1
+    ).astype(np.int32)
+    return out
 
 
 @dataclass

@@ -1,0 +1,340 @@
+"""Hierarchical top-K scaling measurement (the BENCH_XL_* artifact).
+
+The ROADMAP's 100k-node / 1M-pod tier: dense O(G·N) scoring stops fitting
+20-100x past the north-star bucket, and the two-level pipeline
+(`ops.oracle.assign_gangs_topk`) is the device-side answer — one cheap
+coarse rank per wave keeps the top-K candidate columns, the exact
+wavefront selection runs on the gathered [W, K] slices, and per-gang
+demotion to a dense-column replay keeps plans bit-identical to the dense
+scan by construction (docs/scan_parallelism.md "Hierarchical top-K").
+
+Measured per run (operands from ``sim.scenarios.xl_scan_operands``: zipf
+gang sizes, hot-pool skew, sparse extended lanes):
+
+  1. the XL acceptance bucket (default [G=2048, N=65536]): dense
+     wavefront scan vs the top-K scan across candidate widths — the
+     acceptance bar is >=3x wall-clock with bit-identical plans;
+  2. a small XL bucket ([G=512, N=16384]): same pair plus the serial
+     scan (the paper baseline, too slow to run at the full bucket) and a
+     churn-burst steady-state re-run (`xl_churn_burst`);
+  3. demotion counts at every K (the K-mistuned signal feeding
+     ``bst_topk_demotions``) and the sharded composition's collective
+     budget (`sharded_scan_collective_counts(topk=...)` — candidate
+     summaries only, never node state; the figure that transfers to real
+     chips where virtual-mesh wall-clock cannot);
+  4. a cross-rung audit replay: one batch recorded on the top-K rung
+     replays bit-identically on the cpu-ladder rung through the audit
+     log (the in-production identity claim, not just an in-process
+     array compare).
+
+Run: ``python benchmarks/xl_scaling.py`` (full measurement, one JSON
+line; ``make bench-xl`` runs ``--gate``: one half-acceptance bucket
+[G=1024, N=32768] with a speedup floor + identity + the audit replay as
+a CI gate). ``BST_XL_PLATFORM=default`` skips the CPU forcing for the
+TPU capture step (benchmarks/capture_tpu_artifacts.sh).
+``BST_XL_BUCKET=G,N`` overrides the acceptance bucket.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+_FORCE_CPU = os.environ.get("BST_XL_PLATFORM", "cpu") != "default"
+if _FORCE_CPU:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+# the background bucket-cost/coarse probes add compile load the clocks
+# here would absorb as noise
+os.environ.setdefault("BST_BUCKET_COST", "0")
+
+import jax  # noqa: E402
+
+if _FORCE_CPU:
+    jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time  # noqa: E402
+from functools import partial  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+ITERS = 3
+WAVE = 8
+# K must reach past the zipf gang-size tail's node span to keep demotions
+# rare (hot nodes hold ~2 members, so a 256-member gang spans ~128 tight
+# nodes): the sweep's top width is where the XL acceptance bucket clears
+# its floor, the small widths chart the demotion cost of mistuning
+K_SWEEP = (16, 64, 128)
+GATE_FLOOR = 1.5   # small-bucket CI floor (shared 2-core CI hosts)
+XL_FLOOR = 3.0     # acceptance-bucket floor (ISSUE 7)
+
+
+def _operands(g: int, n: int, seed: int = 1):
+    from batch_scheduler_tpu.sim.scenarios import (
+        XLClusterSpec,
+        xl_scan_operands,
+    )
+
+    spec = XLClusterSpec(num_nodes=n, num_groups=g, lanes=6, seed=seed)
+    return spec, tuple(jnp.asarray(x) for x in xl_scan_operands(spec))
+
+
+def _median(fn, operands, iters=ITERS) -> float:
+    out = fn(*operands)
+    jax.block_until_ready(out)  # compile outside the clock
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*operands))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def _identical(a, b) -> bool:
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(a, b)
+    )
+
+
+def measure_bucket(g: int, n: int, with_serial: bool, ks=K_SWEEP) -> dict:
+    from batch_scheduler_tpu.ops.oracle import (
+        assign_gangs,
+        assign_gangs_topk,
+        assign_gangs_wavefront,
+    )
+    from batch_scheduler_tpu.sim.scenarios import xl_churn_burst
+
+    spec, ops = _operands(g, n)
+    wf = partial(assign_gangs_wavefront, wave=WAVE)
+    entry: dict = {
+        "groups": g,
+        "nodes": n,
+        "wavefront_dense_s": round(_median(wf, ops), 4),
+    }
+    if with_serial:
+        entry["serial_s"] = round(_median(assign_gangs, ops), 4)
+    dense_plan = tuple(np.asarray(x) for x in wf(*ops))
+    best_k, best_s = None, None
+    for k in ks:
+        tk_fn = partial(assign_gangs_topk, wave=WAVE, k=k)
+        t = _median(tk_fn, ops)
+        plan = assign_gangs_topk(*ops, wave=WAVE, k=k, with_stats=True)
+        ident = _identical(dense_plan, plan[:3])
+        demotions = int(np.asarray(plan[3][2]).sum())
+        entry[f"topk_{k}"] = {
+            "scan_s": round(t, 4),
+            "speedup_vs_dense": round(entry["wavefront_dense_s"] / t, 3),
+            "bit_identical": bool(ident),
+            "dense_demotions": demotions,
+        }
+        if ident and (best_s is None or t < best_s):
+            best_k, best_s = k, t
+    entry["best_k"] = best_k
+    entry["best_topk_s"] = round(best_s, 4) if best_s is not None else None
+    entry["best_speedup"] = (
+        round(entry["wavefront_dense_s"] / best_s, 3)
+        if best_s is not None
+        else 0.0
+    )
+    entry["all_identical"] = all(
+        entry[f"topk_{k}"]["bit_identical"] for k in ks
+    )
+    # churn steady state: one burst rewrites a node cohort, the warm jit
+    # re-runs — the per-tick cost an XL control plane actually pays
+    if best_k is not None:
+        left2 = jnp.asarray(xl_churn_burst(spec, np.asarray(ops[0]), step=1))
+        churn_ops = (left2,) + ops[1:]
+        entry["churn_steady_topk_s"] = round(
+            _median(
+                partial(assign_gangs_topk, wave=WAVE, k=best_k),
+                churn_ops,
+                iters=2,
+            ),
+            4,
+        )
+    return entry
+
+
+def audit_cross_rung_replay() -> dict:
+    """Record ONE small batch executed on the top-K rung into an audit
+    ring, then replay it on the cpu-ladder rung and bit-compare — the
+    identity evidence chain production uses (docs/observability.md)."""
+    from batch_scheduler_tpu.core.oracle_scorer import replay_audit_record
+    from batch_scheduler_tpu.ops.oracle import (
+        execute_batch_host,
+        forced_scan_rung,
+    )
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot, GroupDemand
+    from batch_scheduler_tpu.sim.scenarios import make_sim_node
+    from batch_scheduler_tpu.utils import audit as audit_mod
+    from batch_scheduler_tpu.utils.audit import AuditLog, AuditReader
+
+    nodes = [
+        make_sim_node(f"n{i:03d}", {"cpu": "16", "memory": "64Gi",
+                                    "pods": "110"})
+        for i in range(64)
+    ]
+    groups = [
+        GroupDemand(f"default/g{x:03d}", 3 + (x % 4),
+                    member_request={"cpu": 2000}, creation_ts=float(x))
+        for x in range(24)
+    ]
+    snap = ClusterSnapshot(nodes, {}, groups)
+    with forced_scan_rung(False, WAVE, 16):
+        host, _ = execute_batch_host(snap.device_args(),
+                                     snap.progress_args())
+    assert host["telemetry"]["scan_topk"] == 16, host["telemetry"]
+    with tempfile.TemporaryDirectory() as d:
+        log = AuditLog(d)
+        log.record_batch(
+            batch_args=snap.device_args(),
+            progress_args=snap.progress_args(),
+            result=host,
+            plan_digest=audit_mod.plan_digest(host),
+            node_names=snap.node_names,
+            group_names=snap.group_names,
+        )
+        assert log.flush()
+        (rec,), _ = AuditReader(d).batches()
+        log.stop()
+        rep = replay_audit_record(rec, against="cpu-ladder")
+    return {
+        "recorded_rung_topk": 16,
+        "replayed_against": "cpu-ladder",
+        "identical": bool(rep["identical"]),
+        "digest": rec["plan_digest"][:16],
+    }
+
+
+def sharded_budget(g: int, n: int) -> dict:
+    """Collective budget of the sharded top-K composition at a shape the
+    virtual mesh can lower quickly — the evidence that transfers to real
+    chips (candidate summaries only, never [N, R] node state)."""
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot, GroupDemand
+    from batch_scheduler_tpu.parallel.mesh import (
+        make_mesh,
+        sharded_scan_collective_counts,
+    )
+    from batch_scheduler_tpu.sim.scenarios import make_sim_node
+
+    nodes = [
+        make_sim_node(f"n{i:03d}", {"cpu": "16", "memory": "64Gi",
+                                    "pods": "110"})
+        for i in range(n)
+    ]
+    groups = [
+        GroupDemand(f"default/g{x:03d}", 4, member_request={"cpu": 2000},
+                    creation_ts=float(x))
+        for x in range(g)
+    ]
+    args = ClusterSnapshot(nodes, {}, groups).device_args()
+    mesh = make_mesh(min(4, len(jax.devices())))
+    rep = sharded_scan_collective_counts(mesh, args, wave=WAVE, topk=16)
+    rep["summary_sized"] = bool(
+        rep["max_collective_bytes"] <= rep["summary_bytes"]
+    )
+    return rep
+
+
+def main() -> int:
+    gate_only = "--gate" in sys.argv[1:]
+    g_xl, n_xl = 2048, 65536
+    if os.environ.get("BST_XL_BUCKET"):
+        g_xl, n_xl = (int(x) for x in
+                      os.environ["BST_XL_BUCKET"].split(","))
+
+    replay = audit_cross_rung_replay()
+
+    if gate_only:
+        # the CI bucket sits at half the acceptance bucket: big enough
+        # that the algorithmic gap clears the floor with margin on a
+        # noisy shared host (at [512, 16384] the dense scan is still
+        # cheap enough that host jitter swamps the ratio), small enough
+        # to keep the gate in CI time
+        gate = measure_bucket(1024, 32768, with_serial=False,
+                              ks=(16, 128))
+        gate_ok = (
+            gate["all_identical"]
+            and gate["best_speedup"] >= GATE_FLOOR
+            and replay["identical"]
+        )
+        result = {
+            "metric": "xl_topk_gate",
+            "value": gate["best_speedup"],
+            "unit": "speedup_vs_dense_wavefront",
+            "detail": {
+                "platform": jax.default_backend(),
+                "bucket": gate,
+                "gate_floor": GATE_FLOOR,
+                "audit_cross_rung_replay": replay,
+                "passed": bool(gate_ok),
+            },
+        }
+        print(json.dumps(result))
+        return 0 if gate_ok else 1
+
+    # the small bucket charts demotion cost vs K (serial included for the
+    # paper-baseline continuity); its SPEEDUP is not a pass criterion —
+    # at N=16384 the dense scan is fast enough that the ratio is host-
+    # noise-bound, and the tier this bench exists for starts above it
+    small = measure_bucket(512, 16384, with_serial=True, ks=K_SWEEP)
+    xl = measure_bucket(g_xl, n_xl, with_serial=False)
+    budget = sharded_budget(256, 1024)
+    xl_ok = (
+        xl["all_identical"]
+        and small["all_identical"]
+        and replay["identical"]
+        and xl["best_speedup"] >= XL_FLOOR
+    )
+    result = {
+        "metric": "xl_topk_scan_s",
+        "value": xl["best_topk_s"],
+        "unit": "seconds_per_scan",
+        "detail": {
+            "platform": jax.default_backend(),
+            "wave": WAVE,
+            "xl_bucket": xl,
+            "small_bucket": small,
+            "sharded_topk_budget": budget,
+            "audit_cross_rung_replay": replay,
+            "accept_floor_vs_dense": XL_FLOOR,
+            "passed": bool(xl_ok),
+            "analysis": (
+                "The two-level pipeline replaces each wave's dense "
+                "[W, N] selection machinery (need-clipped histograms, "
+                "[_BINS, N] cumsums, full-row conflict check) with one "
+                "cheap [W, N] coarse rank (block-min reduce + top-K "
+                "blocks + a K*32 pool sort — a straight lax.top_k over "
+                "N is a comparator sort on CPU and erases the win) plus "
+                "the exact selection on gathered [W, K] candidate "
+                "slices; the only remaining O(N) terms per wave are the "
+                "member-capacity sweep the dense scan pays too and the "
+                "coarse reduce itself. Exactness is demotion-backed, "
+                "not K-hopeful: a gang whose K candidates cannot cover "
+                "its need while pooled capacity remains replays its "
+                "dense column (dense_demotions — the K-mistuned "
+                "signal; K must reach the tail gang's tight-node span, "
+                "so the zipf-to-512 workload wants K=128). Plans are "
+                "bit-identical to the dense scan at every K measured, "
+                "re-verified through the audit log on the cpu-ladder "
+                "rung. The sharded composition's collective budget "
+                "stays candidate-summary sized (never node state), "
+                "which is what transfers to real chips."
+            ),
+        },
+    }
+    print(json.dumps(result))
+    return 0 if xl_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,5 +24,15 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+
+def pytest_configure(config):
+    # the tier-1 gate runs `-m 'not slow'`: slow marks the compile-heavy
+    # widening matrices (extra shard_map signatures) that re-prove paths
+    # a cheaper sibling already covers — run them with `-m slow`
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (compile-heavy variants)",
+    )
+
 assert jax.devices()[0].platform == "cpu", jax.devices()
 assert len(jax.devices()) == 8, jax.devices()

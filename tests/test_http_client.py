@@ -113,6 +113,56 @@ def test_bind_many_batched_over_http(remote):
     assert cs.pods().get("bm-2").spec.node_name == "n3"
 
 
+def test_gateway_restart_fences_zombie_binds(remote):
+    """Regression guard for the churn/outage over-commit flake: a gateway
+    handler thread that survives its server's death (severed socket, but
+    already past the request read) must NOT be able to apply its bind
+    against the shared backing store once a NEW gateway generation has
+    started — otherwise the scheduler's kept-assume resolution ("unbound
+    on a fresh read -> the lost bind never applied") is unsound and the
+    replanned gang over-commits. The zombie is simulated deterministically:
+    capture the old generation's epoch, restart, then bind with it."""
+    api, backing = remote
+    cs = Clientset(api)
+    for name in ("fz-0", "fz-1"):
+        cs.pods().create(make_pod(name))
+    old_epoch = backing._bind_epoch
+    assert old_epoch >= 1  # serve_gateway advanced it at startup
+    # bind through the live generation works
+    assert backing.bind_pods("default", [("fz-0", "n1")], epoch=old_epoch) \
+        == ["fz-0"]
+    # "restart": a new generation advances the fence (what serve_gateway
+    # does at startup)
+    backing.advance_bind_epoch()
+    # the zombie's bind, stamped with the dead generation's epoch,
+    # applies NOTHING — fz-1 stays unbound, exactly what the scheduler's
+    # liveness read concluded
+    assert backing.bind_pods(
+        "default", [("fz-1", "n2")], epoch=old_epoch
+    ) == []
+    assert not cs.pods().get("fz-1").spec.node_name
+    # epoch-less (in-process) callers and the new generation are unfenced
+    assert backing.bind_pods("default", [("fz-1", "n2")]) == ["fz-1"]
+    assert cs.pods().get("fz-1").spec.node_name == "n2"
+
+
+def test_failed_gateway_restart_does_not_burn_the_fence(remote):
+    """A restart attempt that cannot bind (port still held by the live
+    gateway) must raise cleanly BEFORE advancing the bind epoch —
+    advancing first would silently fence a gateway that never got
+    replaced, and every later bind through it would apply nothing."""
+    api, backing = remote
+    cs = Clientset(api)
+    cs.pods().create(make_pod("fb-0"))
+    host, port = api.host, api.port
+    epoch_before = backing._bind_epoch
+    with pytest.raises(OSError):
+        serve_gateway(backing, host, port)  # port busy
+    assert backing._bind_epoch == epoch_before
+    # the surviving generation still binds
+    assert cs.pods().bind_many([("fb-0", "n1")]) == ["fb-0"]
+
+
 def test_watch_streams_over_http(remote):
     api, _ = remote
     cs = Clientset(api)

@@ -1,0 +1,490 @@
+"""Hierarchical top-K scan (ops.oracle.assign_gangs_topk and its
+node-sharded composition): bit-identity with the dense serial scan across
+candidate widths and shard counts, demotion-backed exactness under
+adversarial tight fits, padded-node safety, the dispatch ladder's gate
+isolation, and cross-rung replay identity through the audit log
+(docs/scan_parallelism.md "Hierarchical top-K").
+
+Every distinct (shape, K, mesh) is a fresh shard_map compile (~30s on the
+CPU-mesh host), so the tier-1 set keeps ONE compile per code path and the
+widening matrices (extra Ks per mesh, sharded per-group/mega/adversarial
+variants, the full-batch and budget lowers) ride `-m slow`."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from batch_scheduler_tpu.core.oracle_scorer import (
+    replay_audit_record,
+)
+from batch_scheduler_tpu.ops import oracle as okern
+from batch_scheduler_tpu.ops.bucketing import topk_bucket
+from batch_scheduler_tpu.ops.oracle import (
+    assign_gangs,
+    assign_gangs_topk,
+    assign_gangs_topk_sharded,
+    execute_batch_host,
+    forced_scan_rung,
+    scan_topk_active,
+    schedule_batch,
+)
+from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot, GroupDemand
+from batch_scheduler_tpu.parallel.mesh import (
+    make_mesh,
+    shard_snapshot_args,
+    sharded_scan_collective_counts,
+    sharded_schedule_batch,
+)
+from batch_scheduler_tpu.sim.scenarios import make_sim_node
+from batch_scheduler_tpu.utils import audit as audit_mod
+from batch_scheduler_tpu.utils.audit import AuditLog, AuditReader
+
+
+def _scan_case(n=48, g=14, r=3, per_group=False, uniform=False, seed=7):
+    """Raw assign_gangs inputs (unbucketed, so N can be shard-uneven)."""
+    rng = np.random.RandomState(seed)
+    left = jnp.asarray(rng.randint(0, 120, size=(n, r)), jnp.int32)
+    if uniform:
+        req = jnp.asarray(
+            np.tile(rng.randint(1, 6, size=(1, r)), (g, 1)), jnp.int32
+        )
+    else:
+        req = jnp.asarray(rng.randint(0, 6, size=(g, r)), jnp.int32)
+    rem = jnp.asarray(rng.randint(0, 30, size=(g,)), jnp.int32)
+    if per_group:
+        mask = jnp.asarray(rng.randint(0, 2, size=(g, n)), jnp.int32)
+    else:
+        mask = jnp.ones((1, n), jnp.int32)
+    order = jnp.asarray(rng.permutation(g), jnp.int32)
+    return left, req, rem, mask, order
+
+
+def _assert_identical(args, k, mesh=None, wave=4, want_dense=None):
+    a0, p0, l0 = (np.asarray(x) for x in assign_gangs(*args))
+    if mesh is None:
+        a1, p1, l1, stats = assign_gangs_topk(
+            *args, wave=wave, k=k, with_stats=True
+        )
+    else:
+        a1, p1, l1, stats = assign_gangs_topk_sharded(
+            *args, mesh=mesh, wave=wave, k=k, with_stats=True
+        )
+    np.testing.assert_array_equal(a0, np.asarray(a1))
+    np.testing.assert_array_equal(p0, np.asarray(p1))
+    np.testing.assert_array_equal(l0, np.asarray(l1))
+    dense_n = int(np.asarray(stats[2]).sum())
+    if want_dense is not None:
+        assert (dense_n > 0) is want_dense, stats
+    return dense_n
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: candidate width and shard count are layout choices
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("k", [4, 16, 64])
+def test_bit_identical_across_candidate_widths(k):
+    """K is a performance knob, never a semantic one: any width must
+    reproduce the dense plan exactly (demotion fills the gap when K is
+    too small to cover a gang)."""
+    _assert_identical(_scan_case(per_group=False, uniform=False, seed=k), k)
+
+
+@pytest.mark.parametrize("n_devices", [2, 4])
+def test_bit_identical_across_shard_meshes(n_devices):
+    """The sharded composition: each shard coarse-ranks only its slice
+    and the merged global top-K drives the identical replicated
+    selection on every shard."""
+    _assert_identical(
+        _scan_case(per_group=False, uniform=False, seed=31 + n_devices),
+        16,
+        mesh=make_mesh(n_devices),
+    )
+
+
+@pytest.mark.slow
+def test_bit_identical_single_shard_mesh():
+    """The degenerate 1-shard mesh: the shard_map plumbing with no real
+    partitioning (the merge becomes local arithmetic)."""
+    _assert_identical(
+        _scan_case(per_group=False, uniform=False, seed=32),
+        16,
+        mesh=make_mesh(1),
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("k", [4, 64])
+def test_bit_identical_shard_mesh_k_sweep(k):
+    """Widening matrix: candidate widths beyond the tier-1 K=16 on the
+    4-shard mesh (each K is a fresh shard_map compile)."""
+    _assert_identical(
+        _scan_case(per_group=False, uniform=False, seed=40 + k),
+        k,
+        mesh=make_mesh(4),
+    )
+
+
+def test_per_group_masks_stay_identical():
+    _assert_identical(
+        _scan_case(n=32, g=10, per_group=True, uniform=False, seed=17), 8
+    )
+
+
+@pytest.mark.slow
+def test_per_group_masks_sharded_stay_identical():
+    _assert_identical(
+        _scan_case(n=32, g=10, per_group=True, uniform=False, seed=18),
+        8,
+        mesh=make_mesh(4),
+    )
+
+
+def test_uniform_waves_use_candidate_stream_and_stay_identical():
+    """Bulk-identical gangs ride the restricted aggregate member stream
+    (the mega path) — boundary feasibilities recovered from pooled −
+    candidate-entry + candidate-post sums must match the dense plan."""
+    _assert_identical(
+        _scan_case(n=64, g=16, per_group=False, uniform=True, seed=5), 16
+    )
+
+
+@pytest.mark.slow
+def test_uniform_waves_sharded_stay_identical():
+    _assert_identical(
+        _scan_case(n=64, g=16, per_group=False, uniform=True, seed=6),
+        16,
+        mesh=make_mesh(4),
+    )
+
+
+def test_uneven_node_counts_padded_rows_never_win():
+    """N not divisible by the shard count pads the node axis internally;
+    identity with the serial scan proves a padded (capacity-0) row never
+    ranks into any candidate set, and shapes stay in caller space."""
+    n = 37
+    mesh = make_mesh(4)
+    args = _scan_case(n=n, g=9, uniform=False, seed=n)
+    _assert_identical(args, 8, mesh=mesh)
+    alloc, placed, left = assign_gangs_topk_sharded(
+        *args, mesh=mesh, wave=4, k=8
+    )
+    assert alloc.shape == (9, n)
+    assert left.shape == (n, args[0].shape[1])
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n", [50, 61])
+def test_uneven_node_counts_widening(n):
+    _assert_identical(
+        _scan_case(n=n, g=9, uniform=False, seed=n), 8, mesh=make_mesh(4)
+    )
+
+
+# ---------------------------------------------------------------------------
+# demotion: exactness by construction, not by hoping K is big enough
+# ---------------------------------------------------------------------------
+
+
+def test_adversarial_tight_fit_forces_dense_demotion():
+    """Capacity shredded one member per node: a gang needing 10 members
+    cannot be covered by K=4 candidates while pooled capacity says
+    placement exists, so the gang MUST demote to the dense-column replay
+    (bst_topk_demotions) — and the plan must still be the dense plan."""
+    n, g, r = 40, 3, 2
+    left = jnp.full((n, r), 5, jnp.int32)       # one member per node
+    req = jnp.full((g, r), 5, jnp.int32)
+    rem = jnp.asarray([10, 10, 10], jnp.int32)  # spans 10 nodes >> K=4
+    mask = jnp.ones((1, n), jnp.int32)
+    order = jnp.asarray([0, 1, 2], jnp.int32)
+    args = (left, req, rem, mask, order)
+    dense_n = _assert_identical(args, 4, want_dense=True)
+    assert dense_n >= 3  # every gang outran its candidate set
+    # a covering K places the same gangs with zero demotions
+    _assert_identical(args, 16, want_dense=False)
+
+
+@pytest.mark.slow
+def test_adversarial_tight_fit_sharded_demotes_identically():
+    n, g, r = 40, 3, 2
+    left = jnp.full((n, r), 5, jnp.int32)
+    req = jnp.full((g, r), 5, jnp.int32)
+    rem = jnp.asarray([10, 10, 10], jnp.int32)
+    mask = jnp.ones((1, n), jnp.int32)
+    order = jnp.asarray([0, 1, 2], jnp.int32)
+    _assert_identical(
+        (left, req, rem, mask, order), 4, mesh=make_mesh(4), want_dense=True
+    )
+
+
+def test_pooled_infeasible_gang_needs_no_demotion():
+    """A gang the whole cluster cannot hold is exactly-infeasible from
+    the wave-entry pooled bound alone (capacities only decrease within a
+    batch): no dense replay, no placement, identical to dense."""
+    n, g, r = 24, 2, 2
+    left = jnp.full((n, r), 5, jnp.int32)
+    req = jnp.full((g, r), 5, jnp.int32)
+    rem = jnp.asarray([n + 10, 4], jnp.int32)   # gang 0 can never fit
+    mask = jnp.ones((1, n), jnp.int32)
+    order = jnp.asarray([0, 1], jnp.int32)
+    dense_n = _assert_identical(
+        (left, req, rem, mask, order), 4, want_dense=False
+    )
+    assert dense_n == 0
+
+
+# ---------------------------------------------------------------------------
+# knob bucketing
+# ---------------------------------------------------------------------------
+
+
+def test_topk_bucket_snaps_to_static_widths():
+    assert topk_bucket(0) == 0
+    assert topk_bucket(-3) == 0
+    assert topk_bucket(1) == 4
+    assert topk_bucket(5) == 8
+    assert topk_bucket(16) == 16
+    assert topk_bucket(200) == 128
+
+
+# ---------------------------------------------------------------------------
+# dispatch ladder: rung selection, gate isolation, telemetry
+# ---------------------------------------------------------------------------
+
+
+def _snapshot_args(num_nodes=48, num_groups=18):
+    nodes = [
+        make_sim_node(f"n{i:03d}", {"cpu": "16", "memory": "64Gi", "pods": "32"})
+        for i in range(num_nodes)
+    ]
+    groups = [
+        GroupDemand(
+            full_name=f"default/g{x:03d}",
+            min_member=4 + (x % 3),
+            member_request={"cpu": 2000, "memory": 4 * 1024**3},
+            creation_ts=float(x),
+        )
+        for x in range(num_groups)
+    ]
+    return ClusterSnapshot(nodes, {}, groups).device_args()
+
+
+def _progress_args(g):
+    return (
+        jnp.full((g,), 4, jnp.int32),
+        jnp.zeros((g,), jnp.int32),
+        jnp.full((g,), 4, jnp.int32),
+        jnp.zeros((g,), bool),
+        jnp.arange(g, dtype=jnp.int32),
+    )
+
+
+def test_env_knob_selects_topk_rung(monkeypatch):
+    monkeypatch.setenv("BST_SCAN_TOPK", "16")
+    assert scan_topk_active()
+    args = _snapshot_args(num_nodes=24, num_groups=8)
+    host, _ = execute_batch_host(
+        args, _progress_args(np.asarray(args[2]).shape[0])
+    )
+    tel = host["telemetry"]
+    assert tel["scan_topk"] == 16
+    assert "topk_demotions" in tel
+    assert "waves_per_batch" in tel
+    # the plan matches the dense rung bit-for-bit
+    monkeypatch.delenv("BST_SCAN_TOPK")
+    dense, _ = execute_batch_host(
+        args, _progress_args(np.asarray(args[2]).shape[0])
+    )
+    for key in ("placed", "gang_feasible", "assignment_nodes"):
+        np.testing.assert_array_equal(
+            np.asarray(dense[key]), np.asarray(host[key]), err_msg=key
+        )
+
+
+def test_unparseable_env_knob_degrades_to_dense(monkeypatch):
+    monkeypatch.setenv("BST_SCAN_TOPK", "many")
+    assert not scan_topk_active()
+    args = _snapshot_args(num_nodes=24, num_groups=8)
+    host, _ = execute_batch_host(
+        args, _progress_args(np.asarray(args[2]).shape[0])
+    )
+    assert host["telemetry"]["scan_topk"] == 0
+
+
+def test_topk_composes_with_sharded_layout_on_mesh(monkeypatch):
+    monkeypatch.setenv("BST_SCAN_TOPK", "8")
+    args = _snapshot_args(num_nodes=24, num_groups=8)
+    mesh = make_mesh(4)
+    placed_args = shard_snapshot_args(mesh, args, flat_nodes=True)
+    host, _ = execute_batch_host(
+        placed_args, _progress_args(np.asarray(args[2]).shape[0]),
+        scan_mesh=mesh,
+    )
+    tel = host["telemetry"]
+    assert tel["scan_topk"] == 8
+    assert "topk_demotions" in tel
+
+
+def test_ladder_fallback_disables_only_the_topk_gate(monkeypatch):
+    """A top-K rung failure demotes THIS batch to the dense ladder and
+    flips only _topk_enabled — never the wave, pallas, or sharded gates
+    (independent features must not poison each other). Uses a bucket
+    shape no other test dispatches top-K, so the failure fires at trace
+    time instead of hitting the jit cache."""
+    monkeypatch.setenv("BST_SCAN_TOPK", "16")
+    args = _snapshot_args(num_nodes=40, num_groups=12)
+    g = np.asarray(args[2]).shape[0]
+    monkeypatch.delenv("BST_SCAN_TOPK")
+    single, _ = execute_batch_host(args, _progress_args(g))
+    monkeypatch.setenv("BST_SCAN_TOPK", "16")
+
+    def boom(*a, **kw):
+        raise RuntimeError("top-K lowering exploded")
+
+    monkeypatch.setattr(okern, "assign_gangs_topk", boom)
+    wave_before = okern._wave_enabled[0]
+    sharded_before = okern._sharded_enabled[0]
+    pallas_before = dict(okern._pallas_enabled)
+    try:
+        with pytest.warns(UserWarning, match="top-K"):
+            host, _ = execute_batch_host(args, _progress_args(g))
+        assert host["telemetry"]["scan_topk"] == 0
+        assert okern._topk_enabled[0] is False
+        assert okern._wave_enabled[0] == wave_before
+        assert okern._sharded_enabled[0] == sharded_before
+        assert okern._pallas_enabled == pallas_before
+        assert not scan_topk_active()
+        np.testing.assert_array_equal(
+            np.asarray(single["placed"]), np.asarray(host["placed"])
+        )
+    finally:
+        okern._topk_enabled[0] = True
+
+
+def test_forced_rung_pin_runs_local_topk_never_sharded():
+    """A (pallas=False, wave, topk) pin on a mesh must run the LOCAL
+    top-K variant — pinned replays are single-process by contract, and
+    the sharded compositions are verified by cross-rung identity."""
+    args = _snapshot_args(num_nodes=24, num_groups=8)
+    mesh = make_mesh(4)
+    with forced_scan_rung(False, 8, 16):
+        host, _ = execute_batch_host(
+            args, _progress_args(np.asarray(args[2]).shape[0]),
+            scan_mesh=mesh,
+        )
+    tel = host["telemetry"]
+    assert tel["scan_topk"] == 16
+    assert tel["scan_sharded"] is False
+
+
+@pytest.mark.slow
+def test_full_batch_topk_matches_single_device():
+    """The fused schedule_batch on the sharded top-K layout agrees with
+    the plain single-device batch on every output field."""
+    args = _snapshot_args()
+    single = {
+        k: np.asarray(v)
+        for k, v in execute_batch_host(
+            args, _progress_args(np.asarray(args[2]).shape[0])
+        )[0].items()
+        if k in ("placed", "gang_feasible", "assignment_nodes")
+    }
+    mesh = make_mesh(4)
+    import jax
+
+    sharded = jax.device_get(
+        sharded_schedule_batch(mesh, args, sharded_scan=True, scan_topk=16)
+    )
+    for key in ("gang_feasible", "placed", "capacity", "assignment"):
+        got = np.asarray(sharded[key])
+        want = np.asarray(jax.device_get(schedule_batch(*args))[key])
+        np.testing.assert_array_equal(want, got, err_msg=key)
+    np.testing.assert_array_equal(
+        single["placed"], np.asarray(sharded["placed"])
+    )
+
+
+@pytest.mark.slow
+def test_scan_only_collective_budget_stays_summary_sized():
+    """The sharded top-K module's collectives are all candidate-summary
+    sized: no [N, R] node state ever rides a collective, and instruction
+    sites do not grow with G."""
+    mesh = make_mesh(4)
+    small = sharded_scan_collective_counts(
+        mesh, _snapshot_args(64, 8), topk=8
+    )
+    big = sharded_scan_collective_counts(
+        mesh, _snapshot_args(64, 32), topk=8
+    )
+    assert small["counts"] == big["counts"], (small, big)
+    assert big["waves"] > small["waves"]
+    for rep in (small, big):
+        assert rep["max_collective_bytes"] <= rep["summary_bytes"], rep
+        assert rep["counts"]["collective-permute"] == 0, rep
+        assert rep["counts"]["all-gather"] + rep["counts"]["all-reduce"] > 0
+
+
+# ---------------------------------------------------------------------------
+# cross-rung replay identity through the audit log
+# ---------------------------------------------------------------------------
+
+
+def _audited_batch(tmp_path, monkeypatch, topk_env=None):
+    if topk_env is not None:
+        monkeypatch.setenv("BST_SCAN_TOPK", str(topk_env))
+    else:
+        monkeypatch.delenv("BST_SCAN_TOPK", raising=False)
+    snap_nodes = [
+        make_sim_node(f"n{i}", {"cpu": "8", "memory": "32Gi", "pods": "64"})
+        for i in range(6)
+    ]
+    groups = [
+        GroupDemand(f"default/g{i}", 3, member_request={"cpu": 1000},
+                    creation_ts=float(i))
+        for i in range(4)
+    ]
+    snap = ClusterSnapshot(snap_nodes, {}, groups)
+    host, _ = execute_batch_host(snap.device_args(), snap.progress_args())
+    log = AuditLog(str(tmp_path))
+    log.record_batch(
+        batch_args=snap.device_args(),
+        progress_args=snap.progress_args(),
+        result=host,
+        plan_digest=audit_mod.plan_digest(host),
+        node_names=snap.node_names,
+        group_names=snap.group_names,
+    )
+    assert log.flush()
+    (rec,), _ = AuditReader(str(tmp_path)).batches()
+    log.stop()
+    return rec, host
+
+
+def test_topk_recorded_batch_replays_identically_on_dense_rungs(
+    tmp_path, monkeypatch
+):
+    """A batch RECORDED on the top-K rung replays bit-identically on the
+    dense rungs — the demotion-backed identity claim, verified through
+    the audit log's exact packed inputs."""
+    rec, host = _audited_batch(tmp_path, monkeypatch, topk_env=16)
+    assert host["telemetry"]["scan_topk"] == 16
+    monkeypatch.delenv("BST_SCAN_TOPK")
+    for rung in ("steady", "cpu-ladder", "wavefront"):
+        rep = replay_audit_record(rec, against=rung)
+        assert rep["identical"], (rung, rep)
+        assert rep["replayed_digest"] == rec["plan_digest"]
+
+
+def test_dense_recorded_batch_replays_identically_on_topk_rung(
+    tmp_path, monkeypatch
+):
+    """And the other direction: a dense-recorded batch replayed AGAINST
+    the top-K rung reproduces the digest, with the executed-rung
+    evidence naming the candidate width."""
+    rec, _ = _audited_batch(tmp_path, monkeypatch, topk_env=None)
+    rep = replay_audit_record(rec, against="topk")
+    assert rep["identical"], rep
+    assert rep["executed_rung"]["scan_topk"] == 16
+    assert not rep.get("rung_fell_back")
