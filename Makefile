@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all build vet test test-cpu test-tier1 bench bench-scan bench-pipeline bench-sharding bench-xl native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
+.PHONY: all build vet test test-cpu test-tier1 bench bench-scan bench-pipeline bench-policy bench-sharding bench-xl native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
 
 all: vet native test
 
@@ -75,6 +75,14 @@ serial-e2e:
 # blame records — fails on schema drift (docs/observability.md)
 trace-demo:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/trace_demo.py
+
+# policy-engine CI gate (CPU, 8-device virtual mesh): zero-policy plans
+# bit-identical to the pre-policy scan on the steady/wavefront/sharded
+# rungs, the vectorized preemption pass bounded at 10% of the
+# [G=128, N=1024] steady batch, and a policy-rung audit record replaying
+# bit-identically on steady + cpu-ladder (docs/policy.md)
+bench-policy:
+	$(PY) benchmarks/policy_gate.py
 
 # audit/replay/health CI gate (CPU): records a short sim into an audit
 # ring, replays every batch bit-identically (steady + cpu-ladder rungs),

@@ -143,7 +143,11 @@ def build_parser() -> argparse.ArgumentParser:
         default=[],
         help="Kubernetes manifest(s) to apply (PodGroup/Pod/Node/workloads)",
     )
-    sim.add_argument("--scenario", choices=["race", "synthetic"], default=None)
+    sim.add_argument(
+        "--scenario",
+        choices=["race", "synthetic", "spot-vs-guaranteed"],
+        default=None,
+    )
     sim.add_argument("--scorer", choices=["oracle", "serial"], default=None,
                      help="override the scorer gate (--scorer=tpu north star)")
     sim.add_argument("--oracle-addr", default=None, metavar="HOST:PORT",
@@ -196,6 +200,14 @@ def build_parser() -> argparse.ArgumentParser:
              "never pays the cold XLA compile on the serving path "
              "(in-process oracle; for --oracle-addr pass --compile-warmer "
              "to `serve` instead)",
+    )
+    sim.add_argument(
+        "--policy", default=None, metavar="TERMS",
+        help="enable the vectorized policy engine (docs/policy.md): a "
+             "comma list of terms from "
+             "{affinity,anti-affinity,spread,preempt}, or 'all'. "
+             "Equivalent to BST_POLICY; weights ride the BST_POLICY_* "
+             "knobs. Empty/off = the exact pre-policy scan paths",
     )
     _add_metrics_flag(sim)
     _add_trace_flags(sim)
@@ -640,6 +652,22 @@ def cmd_sim(args) -> int:
                 file=sys.stderr,
             )
 
+    policy_cfg = None
+    if args.policy:
+        # CLI form of BST_POLICY: the env var keeps working (PolicyConfig
+        # reads it when no explicit config is passed); the flag wins
+        import os as _os
+
+        from ..policy.engine import PolicyConfig
+
+        _os.environ["BST_POLICY"] = args.policy
+        policy_cfg = PolicyConfig.from_env()
+        print(
+            f"policy engine: terms={list(policy_cfg.terms)} "
+            f"fingerprint={policy_cfg.fingerprint()['fingerprint']}",
+            file=sys.stderr,
+        )
+
     audit_log = _maybe_audit_log(args)
     cluster = SimCluster(
         scorer=scorer,
@@ -651,6 +679,7 @@ def cmd_sim(args) -> int:
         oracle_compile_warmer=want_warmer and oracle_client is None,
         audit_log=audit_log,
         identity_audit_every=args.identity_audit_every,
+        policy=policy_cfg,
     )
 
     nodes: List[Node] = []
@@ -677,6 +706,36 @@ def cmd_sim(args) -> int:
             name = f"group-{g:03d}"
             groups.append(make_sim_group(name, args.members))
             pods += make_member_pods(name, args.members, {"cpu": "1"})
+    elif args.scenario == "spot-vs-guaranteed":
+        from ..sim.scenarios import spot_vs_guaranteed_scenario
+
+        snodes, sgroups, spods = spot_vs_guaranteed_scenario()
+        nodes += snodes
+        groups += sgroups
+        for plist in spods.values():
+            pods += plist
+        # the operation reads BST_POLICY itself when no explicit config is
+        # passed — check the EFFECTIVE config before warning
+        from ..policy.engine import PolicyConfig as _PC
+
+        effective = policy_cfg if policy_cfg is not None else _PC.from_env()
+        if not effective.preemption:
+            print(
+                "note: spot-vs-guaranteed without the preempt term "
+                "(--policy preempt / BST_POLICY) — the guaranteed gang "
+                "will queue-jump but cannot evict spot capacity",
+                file=sys.stderr,
+            )
+        if args.settle <= 3.0:
+            # permit-parked quorums and deny-cache retries produce no
+            # observable change for up to a 20s TTL window; the default
+            # settle would conclude "stuck" mid-transaction
+            args.settle = 30.0
+            print(
+                "note: --settle raised to 30s for this scenario (permit "
+                "parks + deny-TTL retries look idle to a shorter window)",
+                file=sys.stderr,
+            )
 
     for i in range(args.nodes):
         nodes.append(
@@ -714,7 +773,46 @@ def cmd_sim(args) -> int:
         cluster.create_group(pg)
     cluster.start()
     try:
-        cluster.create_pods(pods)
+        if args.scenario == "spot-vs-guaranteed":
+            # staged arrival: the scenario demos PREEMPTION, which needs
+            # the spot tier bound BEFORE the guaranteed tier arrives
+            # (simultaneous arrival just demos queue priority). Hold the
+            # guaranteed pods back until spot stops making progress.
+            guar = [
+                p for p in pods
+                if p.metadata.labels.get(POD_GROUP_LABEL, "").startswith(
+                    "guaranteed"
+                )
+            ]
+            spot = [p for p in pods if p not in guar]
+            cluster.create_pods(spot)
+            spot_deadline = time.monotonic() + min(args.timeout / 2, 90)
+            last_bound, stable = -1, time.monotonic()
+            while time.monotonic() < spot_deadline:
+                bound = sum(
+                    1
+                    for p in spot
+                    if (cluster.clientset.pods(p.metadata.namespace)
+                        .get(p.metadata.name).spec.node_name)
+                )
+                if bound >= len(spot):
+                    last_bound = bound
+                    break
+                if bound != last_bound:
+                    last_bound, stable = bound, time.monotonic()
+                elif time.monotonic() - stable > 25.0:
+                    # a full deny-cache TTL with no progress: the spot
+                    # tier is as bound as it gets
+                    break
+                time.sleep(0.2)
+            print(
+                f"spot tier settled ({last_bound} bound); releasing "
+                f"guaranteed tier",
+                flush=True,
+            )
+            cluster.create_pods(guar)
+        else:
+            cluster.create_pods(pods)
 
         deadline = time.monotonic() + args.timeout
         names = [(pg.metadata.namespace, pg.metadata.name) for pg in groups]

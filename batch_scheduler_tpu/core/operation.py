@@ -92,12 +92,30 @@ class ScheduleOperation:
         compile_warmer: bool = False,
         audit_log=None,
         identity_audit_every: int = 0,
+        policy=None,
     ):
         self.status_cache = status_cache
         self.cluster = cluster
         self.pg_client = pg_client
         self.max_schedule_seconds = max_schedule_seconds
         self.pg_lister = pg_lister
+        # Policy engine (batch_scheduler_tpu.policy / docs/policy.md):
+        # ``policy`` is a PolicyConfig, or None to read BST_POLICY from the
+        # environment (empty = off: every path below runs the exact
+        # pre-policy code). The engine scores batches through the local
+        # oracle's policy scan rung; the preemption planner works with ANY
+        # scorer transport (it runs its own local jit).
+        from ..policy.engine import PolicyConfig, PolicyEngine
+        from ..policy.preempt import PreemptionPlanner
+
+        if policy is None:
+            policy = PolicyConfig.from_env()
+        self.policy = PolicyEngine(policy) if policy.enabled else None
+        self.preempt_planner = (
+            PreemptionPlanner(policy)
+            if self.policy is not None and policy.preemption
+            else None
+        )
         if isinstance(scorer, str):
             if scorer not in ("oracle", "serial"):
                 raise ValueError(
@@ -113,6 +131,7 @@ class ScheduleOperation:
                     compile_warmer=compile_warmer,
                     audit_log=audit_log,
                     identity_audit_every=identity_audit_every,
+                    policy_engine=self.policy,
                 )
                 if scorer == "oracle"
                 else None
@@ -163,6 +182,14 @@ class ScheduleOperation:
                 # batch's AUDIT_ID annotation correlates the sidecar's own
                 # record (service.protocol)
                 scorer.configure_audit(audit_log, identity_audit_every)
+            if self.policy is not None:
+                # a remote sidecar is policy-UNAWARE (the policy scan runs
+                # in-process only): stamp the client-side fingerprint so
+                # the POLICY annotation rides the wire and a mismatched
+                # peer is visible, never silent (docs/policy.md "Wire")
+                scorer.policy_fingerprint = self.policy.config.fingerprint()[
+                    "fingerprint"
+                ]
         self.last_denied_pg = TTLCache(DENY_CACHE_DEFAULT_TTL, DENY_CACHE_JANITOR, clock=clock)
         self.last_permitted_pod = TTLCache(PERMITTED_CACHE_DEFAULT_TTL, DENY_CACHE_JANITOR, clock=clock)
         self._lock = threading.RLock()
@@ -330,6 +357,34 @@ class ScheduleOperation:
         pgs.plan_base_matched = self._matched_per_node(pgs)
         pgs.placement_plan = oracle.assignment(full_name)
         pgs.plan_batch_seq = seq
+        if self.policy is not None and pgs.placement_plan:
+            # per-term score contributions at the chosen seats — the
+            # flight recorder's policy blame (docs/policy.md): why THESE
+            # nodes, in the terms' own units. Evidence only, never the
+            # decision path.
+            try:
+                snap = oracle.snapshot
+                if snap is not None and snap.policy_cols is not None:
+                    g = snap.group_index(full_name)
+                    idx = [
+                        snap.node_index(n)
+                        for n in pgs.placement_plan
+                        if snap.node_index(n) is not None
+                    ]
+                    terms = self.policy.explain(snap.policy_cols, g, idx)
+                    if terms:
+                        from ..utils.trace import DEFAULT_FLIGHT_RECORDER
+
+                        DEFAULT_FLIGHT_RECORDER.record(
+                            full_name,
+                            phase="policy",
+                            verdict="info",
+                            batch=seq,
+                            nodes=len(idx),
+                            terms=terms,
+                        )
+            except Exception:  # noqa: BLE001 — blame is evidence only
+                pass
 
     def suggested_node(self, pod: Pod) -> Optional[str]:
         """The plan's next open slot for this pod's gang, or None (caller
@@ -598,6 +653,93 @@ class ScheduleOperation:
     def preempt_add_pod(self, pod_to_add: Pod, node_name: str) -> None:
         return None
 
+    def preempt_victim_plan(self, pod: Pod):
+        """Dry-run a vectorized victim plan for a denied gang pod
+        (policy.preempt, docs/policy.md "Preemption pass"): the tier-
+        eligible victim gangs whose whole-gang eviction frees enough
+        capacity, minimal-by-construction. Returns a VictimPlan or None
+        (policy preemption off / pod not a gang member / nothing
+        evictable / infeasible even with full eviction). The commit half
+        lives in the framework (Scheduler._evict_gang_plan) behind a live
+        host-side re-verification."""
+        if self.preempt_planner is None:
+            return None
+        pg_name, ok = pod_group_name(pod)
+        if not ok or pod.spec.priority <= 0:
+            return None  # tier-0 gangs never preempt (nothing is lower)
+        full_name = f"{pod.metadata.namespace}/{pg_name}"
+        pgs = self.status_cache.get(full_name)
+        if pgs is None:
+            return None
+        pg = pgs.pod_group
+        need = max(
+            pg.spec.min_member
+            - pg.status.scheduled
+            - len(pgs.matched_pod_nodes.items()),
+            0,
+        )
+        plan = self.preempt_planner.plan(
+            pod, self.cluster, self.status_cache, full_name, need
+        )
+        if self.policy is not None:
+            self.policy.note_plan(plan is not None)
+        if plan is None:
+            return None
+        # legality gate: every victim must individually pass the existing
+        # preempt hook ("applies through the existing preempt hooks") —
+        # one forbidden victim invalidates the whole plan, because the
+        # device's minimal set is minimal only as a unit
+        for victim in plan.victims():
+            try:
+                self.preempt_remove_pod(pod, victim)
+            except errs.SchedulingError:
+                return None
+        return plan
+
+    def note_gang_evicted(self, full_name: str) -> None:
+        """Reset a victim gang's local schedule state after a policy
+        eviction: its members were deleted (and recreated Pending by the
+        requeue), so the gang re-enters the queue as a fresh unit — phase
+        back to PENDING, scheduled count zeroed, plan dropped. The status
+        patch is best-effort (the controller re-derives phase from live
+        member pods, the same crash-recovery contract post_bind_gangs
+        relies on)."""
+        with self._lock:
+            pgs = self.status_cache.get(full_name)
+            if pgs is None:
+                return
+            pg = pgs.pod_group
+            pg.status.phase = PodGroupPhase.PENDING
+            pg.status.scheduled = 0
+            pg.status.schedule_start_time = None
+            pgs.binds_committed = 0
+            pgs.scheduled = False
+            pgs.placement_plan = None
+            for uid in list(pgs.matched_pod_nodes.items()):
+                pgs.matched_pod_nodes.delete(uid)
+            ns, name = pg.metadata.namespace, pg.metadata.name
+        if self.pg_client is not None:
+            try:
+                self.pg_client.podgroups(ns).patch(
+                    name,
+                    {
+                        "status": {
+                            "phase": PodGroupPhase.PENDING.value,
+                            "scheduled": 0,
+                            "schedule_start_time": None,
+                        }
+                    },
+                )
+            except Exception:  # noqa: BLE001 — controller reconciles
+                pass
+        self.mark_dirty()
+
+    def forget_denied(self, full_name: str) -> None:
+        """Drop a gang's deny-cache entry (a successful preemption freed
+        the capacity the denial was about; the 20s stickiness would
+        otherwise idle the freed capacity for its whole TTL)."""
+        self.last_denied_pg.delete(full_name)
+
     def preempt_remove_pod(self, pod_to_schedule: Pod, pod_to_remove: Pod) -> None:
         """Raises SchedulingError when the preemption is forbidden.
 
@@ -605,7 +747,18 @@ class ScheduleOperation:
         never preempt online; nobody preempts members of Scheduled/Running
         gangs; a gang never preempts itself. ("offline" = carries the group
         label.)
+
+        With the policy engine's preemption term enabled the phase rule is
+        replaced by PRIORITY TIERS (docs/policy.md): a victim is legal iff
+        its priority class is strictly below the preemptor's — including
+        members of released (Scheduled/Running) gangs unless
+        ``protect_running`` restores the reference behavior. The
+        offline-may-not-preempt-online and no-self-preemption rules are
+        kept as-is.
         """
+        if self.policy is not None and self.policy.preemption:
+            self._preempt_remove_tiered(pod_to_schedule, pod_to_remove)
+            return
         remove_group, remove_offline = pod_group_name(pod_to_remove)
         schedule_group, schedule_offline = pod_group_name(pod_to_schedule)
 
@@ -645,6 +798,49 @@ class ScheduleOperation:
             )
         if err is not None:
             raise err
+
+    def _preempt_remove_tiered(
+        self, pod_to_schedule: Pod, pod_to_remove: Pod
+    ) -> None:
+        """Priority-tier legality (the policy engine's preemption
+        eligibility term): strictly-lower tier only, no self-preemption,
+        offline still may not preempt online, and the reference's phase
+        protection applies only under ``protect_running``."""
+        remove_group, remove_offline = pod_group_name(pod_to_remove)
+        schedule_group, schedule_offline = pod_group_name(pod_to_schedule)
+        if schedule_offline and not remove_offline:
+            raise errs.SchedulingError(
+                f"offline pod {pod_to_schedule.metadata.name} may not "
+                f"preempt online pod {pod_to_remove.metadata.name}"
+            )
+        if pod_to_remove.spec.priority >= pod_to_schedule.spec.priority:
+            raise errs.SchedulingError(
+                f"victim {pod_to_remove.metadata.name} (tier "
+                f"{pod_to_remove.spec.priority}) is not strictly below "
+                f"preemptor tier {pod_to_schedule.spec.priority}"
+            )
+        if remove_offline:
+            victim_full = (
+                f"{pod_to_remove.metadata.namespace}/{remove_group}"
+            )
+            if schedule_offline:
+                schedule_full = (
+                    f"{pod_to_schedule.metadata.namespace}/{schedule_group}"
+                )
+                if victim_full == schedule_full:
+                    raise errs.SchedulingError(
+                        "pod group may not preempt its own members"
+                    )
+            if self.policy.config.protect_running:
+                pgs = self.status_cache.get(victim_full)
+                if pgs is not None and pgs.pod_group.status.phase in (
+                    PodGroupPhase.SCHEDULED,
+                    PodGroupPhase.RUNNING,
+                ):
+                    raise errs.SchedulingError(
+                        "members of Scheduled/Running pod groups may not "
+                        "be preempted (protect_running)"
+                    )
 
     # ------------------------------------------------------------------
     # Score (reference stub core.go:263-265 — real ranks in oracle mode)

@@ -76,31 +76,45 @@ REPLAY_RUNGS = ("steady", "wavefront", "cpu-ladder", "topk")
 
 
 def replay_batch(batch_args, progress_args, against: str = "steady",
-                 scan_mesh=None, wave: int = 8, topk: int = 16):
+                 scan_mesh=None, wave: int = 8, topk: int = 16,
+                 policy=None):
     """Re-entry API for deterministic replay: re-execute one recorded
     oracle batch's EXACT packed inputs on the requested rung and return
     ``(host, device_result)`` like ``execute_batch_host``. The rung pin is
     thread-local (ops.oracle.forced_scan_rung), so replays — including the
     identity audit's daemon-thread re-verification — never change which
     rung concurrent serving batches run on, and a replay failure never
-    permanently demotes a serving feature."""
+    permanently demotes a serving feature.
+
+    ``policy`` is a recorded batch's ``(policy_cols, terms, weights)``
+    payload: a policy batch ALWAYS re-executes the policy rung (the
+    composite is part of its semantics — dispatch_batch demotes every
+    other rung), so every ``against`` value degenerates to the policy
+    scan on that rung's device placement. ``cpu-ladder`` therefore covers
+    the policy rung's cross-backend identity (docs/policy.md)."""
     from ..ops.oracle import execute_batch_host, forced_scan_rung
 
     batch_args = tuple(np.asarray(a) for a in batch_args)
     progress_args = tuple(np.asarray(a) for a in progress_args)
+    if policy is not None:
+        cols, terms, weights = policy
+        policy = (
+            tuple(np.asarray(c) for c in cols), tuple(terms), tuple(weights),
+        )
     if against == "steady":
         return execute_batch_host(batch_args, progress_args,
-                                  scan_mesh=scan_mesh)
+                                  scan_mesh=scan_mesh, policy=policy)
     if against == "wavefront":
         from ..ops.bucketing import wave_width_bucket
 
         with forced_scan_rung(False, wave_width_bucket(wave)):
             return execute_batch_host(batch_args, progress_args,
-                                      scan_mesh=scan_mesh)
+                                      scan_mesh=scan_mesh, policy=policy)
     if against == "cpu-ladder":
         cpu = jax.local_devices(backend="cpu")[0]
         with forced_scan_rung(False, 0), jax.default_device(cpu):
-            return execute_batch_host(batch_args, progress_args)
+            return execute_batch_host(batch_args, progress_args,
+                                      policy=policy)
     if against == "topk":
         from ..ops.bucketing import topk_bucket, wave_width_bucket
 
@@ -108,7 +122,7 @@ def replay_batch(batch_args, progress_args, against: str = "steady",
             False, wave_width_bucket(wave), topk_bucket(topk)
         ):
             return execute_batch_host(batch_args, progress_args,
-                                      scan_mesh=scan_mesh)
+                                      scan_mesh=scan_mesh, policy=policy)
     raise ValueError(
         f"unknown replay rung {against!r} (use one of {REPLAY_RUNGS})"
     )
@@ -139,7 +153,8 @@ def replay_audit_record(record: dict, against: str = "steady") -> dict:
                        "plan to re-execute",
         }
     host, _ = replay_batch(
-        record["batch_args"], record["progress_args"], against=against
+        record["batch_args"], record["progress_args"], against=against,
+        policy=record.get("policy_args"),
     )
     digest = audit_mod.plan_digest(host)
     identical = digest == record.get("plan_digest")
@@ -160,6 +175,7 @@ def replay_audit_record(record: dict, against: str = "steady") -> dict:
             "used_pallas": exec_telemetry.get("used_pallas"),
             "wave_width": exec_telemetry.get("wave_width"),
             "scan_topk": exec_telemetry.get("scan_topk"),
+            "scan_policy": exec_telemetry.get("scan_policy"),
         },
     }
     if against == "wavefront" and exec_telemetry.get("wave_width", 0) <= 1:
@@ -253,11 +269,42 @@ def conservative_cpu_batch(snap: ClusterSnapshot):
 
 
 def demand_from_status(full_name: str, pgs: PodGroupMatchStatus) -> GroupDemand:
-    """Project a live PodGroupMatchStatus into the oracle's demand row."""
+    """Project a live PodGroupMatchStatus into the oracle's demand row.
+
+    Policy columns (docs/policy.md) project from the representative pod's
+    policy labels; the spread term additionally needs the gang's matched
+    members per node (its domain occupancy) — read here so queue order,
+    the priority term and the preemption planner all consume ONE field
+    per concept. Pods without policy labels pay nothing: the extra work
+    is guarded on label presence."""
     pg = pgs.pod_group
     member_req = dict(pg.spec.min_resources or {})
     if not member_req and pgs.pod is not None:
         member_req = pgs.pod.resource_require()
+    affinity_hash = anti_hash = 0
+    spread = False
+    placed_nodes: Dict[str, int] = {}
+    if pgs.pod is not None and pgs.pod.metadata.labels:
+        from ..policy.terms import label_hash, parse_label_ref
+        from ..utils.labels import (
+            POLICY_AFFINITY_LABEL,
+            POLICY_ANTI_AFFINITY_LABEL,
+            POLICY_SPREAD_LABEL,
+        )
+
+        labels = pgs.pod.metadata.labels
+        raw = labels.get(POLICY_AFFINITY_LABEL)
+        if raw:
+            k, v = parse_label_ref(raw)
+            affinity_hash = label_hash(k, v) if k else 0
+        raw = labels.get(POLICY_ANTI_AFFINITY_LABEL)
+        if raw:
+            k, v = parse_label_ref(raw)
+            anti_hash = label_hash(k, v) if k else 0
+        spread = bool(labels.get(POLICY_SPREAD_LABEL))
+        if spread:
+            for pair in pgs.matched_pod_nodes.items().values():
+                placed_nodes[pair.node] = placed_nodes.get(pair.node, 0) + 1
     return GroupDemand(
         full_name=full_name,
         min_member=pg.spec.min_member,
@@ -270,6 +317,10 @@ def demand_from_status(full_name: str, pgs: PodGroupMatchStatus) -> GroupDemand:
         tolerations=list(pgs.pod.spec.tolerations) if pgs.pod else [],
         released=pgs.scheduled,
         has_pod=pgs.pod is not None,
+        affinity_hash=affinity_hash,
+        anti_hash=anti_hash,
+        spread=spread,
+        placed_nodes=placed_nodes,
     )
 
 
@@ -339,6 +390,7 @@ class OracleScorer:
         compile_warmer: bool = False,
         audit_log=None,
         identity_audit_every: int = 0,
+        policy_engine=None,
     ):
         # Dirty tracking is a GENERATION pair, not a bool: refresh() clears
         # staleness by recording the generation it observed BEFORE packing
@@ -390,7 +442,11 @@ class OracleScorer:
         # reuse this class used to do inline (the packer enforces the same
         # covers/covers_names validity rules and full-repacks on schema
         # change). self._schema mirrors the packer's for compatibility.
-        self._packer = DeltaSnapshotPacker()
+        # An enabled policy engine (batch_scheduler_tpu.policy) rides the
+        # packer so every snapshot carries packed policy columns and every
+        # local batch runs the policy scan rung (docs/policy.md).
+        self.policy_engine = policy_engine
+        self._packer = DeltaSnapshotPacker(policy_engine=policy_engine)
         self._schema = None
         # Dispatch-ahead (docs/pipelining.md): after each published batch,
         # a daemon thread packs and dispatches the NEXT batch speculatively
@@ -643,6 +699,11 @@ class OracleScorer:
             digest = audit_mod.plan_digest(host)
             aid = audit_id or audit_mod.new_audit_id()
             ctx = trace_mod.current_context()
+            policy_payload = (
+                snap.policy_payload()
+                if hasattr(snap, "policy_payload")
+                else None
+            )
             if self.audit_log is not None:
                 self.audit_log.record_batch(
                     batch_args=snap.device_args(),
@@ -656,6 +717,7 @@ class OracleScorer:
                     speculative=speculative,
                     degraded=bool(self.degraded),
                     telemetry=telemetry or {},
+                    policy=policy_payload,
                 )
             if (
                 self._identity is not None
@@ -668,7 +730,7 @@ class OracleScorer:
                 # degraded conservative batch has no plan to verify
                 self._identity.note_batch(
                     snap.device_args(), snap.progress_args(), digest,
-                    aid, self.audit_log,
+                    aid, self.audit_log, policy=policy_payload,
                 )
         except Exception:  # noqa: BLE001 — evidence, never the decision path
             pass
@@ -687,9 +749,13 @@ class OracleScorer:
         """Run one batch locally on the attached device. Returns the O(G)
         host result dict and a lazy (G,N)-row fetcher. RemoteScorer swaps
         this for the sidecar round-trip."""
+        policy = snap.policy_payload()
+        if policy is not None and self.policy_engine is not None:
+            self.policy_engine.note_batch()
         host, device_result = execute_batch_host(
             snap.device_args(), snap.progress_args(),
             scan_mesh=self.scan_mesh, donate=self._donate(),
+            policy=policy,
         )
 
         def row_fetcher(kind: str, g: int) -> np.ndarray:

@@ -22,6 +22,7 @@ from ..core import resources as rmath
 from ..utils.errors import (
     OracleDeadlineError,
     OracleTransportError,
+    ResourceNotEnoughError,
     SchedulingError,
 )
 from ..utils.labels import pod_group_name
@@ -144,6 +145,18 @@ class Scheduler:
             "bst_cycle_errors_total",
             "Scheduling cycles aborted by an error, by kind",
         )
+        # preemption events by reason: "priority-tier" = the vectorized
+        # policy victim plan (policy.preempt), "host-scan" = the legacy
+        # per-node dry-run loop (docs/policy.md "Preemption pass")
+        self._preemptions_total = DEFAULT_REGISTRY.counter(
+            "bst_preemptions_total",
+            "Preemption transactions committed, by reason",
+        )
+        # Evicted gang members are recreated as fresh Pending pods (the
+        # in-process stand-in for the workload controller's recreate),
+        # which is what re-queues the evicted gang exactly once. Off =
+        # victims stay deleted and their gang waits for external recreation.
+        self.requeue_evicted = True
         # feasible-node count of the last _select_node scan (evidence for
         # the flight recorder's "no feasible node" blame records)
         self._last_scan_feasible = 0
@@ -591,6 +604,15 @@ class Scheduler:
                 with trace_mod.span("pre_filter"):
                     self.plugin.pre_filter(pod)
             except SchedulingError as e:
+                # policy preemption entry point for DENIED GANGS: gang
+                # pods fail at PreFilter (the oracle's whole-batch
+                # verdict), never reaching the per-pod select/preempt
+                # path below — so the vectorized victim plan hangs off
+                # the denial itself. Evicted capacity frees
+                # asynchronously; the pod retries from backoff and the
+                # deny-cache entry is dropped so the retry isn't sticky.
+                if self._policy_preempt(info, pod, e):
+                    self.stats["preemptions"] += 1
                 self._unschedulable(info, str(e))
                 return
             # whole-gang fast lane: pre_filter just ran (stamping a fresh
@@ -724,6 +746,126 @@ class Scheduler:
         self._last_scan_feasible = feasible
         return best_name, False
 
+    def _policy_preempt(self, info: PodInfo, pod: Pod, err=None) -> bool:
+        """Policy-tier preemption transaction for a denied gang
+        (docs/policy.md "Preemption pass"): dry-run the vectorized victim
+        plan, re-verify it host-side against LIVE cluster state, then
+        commit — evict every victim gang whole and requeue it. Fires only
+        on resource denials (a PodGroupNotFound or sticky-deny retry has
+        nothing to preempt for). Returns True when victims were evicted."""
+        if self.plugin is None or not hasattr(
+            self.plugin, "preempt_victim_plan"
+        ):
+            return False
+        if err is not None and not isinstance(err, ResourceNotEnoughError):
+            return False
+        try:
+            with trace_mod.span("preempt_plan"):
+                plan = self.plugin.preempt_victim_plan(pod)
+        except Exception:  # noqa: BLE001 — planning must never kill a cycle
+            return False
+        if plan is None:
+            return False
+        gang = _gang_key(info) or info.name
+        # dry-run verification: the device plan was computed against the
+        # snapshot's leftover; between then and now binds/releases may
+        # have landed. The commit half runs only if the freed capacity
+        # still seats the gang under the control plane's own math.
+        verify = getattr(self.plugin, "operation", None)
+        planner = getattr(verify, "preempt_planner", None) if verify else None
+        if planner is not None and not planner.verify(
+            plan, pod, self.cluster
+        ):
+            DEFAULT_FLIGHT_RECORDER.record(
+                gang,
+                phase="preempt",
+                verdict="denied",
+                reason="victim plan failed live re-verification",
+                victims=len(plan.gangs),
+            )
+            return False
+        self._evict_gang_plan(plan, preemptor=gang)
+        forget = getattr(self.plugin, "forget_denied", None)
+        if forget is not None:
+            # the denial this preemption answers is otherwise 20s-sticky;
+            # the freed capacity should not idle for the TTL
+            forget(gang)
+        return True
+
+    def _evict_gang_plan(self, plan, preemptor: str) -> None:
+        """Commit half of the preemption transaction: evict each victim
+        gang WHOLE (rejecting permit-parked members first so assumed
+        capacity releases, then deleting bound members — k8s eviction
+        semantics), reset its schedule state, and requeue it by
+        recreating its members as fresh Pending pods (the in-process
+        stand-in for the workload controller's recreate — exactly one
+        re-queue per eviction)."""
+        note_evicted = getattr(self.plugin, "note_gang_evicted", None)
+        for victim_gang in plan.gangs:
+            pods = plan.pods_by_gang.get(victim_gang, [])
+            DEFAULT_FLIGHT_RECORDER.record(
+                victim_gang,
+                phase="preempt",
+                verdict="evicted",
+                preemptor=preemptor,
+                members=len(pods),
+            )
+            for victim in pods:
+                uid = victim.metadata.uid
+                wp = self.waiting.get(uid)
+                if wp is not None:
+                    wp.reject("Preempted")
+                try:
+                    self.clientset.pods(victim.metadata.namespace).delete(
+                        victim.metadata.name
+                    )
+                except NotFoundError:
+                    self.cluster.forget(uid)
+            if note_evicted is not None:
+                note_evicted(victim_gang)
+            if self.requeue_evicted:
+                self._respawn_gang(pods)
+        # one increment per TRANSACTION (matching the host-scan path and
+        # the series' help text); the victim-gang count rides the
+        # preemptor's flight record below
+        self._preemptions_total.inc(reason="priority-tier")
+        DEFAULT_FLIGHT_RECORDER.record(
+            preemptor,
+            phase="preempt",
+            verdict="placed-via-preemption",
+            victims=len(plan.gangs),
+            evicted_pods=plan.evicted_pods,
+            pooled_after=plan.pooled_after,
+        )
+        if self.plugin is not None:
+            self.plugin.mark_dirty()
+
+    def _respawn_gang(self, pods: List[Pod]) -> None:
+        """Recreate evicted members as fresh Pending pods (new UID, same
+        name/spec, no node): the informer's ADDED event re-enqueues them,
+        so the evicted gang re-enters the queue exactly once."""
+        from ..api.types import new_uid
+
+        for victim in pods:
+            meta = victim.metadata
+            # deepcopy, not a shallow field copy: the evicted object stays
+            # referenced (cluster state, flight records) and must not
+            # share mutable spec lists with the respawned pod
+            fresh = victim.deepcopy()
+            fresh.metadata.uid = new_uid("pod")
+            fresh.spec.node_name = ""
+            fresh.status = type(fresh.status)()
+            try:
+                self.clientset.pods(meta.namespace).create(fresh)
+            except Exception:  # noqa: BLE001 — best-effort respawn
+                DEFAULT_FLIGHT_RECORDER.record(
+                    f"{meta.namespace}/{meta.name}",
+                    phase="preempt",
+                    verdict="error",
+                    reason="respawn failed; gang waits for external "
+                           "recreation",
+                )
+
     def _try_preempt(self, pod: Pod) -> bool:
         """Victim search + eviction for an unschedulable pod — the role
         upstream kube-scheduler's PostFilter (preemption) plays for the
@@ -748,6 +890,11 @@ class Scheduler:
         demotes the gang). Returns True if victims were evicted."""
         if self.plugin is None:
             return False
+        # vectorized policy plan first (docs/policy.md): whole-gang victim
+        # sets for gang-scale needs; the host loop below remains the
+        # single-pod fallback when the policy engine is off or has no plan
+        if self._policy_preempt(PodInfo(pod=pod), pod):
+            return True
         require = dict(pod.resource_require())
         require["pods"] = require.get("pods", 0) + 1
 
@@ -817,6 +964,7 @@ class Scheduler:
         if best_victims is None:
             return False
         self._evict(best_victims)
+        self._preemptions_total.inc(reason="host-scan")
         return True
 
     def _evict(self, victims: List[Pod]) -> None:

@@ -47,6 +47,7 @@ __all__ = [
     "find_max_group",
     "score_nodes",
     "assign_gangs",
+    "assign_gangs_policy",
     "assign_gangs_wavefront",
     "assign_gangs_sharded",
     "assign_gangs_topk",
@@ -168,7 +169,7 @@ def _cumsum(x, axis):
     return x
 
 
-def _select_best_fit(cap, capc, need):
+def _select_best_fit(cap, capc, need, key=None):
     """Tightest-first take vector for one gang: the histogram threshold
     selection documented in assign_gangs. Shapes are [1, N] (2-D so the iota
     lowers on TPU inside pallas kernels too); returns (take[1,N], feasible).
@@ -176,9 +177,16 @@ def _select_best_fit(cap, capc, need):
     (ops.pallas_assign). The node-sharded rung re-derives these exact
     threshold/remainder formulas from summary histograms (``_hist_select``
     and the sharded mega path below) — its bit-identity guarantee holds
-    only while the formulas match, so change all of them together."""
+    only while the formulas match, so change all of them together.
+
+    ``key`` overrides the selection bucket per node (the policy rung's
+    composite: tightness + policy penalties, assign_gangs_policy). Any
+    override must keep the base invariant key==0 ⟺ capacity==0 (bucket 0
+    carries zero capacity, so the threshold formulas are unchanged);
+    ``key=None`` is the exact pre-policy tightness bucket."""
     feasible = jnp.sum(capc) >= need
-    key = jnp.minimum(cap, _BINS - 1)  # tightness bucket (0 = no fit)
+    if key is None:
+        key = jnp.minimum(cap, _BINS - 1)  # tightness bucket (0 = no fit)
     bins = jax.lax.broadcasted_iota(jnp.int32, (_BINS, 1), 0)
     bin_totals = jnp.sum(
         jnp.where(key == bins, capc, 0), axis=1, keepdims=True
@@ -340,6 +348,74 @@ def assign_gangs(left0, group_req, remaining, fit_mask, order):
         cap = _member_capacity(left, req[None, :]) * mask  # [N] >= 0
         capc = jnp.minimum(cap, need)  # overflow-safe effective capacity
         take2d, feasible = _select_best_fit(cap[None, :], capc[None, :], need)
+        take = take2d[0]
+        left = left - take[:, None] * req[None, :]
+        return left, (take, feasible)
+
+    left, (takes, placed) = jax.lax.scan(body, left0, order, unroll=4)
+    g = group_req.shape[0]
+    alloc = jnp.zeros((g, n), jnp.int32).at[order].set(takes)
+    placed = jnp.zeros((g,), bool).at[order].set(placed)
+    return alloc, placed, left
+
+
+@partial(jax.jit, static_argnames=("policy_terms", "policy_weights"))
+def assign_gangs_policy(left0, group_req, remaining, fit_mask, order,
+                        prio, aff, anti, gang_dom, node_hash, node_dom,
+                        policy_terms: tuple = (),
+                        policy_weights: tuple = ()):
+    """Policy-composite form of ``assign_gangs``: same scan, same
+    tightest-first machinery, with each gang's selection bucket shifted by
+    the composed policy terms (batch_scheduler_tpu.policy.terms,
+    docs/policy.md "Term algebra"):
+
+    - soft penalties (affinity miss, spread-domain occupancy) ADD to the
+      tightness bucket, clipped into ``[1, _BINS-1]`` — penalized nodes
+      are consumed later but never excluded, and the within-bucket
+      node-index tie-break of ``_select_best_fit`` is untouched (the
+      override key keeps bucket 0 ⟺ zero capacity, so the threshold and
+      remainder formulas hold verbatim);
+    - hard masks (anti-affinity) multiply into the capacity row exactly
+      like the fit mask.
+
+    ``policy_terms``/``policy_weights`` are static (each policy config is
+    its own jit signature — bounded: configs change per deployment, not
+    per batch). With every term disabled (or all-zero columns) the
+    composite key equals the base tightness bucket and the result is
+    bit-identical to ``assign_gangs`` — the zero-policy identity
+    ``make bench-policy`` enforces.
+
+    This is the single scan rung policy batches run: the wavefront /
+    sharded / top-K rungs EXPLICITLY DEMOTE to it (dispatch_batch) rather
+    than approximate the composite — their uniform-wave and summary-merge
+    fast paths assume the selection key is a function of capacity alone,
+    which per-gang penalties break (docs/scan_parallelism.md "Policy
+    composite").
+    """
+    from ..policy.terms import compose_terms
+
+    pen_fn = compose_terms(policy_terms, policy_weights)
+    n = left0.shape[0]
+    mask_rows = fit_mask.shape[0]
+
+    def body(left, g):
+        req = jnp.take(group_req, g, axis=0)
+        mask = jnp.take(fit_mask, jnp.minimum(g, mask_rows - 1), axis=0)
+        need = jnp.take(remaining, g)
+        pen, keep = pen_fn(
+            jnp.take(aff, g), jnp.take(anti, g),
+            jnp.take(gang_dom, g, axis=0), node_hash, node_dom,
+        )
+
+        cap = _member_capacity(left, req[None, :]) * mask * keep  # [N]
+        capc = jnp.minimum(cap, need)
+        base = jnp.minimum(cap, _BINS - 1)
+        key = jnp.where(
+            cap > 0, jnp.clip(base + pen, 1, _BINS - 1), 0
+        )
+        take2d, feasible = _select_best_fit(
+            cap[None, :], capc[None, :], need, key=key[None, :]
+        )
         take = take2d[0]
         left = left - take[:, None] * req[None, :]
         return left, (take, feasible)
@@ -1883,14 +1959,15 @@ ASSIGNMENT_TOP_K = 128
     jax.jit,
     static_argnames=(
         "use_pallas", "top_k", "scan_mesh", "scan_wave", "scan_shard",
-        "scan_topk",
+        "scan_topk", "policy_terms", "policy_weights",
     ),
 )
 def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
                    group_valid, order, use_pallas: bool = False,
                    top_k: int = ASSIGNMENT_TOP_K, scan_mesh=None,
                    scan_wave: int = 0, scan_shard: bool = False,
-                   scan_topk: int = 0):
+                   scan_topk: int = 0, policy_cols=None,
+                   policy_terms: tuple = (), policy_weights: tuple = ()):
     """Fused full-batch oracle: leftover -> capacity -> feasibility -> scores
     -> greedy gang assignment, one XLA computation.
 
@@ -1922,14 +1999,30 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
     This is the ``fit()`` of SURVEY.md §7: everything the control plane needs
     for one scheduling batch in a single device round-trip.
 
+    ``policy_cols`` (+ static ``policy_terms``/``policy_weights``) selects
+    the POLICY rung: the composite-key serial scan
+    (``assign_gangs_policy``), with the hard-mask terms also folded into
+    the batch-head capacity matrix so feasibility/scores agree with what
+    the scan will refuse to take. The wavefront/sharded/top-K rungs
+    explicitly demote when policies are active (docs/policy.md).
+
     Output discipline: the (G,N) tensors (capacity/scores/assignment) are
     BIG — fetching them over the host link costs more than computing them
     (measured ~10x the batch time at 5k nodes). Hosts should fetch only the
     O(G) vectors plus the compact top-K assignment, and pull individual
     (G,·) rows on demand (see core.oracle_scorer).
     """
+    policy_on = policy_cols is not None and bool(policy_terms)
     left = left_resources(alloc_lanes, requested)
     cap = group_capacity(left, group_req, fit_mask)
+    if policy_on:
+        # hard-mask policy terms (anti-affinity) shape the head capacity
+        # too: a node the policy scan will never take must not answer
+        # Filter/feasibility as if it could
+        from ..policy.terms import compose_keep_dense
+
+        _prio, _aff, p_anti, _gd, p_node_hash, _nd = policy_cols
+        cap = cap * compose_keep_dense(policy_terms, p_anti, p_node_hash)
     feasible = gang_feasible(cap, remaining, group_valid)
     scores = score_nodes(cap)
     if scan_mesh is not None and not scan_shard:
@@ -1948,13 +2041,34 @@ def schedule_batch(alloc_lanes, requested, group_req, remaining, fit_mask,
             jax.lax.with_sharding_constraint(x, repl)
             for x in (left, group_req, remaining, fit_mask)
         )
+        if policy_on:
+            # the policy scan is serial like the base scan: its columns
+            # ride replicated too, or GSPMD drags per-step collectives
+            # through the G-step loop (the SHARDING_r03 failure mode)
+            policy_cols = tuple(
+                jax.lax.with_sharding_constraint(x, repl)
+                for x in policy_cols
+            )
     else:
         scan_left, scan_gr, scan_rem, scan_fm = (
             left, group_req, remaining, fit_mask,
         )
     wave_stats = None
     topk_stats = None
-    if scan_topk > 0:
+    if policy_on:
+        # the policy rung: composite-key serial scan. Takes precedence
+        # over every parallel rung — the wavefront/sharded/top-K fast
+        # paths assume the selection key is a function of capacity alone
+        # and must demote rather than commit wrong-composite waves
+        # (dispatch_batch already strips them; this guard keeps direct
+        # schedule_batch callers honest too).
+        p_prio, p_aff, p_anti, p_gdom, p_nhash, p_ndom = policy_cols
+        assignment, placed, left_after = assign_gangs_policy(
+            scan_left, scan_gr, scan_rem, scan_fm, order,
+            p_prio, p_aff, p_anti, p_gdom, p_nhash, p_ndom,
+            policy_terms=policy_terms, policy_weights=policy_weights,
+        )
+    elif scan_topk > 0:
         # Hierarchical top-K rung (the XL tier): coarse-rank candidates,
         # exact selection on [G, K] gathered slices, demotion-backed
         # bit-identity (docs/scan_parallelism.md "Hierarchical top-K").
@@ -2061,7 +2175,8 @@ def _batch_blob_impl(alloc_lanes, requested, group_req, remaining, fit_mask,
                      pack_assignment: bool = True,
                      top_k: int = ASSIGNMENT_TOP_K, scan_mesh=None,
                      scan_wave: int = 0, scan_shard: bool = False,
-                     scan_topk: int = 0):
+                     scan_topk: int = 0, policy_cols=None,
+                     policy_terms: tuple = (), policy_weights: tuple = ()):
     """One device computation for a whole control-plane batch: the fused
     oracle + findMaxPG, with every O(G) host-needed output concatenated into
     a single int32 blob. On a high-latency host<->device link (the axon
@@ -2090,7 +2205,9 @@ def _batch_blob_impl(alloc_lanes, requested, group_req, remaining, fit_mask,
                          fit_mask, group_valid, order, use_pallas=use_pallas,
                          top_k=top_k, scan_mesh=scan_mesh,
                          scan_wave=scan_wave, scan_shard=scan_shard,
-                         scan_topk=scan_topk)
+                         scan_topk=scan_topk, policy_cols=policy_cols,
+                         policy_terms=policy_terms,
+                         policy_weights=policy_weights)
     best, exists, progress = find_max_group(min_member, scheduled, matched,
                                             ineligible, creation_rank)
     if pack_assignment:
@@ -2139,7 +2256,8 @@ def _batch_blob_impl(alloc_lanes, requested, group_req, remaining, fit_mask,
 
 
 _BLOB_STATICS = ("use_pallas", "pack_assignment", "top_k", "scan_mesh",
-                 "scan_wave", "scan_shard", "scan_topk")
+                 "scan_wave", "scan_shard", "scan_topk", "policy_terms",
+                 "policy_weights")
 _batch_blob = jax.jit(_batch_blob_impl, static_argnames=_BLOB_STATICS)
 # Donated variant for the double-buffered dispatch-ahead pipeline: the two
 # [N, R] inputs (alloc, requested) are donated so XLA can reuse their
@@ -2194,14 +2312,14 @@ class PendingBatch:
     __slots__ = (
         "blob", "out", "pack", "used_pallas", "_rerun", "blob_np",
         "mask_mode", "used_wave", "compiled", "n_bucket", "g_bucket",
-        "pinned", "used_shard", "shard_count", "used_topk",
+        "pinned", "used_shard", "shard_count", "used_topk", "used_policy",
     )
 
     def __init__(
         self, blob, out, pack, used_pallas, rerun, blob_np=None,
         mask_mode="broadcast", used_wave=0, compiled=None,
         n_bucket=0, g_bucket=0, pinned=False, used_shard=False,
-        shard_count=0, used_topk=0,
+        shard_count=0, used_topk=0, used_policy=False,
     ):
         self.blob = blob
         self.out = out
@@ -2231,10 +2349,15 @@ class PendingBatch:
         # hierarchical top-K rung: the candidate width this batch ran
         # with (0 = rung off); collect's blame + tail slicing need it
         self.used_topk = used_topk
+        # policy rung (assign_gangs_policy): policy batches run a single
+        # rung (no ladder — a policy batch has no semantically-equivalent
+        # fallback), so collect's blame policy must not rerun them serial
+        self.used_policy = used_policy
 
 
 def dispatch_batch(
-    batch_args, progress_args, scan_mesh=None, donate: bool = False
+    batch_args, progress_args, scan_mesh=None, donate: bool = False,
+    policy=None,
 ) -> PendingBatch:
     """Launch one fused batch + max-progress selection WITHOUT waiting for
     the result, and start an async device->host copy of the packed O(G)
@@ -2249,7 +2372,17 @@ def dispatch_batch(
     makes the donated buffer fresh every dispatch, which is what keeps a
     donation from ever aliasing an in-flight batch's inputs); pre-placed
     device arrays must not be reused or re-dispatched. No-op on backends
-    without donation (CPU) — see ``donation_supported``."""
+    without donation (CPU) — see ``donation_supported``.
+
+    ``policy`` = ``(policy_cols, policy_terms, policy_weights)`` selects
+    the policy rung (assign_gangs_policy): the wavefront / sharded /
+    top-K / pallas rungs are EXPLICITLY DEMOTED for the batch (their fast
+    paths assume the selection key is a function of capacity alone) and
+    there is NO fallback ladder — a serial non-policy rerun would be a
+    semantically different plan, so a policy-rung failure surfaces to the
+    caller instead of silently serving the wrong composite. Donation is
+    skipped (single-rung batches re-raise; a consumed donated buffer
+    would make the error unreplayable)."""
     # The fused Pallas scan is single-device TPU only (both mask modes —
     # broadcast [1,N] and per-group [G,N]), and Mosaic lowering is
     # hardware-path-only (tests exercise interpret mode): if a variant
@@ -2286,6 +2419,20 @@ def dispatch_batch(
         scan_wave = forced[1]
         scan_topk = forced[2] if len(forced) > 2 else 0
         scan_sharded = False
+    policy_cols = policy_terms = policy_weights = None
+    if policy is not None:
+        # the policy rung demotes every parallel/fused rung for the batch
+        # (explicit demotion, docs/policy.md): composite selection runs
+        # the serial policy scan only. Rung pins (replays) keep their
+        # policy columns — the recorded batch's semantics ride with them.
+        policy_cols, policy_terms, policy_weights = policy
+        policy_terms = tuple(policy_terms)
+        policy_weights = tuple(policy_weights)
+        use_pallas = False
+        scan_wave = 0
+        scan_sharded = False
+        scan_topk = 0
+        donate = False
     # The packed form saturates per-node counts at 65535; a take can reach
     # the gang's full remaining count on one node, so gate the compact form
     # on the host-side remaining bound and fall back to the exact
@@ -2314,6 +2461,13 @@ def dispatch_batch(
     def run(up: bool, wave: int = 0, dn: bool = False, sh: bool = False,
             tk: int = 0):
         fn = _batch_blob_donated if dn else _batch_blob
+        if policy_cols is not None:
+            return fn(
+                *batch_args, *progress_args, use_pallas=False,
+                pack_assignment=pack, top_k=top_k, scan_mesh=scan_mesh,
+                policy_cols=tuple(policy_cols), policy_terms=policy_terms,
+                policy_weights=policy_weights,
+            )
         return fn(
             *batch_args, *progress_args, use_pallas=up, pack_assignment=pack,
             top_k=top_k, scan_mesh=scan_mesh, scan_wave=wave, scan_shard=sh,
@@ -2342,6 +2496,10 @@ def dispatch_batch(
         attempts.append((use_pallas, 0, False, 0))
     if use_pallas:
         attempts.append((False, 0, False, 0))
+    if policy_cols is not None:
+        # single rung, no ladder: a policy batch has no semantically-
+        # equivalent fallback (see docstring) — failure surfaces
+        attempts = [(False, 0, False, 0)]
 
     blob_np = None
     blob = out = None
@@ -2386,7 +2544,9 @@ def dispatch_batch(
             compiled = cache_size_fn() > cache_before
         except Exception:  # noqa: BLE001 — telemetry only
             compiled = None
-    if compiled and scan_mesh is None and forced is None:
+    if compiled and scan_mesh is None and forced is None and (
+        policy_cols is None
+    ):
         # a fresh executable was just built for this bucket shape: analyze
         # its compiled cost in the background (once per shape per process).
         # `i` is the winning ladder rung — only rung 0 dispatches donated,
@@ -2420,6 +2580,7 @@ def dispatch_batch(
             int(np.prod(scan_mesh.devices.shape)) if used_shard else 0
         ),
         used_topk=used_topk,
+        used_policy=policy_cols is not None,
     )
 
 
@@ -2521,6 +2682,7 @@ def _collect_batch_inner(pending: PendingBatch):
         "g_bucket": int(pending.g_bucket),
         "scan_sharded": bool(used_shard),
         "scan_topk": int(used_topk),
+        "scan_policy": bool(pending.used_policy),
     }
     if used_shard:
         telemetry["shard_count"] = int(pending.shard_count)
@@ -2575,7 +2737,9 @@ def _fold_batch_metrics(telemetry: dict) -> None:
     from ..utils.metrics import DEFAULT_REGISTRY as reg
 
     path = (
-        "topk"
+        "policy"
+        if telemetry.get("scan_policy")
+        else "topk"
         if telemetry.get("scan_topk", 0) > 0
         else "pallas"
         if telemetry["used_pallas"]
@@ -2813,7 +2977,7 @@ def _maybe_analyze_bucket(batch_args, progress_args, use_pallas: bool,
 
 
 def execute_batch_host(batch_args, progress_args, scan_mesh=None,
-                       donate: bool = False):
+                       donate: bool = False, policy=None):
     """Run one fused batch + max-progress selection and fetch ONLY the O(G)
     host vectors (as ONE packed transfer — see _batch_blob); the (G,N)
     tensors come back as device handles for lazy row reads. The single
@@ -2823,7 +2987,10 @@ def execute_batch_host(batch_args, progress_args, scan_mesh=None,
     collect_batch; pipelined callers (ops.rescore.ChurnRescorer's
     tick_dispatch/tick_collect) use the split halves directly. ``donate``
     follows dispatch_batch's buffer-donation contract (host numpy args
-    only)."""
+    only); ``policy`` follows dispatch_batch's policy-rung contract."""
     return collect_batch(
-        dispatch_batch(batch_args, progress_args, scan_mesh, donate=donate)
+        dispatch_batch(
+            batch_args, progress_args, scan_mesh, donate=donate,
+            policy=policy,
+        )
     )

@@ -54,6 +54,14 @@ class GroupDemand:
     released: bool = False
     # No representative pod observed yet (reference core.go:709-710).
     has_pod: bool = True
+    # Policy columns (batch_scheduler_tpu.policy, docs/policy.md): label
+    # hashes of the gang's soft-affinity / hard-anti-affinity targets
+    # (0 = none), the spread opt-in, and the gang's currently-matched
+    # members per node (the spread term's domain occupancy source).
+    affinity_hash: int = 0
+    anti_hash: int = 0
+    spread: bool = False
+    placed_nodes: Dict[str, int] = field(default_factory=dict)
 
     @property
     def remaining(self) -> int:
@@ -73,6 +81,12 @@ def node_requested_from_pods(pods: Sequence[Pod]) -> Dict[str, int]:
         for k, v in p.resource_require().items():
             total[k] = total.get(k, 0) + v
     return total
+
+
+def _policy_hash_lanes() -> int:
+    from ..policy.terms import HASH_LANES
+
+    return HASH_LANES
 
 
 def _member_request_row(g: GroupDemand) -> Dict[str, int]:
@@ -97,6 +111,8 @@ class ClusterSnapshot:
         alloc_lanes: Optional[np.ndarray] = None,
         group_req_lanes: Optional[np.ndarray] = None,
         min_buckets: tuple = (0, 0),
+        policy_engine=None,
+        node_policy_lanes: Optional[tuple] = None,
     ):
         self.node_names = [n.metadata.name for n in nodes]
         self.group_names = [g.full_name for g in groups]
@@ -214,6 +230,65 @@ class ClusterSnapshot:
             node_valid, self.alloc.shape[0], fill=False
         )
 
+        # -- packed policy columns (batch_scheduler_tpu.policy) -----------
+        # Built only when an enabled engine is attached; policy-off
+        # snapshots carry None and every downstream path runs the exact
+        # pre-policy code (the zero-policy identity of docs/policy.md).
+        self.policy_engine = policy_engine
+        self.policy_cols = None
+        if policy_engine is not None and policy_engine.enabled:
+            from ..policy.terms import (
+                DOMAIN_BUCKETS,
+                node_policy_row,
+            )
+
+            nb, gb = self.alloc.shape[0], self.group_req.shape[0]
+            if node_policy_lanes is not None:
+                node_hash, node_dom = node_policy_lanes
+                node_hash = np.asarray(node_hash, np.int32)
+                node_dom = np.asarray(node_dom, np.int32)
+            else:
+                spread_key = policy_engine.config.spread_node_key
+                node_hash = np.zeros(
+                    (len(nodes), _policy_hash_lanes()), np.int32
+                )
+                node_dom = np.zeros(len(nodes), np.int32)
+                truncated = 0
+                for i, n in enumerate(nodes):
+                    row, dom, trunc = node_policy_row(
+                        n.metadata.labels or {}, spread_key
+                    )
+                    node_hash[i] = row
+                    node_dom[i] = dom
+                    truncated += trunc
+                if truncated:
+                    from ..utils.metrics import DEFAULT_REGISTRY
+
+                    DEFAULT_REGISTRY.counter(
+                        "bst_policy_label_truncations_total",
+                        "Node labels beyond the packed hash lanes "
+                        "(affinity against them can never match)",
+                    ).inc(truncated)
+            prio = np.array([g.priority for g in groups], np.int32)
+            aff = np.array([g.affinity_hash for g in groups], np.int32)
+            anti = np.array([g.anti_hash for g in groups], np.int32)
+            gang_dom = np.zeros((len(groups), DOMAIN_BUCKETS), np.int32)
+            for gi, g in enumerate(groups):
+                if not g.spread or not g.placed_nodes:
+                    continue
+                for node_name, count in g.placed_nodes.items():
+                    ni = self._node_index.get(node_name)
+                    if ni is not None:
+                        gang_dom[gi, int(node_dom[ni])] += int(count)
+            self.policy_cols = (
+                pad_rows(prio, gb),
+                pad_rows(aff, gb),
+                pad_rows(anti, gb),
+                pad_rows(gang_dom, gb),
+                pad_rows(node_hash, nb),
+                pad_rows(node_dom, nb),
+            )
+
     def _fit_mask(
         self, nodes: Sequence[Node], groups: Sequence[GroupDemand]
     ) -> np.ndarray:
@@ -269,6 +344,19 @@ class ClusterSnapshot:
             self.creation_rank,
         )
 
+    def policy_payload(self):
+        """The ``policy=`` argument for ops.oracle.dispatch_batch —
+        ``(policy_cols, terms, weights)`` when an enabled engine packed
+        columns for this snapshot, else None (the exact pre-policy path)."""
+        if self.policy_cols is None or self.policy_engine is None:
+            return None
+        cfg = self.policy_engine.config
+        if not cfg.scoring_terms:
+            # preemption-only configs pack columns (the planner reads
+            # priorities) but score nothing: the base rungs stay live
+            return None
+        return (self.policy_cols, cfg.scoring_terms, cfg.weights)
+
     @property
     def shape(self) -> tuple:
         return (
@@ -307,7 +395,7 @@ class DeltaSnapshotPacker:
     Not thread-safe; callers serialize packs (the scorer's refresh lock).
     """
 
-    def __init__(self):
+    def __init__(self, policy_engine=None):
         self.schema: Optional[LaneSchema] = None
         self._node_names: Optional[tuple] = None
         self._alloc_keys: list = []
@@ -321,6 +409,19 @@ class DeltaSnapshotPacker:
         self.full_repacks = 0
         self.delta_packs = 0
         self.last_rows_rewritten = 0
+        # Policy column persistence (docs/policy.md "Packing"): node
+        # label-hash / spread-domain rows keyed by each node's label dict,
+        # so label churn rewrites only touched rows — independent of the
+        # lane-side full-repack rules (a resource_version bump full-repacks
+        # the LANES but the policy rows of unchanged-label nodes survive).
+        # Group policy columns are O(G·D) and rebuilt per pack (spread
+        # occupancy churns with every permit; memoizing it would just
+        # trade the fill for an equality walk).
+        self.policy_engine = policy_engine
+        self._policy_labels: list = []  # per-node sorted label tuples
+        self._policy_hash: Optional[np.ndarray] = None
+        self._policy_dom: Optional[np.ndarray] = None
+        self.policy_rows_rewritten = 0
 
     # -- internals ----------------------------------------------------------
 
@@ -403,6 +504,56 @@ class DeltaSnapshotPacker:
             out[gi] = row
         return out
 
+    def _policy_node_rows(self, nodes) -> Optional[tuple]:
+        """Persistent node policy columns: rewrite only rows whose LABELS
+        changed (spread key included — it lives in the labels). Returns
+        (hash[N, H], dom[N]) copies for the snapshot, or None when no
+        enabled engine is attached."""
+        engine = self.policy_engine
+        if engine is None or not engine.enabled:
+            return None
+        from ..policy.terms import node_policy_row
+
+        spread_key = engine.config.spread_node_key
+        lanes = _policy_hash_lanes()
+        n = len(nodes)
+        if (
+            self._policy_hash is None
+            or self._policy_hash.shape != (n, lanes)
+        ):
+            self._policy_hash = np.zeros((n, lanes), np.int32)
+            self._policy_dom = np.zeros(n, np.int32)
+            self._policy_labels = [None] * n
+        rewritten = 0
+        truncated = 0
+        for i, node in enumerate(nodes):
+            labels = node.metadata.labels or {}
+            key = tuple(sorted(labels.items()))
+            if self._policy_labels[i] == key:
+                continue
+            row, dom, trunc = node_policy_row(labels, spread_key)
+            self._policy_hash[i] = row
+            self._policy_dom[i] = dom
+            self._policy_labels[i] = key
+            rewritten += 1
+            truncated += trunc
+        self.policy_rows_rewritten = rewritten
+        from ..utils.metrics import DEFAULT_REGISTRY
+
+        if rewritten:
+            DEFAULT_REGISTRY.counter(
+                "bst_pack_policy_rows_rewritten",
+                "Node policy (label-hash/spread-domain) rows rewritten by "
+                "the delta snapshot packer",
+            ).inc(rewritten)
+        if truncated:
+            DEFAULT_REGISTRY.counter(
+                "bst_policy_label_truncations_total",
+                "Node labels beyond the packed hash lanes "
+                "(affinity against them can never match)",
+            ).inc(truncated)
+        return self._policy_hash.copy(), self._policy_dom.copy()
+
     def pack(
         self,
         nodes: Sequence[Node],
@@ -414,6 +565,10 @@ class DeltaSnapshotPacker:
         alloc_dicts = [n.status.allocatable for n in nodes]
         req_dicts = [node_requested.get(n.metadata.name, {}) for n in nodes]
         names = tuple(n.metadata.name for n in nodes)
+
+        if names != self._node_names:
+            # node list changed: the policy row cache is positionally keyed
+            self._policy_hash = None
 
         group_req = None
         if self._alloc is not None and names == self._node_names:
@@ -443,4 +598,6 @@ class DeltaSnapshotPacker:
             alloc_lanes=self._alloc.copy(),
             requested_lanes=self._requested,  # ClusterSnapshot copies
             group_req_lanes=group_req,  # freshly allocated per pack
+            policy_engine=self.policy_engine,
+            node_policy_lanes=self._policy_node_rows(nodes),
         )

@@ -132,6 +132,20 @@ class BatchSchedulingPlugin:
     def preempt_remove_pod(self, pod_to_schedule: Pod, pod_to_remove: Pod) -> None:
         self.operation.preempt_remove_pod(pod_to_schedule, pod_to_remove)
 
+    # Vectorized policy preemption (batch_scheduler_tpu.policy /
+    # docs/policy.md): the dry-run victim plan for a denied gang, and the
+    # post-eviction gang reset. The framework drives the transaction
+    # (Scheduler._evict_gang_plan: verify → evict → requeue).
+    def preempt_victim_plan(self, pod: Pod):
+        with self._ext_seconds.time(point="preemptPlan"):
+            return self.operation.preempt_victim_plan(pod)
+
+    def note_gang_evicted(self, full_name: str) -> None:
+        self.operation.note_gang_evicted(full_name)
+
+    def forget_denied(self, full_name: str) -> None:
+        self.operation.forget_denied(full_name)
+
     def mark_dirty(self) -> None:
         self.operation.mark_dirty()
 

@@ -67,6 +67,11 @@ class PluginConfig:
     # (utils.health.IdentityAuditor; mismatch => /debug/health breach).
     # 0 = off.
     oracle_identity_audit_every: int = 0
+    # Policy engine config (batch_scheduler_tpu.policy.PolicyConfig /
+    # docs/policy.md): priority-tiered preemption, affinity / spread
+    # scoring terms. None = read BST_POLICY from the environment (empty =
+    # policies off, the exact pre-policy paths).
+    policy: Optional[object] = None
     controller_workers: int = 10
     leader_poll_seconds: float = 1.0
     lease_renew_seconds: float = 3.0
@@ -188,6 +193,7 @@ def new_plugin_runtime(
         compile_warmer=config.oracle_compile_warmer,
         audit_log=config.oracle_audit_log,
         identity_audit_every=config.oracle_identity_audit_every,
+        policy=config.policy,
         **kwargs,
     )
 

@@ -1,0 +1,49 @@
+"""Vectorized policy engine: priority-tiered preemption, affinity and
+spread as composable jit'd scoring terms over the oracle's packed buffers
+(docs/policy.md).
+
+- policy.terms   — the term registry + packed-column conventions
+- policy.engine  — PolicyConfig / PolicyEngine (env knobs, fingerprint,
+                   /debug/policy view, per-term flight-recorder blame)
+- policy.preempt — the vectorized victim planner + dry-run verifier
+"""
+
+from .engine import (
+    PolicyConfig,
+    PolicyEngine,
+    active_engine,
+    active_fingerprint,
+    policy_debug_view,
+)
+from .preempt import PreemptionPlanner, VictimPlan, plan_victims
+from .terms import (
+    DOMAIN_BUCKETS,
+    HASH_LANES,
+    SCORING_TERMS,
+    TERM_REGISTRY,
+    compose_terms,
+    label_hash,
+    node_policy_row,
+    parse_label_ref,
+    register_term,
+)
+
+__all__ = [
+    "PolicyConfig",
+    "PolicyEngine",
+    "PreemptionPlanner",
+    "VictimPlan",
+    "plan_victims",
+    "active_engine",
+    "active_fingerprint",
+    "policy_debug_view",
+    "DOMAIN_BUCKETS",
+    "HASH_LANES",
+    "SCORING_TERMS",
+    "TERM_REGISTRY",
+    "compose_terms",
+    "label_hash",
+    "node_policy_row",
+    "parse_label_ref",
+    "register_term",
+]

@@ -1,0 +1,378 @@
+"""Vectorized preemption: the masked second scan that answers "which
+victim set frees enough capacity for this denied gang, minimizing
+preempted pods" as one jitted device pass (docs/policy.md "Preemption
+pass").
+
+The host-side ``Scheduler._try_preempt`` loop walks nodes × pods in
+Python — fine for one online pod, hopeless for gang-scale preemption
+where the denied unit needs capacity across many nodes at once. Here the
+victim search is two ``lax.scan`` passes over packed victim rows:
+
+1. **Greedy pass** — victims ordered (priority asc, pods asc — evict the
+   cheapest, lowest tier first; the host computes the order, the device
+   consumes it) are taken whole-gang (gang semantics: evicting ANY member
+   breaks the victim's quorum, so the correct eviction unit is the gang)
+   until the preemptor's pooled need-clipped capacity covers its need.
+2. **Reprieve pass** — in reverse order (most expensive first), any taken
+   victim whose removal still leaves the preemptor covered is given back.
+
+The surviving set is inclusion-minimal BY CONSTRUCTION: after the
+reprieve, removing any single victim drops pooled capacity below the
+need (asserted property-style in tests/test_policy.py). The plan is a
+DRY RUN — the control plane re-verifies it host-side against live
+cluster state and applies it through the existing preempt hooks
+(framework.scheduler) before any eviction happens.
+
+Tier rule enforced on device: a victim is eligible only when its priority
+class is STRICTLY below the preemptor's (never equal-or-higher — the
+first policy invariant).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["plan_victims", "PreemptionPlanner", "VictimPlan"]
+
+_BIG = 2**30
+
+# Victim-gang bucket sizes (power-of-two jit signatures, min 8) and a hard
+# cap: a preemption pass considering more than 512 victim gangs is a sign
+# the cluster is misconfigured, not a planning problem.
+_V_MIN, _V_MAX = 8, 512
+
+
+def _v_bucket(v: int) -> int:
+    b = _V_MIN
+    while b < v and b < _V_MAX:
+        b <<= 1
+    return b
+
+
+def _capacity(left, req):
+    """Members of demand row ``req`` fitting each leftover row of
+    ``left`` [..., R]. Plain int32 division — the planner is off the
+    batch hot path and its answer is re-verified host-side, so it does
+    not share the oracle's _exact_floordiv bit-discipline."""
+    safe = jnp.maximum(req, 1)
+    lpos = jnp.clip(left, 0, _BIG)
+    per_lane = jnp.where(req > 0, lpos // safe, _BIG)
+    return jnp.min(per_lane, axis=-1).astype(jnp.int32)
+
+
+@jax.jit
+def plan_victims(left, fit_row, req, need, prio, valloc, vreq, vprio,
+                 vvalid, vorder):
+    """One preemption plan on packed buffers.
+
+    - ``left[N, R]``    live leftover lanes (post current accounting)
+    - ``fit_row[N]``    0/1 nodes the preemptor may use at all
+    - ``req[R]``        preemptor per-member demand row
+    - ``need``          members still requiring seats (scalar)
+    - ``prio``          preemptor priority class (scalar)
+    - ``valloc[V, N]``  victim members per node
+    - ``vreq[V, R]``    victim per-member demand rows
+    - ``vprio[V]``      victim priority classes
+    - ``vvalid[V]``     0/1 real victim rows (padding = 0)
+    - ``vorder[V]``     host-computed greedy order (priority asc, pods asc)
+
+    Returns ``(taken[V] bool, feasible bool, pooled_after int32)`` where
+    ``taken`` marks the inclusion-minimal victim set and ``feasible``
+    says the set covers the need (False = even evicting every eligible
+    victim cannot seat the gang — no plan).
+    """
+    eligible = (vvalid > 0) & (vprio < prio)  # never equal-or-higher tier
+
+    def pooled(left_c):
+        cap = _capacity(left_c, req[None, :]) * fit_row
+        return jnp.sum(jnp.minimum(cap, need))
+
+    pooled0 = pooled(left)
+
+    def greedy(carry, v):
+        left_c, have = carry
+        freed = valloc[v][:, None] * vreq[v][None, :]  # [N, R]
+        cand = left_c + freed
+        cand_pool = pooled(cand)
+        take = eligible[v] & (have < need)
+        left_c = jnp.where(take, cand, left_c)
+        have = jnp.where(take, cand_pool, have)
+        return (left_c, have), take
+
+    (left_all, have_all), taken_ord = jax.lax.scan(
+        greedy, (left, pooled0), vorder
+    )
+    feasible = have_all >= need
+
+    def reprieve(carry, v):
+        left_c, tk = carry
+        freed = valloc[v][:, None] * vreq[v][None, :]
+        without = left_c - freed
+        still = pooled(without) >= need
+        drop = tk[v] & still & feasible
+        left_c = jnp.where(drop, without, left_c)
+        tk = tk.at[v].set(tk[v] & ~drop)
+        return (left_c, tk), None
+
+    taken = jnp.zeros((valloc.shape[0],), bool).at[vorder].set(taken_ord)
+    taken = taken & feasible  # an infeasible pass evicts nothing
+    # reverse greedy order: give back the most expensive victims first
+    (left_fin, taken), _ = jax.lax.scan(
+        reprieve, (left_all, taken), vorder[::-1]
+    )
+    return taken, feasible, pooled(left_fin)
+
+
+@dataclass
+class VictimPlan:
+    """One dry-run preemption plan, ready for the control plane's
+    verify-then-commit transaction (framework.scheduler)."""
+
+    preemptor: str  # gang full_name (or pod name for non-gang preemptors)
+    need: int
+    gangs: List[str] = field(default_factory=list)
+    # victim gang full_name -> its member pods (the eviction unit)
+    pods_by_gang: Dict[str, list] = field(default_factory=dict)
+    feasible: bool = False
+    pooled_after: int = 0
+    plan_seconds: float = 0.0
+
+    @property
+    def evicted_pods(self) -> int:
+        return sum(len(p) for p in self.pods_by_gang.values())
+
+    def victims(self) -> list:
+        out = []
+        for pods in self.pods_by_gang.values():
+            out.extend(pods)
+        return out
+
+
+class PreemptionPlanner:
+    """Host packer + verifier around ``plan_victims``.
+
+    Victim rows are built from live cluster state (pods grouped by gang
+    per node); the device answers the minimal set; ``verify`` re-checks
+    the freed capacity against the same live state with the control
+    plane's own resource math (core.resources) — the dry-run half of the
+    dry-run/commit transaction.
+    """
+
+    def __init__(self, config):
+        self.config = config
+
+    # -- victim harvest -----------------------------------------------------
+
+    def _harvest(self, cluster, status_cache, preemptor_gang: str,
+                 preemptor_prio: int):
+        """Group every gang pod bound/assumed on the cluster into victim
+        candidates: (full_name -> {node -> [pods]}), honoring the tier
+        and phase eligibility rules host-side (the device re-checks the
+        tier rule; belt and braces)."""
+        from ..utils.labels import pod_group_name
+
+        victims: Dict[str, Dict[str, list]] = {}
+        prio: Dict[str, int] = {}
+        for node in cluster.list_nodes():
+            for pod in cluster.pods_on(node.metadata.name):
+                gname, is_gang = pod_group_name(pod)
+                if not is_gang:
+                    continue  # online pods are never policy-tier victims
+                full = f"{pod.metadata.namespace}/{gname}"
+                if full == preemptor_gang:
+                    continue  # no self-preemption
+                victims.setdefault(full, {}).setdefault(
+                    node.metadata.name, []
+                ).append(pod)
+                # gang tier = its highest member priority: one equal-or-
+                # higher member protects the whole gang (the caller's
+                # vprio_map filter drops it)
+                prio[full] = max(prio.get(full, -1), pod.spec.priority)
+        if self.config.protect_running and status_cache is not None:
+            from ..api.types import PodGroupPhase
+
+            for full in list(victims):
+                pgs = status_cache.get(full)
+                if pgs is not None and pgs.pod_group.status.phase in (
+                    PodGroupPhase.SCHEDULED,
+                    PodGroupPhase.RUNNING,
+                ):
+                    del victims[full]
+        return victims, prio
+
+    def plan(self, pod, cluster, status_cache, full_name: str,
+             need: int) -> Optional[VictimPlan]:
+        """Dry-run one preemption plan for ``pod``'s denied gang. Returns
+        None when nothing is evictable or even full eviction cannot seat
+        the gang."""
+        t0 = time.perf_counter()
+        preemptor_prio = int(pod.spec.priority)
+        victims, vprio_map = self._harvest(
+            cluster, status_cache, full_name, preemptor_prio
+        )
+        victims = {
+            f: nodes
+            for f, nodes in victims.items()
+            if vprio_map.get(f, 0) < preemptor_prio
+        }
+        if not victims or need <= 0:
+            return None
+
+        nodes = cluster.list_nodes()
+        node_idx = {n.metadata.name: i for i, n in enumerate(nodes)}
+        names = sorted(
+            {
+                k
+                for n in nodes
+                for k in n.status.allocatable
+            }
+            | set(pod.resource_require())
+            | {
+                k
+                for per_node in victims.values()
+                for pods in per_node.values()
+                for k in pods[0].resource_require()
+            }
+            | {"pods"}
+        )
+        lane = {k: i for i, k in enumerate(names)}
+        n_count, r_count = len(nodes), len(names)
+
+        def row(d: Dict[str, int]) -> np.ndarray:
+            out = np.zeros(r_count, np.int32)
+            for k, v in d.items():
+                out[lane[k]] = min(int(v), _BIG)
+            return out
+
+        left = np.zeros((n_count, r_count), np.int64)
+        from ..core import resources as rmath
+
+        fit_row = np.zeros(n_count, np.int32)
+        for i, node in enumerate(nodes):
+            left_d = rmath.single_node_left(
+                node, cluster.node_requested(node.metadata.name), None
+            )
+            left[i] = row(left_d)
+            fit_row[i] = int(
+                not node.spec.unschedulable and rmath.check_fit(pod, node)
+            )
+        left = np.clip(left, -_BIG, _BIG).astype(np.int32)
+
+        req_d = dict(pod.resource_require())
+        req_d["pods"] = req_d.get("pods", 0) + 1
+        req = row(req_d)
+
+        vnames = sorted(victims)
+        v = len(vnames)
+        vb = _v_bucket(v)
+        valloc = np.zeros((vb, n_count), np.int32)
+        vreq = np.zeros((vb, r_count), np.int32)
+        vprio = np.zeros(vb, np.int32)
+        vvalid = np.zeros(vb, np.int32)
+        vpods = np.zeros(vb, np.int32)
+        for i, full in enumerate(vnames):
+            per_node = victims[full]
+            any_pod = next(iter(per_node.values()))[0]
+            vr = dict(any_pod.resource_require())
+            vr["pods"] = vr.get("pods", 0) + 1
+            vreq[i] = row(vr)
+            vprio[i] = vprio_map.get(full, 0)
+            vvalid[i] = 1
+            for node_name, pods in per_node.items():
+                ni = node_idx.get(node_name)
+                if ni is not None:
+                    valloc[i, ni] = len(pods)
+                    vpods[i] += len(pods)
+        # greedy order: lowest tier first, then fewest pods (minimize
+        # preempted pods), then name order (deterministic); padding last
+        order = sorted(
+            range(vb),
+            key=lambda i: (
+                -vvalid[i],
+                int(vprio[i]),
+                int(vpods[i]),
+                vnames[i] if i < v else "~",
+            ),
+        )
+        taken, feasible, pooled_after = plan_victims(
+            jnp.asarray(left),
+            jnp.asarray(fit_row),
+            jnp.asarray(req),
+            jnp.int32(min(need, _BIG)),
+            jnp.int32(preemptor_prio),
+            jnp.asarray(valloc),
+            jnp.asarray(vreq),
+            jnp.asarray(vprio),
+            jnp.asarray(vvalid),
+            jnp.asarray(np.array(order, np.int32)),
+        )
+        taken = np.asarray(taken)
+        if not bool(feasible):
+            return None
+        plan = VictimPlan(
+            preemptor=full_name,
+            need=int(need),
+            feasible=True,
+            pooled_after=int(pooled_after),
+            plan_seconds=time.perf_counter() - t0,
+        )
+        for i in range(v):
+            if taken[i]:
+                full = vnames[i]
+                plan.gangs.append(full)
+                plan.pods_by_gang[full] = [
+                    p for pods in victims[full].values() for p in pods
+                ]
+        return plan if plan.gangs else None
+
+    # -- dry-run verification ----------------------------------------------
+
+    def verify(self, plan: VictimPlan, pod, cluster) -> bool:
+        """Re-verify the plan host-side against LIVE cluster state with the
+        control plane's own resource math: after removing every victim
+        pod's charge, the preemptor's pooled member capacity must cover
+        its need. The commit half runs only on a True verdict."""
+        from ..core import resources as rmath
+
+        victim_by_node: Dict[str, list] = {}
+        for pods in plan.pods_by_gang.values():
+            for vp in pods:
+                node = vp.spec.node_name
+                if node is None:
+                    # assumed-but-unbound victims release via their Permit
+                    # reject; their charge is found through cluster state
+                    continue
+                victim_by_node.setdefault(node, []).append(vp)
+        require = dict(pod.resource_require())
+        require["pods"] = require.get("pods", 0) + 1
+        seats = 0
+        for node in cluster.list_nodes():
+            if node.spec.unschedulable or not rmath.check_fit(pod, node):
+                continue
+            left = dict(
+                rmath.single_node_left(
+                    node, cluster.node_requested(node.metadata.name), None
+                )
+            )
+            for vp in victim_by_node.get(node.metadata.name, ()):
+                vreq = dict(vp.resource_require())
+                vreq["pods"] = vreq.get("pods", 0) + 1
+                left = rmath.add_resources(left, vreq)
+            # count members fitting this node under the freed leftover
+            while seats < plan.need and rmath.resource_satisfied(
+                left, require
+            ):
+                left = rmath.add_resources(
+                    left, {k: -v for k, v in require.items()}
+                )
+                seats += 1
+            if seats >= plan.need:
+                return True
+        return seats >= plan.need

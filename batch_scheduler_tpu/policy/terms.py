@@ -1,0 +1,219 @@
+"""Policy term registry: each policy is a pure jit'd scoring term over the
+packed policy columns, composed per gang into the assignment scan's
+selection composite.
+
+The reference plugin exposes ``Score`` and ``PreemptAddPod`` /
+``PreemptRemovePod`` extension points that its shipped implementation
+stubs out (reference core.go:263-265, batchscheduler.go:116-144). Here
+those become real, *vectorized* policies: every term is a pure function of
+per-gang scalars and per-node columns — no host string work inside a batch
+— so the whole policy surface rides the same one-device-round-trip
+discipline as the oracle itself (docs/policy.md "Term algebra").
+
+Packed columns (built host-side once per snapshot, ops.snapshot):
+
+- ``prio[G]``       priority class per gang (the same field queue order
+                    sorts on — one source of truth for tiers)
+- ``aff[G]``        soft-affinity label hash (0 = no preference)
+- ``anti[G]``       anti-affinity label hash (0 = none; HARD exclusion)
+- ``gang_dom[G,D]`` members of the gang already placed per spread-domain
+                    bucket (all-zero when the gang did not opt into spread)
+- ``node_hash[N,H]``label hashes of each node's first H labels (0-padded)
+- ``node_dom[N]``   spread-domain bucket of each node
+
+A term maps those to either a per-node int32 PENALTY (soft: added to the
+tightness bucket so penalized nodes are consumed later, never excluded) or
+a per-node 0/1 KEEP mask (hard: multiplied into the gang's capacity row).
+With every term disabled the composite is identically zero / all-ones, so
+policy-off batches are bit-identical to the base scan by construction —
+the invariant ``make bench-policy`` enforces.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Callable, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "DOMAIN_BUCKETS",
+    "HASH_LANES",
+    "TERM_REGISTRY",
+    "SCORING_TERMS",
+    "register_term",
+    "label_hash",
+    "parse_label_ref",
+    "node_policy_row",
+    "compose_terms",
+    "compose_keep_dense",
+]
+
+# Spread-domain hash buckets. Domains (zones/racks) number in the tens on
+# real clusters; 16 buckets keep the per-gang column one cache line while
+# a hash collision only makes two domains share a spread count —
+# conservative (more spreading), never unsafe.
+DOMAIN_BUCKETS = 16
+
+# Label-hash lanes per node: the first H node labels (sorted by key) ride
+# the packed column. Affinity/anti-affinity against a label beyond the
+# H-th simply never matches — documented in docs/policy.md, and the
+# packer counts such truncations (bst_policy_label_truncations_total).
+HASH_LANES = 4
+
+
+def label_hash(key: str, value: str) -> int:
+    """Stable positive int32 hash of one ``key=value`` label pair; never 0
+    (0 is the empty-lane sentinel in the packed columns)."""
+    h = zlib.crc32(f"{key}={value}".encode()) & 0x7FFFFFFF
+    return h or 1
+
+
+def parse_label_ref(raw: str) -> Tuple[str, str]:
+    """Parse a policy label value naming a node label: "key:value" (or the
+    "key=value" spelling). Returns ("", "") for an unparseable value — a
+    typo'd policy label degrades to "no constraint", never to an error in
+    the packing hot path (the BST_SCAN_WAVE parse-guard idiom)."""
+    for sep in (":", "="):
+        if sep in raw:
+            k, _, v = raw.partition(sep)
+            if k and v:
+                return k, v
+    return "", ""
+
+
+def node_policy_row(labels: Dict[str, str], spread_key: str):
+    """One node's packed policy columns: (hash_lanes[H], domain_bucket,
+    truncated_label_count). Pure host-side numpy — called by the snapshot
+    packer once per churned node, not per batch."""
+    row = np.zeros(HASH_LANES, np.int32)
+    keys = sorted(labels)
+    for i, k in enumerate(keys[:HASH_LANES]):
+        row[i] = label_hash(k, labels[k])
+    dom = 0
+    sv = labels.get(spread_key)
+    if sv is not None:
+        dom = label_hash(spread_key, sv) % DOMAIN_BUCKETS
+    return row, dom, max(0, len(keys) - HASH_LANES)
+
+
+# ---------------------------------------------------------------------------
+# the registry
+# ---------------------------------------------------------------------------
+
+# name -> (kind, fn). Kinds:
+#   "penalty"  fn(ctx) -> pen[N] int32 >= 0 added into the selection key
+#   "mask"     fn(ctx) -> keep[N] int32 0/1 multiplied into capacity
+#   "gate"     no device fn; toggles a control-plane behavior (preemption)
+# ctx is a dict of per-gang scalars + node columns + weights — see
+# compose_terms for the exact keys. Terms must be pure jnp (trace-safe).
+TERM_REGISTRY: Dict[str, Tuple[str, Callable]] = {}
+
+
+def register_term(name: str, kind: str = "penalty"):
+    """Register one policy term. Decorator form:
+
+        @register_term("affinity")
+        def _affinity(ctx): ...
+    """
+
+    def deco(fn):
+        TERM_REGISTRY[name] = (kind, fn)
+        return fn
+
+    return deco
+
+
+@register_term("affinity", "penalty")
+def _affinity_term(ctx):
+    """Soft node-affinity: a gang with ``aff`` set pays ``w_aff`` on every
+    node whose label lanes do not contain the hash — matching nodes are
+    consumed first, non-matching remain available (no starvation)."""
+    aff = ctx["aff"]  # scalar
+    match = jnp.any(ctx["node_hash"] == aff, axis=-1)  # [N]
+    want = aff > 0
+    return jnp.where(want & ~match, ctx["w_aff"], 0).astype(jnp.int32)
+
+
+@register_term("anti-affinity", "mask")
+def _anti_affinity_term(ctx):
+    """Hard anti-affinity: nodes carrying the gang's ``anti`` label are
+    excluded from its capacity row exactly like a failed node selector."""
+    anti = ctx["anti"]
+    hit = jnp.any(ctx["node_hash"] == anti, axis=-1)  # [N]
+    return jnp.where((anti > 0) & hit, 0, 1).astype(jnp.int32)
+
+
+@register_term("spread", "penalty")
+def _spread_term(ctx):
+    """Spread penalty: a node whose spread domain already holds k of this
+    gang's members pays ``w_spread * min(k, spread_cap)`` — emptier
+    domains are consumed first, saturating so one crowded domain cannot
+    push nodes past the loosest tightness bucket forever."""
+    occupancy = jnp.take(ctx["gang_dom"], ctx["node_dom"], mode="clip")  # [N]
+    return (
+        jnp.minimum(occupancy, ctx["spread_cap"]) * ctx["w_spread"]
+    ).astype(jnp.int32)
+
+
+# Preemption is a control-plane gate, not a device scoring term: enabling
+# it arms the vectorized victim planner (policy.preempt) on the deny path.
+register_term("preempt", "gate")(lambda ctx: None)
+
+# Terms with a device-side scoring contribution, in composite order.
+SCORING_TERMS = ("affinity", "anti-affinity", "spread")
+
+
+def compose_terms(terms: tuple, weights: tuple):
+    """Compose the enabled scoring terms into one per-gang function
+    ``fn(aff, anti, dom_row, node_hash, node_dom) -> (pen[N], keep[N])``.
+
+    ``terms`` is the static tuple of enabled term names and ``weights``
+    the static ``(w_aff, w_spread, spread_cap)`` triple — both hashable,
+    so the jitted scan treats each policy config as its own signature.
+    Unknown names are ignored (a version-skewed config must degrade, not
+    crash a batch); "gate" terms contribute nothing here.
+    """
+    w_aff, w_spread, spread_cap = (tuple(weights) + (0, 0, 0))[:3]
+
+    def fn(aff, anti, dom_row, node_hash, node_dom):
+        ctx = {
+            "aff": aff,
+            "anti": anti,
+            "gang_dom": dom_row,
+            "node_hash": node_hash,
+            "node_dom": node_dom,
+            "w_aff": jnp.int32(w_aff),
+            "w_spread": jnp.int32(w_spread),
+            "spread_cap": jnp.int32(spread_cap),
+        }
+        n = node_dom.shape[0]
+        pen = jnp.zeros((n,), jnp.int32)
+        keep = jnp.ones((n,), jnp.int32)
+        for name in terms:
+            entry = TERM_REGISTRY.get(name)
+            if entry is None:
+                continue
+            kind, term = entry
+            if kind == "penalty":
+                pen = pen + term(ctx)
+            elif kind == "mask":
+                keep = keep * term(ctx)
+        return pen, keep
+
+    return fn
+
+
+def compose_keep_dense(terms: tuple, anti, node_hash):
+    """The [G, N] hard-mask product of every enabled mask term — applied to
+    the batch-head capacity matrix so feasibility/scores stay consistent
+    with what the policy scan will refuse to take. Today the only mask
+    term is anti-affinity; unknown names are ignored like compose_terms."""
+    if "anti-affinity" not in terms:
+        g = anti.shape[0]
+        return jnp.ones((g, 1), jnp.int32)
+    hit = jnp.any(
+        node_hash[None, :, :] == anti[:, None, None], axis=-1
+    )  # [G, N]
+    return jnp.where((anti[:, None] > 0) & hit, 0, 1).astype(jnp.int32)

@@ -79,6 +79,7 @@ class OracleClient:
         deadline_ms: Optional[int] = None,
         trace_ctx: Optional[Tuple[str, str]] = None,
         audit_id: Optional[str] = None,
+        policy_fp: Optional[str] = None,
     ) -> Tuple[int, bytes]:
         with self._lock:
             if deadline_ms is not None:
@@ -108,6 +109,15 @@ class OracleClient:
                         self._sock,
                         proto.MsgType.AUDIT_ID,
                         proto.pack_audit_id(audit_id),
+                    )
+                if policy_fp is not None:
+                    # policy skew detection (docs/policy.md "Wire"): the
+                    # client's policy fingerprint rides ahead so a
+                    # policy-unaware sidecar counts the mismatch
+                    proto.write_frame(
+                        self._sock,
+                        proto.MsgType.POLICY_INFO,
+                        proto.pack_policy_info(policy_fp),
                     )
                 proto.write_frame(self._sock, msg_type, payload)
                 try:
@@ -176,6 +186,7 @@ class OracleClient:
         req: proto.ScheduleRequest,
         deadline_ms: Optional[int] = None,
         audit_id: Optional[str] = None,
+        policy_fp: Optional[str] = None,
     ) -> proto.ScheduleResponse:
         # propagate the live span context over the wire (the TRACE
         # annotation frame); None when tracing is off or no span is open,
@@ -191,6 +202,7 @@ class OracleClient:
             deadline_ms=deadline_ms,
             trace_ctx=trace_ctx,
             audit_id=audit_id,
+            policy_fp=policy_fp,
         )
         if resp_type != proto.MsgType.SCHEDULE_RESP:
             raise OracleTransportError(
@@ -259,9 +271,11 @@ class _ClientSlot:
         req: proto.ScheduleRequest,
         deadline_ms: Optional[int] = None,
         audit_id: Optional[str] = None,
+        policy_fp: Optional[str] = None,
     ) -> proto.ScheduleResponse:
         return self._parent.schedule(
-            req, deadline_ms, audit_id=audit_id, _slot=self._idx
+            req, deadline_ms, audit_id=audit_id, policy_fp=policy_fp,
+            _slot=self._idx,
         )
 
     def row(
@@ -511,6 +525,7 @@ class ResilientOracleClient:
         req: proto.ScheduleRequest,
         deadline_ms: Optional[int] = None,
         audit_id: Optional[str] = None,
+        policy_fp: Optional[str] = None,
         _slot: int = 0,
     ) -> proto.ScheduleResponse:
         d = (
@@ -520,7 +535,9 @@ class ResilientOracleClient:
         )
         return self._call(
             "schedule",
-            lambda c: c.schedule(req, deadline_ms=d, audit_id=audit_id),
+            lambda c: c.schedule(
+                req, deadline_ms=d, audit_id=audit_id, policy_fp=policy_fp
+            ),
             slot=_slot,
         )
 
@@ -574,6 +591,12 @@ class RemoteScorer(OracleScorer):
       re-probes automatically once the breaker cooldown elapses."""
 
     FALLBACK_MODES = ("deny", "local-cpu")
+
+    # 16-hex policy-config fingerprint announced on every schedule request
+    # when the embedding operation runs a policy engine (the sidecar
+    # executes base batches; skew is counted server-side, never silent).
+    # Stamped by ScheduleOperation; None keeps the wire pre-policy.
+    policy_fingerprint = None
 
     def __init__(
         self,
@@ -663,9 +686,17 @@ class RemoteScorer(OracleScorer):
             from ..utils import audit as audit_mod
 
             audit_id = audit_mod.new_audit_id()
+        # policy skew annotation (docs/policy.md "Wire"): the sidecar runs
+        # base (policy-unaware) batches, so a client with an active policy
+        # engine announces its config fingerprint and the server counts
+        # the mismatch — never a silent divergence. None when no policy is
+        # live, which keeps the wire bytes identical to a pre-policy client.
+        policy_fp = getattr(self, "policy_fingerprint", None)
         try:
             with trace_mod.span("oracle.wire_round_trip", cat="oracle"):
-                resp = client.schedule(req, audit_id=audit_id)
+                resp = client.schedule(
+                    req, audit_id=audit_id, policy_fp=policy_fp
+                )
         except _TRANSPORT_ERRORS + (OracleDeadlineError,):
             # raw OSError/EOFError included, not just the resilient
             # client's wrapped OracleTransportError: a plain OracleClient

@@ -120,6 +120,7 @@ class MsgType:
     TRACE = 10
     TRACE_INFO = 11
     AUDIT_ID = 12
+    POLICY_INFO = 13
 
 
 ROW_KINDS = ("capacity", "scores")
@@ -387,6 +388,32 @@ def pack_audit_id(audit_id: str) -> bytes:
 
 def unpack_audit_id(payload: bytes) -> str:
     return _AUDIT.unpack(payload)[0].decode("ascii", errors="replace")
+
+
+# -- policy fingerprint annotation ------------------------------------------
+
+# fixed-width ascii like the AUDIT_ID annotation: the 16-hex policy-config
+# fingerprint (policy.engine.PolicyConfig.fingerprint) of the CLIENT's
+# active policy engine, annotating the next request on this connection.
+# The sidecar executes base (policy-unaware) batches; a client running
+# policies compares fingerprints so a mismatched peer is a counted,
+# visible condition (bst_policy_fingerprint_mismatch_total) rather than a
+# silent plan divergence. No reply; old peers that don't know MsgType 13
+# never receive it (clients send it only when a policy engine is live).
+_POLICY = struct.Struct("<16s")
+
+
+def pack_policy_info(fingerprint: str) -> bytes:
+    fp = fingerprint.encode("ascii")
+    if len(fp) != 16:
+        raise ValueError(
+            f"policy fingerprint must be 16 hex chars, got {fingerprint!r}"
+        )
+    return _POLICY.pack(fp)
+
+
+def unpack_policy_info(payload: bytes) -> str:
+    return _POLICY.unpack(payload)[0].decode("ascii", errors="replace")
 
 
 # -- row request/response --------------------------------------------------

@@ -328,6 +328,31 @@ class _Handler(socketserver.BaseRequestHandler):
         return self._worker.run(fn, budget_ms)
 
     @staticmethod
+    def _note_policy_skew(peer_fp: str) -> None:
+        """A client announced its policy-config fingerprint (the
+        POLICY_INFO annotation). The sidecar executes BASE batches — it
+        has no policy engine unless one was configured process-wide — so
+        any fingerprint it does not share means the client's policy scan
+        and this server's base scan would produce different plans. Counted,
+        never fatal: the client still gets its batch, and the counter (plus
+        the client-side audit fingerprints) is the skew evidence."""
+        own = None
+        try:
+            from ..policy.engine import active_fingerprint
+
+            fp = active_fingerprint()
+            own = fp["fingerprint"] if fp else None
+        except Exception:  # noqa: BLE001 — detection must never drop a batch
+            pass
+        if peer_fp != (own or ""):
+            DEFAULT_REGISTRY.counter(
+                "bst_policy_fingerprint_mismatch_total",
+                "Schedule requests whose client announced a policy-config "
+                "fingerprint this server does not share (the client-side "
+                "policy scan and this sidecar's base scan would diverge)",
+            ).inc()
+
+    @staticmethod
     def _mk_span(name: str, ts_epoch: float, dur_s: float, trace_ctx, **args):
         """One Chrome-trace span dict for the TRACE_INFO reply, stamped
         with the CLIENT's trace/parent IDs so both sides of the wire
@@ -351,6 +376,7 @@ class _Handler(socketserver.BaseRequestHandler):
         deadline_ms: Optional[int] = None  # armed for the NEXT request
         trace_ctx: Optional[tuple] = None  # armed for the NEXT request
         audit_ctx: Optional[str] = None  # armed for the NEXT request
+        policy_ctx: Optional[str] = None  # armed for the NEXT request
         self._worker: Optional[_ConnWorker] = None
         batch_seconds = DEFAULT_REGISTRY.histogram(
             "bst_oracle_server_batch_seconds",
@@ -380,9 +406,15 @@ class _Handler(socketserver.BaseRequestHandler):
                     if msg_type == proto.MsgType.AUDIT_ID:
                         audit_ctx = proto.unpack_audit_id(payload)
                         continue  # annotation only; no reply
+                    if msg_type == proto.MsgType.POLICY_INFO:
+                        policy_ctx = proto.unpack_policy_info(payload)
+                        continue  # annotation only; no reply
                     budget_ms, deadline_ms = deadline_ms, None
                     req_trace, trace_ctx = trace_ctx, None
                     req_audit, audit_ctx = audit_ctx, None
+                    req_policy, policy_ctx = policy_ctx, None
+                    if req_policy is not None:
+                        self._note_policy_skew(req_policy)
                     if msg_type == proto.MsgType.PING:
                         # answered inline, never through the worker:
                         # liveness must stay observable even while a

@@ -43,6 +43,7 @@ class SimCluster:
         oracle_compile_warmer: bool = False,
         audit_log=None,
         identity_audit_every: int = 0,
+        policy=None,
         api=None,
     ):
         # ``api``: any APIServer-interface implementation — pass an
@@ -64,6 +65,9 @@ class SimCluster:
             oracle_compile_warmer=oracle_compile_warmer,
             oracle_audit_log=audit_log,
             oracle_identity_audit_every=identity_audit_every,
+            # policy engine config (batch_scheduler_tpu.policy.PolicyConfig);
+            # None reads BST_POLICY from the environment
+            policy=policy,
             **kwargs,
         )
         self.runtime = None

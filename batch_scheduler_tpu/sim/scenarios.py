@@ -34,6 +34,7 @@ __all__ = [
     "make_member_pods",
     "race_scenario",
     "readback_tail_scenarios",
+    "spot_vs_guaranteed_scenario",
     "synthetic_cluster",
     "XLClusterSpec",
     "xl_scan_operands",
@@ -163,6 +164,59 @@ def readback_tail_scenarios():
         )
     ]
     return (wide_nodes, wide_groups), (big_nodes, big_groups)
+
+
+def spot_vs_guaranteed_scenario(
+    nodes: int = 2,
+    node_cpu: str = "8",
+    spot_gangs: int = 2,
+    guaranteed_gangs: int = 1,
+    min_member: int = 4,
+    member_cpu: str = "2",
+    guaranteed_priority: int = 10,
+):
+    """Mixed-tier preemption scenario (docs/policy.md): tier-0 "spot"
+    gangs sized to fill the cluster, then tier-``guaranteed_priority``
+    "guaranteed" gangs that can only place by evicting spot capacity
+    through the policy engine's vectorized preemption pass. Defaults: 2
+    nodes x 8 cpu, 2 spot gangs x 4 members x 2 cpu = 16 cpu (exactly
+    full), 1 guaranteed gang needing 8 cpu — the shape the e2e test
+    proves converges deterministically; wider shapes stress the respawn
+    race (docs/policy.md "Known limitation") harder.
+
+    Returns ``(nodes, groups, pods)`` with the guaranteed pods created
+    LAST (the caller controls arrival order — create spot first, wait for
+    them to bind, then create guaranteed to exercise preemption rather
+    than queue priority; `sim --scenario spot-vs-guaranteed` stages
+    exactly that)."""
+    now = time.time()
+    node_objs = [
+        make_sim_node(
+            f"node-{i:03d}",
+            {"cpu": node_cpu, "memory": "32Gi", "pods": "110"},
+            labels={"zone": f"z{i % 2}"},
+        )
+        for i in range(nodes)
+    ]
+    groups, pods = [], {}
+    for s in range(spot_gangs):
+        name = f"spot-{s:03d}"
+        groups.append(
+            make_sim_group(name, min_member, creation_ts=now - 1.0 + s * 1e-3)
+        )
+        pods[name] = make_member_pods(
+            name, min_member, {"cpu": member_cpu}, priority=0
+        )
+    for g in range(guaranteed_gangs):
+        name = f"guaranteed-{g:03d}"
+        groups.append(
+            make_sim_group(name, min_member, creation_ts=now + g * 1e-3)
+        )
+        pods[name] = make_member_pods(
+            name, min_member, {"cpu": member_cpu},
+            priority=guaranteed_priority,
+        )
+    return node_objs, groups, pods
 
 
 @dataclass

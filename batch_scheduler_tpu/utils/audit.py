@@ -89,9 +89,23 @@ PROGRESS_ARG_NAMES = (
     "min_member", "scheduled", "matched", "ineligible", "creation_rank",
 )
 
+# Packed policy columns (batch_scheduler_tpu.policy / docs/policy.md),
+# present only in records of policy-rung batches. They ride the same
+# keyframe/delta machinery as the batch args, so a policy audit record
+# replays bit-identically with its exact composite inputs.
+POLICY_ARG_NAMES = (
+    "policy_prio", "policy_aff", "policy_anti", "policy_gang_dom",
+    "policy_node_hash", "policy_node_dom",
+)
+
 # the big lane arrays worth delta-packing; everything else is O(G) or a
-# broadcast row and rides full in every record
-_DELTA_ARRAYS = ("alloc", "requested", "group_req")
+# broadcast row and rides full in every record. The 2-D policy columns
+# (label hashes churn with node labels, domain occupancy with permits)
+# delta-pack the same way; absent keys are skipped per record.
+_DELTA_ARRAYS = (
+    "alloc", "requested", "group_req", "policy_gang_dom",
+    "policy_node_hash",
+)
 
 _BOOL_ARRAYS = ("fit_mask", "group_valid", "ineligible", "placed",
                 "gang_feasible")
@@ -165,6 +179,17 @@ def config_fingerprint(extra: Optional[dict] = None) -> dict:
         cfg["scan_wave"] = okern._scan_wave_from_env() if okern._wave_enabled[0] else 0
         cfg["pallas"] = dict(okern._pallas_enabled)
         cfg["donate"] = okern.donation_supported()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from ..policy.engine import active_fingerprint
+
+        pol = active_fingerprint()
+        if pol is not None:
+            # the active policy config is execution-relevant: a replay on
+            # a host with a different policy would diverge, and the blame
+            # report must name the policy knob, not just "config differed"
+            cfg["policy"] = pol
     except Exception:  # noqa: BLE001
         pass
     try:
@@ -342,10 +367,13 @@ class AuditLog:
         degraded: bool = False,
         telemetry: Optional[dict] = None,
         extra: Optional[dict] = None,
+        policy=None,
     ) -> str:
         """Enqueue one batch record; returns its audit ID. Array arguments
         are held BY REFERENCE — callers pass published (immutable)
-        snapshot/result arrays only."""
+        snapshot/result arrays only. ``policy`` is the batch's
+        ``(policy_cols, terms, weights)`` payload when it ran the policy
+        rung — recorded so replay re-executes the exact composite."""
         aid = audit_id or new_audit_id()
         item = {
             "kind": "batch",
@@ -361,6 +389,12 @@ class AuditLog:
             "_result": {k: result[k] for k in PLAN_FIELDS},
             "_names": (list(node_names or []), list(group_names or [])),
         }
+        if policy is not None:
+            cols, terms, weights = policy
+            item["_arrays"] |= dict(zip(POLICY_ARG_NAMES, cols))
+            item["policy"] = {
+                "terms": list(terms), "weights": list(weights),
+            }
         if extra:
             item.update(extra)
         self._enqueue(item)
@@ -493,6 +527,10 @@ class AuditLog:
             self._prev is None
             or self._since_keyframe >= self.keyframe_every - 1
             or self._prev_names != (tuple(names[0]), tuple(names[1]))
+            # a policy flip mid-run changes the array SET: force a
+            # keyframe so the reader's rolling state never carries stale
+            # policy columns across the boundary
+            or set(self._prev) != set(snap)
             or any(self._prev[k].shape != snap[k].shape for k in snap)
         )
         if keyframe:
@@ -515,6 +553,8 @@ class AuditLog:
             item["keyframe"] = False
             deltas = {}
             for k in _DELTA_ARRAYS:
+                if k not in snap:
+                    continue
                 changed = np.flatnonzero((snap[k] != self._prev[k]).any(axis=1))
                 if changed.size:
                     deltas[k] = {
@@ -652,6 +692,13 @@ class AuditReader:
                 out["progress_args"] = tuple(
                     state[k] for k in PROGRESS_ARG_NAMES
                 )
+                pol = rec.get("policy")
+                if pol and all(k in state for k in POLICY_ARG_NAMES):
+                    out["policy_args"] = (
+                        tuple(state[k] for k in POLICY_ARG_NAMES),
+                        tuple(pol.get("terms") or ()),
+                        tuple(pol.get("weights") or ()),
+                    )
                 out["result_arrays"] = {
                     k: _dec(v) for k, v in rec["result"].items()
                 }

@@ -286,9 +286,13 @@ class IdentityAuditor:
         )
 
     def note_batch(self, batch_args, progress_args, plan_digest: str,
-                   audit_id: Optional[str], audit_log=None) -> None:
+                   audit_id: Optional[str], audit_log=None,
+                   policy=None) -> None:
         """Hot-path entry: counts the batch and, on the Kth, hands the
-        (immutable, published) arrays to the verification thread."""
+        (immutable, published) arrays to the verification thread.
+        ``policy`` is a policy-rung batch's (cols, terms, weights) payload
+        — re-verification must run the same composite or every policy
+        batch would "diverge" against the wrong plan."""
         with self._lock:
             self._count += 1
             if self._count % self.every:
@@ -298,7 +302,7 @@ class IdentityAuditor:
             t = threading.Thread(
                 target=self._verify,
                 args=(batch_args, progress_args, plan_digest, audit_id,
-                      audit_log),
+                      audit_log, policy),
                 name="identity-audit",
                 daemon=True,
             )
@@ -306,13 +310,13 @@ class IdentityAuditor:
         t.start()
 
     def _verify(self, batch_args, progress_args, plan_digest, audit_id,
-                audit_log) -> None:
+                audit_log, policy=None) -> None:
         try:
             from ..core.oracle_scorer import replay_batch
             from . import audit as audit_mod
 
             host, _ = replay_batch(
-                batch_args, progress_args, against=self.rung
+                batch_args, progress_args, against=self.rung, policy=policy
             )
             got = audit_mod.plan_digest(host)
         except Exception:  # noqa: BLE001 — an audit error is not a mismatch

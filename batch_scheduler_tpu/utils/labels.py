@@ -11,6 +11,22 @@ from ..api.types import Pod, PodGroup
 POD_GROUP_LABEL = "group.batch.scheduler.tpu"
 POD_GROUP_ANN = POD_GROUP_LABEL
 
+# Policy-engine labels (batch_scheduler_tpu.policy / docs/policy.md).
+# Carried on the gang's representative pod; projected into packed policy
+# columns at snapshot-pack time.
+#
+# - affinity: "key:value" — soft preference for nodes carrying that label
+#   (non-matching nodes pay the affinity penalty in the selection
+#   composite; the gang still places elsewhere when matchers are full).
+# - anti-affinity: "key:value" — HARD exclusion of nodes carrying that
+#   label (masked out of the gang's capacity like a failed selector).
+# - spread: any non-empty value opts the gang into the spread penalty:
+#   nodes whose spread domain (PolicyConfig.spread_node_key) already
+#   holds members of this gang rank behind emptier domains.
+POLICY_AFFINITY_LABEL = "policy.batch.scheduler.tpu/affinity"
+POLICY_ANTI_AFFINITY_LABEL = "policy.batch.scheduler.tpu/anti-affinity"
+POLICY_SPREAD_LABEL = "policy.batch.scheduler.tpu/spread"
+
 # Default gang wait time when neither the scheduler flag nor the group spec
 # sets one (reference pkg/util/k8s.go:31).
 DEFAULT_WAIT_SECONDS = 60.0

@@ -12,8 +12,10 @@ Usage: components take a ``Registry`` (default: the process-wide
 ``/healthz``, plus the trace/explain surfaces ``/debug/trace`` (the span
 ring as Chrome-trace JSON, utils.trace), ``/debug/decisions`` (the gang
 decision flight recorder), ``/debug/health`` (the live SLO health model,
-utils.health), and ``/debug/buckets`` (per-bucket compiled HLO cost
-telemetry, ops.oracle) — docs/observability.md has the catalog.
+utils.health), ``/debug/buckets`` (per-bucket compiled HLO cost
+telemetry, ops.oracle), and ``/debug/policy`` (the active policy engine's
+terms/weights/counters, batch_scheduler_tpu.policy) —
+docs/observability.md has the catalog.
 """
 
 from __future__ import annotations
@@ -285,6 +287,16 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             body = json.dumps(
                 health_mod.DEFAULT_HEALTH.evaluate(), default=str
             ).encode()
+            ctype = "application/json"
+        elif path == "/debug/policy":
+            # the active policy engine's view (batch_scheduler_tpu.policy):
+            # enabled terms + weights + fingerprint, the term registry,
+            # packed-column geometry, and the scoring/preemption counters
+            import json
+
+            from ..policy.engine import policy_debug_view
+
+            body = json.dumps(policy_debug_view(), default=str).encode()
             ctype = "application/json"
         elif path == "/debug/buckets":
             # per-bucket compiled HLO cost/memory telemetry

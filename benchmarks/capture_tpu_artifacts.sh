@@ -170,6 +170,22 @@ else
     echo "audit-recorded sim failed:"; tail -3 /tmp/audit_sim.out; fail=1
 fi
 
+echo "== policy gate on hardware (zero-policy identity + preempt-pass cost) =="
+# the bench-policy gate on the real backend: zero-policy plans must stay
+# bit-identical to the pre-policy scan on the hardware rungs, the policy
+# composite must actually reach the selection, the vectorized preemption
+# pass must hold its <=10%-of-steady-batch budget against TPU batch
+# times, and a policy-rung audit record (recorded here on TPU) must
+# replay bit-identically on the cpu-ladder rung (docs/policy.md)
+if BST_POLICY_GATE_PLATFORM=default timeout 900 \
+        python benchmarks/policy_gate.py "POLICY_${TAG}.json" \
+        > /tmp/policy_gate.out 2>&1; then
+    echo "policy gate captured: POLICY_${TAG}.json"
+    tail -1 /tmp/policy_gate.out
+else
+    echo "policy gate failed:"; tail -4 /tmp/policy_gate.out; fail=1
+fi
+
 echo "== scale headroom probe =="
 timeout 1200 python benchmarks/scale_probe.py > "SCALE_${TAG}.json" 2>/dev/null \
     || { echo "scale probe failed"; rm -f "SCALE_${TAG}.json"; fail=1; }
