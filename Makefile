@@ -5,15 +5,31 @@
 
 PY ?= python
 
-.PHONY: all build vet test test-cpu test-tier1 bench bench-scan bench-pipeline bench-policy bench-sharding bench-xl native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
+.PHONY: all build vet analyze stamp-coupling test test-cpu test-tier1 bench bench-scan bench-pipeline bench-policy bench-sharding bench-xl native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
 
-all: vet native test
+all: vet analyze native test
 
-build: vet native
+build: vet analyze native
 
-# go-vet analog: byte-compile every module, fail on syntax errors
+# go-vet analog, part 1: byte-compile every module, fail on syntax errors
+# (the semantic half is `analyze` below — together they are this repo's
+# equivalent of the reference Makefile's vet line)
 vet:
 	$(PY) -m compileall -q batch_scheduler_tpu tests benchmarks bench.py __graft_entry__.py
+
+# go-vet analog, part 2: the in-repo invariant analyzer suite
+# (docs/static_analysis.md) — guarded-by lock discipline, jit purity +
+# donation discipline, formula-coupling fingerprints, the BST_* knob
+# registry, MsgType/metric exhaustiveness. Pure-AST, no jax import,
+# budgeted well under 30s; exit 1 on any finding. The runtime half is
+# BST_LOCKCHECK=1 (armed in the chaos/fuzz suites), not a make target.
+analyze:
+	$(PY) -m batch_scheduler_tpu.analysis
+
+# after an INTENTIONAL change to a declared change-together formula group:
+# verify bit-identity (bench-policy / bench-xl / replay-gate), then stamp
+stamp-coupling:
+	$(PY) -m batch_scheduler_tpu.analysis --stamp-coupling
 
 # the native C++ sidecar client + bench harness
 native:

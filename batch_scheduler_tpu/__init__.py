@@ -26,3 +26,12 @@ Layout (mirrors the reference's component inventory, SURVEY.md §2):
 """
 
 __version__ = "0.1.0"
+
+# BST_LOCKCHECK=1 arms the runtime lock-discipline checker (the `go test
+# -race` analog, docs/static_analysis.md): every class annotated
+# `# guarded-by:` is instrumented so unguarded cross-thread access raises
+# with both stacks. A no-op (one env probe) when the knob is unset.
+from .analysis.lockcheck import maybe_install as _lockcheck_maybe_install
+
+_lockcheck_maybe_install()
+del _lockcheck_maybe_install

@@ -1,0 +1,43 @@
+"""In-repo static analyzer suite + runtime lock-discipline checker.
+
+``python -m batch_scheduler_tpu.analysis`` (``make analyze``) runs the six
+static checkers; ``BST_LOCKCHECK=1`` arms the runtime race detector
+(lockcheck.maybe_install, called from the package __init__). See
+docs/static_analysis.md for the annotation grammar and checker catalog.
+
+Pure stdlib on purpose: the analyzers parse the tree, they never import
+it, so `make analyze` needs no jax and stays fast and side-effect free.
+
+Exports resolve lazily (PEP 562): the package __init__'s lockcheck hook
+must cost one env probe on every ``import batch_scheduler_tpu``, not the
+import of the whole checker suite — only ``lockcheck`` loads eagerly
+(os/sys/threading), the rest on first attribute access.
+"""
+
+from .lockcheck import LockDisciplineError, lockcheck_enabled, maybe_install  # noqa: F401
+
+_LAZY = {
+    "Finding": ("findings", "Finding"),
+    "CHECKS": ("runner", "CHECKS"),
+    "main": ("runner", "main"),
+    "run_all": ("runner", "run_all"),
+}
+
+__all__ = [
+    "LockDisciplineError",
+    "lockcheck_enabled",
+    "maybe_install",
+    *_LAZY,
+]
+
+
+def __getattr__(name):
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    module = importlib.import_module(f".{entry[0]}", __name__)
+    value = getattr(module, entry[1])
+    globals()[name] = value
+    return value

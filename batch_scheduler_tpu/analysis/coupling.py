@@ -1,0 +1,185 @@
+"""Checker ``coupling`` — formula-coupled "change-together" blocks, mechanized.
+
+The scan ladder's bit-identity spine (PRs 2/6/7/8) rests on formulas that
+are re-derived in multiple places: ``_select_best_fit``'s threshold/
+remainder arithmetic is recomputed from summary histograms by
+``_hist_select``, vmapped by ``_select_best_fit_wave``, approximated by the
+top-K coarse rank, and bucket-shifted by the policy composite's key
+override. The prose contract ("change all of them together",
+ops/oracle.py) is exactly the kind a refactor silently breaks.
+
+This checker pins each declared group member to an AST fingerprint (a
+sha256 of the normalized AST, docstrings and line info stripped — comments
+and formatting never trip it). Editing any member changes its fingerprint
+and fails ``make analyze`` until the stamp file is regenerated with
+
+    python -m batch_scheduler_tpu.analysis --stamp-coupling
+
+which is the mechanical "I looked at every paired formula" acknowledgement
+(back it with ``make bench-policy`` / ``make bench-xl`` / replay-gate, the
+bit-identity gates — docs/static_analysis.md "Stamping a coupled change").
+
+Stamps live in coupling_stamps.json next to this module.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional
+
+from .findings import Finding
+
+CHECKER = "coupling"
+
+STAMP_FILE = os.path.join(os.path.dirname(__file__), "coupling_stamps.json")
+
+# group name -> list of "relpath::qualname" members (relpath under repo root)
+COUPLED_GROUPS: Dict[str, List[str]] = {
+    # the tightest-first selection arithmetic and every re-derivation of it
+    "selection-formula": [
+        "batch_scheduler_tpu/ops/oracle.py::_cumsum",
+        "batch_scheduler_tpu/ops/oracle.py::_select_best_fit",
+        "batch_scheduler_tpu/ops/oracle.py::_hist_select",
+        "batch_scheduler_tpu/ops/oracle.py::_select_best_fit_wave",
+        "batch_scheduler_tpu/ops/oracle.py::_coarse_rank",
+        "batch_scheduler_tpu/ops/oracle.py::assign_gangs_policy",
+    ],
+    # member-capacity computed in [.., R] layout host-side and re-derived in
+    # the pallas kernel's transposed [R, N] layout
+    "member-capacity": [
+        "batch_scheduler_tpu/ops/oracle.py::_member_capacity",
+        "batch_scheduler_tpu/ops/pallas_assign.py::_cap_t",
+    ],
+}
+
+
+def _strip_docstring(fn: ast.AST) -> None:
+    if (
+        fn.body
+        and isinstance(fn.body[0], ast.Expr)
+        and isinstance(fn.body[0].value, ast.Constant)
+        and isinstance(fn.body[0].value.value, str)
+    ):
+        fn.body = fn.body[1:] or [ast.Pass()]
+
+
+def _find_function(tree: ast.AST, qualname: str) -> Optional[ast.AST]:
+    parts = qualname.split(".")
+    scope = tree.body
+    node = None
+    for part in parts:
+        node = None
+        for cand in scope:
+            if (
+                isinstance(cand, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef))
+                and cand.name == part
+            ):
+                node = cand
+                break
+        if node is None:
+            return None
+        scope = node.body
+    return node
+
+
+def fingerprint(root: str, member: str) -> Optional[str]:
+    """sha256 fingerprint of one member's normalized AST, None if missing."""
+    relpath, qualname = member.split("::", 1)
+    path = os.path.join(root, relpath)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+    fn = _find_function(tree, qualname)
+    if fn is None:
+        return None
+    _strip_docstring(fn)
+    dump = ast.dump(fn, annotate_fields=True, include_attributes=False)
+    return hashlib.sha256(dump.encode()).hexdigest()
+
+
+def load_stamps(stamp_file: str = STAMP_FILE) -> Dict[str, Dict[str, str]]:
+    try:
+        with open(stamp_file, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def stamp(root: str, stamp_file: str = STAMP_FILE,
+          groups: Optional[Dict[str, List[str]]] = None) -> Dict[str, Dict[str, str]]:
+    """Regenerate the stamp file from the current tree."""
+    groups = groups if groups is not None else COUPLED_GROUPS
+    out: Dict[str, Dict[str, str]] = {}
+    for group, members in groups.items():
+        out[group] = {}
+        for member in members:
+            fp = fingerprint(root, member)
+            if fp is not None:
+                out[group][member] = fp
+    with open(stamp_file, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return out
+
+
+def check(root: str, stamp_file: str = STAMP_FILE,
+          groups: Optional[Dict[str, List[str]]] = None) -> List[Finding]:
+    groups = groups if groups is not None else COUPLED_GROUPS
+    stamps = load_stamps(stamp_file)
+    findings: List[Finding] = []
+    for group, members in groups.items():
+        stamped = stamps.get(group, {})
+        drifted = []
+        for member in members:
+            relpath, qualname = member.split("::", 1)
+            fp = fingerprint(root, member)
+            if fp is None:
+                findings.append(
+                    Finding(
+                        CHECKER,
+                        relpath,
+                        0,
+                        f"coupled group '{group}' member '{qualname}' not "
+                        "found — a declared change-together formula was "
+                        "moved or deleted without updating the registry "
+                        "(analysis/coupling.py COUPLED_GROUPS)",
+                    )
+                )
+                continue
+            want = stamped.get(member)
+            if want is None:
+                findings.append(
+                    Finding(
+                        CHECKER,
+                        relpath,
+                        0,
+                        f"coupled group '{group}' member '{qualname}' has no "
+                        "stamp — run `python -m batch_scheduler_tpu.analysis "
+                        "--stamp-coupling` after verifying the group",
+                    )
+                )
+            elif want != fp:
+                drifted.append((relpath, qualname))
+        for relpath, qualname in drifted:
+            others = [
+                m.split("::", 1)[1] for m in members
+                if m.split("::", 1)[1] != qualname
+            ]
+            findings.append(
+                Finding(
+                    CHECKER,
+                    relpath,
+                    0,
+                    f"'{qualname}' changed but coupled group '{group}' was "
+                    f"not re-stamped — verify the paired formulas "
+                    f"({', '.join(others)}) still agree (the bit-identity "
+                    "gates: bench-policy / bench-xl / replay-gate), then "
+                    "`python -m batch_scheduler_tpu.analysis --stamp-coupling`",
+                )
+            )
+    return findings

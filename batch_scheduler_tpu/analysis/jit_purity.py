@@ -1,0 +1,273 @@
+"""Checker ``jit-purity`` — host effects and donation discipline in traced code.
+
+Inside functions that jax traces (jit/shard_map/scan bodies/pallas kernels),
+host-side effects execute once at trace time and silently never again —
+the class of bug where an ``os.environ`` read or ``time.time()`` call gets
+baked into a compiled executable and the knob stops responding. This
+checker forbids, lexically inside any traced function in the scoped
+packages (ops/, parallel/, policy/):
+
+  * ``os.environ`` / ``os.getenv`` / ``os.putenv`` reads
+  * ``time.*`` calls (trace-time constants masquerading as clocks)
+  * the stdlib ``random`` module (``jax.random`` / ``np.asarray`` are fine)
+  * ``print(...)`` (host I/O at trace time; use ``jax.debug.print``)
+  * ``open(...)`` and ``global`` mutation (host state from traced code)
+
+Donation discipline (PR 4): callables jitted with ``donate_argnums`` consume
+their donated operands — the buffer behind the handle is gone after
+dispatch. The checker scans each file for ``jax.jit(..., donate_argnums=…)``
+bindings and flags any later lexical *use* of a name that was passed in a
+donated position of a direct call to such a binding (the PR 4 donation
+misfire class: reusing ``alloc`` after ``_batch_blob_donated(alloc, …)``).
+
+Traced-context discovery (lexical, per file):
+  * ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)`` decorated defs
+  * ``name = jax.jit(fn, ...)`` bindings mark ``fn``'s def
+  * functions passed to ``lax.scan`` / ``shard_map`` / ``pl.pallas_call``
+    / ``jax.vmap`` / ``lax.cond`` / ``lax.while_loop``
+
+Suppress one line with ``# analysis: allow(jit-purity) <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .annotations import comment_map, is_suppressed, suppressions_at
+from .findings import Finding
+
+CHECKER = "jit-purity"
+
+_TRACING_CALLS = {
+    "scan",
+    "shard_map",
+    "pallas_call",
+    "vmap",
+    "pmap",
+    "cond",
+    "while_loop",
+    "fori_loop",
+    "switch",
+    "checkpoint",
+    "remat",
+    "custom_vjp",
+}
+
+_BANNED_MODULES = {"time", "random"}
+
+
+def _callee_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """True for ``jax.jit``/``jit`` or ``partial(jax.jit, ...)`` shapes."""
+    if isinstance(node, ast.Name) and node.id == "jit":
+        return True
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return True
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if _callee_name(fn) in ("partial", "wraps"):
+            return any(_is_jit_expr(a) for a in node.args)
+        return _is_jit_expr(fn)
+    return False
+
+
+def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """donate_argnums of a ``jax.jit(...)`` call, if statically visible."""
+    if not _is_jit_expr(call.func) and not _is_jit_expr(call):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Tuple):
+                out = []
+                for elt in v.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                        out.append(elt.value)
+                return tuple(out)
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+    return None
+
+
+def _collect_traced_names(tree: ast.AST) -> Tuple[Set[str], Dict[str, Tuple[int, ...]]]:
+    """Names of functions that end up traced + donating jit bindings."""
+    traced: Set[str] = set()
+    donors: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            callee = _callee_name(node.func)
+            if callee in _TRACING_CALLS or _is_jit_expr(node.func):
+                for a in node.args[:1] if callee != "pallas_call" else node.args:
+                    if isinstance(a, ast.Name):
+                        traced.add(a.id)
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            pos = _donated_positions(node.value)
+            if pos:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        donors[t.id] = pos
+                # the wrapped fn is traced too
+                for a in node.value.args:
+                    if isinstance(a, ast.Name):
+                        traced.add(a.id)
+    # alias propagation: `fn = _donated if cond else _plain` (the dispatch
+    # ladder's spelling, ops/oracle.py) — calls through the alias MAY
+    # donate, so reuse after them is flagged conservatively
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.IfExp):
+            branches = (node.value.body, node.value.orelse)
+            hit = [
+                donors[b.id]
+                for b in branches
+                if isinstance(b, ast.Name) and b.id in donors
+            ]
+            if hit:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        donors[t.id] = hit[0]
+    return traced, donors
+
+
+def _is_traced_def(fn: ast.AST, traced_names: Set[str]) -> bool:
+    if fn.name in traced_names:
+        return True
+    for dec in fn.decorator_list:
+        if _is_jit_expr(dec):
+            return True
+        if isinstance(dec, ast.Call) and _callee_name(dec.func) in _TRACING_CALLS:
+            return True
+    return False
+
+
+class _PurityVisitor(ast.NodeVisitor):
+    def __init__(self, path: str, findings: List[Finding], supp, context: str):
+        self.path = path
+        self.findings = findings
+        self.supp = supp
+        self.context = context
+
+    def _flag(self, node: ast.AST, msg: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if is_suppressed(self.supp, line, CHECKER):
+            return
+        self.findings.append(
+            Finding(CHECKER, self.path, line, f"{self.context}: {msg}")
+        )
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.value, ast.Name):
+            root = node.value.id
+            if root == "os" and node.attr in ("environ", "getenv", "putenv"):
+                self._flag(node, f"os.{node.attr} inside a traced function "
+                                 "(baked in at trace time)")
+            elif root in _BANNED_MODULES:
+                self._flag(
+                    node,
+                    f"host module '{root}.{node.attr}' inside a traced "
+                    "function (trace-time constant, not a runtime effect)",
+                )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Name) and node.func.id in ("print", "open"):
+            self._flag(
+                node,
+                f"'{node.func.id}(...)' inside a traced function "
+                "(host I/O at trace time; use jax.debug.print)",
+            )
+        self.generic_visit(node)
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self._flag(
+            node,
+            f"global mutation of {', '.join(node.names)} inside a traced "
+            "function (host state from traced code)",
+        )
+
+
+class _DonationVisitor(ast.NodeVisitor):
+    """Within one function: flag lexical reuse of donated operands."""
+
+    def __init__(self, path, findings, supp, donors: Dict[str, Tuple[int, ...]],
+                 context: str):
+        self.path = path
+        self.findings = findings
+        self.supp = supp
+        self.donors = donors
+        self.context = context
+        # donated name -> (donating call line, donor fn name)
+        self.consumed: Dict[str, Tuple[int, str]] = {}
+
+    def visit_Call(self, node: ast.Call) -> None:
+        callee = _callee_name(node.func)
+        if callee in self.donors:
+            # consumed from the call's LAST line: the donating call's own
+            # argument Names (which may sit on later lines of a multi-line
+            # call) must not trip the reuse flag
+            end = getattr(node, "end_lineno", node.lineno) or node.lineno
+            for pos in self.donors[callee]:
+                if pos < len(node.args) and isinstance(node.args[pos], ast.Name):
+                    name = node.args[pos].id
+                    self.consumed[name] = (end, callee)
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Store):
+            # rebinding a name makes it safe again
+            self.consumed.pop(node.id, None)
+            return
+        hit = self.consumed.get(node.id)
+        if hit and node.lineno > hit[0]:
+            line = node.lineno
+            if not is_suppressed(self.supp, line, CHECKER):
+                self.findings.append(
+                    Finding(
+                        CHECKER,
+                        self.path,
+                        line,
+                        f"{self.context}: '{node.id}' used after being "
+                        f"donated to {hit[1]} (line {hit[0]}) — the buffer "
+                        "is consumed by dispatch (PR 4 donation discipline)",
+                    )
+                )
+                # report once per name
+                self.consumed.pop(node.id, None)
+
+
+def check_source(path: str, source: str) -> List[Finding]:
+    findings: List[Finding] = []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return findings
+    supp = suppressions_at(comment_map(source), path)
+    traced_names, donors = _collect_traced_names(tree)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _is_traced_def(node, traced_names):
+                v = _PurityVisitor(path, findings, supp, node.name)
+                for stmt in node.body:
+                    v.visit(stmt)
+            if donors:
+                dv = _DonationVisitor(path, findings, supp, donors, node.name)
+                for stmt in node.body:
+                    dv.visit(stmt)
+    # nested defs are reachable both standalone (ast.walk) and through
+    # their parent's visitor — dedupe identical findings
+    seen = set()
+    out: List[Finding] = []
+    for f in findings:
+        key = (f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out

@@ -1,0 +1,242 @@
+"""`make analyze` — the in-repo analyzer suite's entry point.
+
+The Python analog of the reference Makefile's ``go vet`` line, specialized
+to this codebase's stated invariants (ISSUE: the contracts PRs 1-8 wrote
+as prose). Six checkers, all pure stdlib AST/tokenize — no imports of the
+checked modules, no jax, so the whole sweep runs in well under the 30s CI
+budget:
+
+  guarded-by   static lock discipline over annotated shared attributes
+  jit-purity   host effects + donation discipline inside traced functions
+  coupling     AST fingerprints over declared change-together formulas
+  knobs        BST_* parse-guard discipline + README knob-table coverage
+  wire         MsgType exhaustiveness on both peer dispatch paths
+  metrics      bst_ namespace, single-kind, documented in observability.md
+
+Exit 0 with no findings; exit 1 with findings rendered one per line
+(file:line: [checker] message). ``--stamp-coupling`` regenerates the
+coupling stamp file after an intentional coupled change. The BST_LOCKCHECK
+runtime mode lives in lockcheck.py, armed by env var, not by this runner.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Iterable, List, Optional, Tuple
+
+from . import annotations as ann
+from . import coupling, guards, jit_purity, knobs, wire
+from .findings import Finding, render_all
+
+# files/dirs never scanned: seeded-violation fixtures and generated trees
+_EXCLUDE_PARTS = ("analysis_fixtures", "__pycache__", ".git", "native")
+
+# jit-purity scope: packages whose functions run under trace
+_JIT_SCOPED = ("ops", "parallel", "policy")
+
+
+def package_root() -> str:
+    """The repo root: analysis/ -> batch_scheduler_tpu/ -> root."""
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def _iter_py(root: str, subdirs: Iterable[str]) -> Iterable[str]:
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        if os.path.isfile(base):
+            yield base
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d not in _EXCLUDE_PARTS]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def _read(path: str) -> str:
+    with open(path, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def _rel(root: str, path: str) -> str:
+    return os.path.relpath(path, root)
+
+
+def annotated_sources(
+    root: str, modules: Optional[List[str]] = None
+) -> List[Tuple[str, str]]:
+    """(path, source) for every package file (lockcheck.install reuses this)."""
+    if modules:
+        paths = [os.path.join(root, m) for m in modules]
+    else:
+        paths = list(_iter_py(root, ["batch_scheduler_tpu"]))
+    return [(p, _read(p)) for p in paths]
+
+
+def run_guards(root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for path, source in annotated_sources(root):
+        mod = ann.scan_module(path, source)
+        if mod.classes or mod.guarded_globals:
+            for f in guards.check_module(mod, source):
+                f.path = _rel(root, f.path)
+                findings.append(f)
+    return findings
+
+
+def run_jit_purity(root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    subdirs = [os.path.join("batch_scheduler_tpu", d) for d in _JIT_SCOPED]
+    for path in _iter_py(root, subdirs):
+        for f in jit_purity.check_source(path, _read(path)):
+            f.path = _rel(root, f.path)
+            findings.append(f)
+    return findings
+
+
+def run_coupling(root: str) -> List[Finding]:
+    return coupling.check(root)
+
+
+def run_knobs(root: str) -> List[Finding]:
+    readme = _read(os.path.join(root, "README.md"))
+    findings: List[Finding] = []
+    targets = list(
+        _iter_py(
+            root,
+            ["batch_scheduler_tpu", "benchmarks", "bench.py", "__graft_entry__.py"],
+        )
+    )
+    for path in targets:
+        for f in knobs.check_source(path, _read(path), readme):
+            f.path = _rel(root, f.path)
+            findings.append(f)
+    return findings
+
+
+def run_wire(root: str) -> List[Finding]:
+    svc = os.path.join(root, "batch_scheduler_tpu", "service")
+    protocol_path = os.path.join(svc, "protocol.py")
+    peers = [
+        ("server dispatch", os.path.join(svc, "server.py")),
+        ("client annotation", os.path.join(svc, "client.py")),
+    ]
+    findings = wire.check_wire(
+        _rel(root, protocol_path),
+        _read(protocol_path),
+        [(role, _rel(root, p), _read(p)) for role, p in peers],
+    )
+    return findings
+
+
+def run_metrics(root: str) -> List[Finding]:
+    obs = _read(os.path.join(root, "docs", "observability.md"))
+    files = [
+        (_rel(root, p), _read(p))
+        for p in _iter_py(root, ["batch_scheduler_tpu"])
+        # the metrics module itself is the registry implementation: its
+        # counter()/gauge()/histogram() defs and internal calls are plumbing
+        if os.path.basename(p) != "metrics.py"
+    ]
+    return wire.check_metrics(files, obs)
+
+
+CHECKS = {
+    "guarded-by": run_guards,
+    "jit-purity": run_jit_purity,
+    "coupling": run_coupling,
+    "knobs": run_knobs,
+    "wire": run_wire,
+    "metrics": run_metrics,
+}
+
+
+def suppression_inventory(root: str) -> Tuple[List[ann.Suppression], List[Finding]]:
+    """Every allow() suppression in the scanned tree; reasonless ones are
+    findings — the gate lands with zero unreviewed escapes."""
+    supps: List[ann.Suppression] = []
+    findings: List[Finding] = []
+    scoped = ["batch_scheduler_tpu", "benchmarks", "bench.py", "__graft_entry__.py"]
+    for path in _iter_py(root, scoped):
+        source = _read(path)
+        mod_supps = ann.suppressions_at(ann.comment_map(source), path)
+        for s in mod_supps.values():
+            s.path = _rel(root, s.path)
+            supps.append(s)
+            if not s.reason:
+                findings.append(
+                    Finding(
+                        "suppressions",
+                        s.path,
+                        s.line,
+                        f"allow({s.checker}) without a reason — every "
+                        "suppression must say why (docs/static_analysis.md)",
+                    )
+                )
+    return supps, findings
+
+
+def run_all(root: Optional[str] = None, checks: Optional[List[str]] = None) -> Tuple[List[Finding], List[ann.Suppression]]:
+    root = root or package_root()
+    findings: List[Finding] = []
+    for name, fn in CHECKS.items():
+        if checks and name not in checks:
+            continue
+        findings.extend(fn(root))
+    supps, supp_findings = suppression_inventory(root)
+    findings.extend(supp_findings)
+    return findings, supps
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m batch_scheduler_tpu.analysis",
+        description="in-repo invariant analyzer suite (make analyze)",
+    )
+    parser.add_argument("--root", default=None, help="repo root to scan")
+    parser.add_argument(
+        "--check",
+        action="append",
+        choices=sorted(CHECKS),
+        help="run only the named checker(s)",
+    )
+    parser.add_argument(
+        "--stamp-coupling",
+        action="store_true",
+        help="regenerate coupling_stamps.json from the current tree "
+        "(after verifying the group via the bit-identity gates)",
+    )
+    args = parser.parse_args(argv)
+    root = args.root or package_root()
+
+    if args.stamp_coupling:
+        stamps = coupling.stamp(root)
+        n = sum(len(v) for v in stamps.values())
+        print(f"stamped {n} coupled members across {len(stamps)} groups "
+              f"-> {coupling.STAMP_FILE}")
+        return 0
+
+    t0 = time.monotonic()
+    findings, supps = run_all(root, args.check)
+    dt = time.monotonic() - t0
+    if supps:
+        print(f"# {len(supps)} reviewed suppression(s):", file=sys.stderr)
+        for s in supps:
+            print(
+                f"#   {s.path}:{s.line}: allow({s.checker}) {s.reason}",
+                file=sys.stderr,
+            )
+    if findings:
+        print(render_all(findings))
+        print(
+            f"analyze: {len(findings)} finding(s) in {dt:.2f}s",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"analyze: clean ({dt:.2f}s)", file=sys.stderr)
+    return 0

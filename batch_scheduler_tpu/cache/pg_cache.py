@@ -91,7 +91,9 @@ class PGStatusCache:
 
     def __init__(self):
         self._lock = threading.RLock()
-        self._map: Dict[str, PodGroupMatchStatus] = {}
+        self._map: Dict[str, PodGroupMatchStatus] = {}  # guarded-by: _lock
+        # registration-time list; delete() iterates it OUTSIDE the lock on
+        # purpose (callbacks may re-enter this cache)
         self._on_delete: list = []
 
     def on_delete(self, fn: Callable[[str], None]) -> None:

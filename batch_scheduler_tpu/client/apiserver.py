@@ -59,14 +59,14 @@ class APIServer:
         self._lock = threading.RLock()
         self._clock = clock
         # kind -> (namespace, name) -> dict
-        self._store: Dict[str, Dict[Tuple[str, str], dict]] = {}
-        self._rv = 0
-        self._watchers: Dict[str, List[queue.Queue]] = {}
-        self._crds: Dict[str, dict] = {}
+        self._store: Dict[str, Dict[Tuple[str, str], dict]] = {}  # guarded-by: _lock
+        self._rv = 0  # guarded-by: _lock
+        self._watchers: Dict[str, List[queue.Queue]] = {}  # guarded-by: _lock
+        self._crds: Dict[str, dict] = {}  # guarded-by: _lock
         # label index: kind -> (label_key, label_value) -> object keys —
         # keeps selector lists (the controller's per-group member listing,
         # reference controller.go:235-241) O(matches), not O(all objects)
-        self._label_idx: Dict[str, Dict[Tuple[str, str], Set[Tuple[str, str]]]] = {}
+        self._label_idx: Dict[str, Dict[Tuple[str, str], Set[Tuple[str, str]]]] = {}  # guarded-by: _lock
         # bind fencing token: each gateway generation advances it at
         # startup (serve_gateway) and stamps its binds with the epoch it
         # was born under. A handler thread that outlives its gateway's
@@ -82,26 +82,26 @@ class APIServer:
 
     # -- helpers -----------------------------------------------------------
 
-    def _kind_store(self, kind: str) -> Dict[Tuple[str, str], dict]:
+    def _kind_store(self, kind: str) -> Dict[Tuple[str, str], dict]:  # lock-held: _lock
         return self._store.setdefault(kind, {})
 
     @staticmethod
     def _labels_of(obj: dict) -> dict:
         return (obj.get("metadata") or {}).get("labels") or {}
 
-    def _index_add(self, kind: str, key: Tuple[str, str], obj: dict) -> None:
+    def _index_add(self, kind: str, key: Tuple[str, str], obj: dict) -> None:  # lock-held: _lock
         idx = self._label_idx.setdefault(kind, {})
         for kv in self._labels_of(obj).items():
             idx.setdefault(kv, set()).add(key)
 
-    def _index_remove(self, kind: str, key: Tuple[str, str], obj: dict) -> None:
+    def _index_remove(self, kind: str, key: Tuple[str, str], obj: dict) -> None:  # lock-held: _lock
         idx = self._label_idx.get(kind, {})
         for kv in self._labels_of(obj).items():
             bucket = idx.get(kv)
             if bucket is not None:
                 bucket.discard(key)
 
-    def _notify(self, kind: str, event: WatchEvent) -> None:
+    def _notify(self, kind: str, event: WatchEvent) -> None:  # lock-held: _lock
         """Fan an event out to every watcher.
 
         ``event.obj`` is the STORED dict itself, shared by all watchers and
@@ -115,7 +115,7 @@ class APIServer:
         for q in self._watchers.get(kind, []):
             q.put(event)
 
-    def _notify_many(self, kind: str, events: List[WatchEvent]) -> None:
+    def _notify_many(self, kind: str, events: List[WatchEvent]) -> None:  # lock-held: _lock
         """Batched fanout: ONE queue put per watcher for a whole chunk of
         events (same shared-stored-dict contract as _notify). The put/get
         machinery costs ~2µs a side, so per-object puts across a 30k-event

@@ -356,7 +356,7 @@ class GatewayServer(ThreadingHTTPServer):
         # attempt) makes the base class call self.server_close(), which
         # needs these — assigning after would turn the OSError into an
         # AttributeError
-        self._live_conns: set = set()
+        self._live_conns: set = set()  # guarded-by: _conn_lock
         self._conn_lock = threading.Lock()
         super().__init__(*args, **kwargs)
 

@@ -28,11 +28,13 @@ class SharedInformer:
     def __init__(self, api: APIServer, kind: str):
         self._api = api
         self.kind = kind
-        self._store: Dict[Tuple[str, str], dict] = {}
+        self._store: Dict[Tuple[str, str], dict] = {}  # guarded-by: _lock
         # (label, value) -> store keys, maintained by _dispatch; backs the
         # raw label-selector reads (list_raw_by_label)
-        self._label_index: Dict[Tuple[str, str], set] = {}
+        self._label_index: Dict[Tuple[str, str], set] = {}  # guarded-by: _lock
         self._lock = threading.RLock()
+        # registration-time only; published to the hot path as the _tables
+        # tuple, swapped atomically under the GIL (see _rebuild_tables)
         self._handlers: List[dict] = []
         self._rebuild_tables()
         self._synced = threading.Event()
@@ -41,7 +43,7 @@ class SharedInformer:
         # lazily-built typed views for read-only hot paths (queue compare
         # runs two lister reads per heap comparison); keyed by store-dict
         # identity so any update invalidates
-        self._typed_cache: Dict[Tuple[str, str], tuple] = {}
+        self._typed_cache: Dict[Tuple[str, str], tuple] = {}  # guarded-by: _lock
 
     # -- registration ------------------------------------------------------
 
@@ -142,8 +144,8 @@ class SharedInformer:
         lock hold; returns (event, old_stored_dict) pairs for handler
         dispatch outside the lock."""
         updates = []
-        store = self._store
         with self._lock:
+            store = self._store
             for event in batch:
                 meta = event.obj.get("metadata") or {}
                 key = (meta.get("namespace", "default"), meta.get("name", ""))

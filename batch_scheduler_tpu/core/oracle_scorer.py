@@ -347,7 +347,7 @@ class _BatchState:
         self.result = result
         self.max_group = max_group
         self.row_fetcher = row_fetcher
-        self._rows: Dict[tuple, np.ndarray] = {}
+        self._rows: Dict[tuple, np.ndarray] = {}  # guarded-by: _rows_lock
         self._rows_lock = threading.Lock()
 
     def row(self, kind: str, g: int) -> np.ndarray:
@@ -411,7 +411,7 @@ class OracleScorer:
         # forces the BLOCKING path so transport errors surface in a cycle
         # instead of decaying into an invisible all-deny.
         self.background_refresh = background_refresh
-        self._bg_thread: Optional[threading.Thread] = None
+        self._bg_thread: Optional[threading.Thread] = None  # guarded-by: _bg_lock
         self._bg_lock = threading.Lock()
         self._bg_error: Optional[Exception] = None
         # Multi-chip layout: when set (parallel.global_mesh() on a >1-chip
@@ -427,7 +427,7 @@ class OracleScorer:
         # (member assumes/binds the current batch already charged via its
         # gang placement) are *credited* rather than invalidating the batch,
         # so batches scale with gangs and cluster churn — not with pods.
-        self._version_credits = 0
+        self._version_credits = 0  # guarded-by: _credits_lock
         self._credits_lock = threading.Lock()
         # Optional re-batch coalescing: when > 0, a dirty batch whose answers
         # can still be served (all queried groups known) is refreshed at most
@@ -458,9 +458,12 @@ class OracleScorer:
         # or uncredited version bump mid-flight).
         self.dispatch_ahead = dispatch_ahead
         self._spec_lock = threading.Lock()
-        self._spec_thread: Optional[threading.Thread] = None
-        # (snap, host, row_fetcher, gen, version, pack_s, batch_s)
-        self._spec: Optional[tuple] = None
+        self._spec_thread: Optional[threading.Thread] = None  # guarded-by: _spec_lock
+        # (snap, host, row_fetcher, gen, version, pack_s, batch_s) — the
+        # banked speculative batch travels under the REFRESH lock (packed
+        # and consumed inside it), not _spec_lock, which only serializes
+        # thread lifecycle
+        self._spec: Optional[tuple] = None  # guarded-by: _refresh_lock
         self._spec_error: Optional[Exception] = None
         self.spec_served = 0
         self.spec_discarded = 0
@@ -478,8 +481,8 @@ class OracleScorer:
         # oracle-batch latency telemetry (SURVEY.md §5: schedule-cycle
         # latency is the headline metric; the reference has no equivalent
         # instrumentation, only klog verbosity)
-        self.pack_seconds: list = []
-        self.batch_seconds: list = []
+        self.pack_seconds: list = []  # guarded-by: _stats_lock
+        self.batch_seconds: list = []  # guarded-by: _stats_lock
         self._stats_lock = threading.Lock()
         self.configure_audit(audit_log, identity_audit_every)
 
@@ -887,7 +890,7 @@ class OracleScorer:
 
     # -- dispatch-ahead (docs/pipelining.md) --------------------------------
 
-    def _consume_speculative(self, cluster, group: Optional[str]) -> bool:
+    def _consume_speculative(self, cluster, group: Optional[str]) -> bool:  # lock-held: _refresh_lock
         """Publish the speculative batch iff NOTHING changed since it was
         packed — the same generation + raw-version equality the staleness
         check uses, with no credit forgiveness (a credited bump means an

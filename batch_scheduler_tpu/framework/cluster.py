@@ -25,17 +25,17 @@ _TERMINAL = (PodPhase.SUCCEEDED, PodPhase.FAILED)
 class ClusterState:
     def __init__(self):
         self._lock = threading.RLock()
-        self._nodes: Dict[str, Node] = {}
+        self._nodes: Dict[str, Node] = {}  # guarded-by: _lock
         # node -> pod uid -> canonical requested resources (incl. pod slot)
-        self._requested: Dict[str, Dict[str, Dict[str, int]]] = {}
+        self._requested: Dict[str, Dict[str, Dict[str, int]]] = {}  # guarded-by: _lock
         # pod uid -> node, for pods assumed but not yet observed bound
-        self._assumed: Dict[str, str] = {}
-        self._pod_nodes: Dict[str, str] = {}
+        self._assumed: Dict[str, str] = {}  # guarded-by: _lock
+        self._pod_nodes: Dict[str, str] = {}  # guarded-by: _lock
         # pod uid -> Pod object, for victim search in the preemption cycle
-        self._pod_objs: Dict[str, Pod] = {}
+        self._pod_objs: Dict[str, Pod] = {}  # guarded-by: _lock
         # bumped on every capacity-relevant change; the oracle scorer uses it
         # to invalidate its batch without explicit mark_dirty plumbing
-        self._version = 0
+        self._version = 0  # guarded-by: _lock
 
     def version(self) -> int:
         with self._lock:

@@ -70,13 +70,13 @@ class SchedulingQueue:
         # per heap operation
         self._sort_key = sort_key_fn
         self._cond = threading.Condition()
-        self._active: list = []
-        self._active_dead = 0
-        self._live_active = 0
+        self._active: list = []  # guarded-by: _cond
+        self._active_dead = 0  # guarded-by: _cond
+        self._live_active = 0  # guarded-by: _cond
         # gang-unit admission index: group key -> live active entries, so a
         # batch-planned gang's queued members drain in one cycle instead of
         # one heap pop + full comparator churn each (pop_group)
-        self._groups: dict = {}
+        self._groups: dict = {}  # guarded-by: _cond
         # Two-level gang queueing: the heap holds ONE resident entry per
         # (group, priority) bucket; later same-bucket arrivals park in a
         # FIFO and are promoted when the resident pops. Same-bucket pods
@@ -87,8 +87,8 @@ class SchedulingQueue:
         # 10k pods that was most of the push cost). One deviation: a
         # backoff RE-entry re-parks at its bucket's FIFO tail even though
         # its original timestamp may precede a queued sibling's.
-        self._fifos: dict = {}
-        self._heads: dict = {}
+        self._fifos: dict = {}  # guarded-by: _cond
+        self._heads: dict = {}  # guarded-by: _cond
         self._backoff: list = []  # heap of (ready_at, seq, PodInfo)
         self._closed = False
         self._flusher = threading.Thread(
@@ -96,7 +96,7 @@ class SchedulingQueue:
         )
         self._flusher.start()
 
-    def _push_active_locked(self, info: PodInfo) -> None:
+    def _push_active_locked(self, info: PodInfo) -> None:  # lock-held: _cond
         group = self._group_key(info) if self._group_key else None
         entry = _Entry(info, self._less, group)
         self._live_active += 1
@@ -110,14 +110,14 @@ class SchedulingQueue:
             self._heads[bucket] = entry
         self._heap_insert_locked(entry)
 
-    def _heap_insert_locked(self, entry: _Entry) -> None:
+    def _heap_insert_locked(self, entry: _Entry) -> None:  # lock-held: _cond
         if self._sort_key is not None:
             # seq appended for a stable total order
             entry.key = (*self._sort_key(entry.info), entry.info.seq)
         entry.in_heap = True
         heapq.heappush(self._active, entry)
 
-    def _promote_bucket_locked(self, entry: _Entry) -> None:
+    def _promote_bucket_locked(self, entry: _Entry) -> None:  # lock-held: _cond
         """A gang bucket's heap-resident entry was popped (live or dead):
         promote its next live FIFO member into the heap."""
         bucket = (entry.group, entry.info.priority)
@@ -133,7 +133,7 @@ class SchedulingQueue:
         self._heads.pop(bucket, None)
         self._fifos.pop(bucket, None)
 
-    def _drop_from_group_locked(self, entry: "_Entry") -> None:
+    def _drop_from_group_locked(self, entry: "_Entry") -> None:  # lock-held: _cond
         if entry.group is not None:
             bucket = self._groups.get(entry.group)
             if bucket is not None:

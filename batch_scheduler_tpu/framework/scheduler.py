@@ -101,14 +101,14 @@ class Scheduler:
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
         # cross-gang commit buffer: (gang, namespace, assigned) awaiting
-        # the batched bind + post-bind flush. Appended only by the
-        # scheduling thread; the buffer SWAP in _flush_gangs is guarded by
-        # _flush_lock so stop()'s safety-net flush (after a join that may
-        # time out mid-outage) can never double-commit a batch the cycle
-        # thread is still flushing — concurrent flushes take disjoint
-        # buffers. _buffer_since bounds deferral.
-        self._gang_buffer: List[tuple] = []
-        self._buffer_since = 0.0
+        # the batched bind + post-bind flush. Every access holds
+        # _flush_lock (uncontended in the normal case): the buffer SWAP in
+        # _flush_gangs takes it so stop()'s safety-net flush (after a join
+        # that may time out mid-outage) can never double-commit a batch
+        # the cycle thread is still flushing — concurrent flushes take
+        # disjoint buffers. _buffer_since bounds deferral.
+        self._gang_buffer: List[tuple] = []  # guarded-by: _flush_lock
+        self._buffer_since = 0.0  # guarded-by: _flush_lock
         self._flush_lock = threading.Lock()
         # uids whose bind failed AMBIGUOUSLY (transport error: the request
         # may have applied with only the response lost) and whose capacity
@@ -221,7 +221,7 @@ class Scheduler:
             # with commits buffered, drain fast and flush the moment the
             # queue goes momentarily idle; otherwise wait normally
             info = self.queue.pop(
-                timeout=0.005 if self._gang_buffer else 0.2
+                timeout=0.005 if self._buffer_pending() else 0.2
             )
             if info is None:
                 self._flush_gangs()
@@ -235,12 +235,24 @@ class Scheduler:
                 # seat fall through to the scan/backoff path as usual.
                 for sibling in self.queue.pop_group(gang):
                     self._run_cycle(sibling)
-            if self._gang_buffer and (
-                len(self._gang_buffer) >= self.FLUSH_GANGS
-                or self._clock() - self._buffer_since > self.FLUSH_SECONDS
-            ):
+            if self._buffer_ripe():
                 self._flush_gangs()
         self._flush_gangs()  # nothing may stay assumed-but-unbound
+
+    def _buffer_pending(self) -> bool:
+        with self._flush_lock:
+            return bool(self._gang_buffer)
+
+    def _buffer_ripe(self) -> bool:
+        """Commit buffer due for a flush: size or age threshold crossed.
+        Pre-analyzer these peeks ran lock-free on the scheduling thread (a
+        documented benign race); the lock is uncontended, so holding the
+        guarded-by contract costs nothing and keeps the invariant clean."""
+        with self._flush_lock:
+            return bool(self._gang_buffer) and (
+                len(self._gang_buffer) >= self.FLUSH_GANGS
+                or self._clock() - self._buffer_since > self.FLUSH_SECONDS
+            )
 
     # -- whole-gang fast lane ---------------------------------------------
 

@@ -32,8 +32,10 @@ class WaitingPod:
         self.node_name = node_name
         self.deadline = deadline
         self._lock = threading.Lock()
-        self._outcome: Optional[Tuple[str, str]] = None
-        self._sink: Optional[Callable[["WaitingPod", str, str], None]] = None
+        self._outcome: Optional[Tuple[str, str]] = None  # guarded-by: _lock
+        # written once by park() before the pod is published (single-thread
+        # phase); read under _lock thereafter
+        self._sink: Optional[Callable[["WaitingPod", str, str], None]] = None  # guarded-by: _lock
 
     def get_pod(self) -> Pod:
         return self.pod
@@ -63,8 +65,8 @@ class WaitingPods:
     def __init__(self, clock: Callable[[], float] = time.monotonic):
         self._clock = clock
         self._lock = threading.RLock()
-        self._pods: Dict[str, WaitingPod] = {}
-        self._deadlines: list = []  # heap of (deadline, uid)
+        self._pods: Dict[str, WaitingPod] = {}  # guarded-by: _lock
+        self._deadlines: list = []  # heap of (deadline, uid); guarded-by: _lock
         self.resolved: "queue.Queue[Tuple[WaitingPod, str, str]]" = queue.Queue()
         self._stop = threading.Event()
         self._timer = threading.Thread(

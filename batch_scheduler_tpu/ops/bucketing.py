@@ -263,10 +263,12 @@ class CompileWarmer:
         self._run_lock = run_lock
         self._q = queue.SimpleQueue()
         self._state_lock = threading.Lock()
-        self._warmed: set = set()  # shapes THIS warmer precompiled
-        self._seen: set = set()    # shapes serving traffic already compiled
-        self._failed: set = set()
-        self._last_key = None
+        self._warmed: set = set()  # shapes THIS warmer precompiled; guarded-by: _state_lock
+        self._seen: set = set()    # shapes serving traffic already compiled; guarded-by: _state_lock
+        self._failed: set = set()  # guarded-by: _state_lock
+        self._last_key = None  # guarded-by: _state_lock
+        # GIL-atomic one-way flag (single writer: stop()); deliberately
+        # lock-free so the worker can observe it mid-compile
         self._stopped = False
         reg = registry or DEFAULT_REGISTRY
         self._hits = reg.counter(

@@ -2275,7 +2275,7 @@ _batch_blob_donated = jax.jit(
 # In-flight fused batches (dispatched, not yet collected), process-wide:
 # the pipelining observability the dispatch-ahead paths hang off.
 _inflight_lock = threading.Lock()
-_inflight_count = [0]
+_inflight_count = [0]  # guarded-by: _inflight_lock
 
 
 def _note_inflight(delta: int) -> None:
@@ -2813,9 +2813,9 @@ def _fold_batch_metrics(telemetry: dict) -> None:
 # TRACE_INFO as ``coarse_pass_device_seconds``. Same background-landing
 # discipline as the bucket-cost analysis below.
 
-_coarse_probe: dict = {}
+_coarse_probe: dict = {}  # guarded-by: _coarse_probe_lock
 _coarse_probe_lock = threading.Lock()
-_coarse_probe_inflight: set = set()
+_coarse_probe_inflight: set = set()  # guarded-by: _coarse_probe_lock
 
 
 def _coarse_pass_seconds(n_bucket: int, lanes: int, wave: int, k: int):
@@ -2881,9 +2881,9 @@ def _coarse_pass_seconds(n_bucket: int, lanes: int, wave: int, k: int):
 # module's collective counts are measured by benchmarks/sharding_scaling.py
 # with the real mesh shardings. BST_BUCKET_COST=0 disables.
 
-_bucket_costs: dict = {}
+_bucket_costs: dict = {}  # guarded-by: _bucket_cost_lock
 _bucket_cost_lock = threading.Lock()
-_bucket_cost_inflight: set = set()
+_bucket_cost_inflight: set = set()  # guarded-by: _bucket_cost_lock
 
 
 def bucket_cost_report() -> dict:

@@ -141,8 +141,8 @@ class ChurnRescorer:
         # here, maintained by admit()/release() without any dict packing
         self.requested_lanes = np.zeros(
             (len(self.nodes), self.schema.num_lanes), dtype=np.int32
-        )
-        self._running: Dict[str, tuple] = {}  # gang -> (node_idx, counts, lane_vec)
+        )  # guarded-by: _state_lock
+        self._running: Dict[str, tuple] = {}  # gang -> (node_idx, counts, lane_vec); guarded-by: _state_lock
         # the alloc side of the snapshot never changes tick-to-tick
         self._alloc_lanes = self.schema.pack_many(
             [n.status.allocatable for n in self.nodes], capacity=True
@@ -172,12 +172,12 @@ class ChurnRescorer:
         # Invariant: _req_dev == padded(mirror at last upload) + every delta
         # appended since; any failure drops _req_dev and the next tick
         # re-uploads the numpy mirror (the ground truth) and clears deltas.
-        self._req_dev = None
-        self._req_deltas: List[tuple] = []  # (row_idx[int32], update[?,R])
+        self._req_dev = None  # guarded-by: _state_lock
+        self._req_deltas: List[tuple] = []  # (row_idx[int32], update[?,R]); guarded-by: _state_lock
         # True while a resync upload is in flight outside the lock: admits
         # in that window must still queue their deltas (the upload snapshot
         # predates them), even though _req_dev may read as None
-        self._req_uploading = False
+        self._req_uploading = False  # guarded-by: _state_lock
         # Serializes admit/release (occupancy charge + delta enqueue)
         # against tick_dispatch's snapshot pack + delta drain. A pipeline
         # deeper than one tick runs dispatches on a helper thread that can
@@ -381,7 +381,10 @@ class ChurnRescorer:
                 dev = _scatter_add_rows(cur_dev, *drained)
                 with self._state_lock:
                     self._req_dev = dev
-            return self._req_dev
+            else:
+                # no resync, no deltas: the locked read above is the value
+                dev = cur_dev
+            return dev
         except Exception:
             with self._state_lock:
                 self._req_dev = None
@@ -463,8 +466,6 @@ class ChurnRescorer:
         oracle's compact readback; 128 by default — far above any
         minMember in the BASELINE ladder).
         """
-        if full_name in self._running:
-            raise ValueError(f"{full_name} already admitted")
         gi = tick.snapshot.group_index(full_name)
         if gi is None:
             raise KeyError(full_name)
@@ -476,6 +477,11 @@ class ChurnRescorer:
         vec = self._member_lane_vec(group)
         update = (cnt[:, None] * vec[None, :]).astype(np.int32)
         with self._state_lock:  # vs a concurrent dispatch's pack/drain
+            # membership check inside the critical section: pre-analyzer it
+            # ran lock-free before the charge, so two concurrent admits of
+            # the same gang could both pass and double-charge
+            if full_name in self._running:
+                raise ValueError(f"{full_name} already admitted")
             self.requested_lanes[idx] += update
             # Staleness guard (ADVICE r3): charging a one-tick-stale
             # assignment is safe only under this class's contract that
@@ -523,26 +529,30 @@ class ChurnRescorer:
         completion on the caller side, as benchmarks/ladder.py config 5
         does with its placed-ever set.
         """
-        if full_name in self._running:
-            return False
+        with self._state_lock:
+            if full_name in self._running:
+                return False
         try:
+            # narrow TOCTOU window is safe: admit re-checks membership
+            # inside its own critical section and raises ValueError
             self.admit(tick, full_name)
-        except RuntimeError:
+        except (RuntimeError, ValueError):
             return False
         return True
 
     def release(self, full_name: str) -> None:
         """A running gang finished: free its occupancy (the exact negation
         of the admit-time update, by construction)."""
-        idx, update = self._running.pop(full_name)
         with self._state_lock:  # vs a concurrent dispatch's pack/drain
+            idx, update = self._running.pop(full_name)
             self.requested_lanes[idx] -= update
             if self._req_dev is not None or self._req_uploading:
                 self._req_deltas.append((idx.astype(np.int32), -update))
 
     @property
     def running(self) -> List[str]:
-        return list(self._running)
+        with self._state_lock:
+            return list(self._running)
 
     # -- stats -------------------------------------------------------------
 
@@ -671,8 +681,8 @@ class _DaemonDispatcher:
         from collections import deque
 
         self._cond = threading.Condition()
-        self._items = deque()  # (fn, args, future)
-        self._closed = False
+        self._items = deque()  # (fn, args, future); guarded-by: _cond
+        self._closed = False  # guarded-by: _cond
         self._thread = threading.Thread(
             target=self._loop, name=name, daemon=True
         )

@@ -19,6 +19,7 @@ every device in the job.
 from __future__ import annotations
 
 import os
+import warnings
 from typing import Optional
 
 import jax
@@ -49,12 +50,27 @@ def init_distributed(
     coordinator_address = coordinator_address or os.environ.get("BST_COORDINATOR")
     if not coordinator_address:
         return False
-    num_processes = num_processes or int(os.environ.get("BST_NUM_PROCESSES", "1"))
-    process_id = (
-        process_id
-        if process_id is not None
-        else int(os.environ.get("BST_PROCESS_ID", "0"))
-    )
+    # parse-guarded (the BST_SCAN_WAVE idiom): a typo'd knob degrades to
+    # the single-process topology instead of crashing bootstrap — but only
+    # when the value is absent/garbage, never silently renumbering a host
+    try:
+        num_processes = num_processes or int(
+            os.environ.get("BST_NUM_PROCESSES", "1")
+        )
+    except ValueError:
+        warnings.warn(
+            "ignoring unparseable BST_NUM_PROCESSES; assuming 1 process"
+        )
+        num_processes = 1
+    try:
+        process_id = (
+            process_id
+            if process_id is not None
+            else int(os.environ.get("BST_PROCESS_ID", "0"))
+        )
+    except ValueError:
+        warnings.warn("ignoring unparseable BST_PROCESS_ID; assuming id 0")
+        process_id = 0
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

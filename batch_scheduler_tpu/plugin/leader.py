@@ -44,7 +44,7 @@ class InMemoryLease:
     def __init__(self, clock: Callable[[], float] = time.time):
         self._clock = clock
         self._lock = threading.Lock()
-        self._record = LeaseRecord()
+        self._record = LeaseRecord()  # guarded-by: _lock
 
     def get(self) -> Optional[LeaseRecord]:
         with self._lock:

@@ -166,12 +166,12 @@ class PolicyEngine:
     def __init__(self, config: Optional[PolicyConfig] = None):
         self.config = config if config is not None else PolicyConfig.from_env()
         self._lock = threading.Lock()
-        self.batches_scored = 0
-        self.preempt_plans = 0
+        self.batches_scored = 0  # guarded-by: _lock
+        self.preempt_plans = 0  # guarded-by: _lock
         # denied-gang preemption attempts that yielded NO plan (no
         # eligible victims, nothing to free, or infeasible even with full
         # eviction — the planner returns one None for all three)
-        self.preempt_no_plan = 0
+        self.preempt_no_plan = 0  # guarded-by: _lock
         if self.config.enabled:
             set_active_engine(self)
 

@@ -59,15 +59,19 @@ class OracleClient:
         connect_timeout: Optional[float] = None,
     ):
         self._timeout = timeout
+        # one in-flight round-trip per connection: every frame write/read
+        # holds _lock so annotation frames and their response can never
+        # interleave with another thread's request on the same stream
         self._sock = socket.create_connection(
             (host, port), timeout=connect_timeout or timeout
-        )
+        )  # guarded-by: _lock
         self._sock.settimeout(timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
 
     def close(self) -> None:
         try:
+            # analysis: allow(guarded-by) close() is the cancellation path: it must sever the socket while a stuck round-trip still HOLDS _lock
             self._sock.close()
         except OSError:
             pass

@@ -54,14 +54,14 @@ class ChaosProxy:
         self._listener.settimeout(0.2)
         self._stop = threading.Event()
         self._lock = threading.Lock()
-        self._rng = random.Random(seed)
+        self._rng = random.Random(seed)  # guarded-by: _lock
         # kind -> probability per response frame; drawn in FAULT_KINDS order
-        self._faults: Dict[str, float] = {}
-        self._limit: Optional[int] = None
+        self._faults: Dict[str, float] = {}  # guarded-by: _lock
+        self._limit: Optional[int] = None  # guarded-by: _lock
         self.delay_s = 0.05
         self.hang_s = 30.0
-        self.injected: Dict[str, int] = {k: 0 for k in FAULT_KINDS}
-        self._socks: list = [self._listener]
+        self.injected: Dict[str, int] = {k: 0 for k in FAULT_KINDS}  # guarded-by: _lock
+        self._socks: list = [self._listener]  # guarded-by: _lock
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="chaos-accept", daemon=True
         )
@@ -106,6 +106,13 @@ class ChaosProxy:
 
     def clear_fault(self) -> None:
         self.set_fault(None)
+
+    def injected_counts(self) -> Dict[str, int]:
+        """Snapshot of per-kind injection counters. The BST_LOCKCHECK sweep
+        caught the callers reading ``.injected`` bare from the test thread
+        while relay threads increment it — read through here instead."""
+        with self._lock:
+            return dict(self.injected)
 
     def _draw(self) -> Optional[str]:
         with self._lock:
@@ -219,7 +226,13 @@ class ChaosProxy:
                     dst.sendall(header + payload[: len(payload) // 2])
                     break
                 elif fault == "garbage":
-                    dst.sendall(b"JUNK" + bytes(self._rng.randrange(256) for _ in range(28)))
+                    # draw under the lock: Random's state is shared with
+                    # _draw across every relay thread
+                    with self._lock:
+                        junk = bytes(
+                            self._rng.randrange(256) for _ in range(28)
+                        )
+                    dst.sendall(b"JUNK" + junk)
                     break
         except OSError:
             pass

@@ -65,7 +65,7 @@ class Counter:
     def __init__(self, name: str, help_: str):
         self.name, self.help = name, help_
         self._lock = threading.Lock()
-        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}  # guarded-by: _lock
 
     def inc(self, n: float = 1.0, **labels: str) -> None:
         key = tuple(sorted(labels.items()))
@@ -89,7 +89,7 @@ class Gauge:
     def __init__(self, name: str, help_: str):
         self.name, self.help = name, help_
         self._lock = threading.Lock()
-        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}  # guarded-by: _lock
 
     def set(self, v: float, **labels: str) -> None:
         with self._lock:
@@ -122,7 +122,7 @@ class Histogram:
         self.buckets = tuple(sorted(buckets))
         self._lock = threading.Lock()
         # label key -> (bucket counts, sum, count)
-        self._series: Dict[Tuple[Tuple[str, str], ...], list] = {}
+        self._series: Dict[Tuple[Tuple[str, str], ...], list] = {}  # guarded-by: _lock
 
     def observe(self, v: float, **labels: str) -> None:
         key = tuple(sorted(labels.items()))
@@ -210,7 +210,7 @@ class Histogram:
 class Registry:
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: Dict[str, object] = {}
+        self._metrics: Dict[str, object] = {}  # guarded-by: _lock
 
     def _get_or_make(self, cls, name: str, help_: str, **kwargs):
         with self._lock:

@@ -82,8 +82,8 @@ class TraceRecorder:
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         self._lock = threading.Lock()
-        self._events: deque = deque(maxlen=capacity)
-        self.dropped = 0
+        self._events: deque = deque(maxlen=capacity)  # guarded-by: _lock
+        self.dropped = 0  # guarded-by: _lock
 
     def add(self, event: dict) -> None:
         with self._lock:
@@ -95,6 +95,11 @@ class TraceRecorder:
         with self._lock:
             return list(self._events)
 
+    def _snapshot_with_dropped(self) -> tuple:
+        # one locked read so the exported ring and its drop count cohere
+        with self._lock:
+            return list(self._events), self.dropped
+
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
@@ -104,7 +109,7 @@ class TraceRecorder:
         """The Chrome-trace/Perfetto JSON object format: load the file at
         chrome://tracing or ui.perfetto.dev as-is. Process-name metadata
         rows label the tracks (scheduler vs oracle-server)."""
-        events = self.snapshot()
+        events, dropped = self._snapshot_with_dropped()
         pids = []
         for e in events:
             if e.get("pid") not in pids:
@@ -122,7 +127,7 @@ class TraceRecorder:
         return {
             "traceEvents": meta + events,
             "displayTimeUnit": "ms",
-            "otherData": {"dropped_spans": self.dropped},
+            "otherData": {"dropped_spans": dropped},
         }
 
     def export(self, path: str) -> str:
@@ -340,8 +345,8 @@ class FlightRecorder:
         self.per_gang = per_gang
         self.max_gangs = max_gangs
         self._lock = threading.Lock()
-        self._gangs: "OrderedDict[str, deque]" = OrderedDict()
-        self.dropped_gangs = 0
+        self._gangs: "OrderedDict[str, deque]" = OrderedDict()  # guarded-by: _lock
+        self.dropped_gangs = 0  # guarded-by: _lock
 
     def record(
         self,
@@ -375,11 +380,18 @@ class FlightRecorder:
             ring.append(rec)
 
     def snapshot(self, gang: Optional[str] = None) -> Dict[str, List[dict]]:
+        return self._snapshot_with_dropped(gang)[0]
+
+    def _snapshot_with_dropped(self, gang: Optional[str] = None):
+        # one locked read so a payload and its drop count cohere (the
+        # TraceRecorder helper's pattern)
         with self._lock:
             if gang is not None:
                 ring = self._gangs.get(gang)
-                return {gang: list(ring)} if ring is not None else {}
-            return {g: list(r) for g, r in self._gangs.items()}
+                decisions = {gang: list(ring)} if ring is not None else {}
+            else:
+                decisions = {g: list(r) for g, r in self._gangs.items()}
+            return decisions, self.dropped_gangs
 
     def last(self, gang: str) -> Optional[dict]:
         with self._lock:
@@ -387,10 +399,11 @@ class FlightRecorder:
             return ring[-1] if ring else None
 
     def to_json(self, gang: Optional[str] = None) -> bytes:
+        decisions, dropped = self._snapshot_with_dropped(gang)
         return json.dumps(
             {
-                "decisions": self.snapshot(gang),
-                "dropped_gangs": self.dropped_gangs,
+                "decisions": decisions,
+                "dropped_gangs": dropped,
             },
             default=str,
         ).encode()

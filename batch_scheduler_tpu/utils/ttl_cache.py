@@ -47,8 +47,8 @@ class _SharedJanitor:
         # unregister themselves.
         self._due: "weakref.WeakKeyDictionary[TTLCache, float]" = (
             weakref.WeakKeyDictionary()
-        )
-        self._thread: Optional[threading.Thread] = None
+        )  # guarded-by: _lock
+        self._thread: Optional[threading.Thread] = None  # guarded-by: _lock
 
     @classmethod
     def instance(cls) -> "_SharedJanitor":
@@ -96,7 +96,7 @@ class TTLCache:
         self._clock = clock
         self._lock = threading.RLock()
         # key -> (value, expire_at); expire_at == NO_EXPIRY means never.
-        self._items: Dict[str, Tuple[Any, float]] = {}
+        self._items: Dict[str, Tuple[Any, float]] = {}  # guarded-by: _lock
         self._on_evicted: Optional[Callable[[str, Any], None]] = None
         self._janitor_interval = janitor_interval
         if janitor_interval > 0:
@@ -130,7 +130,7 @@ class TTLCache:
             self._items[key] = (value, self._expire_at(ttl))
             return True
 
-    def _get_locked(self, key: str):
+    def _get_locked(self, key: str):  # lock-held: _lock
         entry = self._items.get(key)
         if entry is None:
             return None

@@ -30,14 +30,14 @@ class RateLimitingQueue:
         self._cap = max_delay
         self._clock = clock
         self._cond = threading.Condition()
-        self._queue: list = []  # FIFO of ready keys
-        self._queued: Set[str] = set()
-        self._processing: Set[str] = set()
-        self._dirty: Set[str] = set()  # re-added while processing
-        self._failures: Dict[str, int] = {}
-        self._delayed: list = []  # heap of (ready_at, seq, key)
-        self._seq = 0
-        self._shutdown = False
+        self._queue: list = []  # FIFO of ready keys; guarded-by: _cond
+        self._queued: Set[str] = set()  # guarded-by: _cond
+        self._processing: Set[str] = set()  # guarded-by: _cond
+        self._dirty: Set[str] = set()  # re-added while processing; guarded-by: _cond
+        self._failures: Dict[str, int] = {}  # guarded-by: _cond
+        self._delayed: list = []  # heap of (ready_at, seq, key); guarded-by: _cond
+        self._seq = 0  # guarded-by: _cond
+        self._shutdown = False  # guarded-by: _cond
 
     # -- add/get/done ------------------------------------------------------
 
@@ -124,7 +124,7 @@ class RateLimitingQueue:
 
     # -- internals ---------------------------------------------------------
 
-    def _promote_due_locked(self) -> None:
+    def _promote_due_locked(self) -> None:  # lock-held: _cond
         now = self._clock()
         while self._delayed and self._delayed[0][0] <= now:
             _, _, key = heapq.heappop(self._delayed)

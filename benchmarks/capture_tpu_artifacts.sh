@@ -186,6 +186,30 @@ else
     echo "policy gate failed:"; tail -4 /tmp/policy_gate.out; fail=1
 fi
 
+echo "== lockcheck-enabled sim cycle (LOCKCHECK_${TAG}) =="
+# one short sim cycle with the runtime lock-discipline checker armed
+# (BST_LOCKCHECK=1, docs/static_analysis.md): TPU batch times shift every
+# thread-interleaving window the CPU suites see — dispatch-ahead vs admit,
+# executor vs deadline-abandoned workers — so the race detector must also
+# ride real hardware once per tunnel. Pass = the sim completes with no
+# LockDisciplineError; the note file records the verdict either way.
+if BST_LOCKCHECK=1 timeout 600 python -m batch_scheduler_tpu sim \
+        --scenario synthetic --nodes 200 --groups 40 \
+        --oracle-background-refresh \
+        > /tmp/lockcheck_sim.out 2>&1; then
+    echo "{\"tag\": \"${TAG}\", \"lockcheck\": \"clean\"}" > "LOCKCHECK_${TAG}.json"
+    echo "lockcheck sim cycle clean: LOCKCHECK_${TAG}.json"
+else
+    if grep -q "LockDisciplineError" /tmp/lockcheck_sim.out; then
+        echo "{\"tag\": \"${TAG}\", \"lockcheck\": \"RACE\"}" > "LOCKCHECK_${TAG}.json"
+        echo "lockcheck sim cycle caught a race — stacks in /tmp/lockcheck_sim.out:"
+        grep -A 6 "LockDisciplineError" /tmp/lockcheck_sim.out | head -20
+        fail=1
+    else
+        echo "lockcheck sim cycle failed (not a race):"; tail -3 /tmp/lockcheck_sim.out; fail=1
+    fi
+fi
+
 echo "== scale headroom probe =="
 timeout 1200 python benchmarks/scale_probe.py > "SCALE_${TAG}.json" 2>/dev/null \
     || { echo "scale probe failed"; rm -f "SCALE_${TAG}.json"; fail=1; }

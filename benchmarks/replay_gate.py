@@ -227,7 +227,7 @@ def phase_health_flip() -> dict:
             "chaos_verdict": chaos["verdict"],
             "chaos_batch_p95_s": chaos["signals"]["batch"]["p95_s"],
             "breach_increment": breach_after - breach_before,
-            "faults_injected": dict(proxy.injected),
+            "faults_injected": proxy.injected_counts(),
         }
     finally:
         del os.environ["BST_SLO_BATCH_P95_S"]

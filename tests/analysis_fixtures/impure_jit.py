@@ -1,0 +1,44 @@
+"""Seeded jit-purity violations. Parsed only, never imported/executed."""
+
+import os
+import random
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def impure_env(x):
+    flag = os.environ.get("BST_FIXTURE", "")  # VIOLATION: env read in trace
+    return x + (1 if flag else 0)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def impure_clock(x, k: int = 2):
+    t = time.time()  # VIOLATION: trace-time constant clock
+    print("tracing", k)  # VIOLATION: host I/O at trace time
+    return x * k + t
+
+
+def scanned(xs):
+    def body(carry, x):
+        carry = carry + x + random.random()  # VIOLATION: stdlib random
+        return carry, carry
+
+    return jax.lax.scan(body, 0.0, xs)
+
+
+_blob_donated = jax.jit(lambda a, b: a + b, donate_argnums=(0, 1))
+
+
+def reuse_donated(a, b):
+    out = _blob_donated(a, b)
+    return out + a  # VIOLATION: 'a' was donated to the dispatch
+
+
+def pure_ok(x):
+    # jnp and jax.random are fine inside traces
+    key = jax.random.PRNGKey(0)
+    return jnp.sum(x) + jax.random.uniform(key)

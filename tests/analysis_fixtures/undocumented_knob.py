@@ -1,0 +1,28 @@
+"""Seeded knob-registry violations. Parsed only, never imported."""
+
+import os
+
+
+def undocumented():
+    # BST_FIXTURE_MISSING is (by construction) absent from the fixture README
+    return os.environ.get("BST_FIXTURE_MISSING", "")
+
+
+def unguarded_parse():
+    return int(os.environ.get("BST_FIXTURE_INT", "1"))  # VIOLATION: bare int()
+
+
+def unguarded_via_name():
+    raw = os.environ.get("BST_FIXTURE_FLOAT", "1.0")
+    return float(raw)  # VIOLATION: bare float() through a local name
+
+
+def guarded_ok():
+    try:
+        return int(os.environ.get("BST_FIXTURE_INT", "1"))
+    except ValueError:
+        return 1
+
+
+def flag_ok():
+    return os.environ.get("BST_FIXTURE_FLAG", "") == "1"

@@ -1,0 +1,42 @@
+"""Seeded guarded-by violations — tests/test_analysis.py feeds this to the
+static checker and asserts each marked line is caught. Never imported."""
+
+import threading
+
+
+class Sharded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items: dict = {}  # guarded-by: _lock
+        self._count = 0  # guarded-by: _lock
+
+    def good(self):
+        with self._lock:
+            self._count += 1
+            return dict(self._items)
+
+    def bad_read(self):
+        return len(self._items)  # VIOLATION: no lock held
+
+    def bad_write(self):
+        self._count += 1  # VIOLATION: no lock held
+
+    def helper(self):  # lock-held: _lock
+        return self._items.get("k")  # ok: documented lock-held
+
+    def suppressed(self):
+        # analysis: allow(guarded-by) fixture-reviewed benign read
+        return self._count
+
+
+_GLOBAL_LOCK = threading.Lock()
+_GLOBAL_STATE: list = []  # guarded-by: _GLOBAL_LOCK
+
+
+def good_global():
+    with _GLOBAL_LOCK:
+        _GLOBAL_STATE.append(1)
+
+
+def bad_global():
+    _GLOBAL_STATE.clear()  # VIOLATION: module-global without its lock

@@ -1,0 +1,28 @@
+"""Seeded wire-exhaustiveness fixture: a protocol with one more MsgType
+than the peers handle. Parsed only, never imported."""
+
+
+class MsgType:
+    PING = 1
+    PONG = 2
+    DATA = 3
+    NEW_FRAME = 4  # neither peer below mentions this one
+
+
+SERVER_SRC = '''
+class _H:
+    def handle(self, t, payload):
+        if t == MsgType.PING:
+            return MsgType.PONG
+        if t == MsgType.DATA:
+            return self.process(payload)
+        # msgtype-ignored: PONG server never receives its own reply frame
+'''
+
+CLIENT_SRC = '''
+class _C:
+    def request(self, payload):
+        self.send(MsgType.PING)
+        self.send(MsgType.DATA, payload)
+        return self.recv()  # PONG
+'''

@@ -1,0 +1,10 @@
+"""Seeded metric violations. Parsed only, never imported."""
+
+
+def register(reg, series):
+    reg.counter("fixture_unprefixed_total", "missing the bst_ prefix")  # VIOLATION
+    reg.counter("bst_fixture_undocumented_total", "absent from the doc")  # VIOLATION
+    reg.gauge("bst_fixture_conflict", "registered as a gauge here")
+    reg.counter("bst_fixture_conflict", "and as a counter here")  # VIOLATION: kind conflict
+    reg.histogram(series, "dynamic name, no suppression")  # VIOLATION
+    reg.counter("bst_fixture_documented_total", "this one is in the doc")  # ok

@@ -1,0 +1,316 @@
+"""The analyzer suite's own gate (ISSUE 10): every checker must catch its
+seeded-violation fixture, the clean tree must pass end-to-end (the wrapper
+that folds `make analyze` into tier-1), and the BST_LOCKCHECK runtime mode
+must reproduce a synthetic unguarded-access race deterministically."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from batch_scheduler_tpu.analysis import annotations, coupling, guards, jit_purity
+from batch_scheduler_tpu.analysis import knobs as knobs_mod
+from batch_scheduler_tpu.analysis import lockcheck, runner, wire
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+REPO = runner.package_root()
+
+
+def _fixture(name: str):
+    path = os.path.join(FIXTURES, name)
+    with open(path, "r", encoding="utf-8") as f:
+        return path, f.read()
+
+
+# -- checker 1: guarded-by ---------------------------------------------------
+
+
+def test_guards_fixture_detects_each_seeded_violation():
+    path, src = _fixture("unguarded_access.py")
+    mod = annotations.scan_module(path, src)
+    findings = guards.check_module(mod, src)
+    msgs = [f.message for f in findings]
+    assert any("bad_read" in m and "_items" in m for m in msgs), msgs
+    assert any("bad_write" in m and "_count" in m for m in msgs), msgs
+    assert any("bad_global" in m and "_GLOBAL_STATE" in m for m in msgs), msgs
+    # locked, lock-held, and suppressed accesses stay quiet
+    assert not any("good" in m or "helper" in m or "suppressed" in m for m in msgs)
+    assert len(findings) == 3, findings
+
+
+def test_guards_lock_held_annotation_and_suppression_parse():
+    path, src = _fixture("unguarded_access.py")
+    mod = annotations.scan_module(path, src)
+    ca = mod.classes["Sharded"]
+    assert ca.guarded == {"_items": "_lock", "_count": "_lock"}
+    assert ca.lock_held == {"helper": {"_lock"}}
+    assert mod.guarded_globals == {"_GLOBAL_STATE": "_GLOBAL_LOCK"}
+    assert any(s.checker == "guarded-by" and s.reason for s in mod.suppressions)
+
+
+# -- checker 2: lockcheck runtime mode --------------------------------------
+
+
+def test_lockcheck_reproduces_unguarded_race_deterministically():
+    lockcheck.install(modules=["batch_scheduler_tpu/framework/cluster.py"])
+    from batch_scheduler_tpu.framework.cluster import ClusterState
+
+    cs = ClusterState()
+    t = threading.Thread(target=cs.version)
+    t.start()
+    t.join()
+    # deterministic: the instance is provably shared, the lock is not held
+    for _ in range(3):
+        with pytest.raises(lockcheck.LockDisciplineError) as ei:
+            _ = cs._nodes
+        msg = str(ei.value)
+        assert "this access" in msg and "lock NOT held" in msg
+        assert "thread" in msg  # both stacks, attributed by thread id
+
+
+def test_lockcheck_guarded_and_lock_held_paths_stay_quiet():
+    lockcheck.install(
+        modules=[
+            "batch_scheduler_tpu/framework/cluster.py",
+            "batch_scheduler_tpu/utils/ttl_cache.py",
+        ]
+    )
+    from batch_scheduler_tpu.framework.cluster import ClusterState
+    from batch_scheduler_tpu.utils.ttl_cache import TTLCache
+
+    cs = ClusterState()
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(100):
+                cs.version()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    # explicit guarded access from a second thread is fine too
+    with cs._lock:
+        assert cs._nodes == {}
+
+    # _get_locked is annotated lock-held and called under the RLock: the
+    # frame walk must honor it across threads
+    c = TTLCache()
+    c.set("k", 41)
+
+    def getter():
+        try:
+            assert c.get("k") == 41
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    t = threading.Thread(target=getter)
+    t.start()
+    t.join()
+    assert not errors
+
+
+# -- checker 3: jit-purity ---------------------------------------------------
+
+
+def test_jit_purity_fixture_detects_each_seeded_violation():
+    path, src = _fixture("impure_jit.py")
+    findings = jit_purity.check_source(path, src)
+    msgs = [f.message for f in findings]
+    assert any("os.environ" in m and "impure_env" in m for m in msgs), msgs
+    assert any("time." in m and "impure_clock" in m for m in msgs), msgs
+    assert any("print" in m and "impure_clock" in m for m in msgs), msgs
+    assert any("random" in m and "body" in m for m in msgs), msgs
+    assert any("donated" in m and "reuse_donated" in m for m in msgs), msgs
+    assert not any("pure_ok" in m for m in msgs), msgs
+
+
+# -- checker 4: formula coupling ---------------------------------------------
+
+
+def test_coupling_fixture_drifted_formula_fails_until_restamped(tmp_path):
+    mod = tmp_path / "pair.py"
+    mod.write_text(
+        textwrap.dedent(
+            """
+            def side_a(x):
+                return x * 3 + 1
+
+            def side_b(x):
+                return x * 3 + 1
+            """
+        )
+    )
+    groups = {"pair": ["pair.py::side_a", "pair.py::side_b"]}
+    stamp_file = str(tmp_path / "stamps.json")
+    coupling.stamp(str(tmp_path), stamp_file, groups)
+    assert coupling.check(str(tmp_path), stamp_file, groups) == []
+
+    # comment/docstring-only edits never trip the fingerprint
+    mod.write_text(
+        textwrap.dedent(
+            '''
+            def side_a(x):
+                """Docstring added."""
+                # comment added
+                return x * 3 + 1
+
+            def side_b(x):
+                return x * 3 + 1
+            '''
+        )
+    )
+    assert coupling.check(str(tmp_path), stamp_file, groups) == []
+
+    # a formula change on one side fails and names the pair
+    mod.write_text(
+        textwrap.dedent(
+            """
+            def side_a(x):
+                return x * 4 + 1
+
+            def side_b(x):
+                return x * 3 + 1
+            """
+        )
+    )
+    findings = coupling.check(str(tmp_path), stamp_file, groups)
+    assert len(findings) == 1
+    assert "side_a" in findings[0].message and "side_b" in findings[0].message
+    # re-stamping (the explicit acknowledgement) clears it
+    coupling.stamp(str(tmp_path), stamp_file, groups)
+    assert coupling.check(str(tmp_path), stamp_file, groups) == []
+
+    # a deleted member is a registry error, not a silent pass
+    mod.write_text("def side_b(x):\n    return x * 3 + 1\n")
+    findings = coupling.check(str(tmp_path), stamp_file, groups)
+    assert any("not found" in f.message for f in findings)
+
+
+def test_coupling_clean_tree_stamps_match():
+    assert coupling.check(REPO) == []
+
+
+# -- checker 5: knob registry ------------------------------------------------
+
+
+def test_knobs_fixture_detects_each_seeded_violation():
+    path, src = _fixture("undocumented_knob.py")
+    readme = "| `BST_FIXTURE_INT` | `BST_FIXTURE_FLOAT` | `BST_FIXTURE_FLAG` |"
+    findings = knobs_mod.check_source(path, src, readme)
+    msgs = [f.message for f in findings]
+    assert any("BST_FIXTURE_MISSING" in m and "README" in m for m in msgs), msgs
+    unguarded = [m for m in msgs if "unguarded" in m]
+    assert any("BST_FIXTURE_INT" in m for m in unguarded), msgs
+    assert any("BST_FIXTURE_FLOAT" in m for m in unguarded), msgs
+    # try/except-guarded and flag-style reads stay quiet
+    assert len(findings) == 3, findings
+
+
+# -- checker 6: wire + metrics ----------------------------------------------
+
+
+def test_wire_fixture_detects_unhandled_msgtype():
+    path, src = _fixture("unhandled_msgtype.py")
+    import ast
+
+    tree = ast.parse(src)
+    server_src = client_src = None
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Constant):
+            if node.targets[0].id == "SERVER_SRC":
+                server_src = node.value.value
+            elif node.targets[0].id == "CLIENT_SRC":
+                client_src = node.value.value
+    findings = wire.check_wire(
+        path,
+        src,
+        [("server dispatch", "server.py", server_src),
+         ("client annotation", "client.py", client_src)],
+    )
+    msgs = [(f.path, f.message) for f in findings]
+    # NEW_FRAME: unhandled on both peers; PONG: explicitly waived on the
+    # server, referenced nowhere on the client
+    assert sum("NEW_FRAME" in m for _, m in msgs) == 2, msgs
+    assert not any("PONG" in m and p == "server.py" for p, m in msgs), msgs
+
+
+def test_metrics_fixture_detects_each_seeded_violation():
+    path, src = _fixture("unregistered_metric.py")
+    doc = "bst_fixture_documented_total and bst_fixture_conflict are listed"
+    findings = wire.check_metrics([(path, src)], doc)
+    msgs = [f.message for f in findings]
+    assert any("fixture_unprefixed_total" in m and "bst_" in m for m in msgs)
+    assert any("bst_fixture_undocumented_total" in m for m in msgs), msgs
+    assert any("bst_fixture_conflict" in m and "kinds" in m for m in msgs), msgs
+    assert any("non-constant" in m for m in msgs), msgs
+
+
+# -- the gate itself ---------------------------------------------------------
+
+
+def test_clean_tree_analyzer_exits_zero():
+    """The wrapper that makes `make analyze` part of tier-1: the shipped
+    tree must stay clean, with every suppression carrying a reason."""
+    findings, supps = runner.run_all(REPO)
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+    assert all(s.reason for s in supps), supps
+
+
+def test_analyzer_cli_exit_codes(tmp_path):
+    """exit 0 on the clean repo, nonzero findings rendered file:line."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "batch_scheduler_tpu.analysis"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stderr
+
+
+def test_analyzer_cli_exits_one_on_seeded_violations(tmp_path):
+    """`make analyze` semantics end-to-end: a tree seeded with a fixture
+    violation makes the CLI exit 1 and render file:line findings."""
+    pkg = tmp_path / "batch_scheduler_tpu"
+    pkg.mkdir()
+    src = os.path.join(FIXTURES, "unguarded_access.py")
+    with open(src) as f:
+        (pkg / "seeded.py").write_text(f.read())
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "batch_scheduler_tpu.analysis",
+            "--check",
+            "guarded-by",
+            "--root",
+            str(tmp_path),
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "seeded.py" in proc.stdout and "[guarded-by]" in proc.stdout
+
+
+def test_fixture_files_fail_the_checkers_not_the_gate():
+    """The seeded fixtures live under tests/analysis_fixtures and must be
+    excluded from the repo sweep — the gate stays green while the fixtures
+    stay red."""
+    path, src = _fixture("unguarded_access.py")
+    mod = annotations.scan_module(path, src)
+    assert guards.check_module(mod, src)  # red standalone
+    findings, _ = runner.run_all(REPO)  # green swept
+    assert findings == []

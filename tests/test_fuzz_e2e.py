@@ -34,6 +34,28 @@ from batch_scheduler_tpu.sim import (
 )
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _lockcheck():
+    """BST_LOCKCHECK: the full-stack fuzz (informers, scheduler, plugin,
+    controller, kubelet — every thread in the system) runs as a genuine
+    race detector over the guarded-by-annotated classes
+    (docs/static_analysis.md)."""
+    import os
+
+    from batch_scheduler_tpu.analysis import lockcheck
+
+    prev = os.environ.get("BST_LOCKCHECK")
+    os.environ["BST_LOCKCHECK"] = "1"
+    lockcheck.install()
+    yield
+    # restore the env so SUBPROCESSES spawned by later tests don't inherit
+    # the knob (in-process instrumentation intentionally stays installed)
+    if prev is None:
+        os.environ.pop("BST_LOCKCHECK", None)
+    else:
+        os.environ["BST_LOCKCHECK"] = prev
+
+
 @pytest.fixture
 def sim(request):
     clusters = []
@@ -313,7 +335,7 @@ def test_fuzz_full_framework_invariants_with_chaos_faults(sim):
             "feasible work never fully bound under chaos faults",
             expected,
             cluster.scheduler.stats,
-            proxy.injected,
+            proxy.injected_counts(),
         )
         _assert_no_overcommit(cluster)
         for name, members in feasible:
@@ -323,7 +345,8 @@ def test_fuzz_full_framework_invariants_with_chaos_faults(sim):
             bound = [p for p in cluster.member_pods(name) if p.spec.node_name]
             assert bound == [], f"infeasible gang {name} bound {len(bound)} pods"
         # the run actually exercised the fault injector
-        assert sum(proxy.injected.values()) > 0, proxy.injected
+        injected = proxy.injected_counts()
+        assert sum(injected.values()) > 0, injected
     finally:
         scorer.close()
         proxy.stop()

@@ -193,7 +193,7 @@ def test_debug_trace_and_decisions_endpoints():
                     assert "application/json" in r.headers["Content-Type"]
                     doc = json.loads(r.read().decode())
                 events = doc["traceEvents"]
-                assert len(events) <= trace_mod.DEFAULT_RECORDER._events.maxlen + 10
+                assert len(events) <= trace_mod.DEFAULT_CAPACITY + 10
                 assert any(e.get("name") == "select_node" for e in events)
                 with urllib.request.urlopen(
                     f"http://127.0.0.1:{port}/debug/decisions", timeout=5

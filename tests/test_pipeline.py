@@ -177,11 +177,18 @@ def _gang_cluster(n_nodes=5, n_gangs=3):
 
 def _wait_for_spec(scorer, timeout=15.0):
     deadline = time.monotonic() + timeout
-    while scorer._spec is None and time.monotonic() < deadline:
+
+    def banked():
+        # _spec travels under the refresh lock (guarded-by annotation);
+        # polling takes it briefly each probe
+        with scorer._refresh_lock:
+            return scorer._spec is not None
+
+    while not banked() and time.monotonic() < deadline:
         if scorer._spec_error is not None:
             raise AssertionError(scorer._spec_error)
         time.sleep(0.01)
-    assert scorer._spec is not None, "speculative batch never banked"
+    assert banked(), "speculative batch never banked"
 
 
 def test_dispatch_ahead_bit_identical_under_concurrent_mutation():
@@ -228,8 +235,10 @@ def test_dispatch_ahead_serves_speculative_batch_when_state_unchanged():
         # then consume — no blocking batch needed
         with ahead._refresh_lock:
             ahead._spec = None
-        if ahead._spec_thread is not None:
-            ahead._spec_thread.join(15.0)
+        with ahead._spec_lock:  # guarded state, read guarded (lockcheck)
+            spec_thread = ahead._spec_thread
+        if spec_thread is not None:
+            spec_thread.join(15.0)
         ahead.mark_dirty()
         ahead._kick_speculative(cluster, cache)
         _wait_for_spec(ahead)

@@ -316,10 +316,13 @@ def test_concurrent_dispatch_admit_consistency():
             for g in gangs:
                 if g.full_name in out.placed_groups():
                     r.admit_verified(out, g.full_name)
-            expect = np.zeros_like(r.requested_lanes)
-            for idx, update in r._running.values():
+            with r._state_lock:  # the lockcheck sweep: read guarded state guarded
+                lanes = r.requested_lanes.copy()
+                running = dict(r._running)
+            expect = np.zeros_like(lanes)
+            for idx, update in running.values():
                 np.add.at(expect, idx, update)
-            assert (r.requested_lanes == expect).all(), (
+            assert (lanes == expect).all(), (
                 f"occupancy mirror diverged from running charges at "
                 f"round {round_i}"
             )
@@ -328,11 +331,13 @@ def test_concurrent_dispatch_admit_consistency():
     probe = _gang("probe", 60, ts=999.0)  # needs most of the cluster
     out_dev = r.tick(None, [probe])
     r2 = ChurnRescorer(nodes)
+    with r._state_lock:  # guarded state, read guarded (lockcheck)
+        lanes_snapshot = r.requested_lanes.copy()
     out_ref = r2.tick(
         {
             n.metadata.name: {
                 res: int(v)
-                for res, v in zip(r.schema.names, r.requested_lanes[i])
+                for res, v in zip(r.schema.names, lanes_snapshot[i])
                 if v
             }
             for i, n in enumerate(nodes)

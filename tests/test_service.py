@@ -413,7 +413,9 @@ def test_remote_scorer_dual_connection_background_refresh(server):
     for round_no in range(3):
         scorer.mark_dirty()
         scorer.ensure_fresh(cluster, cache, group="default/dual")  # kicks bg
-        assert scorer._bg_thread is not None  # background path actually ran
+        with scorer._bg_lock:  # guarded state, read guarded (lockcheck)
+            assert scorer._bg_thread is not None  # background path ran
+
         assert op.score(members[0], "n1") > -(2**30)  # stale rows still served
         deadline = _time.monotonic() + 10.0
         while (

@@ -92,7 +92,9 @@ def test_recorder_ring_bounded_and_concurrent():
         t.join()
     events = rec.snapshot()
     assert len(events) == 64  # bounded, oldest dropped
-    assert rec.dropped == 8 * 100 - 64
+    with rec._lock:  # the lockcheck sweep: guarded state, read guarded
+        dropped = rec.dropped
+    assert dropped == 8 * 100 - 64
 
 
 def test_chrome_trace_export_schema(tmp_path):
