@@ -5,9 +5,9 @@
 
 PY ?= python
 
-.PHONY: all build vet analyze stamp-coupling test test-cpu test-tier1 bench bench-scan bench-pipeline bench-policy bench-sharding bench-xl native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
+.PHONY: all build vet analyze stamp-coupling test test-cpu test-tier1 bench bench-scan bench-pipeline bench-policy bench-sharding bench-xl bench-regress validate-artifacts native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
 
-all: vet analyze native test
+all: vet analyze native test bench-regress validate-artifacts
 
 build: vet analyze native
 
@@ -140,6 +140,23 @@ sharding: bench-sharding
 # bucket) is `python benchmarks/xl_scaling.py` without --gate.
 bench-xl:
 	$(PY) benchmarks/xl_scaling.py --gate
+
+# perf-regression tripwire (CPU): re-run the fixed probe set and compare
+# median-of-k against the committed baseline envelope
+# (benchmarks/perf_baseline.json, host-fingerprint-guarded); exits 1 with
+# structured blame (metric, baseline, observed, ratio, knob diff) on
+# regression. Runs land in PERF_LEDGER.jsonl. Re-baseline after an
+# INTENTIONAL perf change: JAX_PLATFORMS=cpu python
+# benchmarks/perf_regress.py --update-baseline
+bench-regress:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/perf_regress.py
+
+# schema-check every repo-root *_r*.json artifact (+ PERF_LEDGER.jsonl)
+# against the unified bench envelope; pre-envelope artifacts pass via the
+# frozen grandfather list (benchmarks/validate_artifacts.py) — future
+# captures can't drift silently
+validate-artifacts:
+	$(PY) benchmarks/validate_artifacts.py
 
 # the reference's serial hot loop in C++ — bench.py's vs_baseline denominator
 serial-baseline:

@@ -45,6 +45,26 @@ def _add_metrics_flag(p: argparse.ArgumentParser) -> None:
     )
 
 
+def _add_profile_flag(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--profile-dir", default=None, metavar="DIR",
+        help="directory for on-demand jax.profiler captures "
+             "(/debug/profile?seconds=N on --metrics-port writes bounded "
+             "trace dirs here; default: a per-process tmpdir — "
+             "docs/observability.md 'Device profiling')",
+    )
+
+
+def _start_profiler(args) -> None:
+    """Shared sim/serve profiler bring-up: capture dir + the device-memory
+    gauge sampler (daemon; a CPU backend has no memory_stats and the
+    sampler exits after its first empty pass)."""
+    from ..utils import profiler as profiler_mod
+
+    profiler_mod.configure(profile_dir=getattr(args, "profile_dir", None))
+    profiler_mod.start_memory_sampler()
+
+
 def _add_trace_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--trace", action="store_true",
@@ -210,6 +230,7 @@ def build_parser() -> argparse.ArgumentParser:
              "knobs. Empty/off = the exact pre-policy scan paths",
     )
     _add_metrics_flag(sim)
+    _add_profile_flag(sim)
     _add_trace_flags(sim)
     _add_audit_flags(sim, identity=True)
     sim.add_argument("--settle", type=float, default=3.0,
@@ -235,6 +256,7 @@ def build_parser() -> argparse.ArgumentParser:
              "TRACE_INFO telemetry — docs/pipelining.md)",
     )
     _add_metrics_flag(serve)
+    _add_profile_flag(serve)
     _add_trace_flags(serve)
     _add_audit_flags(serve)
 
@@ -512,8 +534,28 @@ def cmd_replay(args) -> int:
     }
     print(json.dumps(summary, default=str))
     if args.json:
+        # the written artifact (AUDIT_<tag>.json in the capture suite)
+        # carries the bench envelope when the repo checkout provides it
+        # (make validate-artifacts requires envelopes on new artifacts);
+        # an installed package without benchmarks/ writes the bare
+        # summary, which the validator's replay-summary recognizer accepts
+        doc = summary
+        try:
+            from benchmarks.artifact import envelope
+
+            doc = envelope(summary)
+        except Exception:  # noqa: BLE001 — evidence formatting only
+            pass
         with open(args.json, "w") as f:
-            json.dump(summary, f, indent=2, default=str)
+            json.dump(doc, f, indent=2, default=str)
+    # a steady-rung replay runs UNPINNED, so a fresh compile spawned a
+    # bucket-cost-analysis daemon thread; join it before the interpreter
+    # (and the XLA runtime) can exit — the same teardown rule as
+    # drain_background (this abort made every capture-suite AUDIT step
+    # with a cold jit cache report rc=134 as a divergence)
+    from ..ops.oracle import drain_telemetry_threads
+
+    drain_telemetry_threads(timeout=60.0)
     if divergent:
         return 1
     if summary["replayed"] == 0:
@@ -560,6 +602,7 @@ def cmd_serve(args) -> int:
     # TRACE_INFO frames, --trace or not)
     _maybe_configure_trace(args)
     _maybe_serve_metrics(args)
+    _start_profiler(args)
 
     server = OracleServer(
         host=args.host, port=args.port, compile_warmer=args.compile_warmer,
@@ -574,6 +617,9 @@ def cmd_serve(args) -> int:
     finally:
         server.shutdown()
         server.server_close()
+        from ..utils import profiler as profiler_mod
+
+        profiler_mod.shutdown()
     return 0
 
 
@@ -601,6 +647,7 @@ def cmd_sim(args) -> int:
     _maybe_serve_metrics(args)
     _resolve_backend_or_degrade()
     _enable_compilation_cache()
+    _start_profiler(args)
 
     scorer = cfg.plugin_config.scorer
     oracle_client = None
@@ -886,6 +933,9 @@ def cmd_sim(args) -> int:
             audit_log.stop()
         if remote_scorer is not None:
             remote_scorer.close()  # closes both connections
+        from ..utils import profiler as profiler_mod
+
+        profiler_mod.shutdown()
     return 0
 
 

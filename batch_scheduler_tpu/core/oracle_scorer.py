@@ -868,6 +868,13 @@ class OracleScorer:
             self.dispatch_ahead = False  # no new speculative kicks either
             spec_t = self._spec_thread
         ok = True
+        # the warmer stops FIRST: every warm precompile is a jit-cache
+        # miss, and each miss spawns a bucket-cost-analysis telemetry
+        # thread (ops.oracle) — stopping the producer before the
+        # telemetry-thread join below is what makes that join final
+        # (the --dispatch-ahead --compile-warmer exit-abort fix)
+        if self._warmer is not None:
+            ok = self._warmer.stop(timeout) and ok
         for name, th in (("background", t), ("dispatch-ahead", spec_t)):
             if th is not None and th.is_alive():
                 th.join(timeout)
@@ -880,12 +887,17 @@ class OracleScorer:
                         file=sys.stderr,
                     )
                     ok = False
-        if self._warmer is not None:
-            ok = self._warmer.stop(timeout) and ok
         if self._identity is not None:
             # the identity audit's re-verification is an XLA call on a
             # daemon thread — same teardown rule as the refresh threads
             ok = self._identity.drain(timeout) and ok
+        # LAST, with every batch producer above quiesced: join the
+        # telemetry daemon threads (bucket-cost analyses, coarse probes)
+        # each compiled dispatch spawned — a daemon thread dying inside
+        # an XLA compile at interpreter exit aborts the process
+        from ..ops.oracle import drain_telemetry_threads
+
+        ok = drain_telemetry_threads(timeout) and ok
         return ok
 
     # -- dispatch-ahead (docs/pipelining.md) --------------------------------

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from functools import partial
 
 import jax
@@ -63,6 +64,7 @@ __all__ = [
     "forced_scan_rung",
     "bucket_cost_report",
     "bucket_cost_for",
+    "drain_telemetry_threads",
 ]
 
 # Plain int (not a device array) so pallas kernels can share these helpers
@@ -2505,6 +2507,7 @@ def dispatch_batch(
     blob = out = None
     errors: list = []
     used_pallas, used_wave, used_shard, used_topk = attempts[0]
+    dispatch_t0 = time.perf_counter()
     for i, (up, wave, sh, tk) in enumerate(attempts):
         try:
             # only the first rung donates: a fallback rung re-runs from the
@@ -2538,12 +2541,37 @@ def dispatch_batch(
                 _disable_pallas(errors[-1], mask_mode)
         break
 
+    dispatch_s = time.perf_counter() - dispatch_t0
     compiled = None
     if cache_before is not None:
         try:
             compiled = cache_size_fn() > cache_before
         except Exception:  # noqa: BLE001 — telemetry only
             compiled = None
+    if compiled:
+        # compile-ledger feed (utils.profiler): one entry per executable
+        # BUILT on a dispatch path, keyed (bucket shape, rung, donated)
+        # with the dispatch wall-clock that absorbed it — the
+        # cold-compile cost attribution /debug/perf and the persisted
+        # JSONL serve. Telemetry only: any failure is swallowed.
+        try:
+            from ..utils.profiler import COMPILE_LEDGER
+
+            rung = (
+                "policy" if policy_cols is not None
+                else "topk" if used_topk > 0
+                else "sharded" if used_shard
+                else "pallas" if used_pallas
+                else "wavefront" if used_wave > 1
+                else "serial"
+            )
+            COMPILE_LEDGER.record(
+                g_bucket, n_bucket, rung, donate and i == 0, dispatch_s,
+                mask_mode=mask_mode, pinned=forced is not None,
+                backend=jax.default_backend(),
+            )
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
     if compiled and scan_mesh is None and forced is None and (
         policy_cols is None
     ):
@@ -2803,6 +2831,53 @@ def _fold_batch_metrics(telemetry: dict) -> None:
         ).inc(telemetry["wave_uniform"])
 
 
+# -- telemetry daemon-thread registry ---------------------------------------
+#
+# The bucket-cost analysis and the coarse-pass probe below run XLA compiles
+# on daemon threads. A daemon thread still inside an XLA call when the
+# interpreter tears the runtime down aborts the process ("terminate called
+# without an active exception" — the README's long-standing
+# --dispatch-ahead --compile-warmer exit crash: every warmer precompile is
+# a jit-cache miss, so each spawned one of these analyses, and nothing
+# joined them). Every such thread registers here;
+# ``drain_telemetry_threads`` is the teardown join, called from
+# OracleScorer.drain_background and OracleServer.server_close AFTER their
+# batch producers (warmer, refresh/spec threads, executor) stop — stopped
+# producers mean no new registrations race the drain.
+
+_telemetry_threads: set = set()  # guarded-by: _telemetry_threads_lock
+_telemetry_threads_lock = threading.Lock()
+
+
+def _spawn_telemetry_thread(target, name: str) -> None:
+    t = threading.Thread(target=target, name=name, daemon=True)
+    t.start()
+    with _telemetry_threads_lock:
+        _telemetry_threads.add(t)
+        _telemetry_threads.difference_update(
+            {x for x in _telemetry_threads if x is not t and not x.is_alive()}
+        )
+
+
+def drain_telemetry_threads(timeout: float = 60.0) -> bool:
+    """Join every live telemetry thread (bucket-cost analyses, coarse
+    probes). Returns False when one is still alive after ``timeout`` —
+    the caller must not let the process (and the XLA runtime) die yet,
+    same contract as OracleScorer.drain_background."""
+    deadline = time.monotonic() + timeout
+    with _telemetry_threads_lock:
+        threads = list(_telemetry_threads)
+    ok = True
+    for t in threads:
+        t.join(max(0.0, deadline - time.monotonic()))
+        ok = ok and not t.is_alive()
+    with _telemetry_threads_lock:
+        _telemetry_threads.difference_update(
+            {t for t in threads if not t.is_alive()}
+        )
+    return ok
+
+
 # -- standalone coarse-pass cost probe (hierarchical top-K telemetry) -------
 #
 # The coarse pass runs fused inside the jitted scan, so its per-batch cost
@@ -2861,9 +2936,7 @@ def _coarse_pass_seconds(n_bucket: int, lanes: int, wave: int, k: int):
             _coarse_probe[key] = value
             _coarse_probe_inflight.discard(key)
 
-    threading.Thread(
-        target=_run, name="coarse-pass-probe", daemon=True
-    ).start()
+    _spawn_telemetry_thread(_run, "coarse-pass-probe")
     return None
 
 
@@ -2971,9 +3044,7 @@ def _maybe_analyze_bucket(batch_args, progress_args, use_pallas: bool,
             _bucket_costs[key] = entry
             _bucket_cost_inflight.discard(key)
 
-    threading.Thread(
-        target=_run, name="bucket-cost-analysis", daemon=True
-    ).start()
+    _spawn_telemetry_thread(_run, "bucket-cost-analysis")
 
 
 def execute_batch_host(batch_args, progress_args, scan_mesh=None,

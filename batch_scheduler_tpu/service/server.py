@@ -579,6 +579,27 @@ class _Handler(socketserver.BaseRequestHandler):
                                 telemetry.update(
                                     self.server.warmer.stats()
                                 )
+                            # sidecar HBM + compile-ledger evidence rides
+                            # back with the device telemetry: the client
+                            # (whose own process has no accelerator) sees
+                            # the server's memory watermarks and cold-
+                            # compile count per traced batch
+                            # (docs/observability.md "Device profiling")
+                            try:
+                                from ..utils import profiler as prof_mod
+
+                                mem = prof_mod.sample_device_memory()
+                                if mem is not None:
+                                    telemetry["device_memory"] = mem
+                                ledger_n = (
+                                    prof_mod.COMPILE_LEDGER.entry_count()
+                                )
+                                if ledger_n:
+                                    telemetry["compile_ledger_entries"] = (
+                                        ledger_n
+                                    )
+                            except Exception:  # noqa: BLE001 — telemetry
+                                pass
                             ts0 = timings["ts0"]
                             spans = [
                                 self._mk_span(
@@ -741,11 +762,32 @@ class OracleServer(socketserver.ThreadingTCPServer):
 
     def server_close(self) -> None:
         try:
-            self.executor.stop(timeout=10.0)
+            # warmer first (its precompiles spawn bucket-cost telemetry
+            # threads), then the executor, then the telemetry-thread
+            # join — the same producer-before-join shutdown ordering as
+            # OracleScorer.drain_background (exit-abort fix)
             if self.warmer is not None:
                 self.warmer.stop(timeout=10.0)
+            self.executor.stop(timeout=10.0)
             if self.audit_log is not None:
                 self.audit_log.stop(timeout=10.0)
+            from ..ops.oracle import drain_telemetry_threads
+
+            # escalating patience, like plugin factory shutdown: a
+            # telemetry thread may be inside a 20-40s accelerator
+            # compile, and a timed-out join means teardown would still
+            # race the XLA call
+            for timeout in (60.0, 120.0):
+                if drain_telemetry_threads(timeout=timeout):
+                    break
+            else:
+                import sys
+
+                print(
+                    "server_close: telemetry compile thread still live "
+                    "after drain; teardown may race an XLA call",
+                    file=sys.stderr,
+                )
         finally:
             super().server_close()
 

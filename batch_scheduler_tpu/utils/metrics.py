@@ -13,9 +13,12 @@ Usage: components take a ``Registry`` (default: the process-wide
 ring as Chrome-trace JSON, utils.trace), ``/debug/decisions`` (the gang
 decision flight recorder), ``/debug/health`` (the live SLO health model,
 utils.health), ``/debug/buckets`` (per-bucket compiled HLO cost
-telemetry, ops.oracle), and ``/debug/policy`` (the active policy engine's
-terms/weights/counters, batch_scheduler_tpu.policy) —
-docs/observability.md has the catalog.
+telemetry, ops.oracle), ``/debug/policy`` (the active policy engine's
+terms/weights/counters, batch_scheduler_tpu.policy), ``/debug/perf``
+(the perf observatory: rolling phase quantiles, scan-rung mix, device
+memory, compile ledger, utils.profiler), and ``/debug/profile``
+(on-demand jax.profiler capture). ``/debug/`` serves the machine-readable
+index (``DEBUG_ENDPOINTS``) — docs/observability.md has the catalog.
 """
 
 from __future__ import annotations
@@ -31,6 +34,7 @@ __all__ = [
     "Histogram",
     "Registry",
     "DEFAULT_REGISTRY",
+    "DEBUG_ENDPOINTS",
     "LONG_OP_BUCKETS",
     "serve_metrics",
 ]
@@ -54,10 +58,31 @@ LONG_OP_BUCKETS = (
 )
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and newline must be escaped or a hostile/unlucky label value
+    (a node name with a quote, a reason string with a newline) corrupts
+    the whole exposition for every scraper."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    """HELP-line escaping per the exposition format: backslash and
+    newline only (quotes are legal in HELP text)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(labels: Dict[str, str]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in sorted(labels.items())
+    )
     return "{" + inner + "}"
 
 
@@ -76,8 +101,16 @@ class Counter:
         with self._lock:
             return self._values.get(tuple(sorted(labels.items())), 0.0)
 
+    def values(self) -> Dict[Tuple[Tuple[str, str], ...], float]:
+        """Every labeled series — the perf report folds the scan-rung
+        mix without knowing the path labels up front (Gauge.values'
+        contract)."""
+        with self._lock:
+            return dict(self._values)
+
     def render(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
+                 f"# TYPE {self.name} counter"]
         with self._lock:
             items = sorted(self._values.items()) or [((), 0.0)]
         for key, v in items:
@@ -106,7 +139,8 @@ class Gauge:
             return dict(self._values)
 
     def render(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
+                 f"# TYPE {self.name} gauge"]
         with self._lock:
             items = sorted(self._values.items()) or [((), 0.0)]
         for key, v in items:
@@ -190,7 +224,8 @@ class Histogram:
             return s[2] if s else 0
 
     def render(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
+                 f"# TYPE {self.name} histogram"]
         with self._lock:
             items = sorted(self._series.items())
         for key, (counts, total, n) in items:
@@ -233,6 +268,13 @@ class Registry:
     ) -> Histogram:
         return self._get_or_make(Histogram, name, help_, buckets=buckets)
 
+    def get(self, name: str):
+        """The registered metric under ``name`` (any kind), or None —
+        read-only introspection for report surfaces (utils.profiler's
+        /debug/perf) that must not create series as a side effect."""
+        with self._lock:
+            return self._metrics.get(name)
+
     def render(self) -> str:
         with self._lock:
             metrics = [self._metrics[k] for k in sorted(self._metrics)]
@@ -240,6 +282,27 @@ class Registry:
 
 
 DEFAULT_REGISTRY = Registry()
+
+
+# The /debug/ index payload: one entry per surface this endpoint serves
+# (docs/observability.md "Endpoints" and the README table mirror it).
+# Kept as data so the index, the handler dispatch, and the endpoint test
+# can't drift apart silently.
+DEBUG_ENDPOINTS = {
+    "/metrics": "Prometheus text exposition (every bst_* series)",
+    "/healthz": "liveness",
+    "/debug/": "this index",
+    "/debug/trace": "the span ring as Chrome-trace JSON (utils.trace)",
+    "/debug/decisions": "the gang decision flight recorder "
+                        "(?gang=ns/name scopes)",
+    "/debug/health": "the live SLO health model (utils.health)",
+    "/debug/buckets": "per-bucket compiled HLO cost telemetry (ops.oracle)",
+    "/debug/policy": "the active policy engine's terms/weights/counters",
+    "/debug/perf": "rolling per-phase p50/p95, scan-rung mix, device "
+                   "memory, compile ledger (utils.profiler)",
+    "/debug/profile": "?seconds=N runs a jax.profiler capture and "
+                      "returns the trace dir; bare GET reports state",
+}
 
 
 class _MetricsHandler(BaseHTTPRequestHandler):
@@ -250,6 +313,7 @@ class _MetricsHandler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:
         path = self.path.split("?")[0]
+        status = 200
         if path == "/metrics":
             body = self.registry.render().encode()
             ctype = "text/plain; version=0.0.4"
@@ -309,11 +373,64 @@ class _MetricsHandler(BaseHTTPRequestHandler):
 
             body = json.dumps(bucket_cost_report(), default=str).encode()
             ctype = "application/json"
+        elif path == "/debug/perf":
+            # the perf observatory (utils.profiler): rolling p50/p95 per
+            # phase, scan-rung mix, device-memory watermarks, and the
+            # compile ledger — "where do the nanoseconds and HBM bytes go"
+            import json
+
+            from . import profiler as profiler_mod
+
+            body = json.dumps(
+                profiler_mod.perf_report(self.registry), default=str
+            ).encode()
+            ctype = "application/json"
+        elif path == "/debug/profile":
+            # on-demand jax.profiler capture: ?seconds=N blocks this
+            # handler thread for the (clamped) window and answers the
+            # trace path; without ?seconds= it reports capture state
+            import json
+            from urllib.parse import parse_qs, urlparse
+
+            from . import profiler as profiler_mod
+
+            q = parse_qs(urlparse(self.path).query)
+            raw = (q.get("seconds") or [None])[0]
+            if raw is None:
+                payload = profiler_mod.profile_state()
+            else:
+                import math
+
+                try:
+                    seconds = float(raw)
+                    if not math.isfinite(seconds):
+                        raise ValueError(raw)  # nan/inf parse but are junk
+                except ValueError:
+                    # a malformed duration must NOT run a real capture
+                    # (it blocks a handler and consumes the global
+                    # profiler slot) — answer 400 instead
+                    seconds = None
+                    status = 400
+                    payload = {
+                        "ok": False,
+                        "error": f"malformed seconds={raw!r}",
+                    }
+                if seconds is not None:
+                    payload = profiler_mod.capture_profile(seconds)
+            body = json.dumps(payload, default=str).encode()
+            ctype = "application/json"
+        elif path in ("/debug", "/debug/"):
+            # the debug index: every surface this endpoint serves, so an
+            # operator (or a probe) can enumerate them without the docs
+            import json
+
+            body = json.dumps({"endpoints": DEBUG_ENDPOINTS}).encode()
+            ctype = "application/json"
         else:
             self.send_response(404)
             self.end_headers()
             return
-        self.send_response(200)
+        self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()

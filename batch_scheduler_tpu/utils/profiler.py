@@ -1,0 +1,515 @@
+"""Continuous device profiler + perf ledger: where the nanoseconds and
+the HBM bytes go.
+
+PRs 3 and 5 answered "what did the scheduler decide and was it
+bit-identical"; this module answers the hardware-speed question the
+north star ("as fast as the hardware allows") needs answered
+continuously, with three surfaces:
+
+1. **On-demand ``jax.profiler`` capture** (``capture_profile``, served at
+   ``/debug/profile?seconds=N`` on the metrics endpoint): start/stop a
+   real XLA trace into a bounded-size capture directory and return the
+   trace path, so "where did the batch spend its device time" is one
+   curl away from a live sim/sidecar instead of a restart with
+   instrumentation. One capture at a time (the jax profiler is a global
+   singleton); old captures are pruned oldest-first so the directory
+   never grows without bound. ``--profile-dir`` on ``sim``/``serve``
+   picks the directory (default: a per-process tmpdir).
+
+2. **Device-memory telemetry** (``DeviceMemorySampler``): a daemon
+   sampler reading ``device.memory_stats()`` into the
+   ``bst_device_bytes_in_use`` / ``bst_device_peak_bytes`` /
+   ``bst_device_bytes_limit`` gauges. This is the HBM-headroom feed the
+   device-resident-state refactor (ROADMAP top open item) sizes its
+   resident [N,R]/[G,R]/policy buffers against. CPU backends expose no
+   memory_stats — the sampler notices on its first pass and exits (a
+   true no-op, not a spinning thread). ``stop()`` joins the thread
+   before teardown (the XLA-daemon-thread rule, ADVICE r3).
+
+3. **The compile ledger** (``CompileLedger``): every jit-cache miss the
+   serving path detects (ops.oracle.dispatch_batch) lands one entry
+   keyed (g_bucket, n_bucket, rung, donated) with the dispatch
+   wall-clock that paid for it — and is appended to a persistent JSONL
+   file (``BST_COMPILE_LEDGER`` overrides the path; ``off`` disables)
+   so cold-compile cost is attributable ACROSS runs: "this shape
+   compiles on every restart" is a ledger query, not a guess. The
+   in-memory ring is bounded; the JSONL is append-only evidence.
+
+``perf_report()`` folds all three plus the live registry (rolling
+p50/p95 per phase, scan-rung mix) into the ``/debug/perf`` payload
+(utils.metrics). Everything here is telemetry: every failure degrades
+to "no data", never into a batch or a request.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = [
+    "configure",
+    "capture_profile",
+    "profile_state",
+    "DeviceMemorySampler",
+    "start_memory_sampler",
+    "sample_device_memory",
+    "CompileLedger",
+    "COMPILE_LEDGER",
+    "perf_report",
+    "shutdown",
+]
+
+# Captures kept on disk before oldest-first pruning: each jax.profiler
+# trace of a busy batch loop is tens of MB, and the capture dir must stay
+# bounded on a long-lived sidecar.
+_KEEP_CAPTURES = 4
+
+# Longest admissible /debug/profile capture: the handler thread blocks
+# for the capture window, and an unbounded ?seconds= would let one curl
+# wedge a handler (and the profiler singleton) for hours.
+_MAX_CAPTURE_S = 120.0
+
+_state_lock = threading.Lock()
+_profile_dir: List[Optional[str]] = [None]  # guarded-by: _state_lock
+_capture_seq = [0]  # guarded-by: _state_lock
+# the jax profiler is process-global: one capture at a time, and the
+# busy flag must be readable without blocking behind a live capture
+_capture_busy = [False]  # guarded-by: _state_lock
+_last_capture: List[Optional[dict]] = [None]  # guarded-by: _state_lock
+# set while NO capture is in flight: shutdown() waits on it — a process
+# exiting while stop_trace serializes on a handler thread segfaults in
+# XLA teardown (the same abort class ops.oracle.drain_telemetry_threads
+# exists for)
+_capture_idle = threading.Event()
+_capture_idle.set()
+# set by shutdown(): refuses NEW captures — the metrics HTTP server is a
+# daemon and may outlive the CLI's teardown, and a capture STARTING after
+# shutdown would re-create the exit-abort this module guards against.
+# configure() (the bring-up call) reopens.
+_closed = [False]  # guarded-by: _state_lock
+
+
+def configure(profile_dir: Optional[str] = None) -> None:
+    """Set the capture directory (the ``--profile-dir`` flag). Created
+    lazily on first capture; None keeps the per-process tmpdir default."""
+    with _state_lock:
+        _profile_dir[0] = profile_dir
+        _closed[0] = False
+
+
+def _resolve_profile_dir() -> str:
+    with _state_lock:
+        d = _profile_dir[0]
+    if not d:
+        d = os.path.join(
+            tempfile.gettempdir(), f"bst-profile-{os.getpid()}"
+        )
+        with _state_lock:
+            _profile_dir[0] = d
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _prune_captures(base: str, keep: int = _KEEP_CAPTURES) -> None:
+    """Oldest-first prune of capture subdirs so the dir stays bounded."""
+    try:
+        subdirs = sorted(
+            e for e in os.listdir(base)
+            if e.startswith("capture-")
+            and os.path.isdir(os.path.join(base, e))
+        )
+        for name in subdirs[:-keep] if keep > 0 else subdirs:
+            shutil.rmtree(os.path.join(base, name), ignore_errors=True)
+    except OSError:
+        pass  # pruning is best-effort housekeeping
+
+
+def profile_state() -> dict:
+    """The /debug/profile GET-without-seconds view: capture dir, busy
+    flag, and the last capture's summary."""
+    with _state_lock:
+        return {
+            "profile_dir": _profile_dir[0],
+            "busy": _capture_busy[0],
+            "closed": _closed[0],
+            "captures": _capture_seq[0],
+            "last_capture": dict(_last_capture[0]) if _last_capture[0] else None,
+        }
+
+
+def capture_profile(seconds: float) -> dict:
+    """Run one bounded ``jax.profiler`` capture and return its summary
+    dict: ``{ok, trace_dir, seconds, events}`` or ``{ok: False, error}``.
+
+    Blocks the calling thread for the capture window (the metrics
+    endpoint serves each request on its own thread). A second concurrent
+    request answers ``busy`` instead of corrupting the global profiler
+    state.
+    """
+    import math
+
+    seconds = float(seconds)
+    if not math.isfinite(seconds):
+        # NaN slips through min/max clamps (comparisons are False) and
+        # would reach time.sleep mid-capture
+        return {"ok": False, "error": f"invalid seconds={seconds!r}"}
+    seconds = min(max(seconds, 0.05), _MAX_CAPTURE_S)
+    with _state_lock:
+        if _closed[0]:
+            return {"ok": False, "error": "profiler shut down"}
+        if _capture_busy[0]:
+            return {"ok": False, "error": "capture already in progress"}
+        _capture_busy[0] = True
+        _capture_idle.clear()
+        _capture_seq[0] += 1
+        seq = _capture_seq[0]
+    t0 = time.perf_counter()
+    try:
+        import jax
+
+        base = _resolve_profile_dir()
+        trace_dir = os.path.join(base, f"capture-{seq:04d}")
+        jax.profiler.start_trace(trace_dir)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+        _prune_captures(base)
+        n_files = sum(len(files) for _, _, files in os.walk(trace_dir))
+        summary = {
+            "ok": True,
+            "trace_dir": trace_dir,
+            "seconds": round(time.perf_counter() - t0, 3),
+            "requested_seconds": seconds,
+            "files": n_files,
+        }
+    except Exception as e:  # noqa: BLE001 — telemetry, never a crash
+        summary = {"ok": False, "error": repr(e)[:300]}
+    finally:
+        with _state_lock:
+            _capture_busy[0] = False
+            _last_capture[0] = summary
+        _capture_idle.set()
+    if summary.get("ok"):
+        from .metrics import DEFAULT_REGISTRY
+
+        DEFAULT_REGISTRY.counter(
+            "bst_profile_captures_total",
+            "On-demand jax.profiler captures served at /debug/profile",
+        ).inc()
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# device-memory telemetry
+# ---------------------------------------------------------------------------
+
+
+def sample_device_memory() -> Optional[dict]:
+    """One synchronous ``memory_stats()`` sweep over the local devices:
+    ``{bytes_in_use, peak_bytes_in_use, bytes_limit, devices}`` summed
+    across devices, or None when the backend exposes no stats (CPU).
+    The gauge-feeding sampler and the sidecar TRACE_INFO telemetry both
+    use this; it costs one host call per device, no device sync."""
+    try:
+        import jax
+
+        totals = {"bytes_in_use": 0, "peak_bytes_in_use": 0, "bytes_limit": 0}
+        n = 0
+        for dev in jax.local_devices():
+            stats_fn = getattr(dev, "memory_stats", None)
+            stats = stats_fn() if callable(stats_fn) else None
+            if not stats:
+                continue
+            n += 1
+            totals["bytes_in_use"] += int(stats.get("bytes_in_use", 0))
+            totals["peak_bytes_in_use"] += int(
+                stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0))
+            )
+            totals["bytes_limit"] += int(stats.get("bytes_limit", 0))
+        if n == 0:
+            return None
+        totals["devices"] = n
+        return totals
+    except Exception:  # noqa: BLE001 — telemetry only
+        return None
+
+
+class DeviceMemorySampler:
+    """Daemon sampler feeding the device-memory gauges.
+
+    Samples every ``interval_s`` (``BST_DEVICE_MEM_SAMPLE_S``, default
+    10; a gauge read costs nothing between samples). On a backend with
+    no ``memory_stats`` (CPU) the first pass finds nothing and the
+    thread exits — the documented no-op. ``stop()`` joins before
+    teardown like every other XLA-adjacent daemon thread."""
+
+    def __init__(self, interval_s: Optional[float] = None, registry=None):
+        if interval_s is None:
+            try:
+                interval_s = float(
+                    os.environ.get("BST_DEVICE_MEM_SAMPLE_S", "10")
+                )
+            except ValueError:
+                interval_s = 10.0
+        self.interval_s = max(interval_s, 0.5)
+        self._registry = registry
+        # gauges registered LAZILY on the first successful sample: a
+        # registered-but-never-set gauge renders as 0, so eager
+        # registration on CPU would expose bst_device_bytes_limit 0 —
+        # false telemetry for the exact HBM-headroom consumers this
+        # sampler feeds. "Absent on CPU" (the documented contract) means
+        # absent from /metrics too.
+        self._gauges = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="device-mem-sampler", daemon=True
+        )
+        self._thread.start()
+
+    def sample_once(self) -> Optional[dict]:
+        totals = sample_device_memory()
+        if totals is None:
+            return None
+        if self._gauges is None:
+            from .metrics import DEFAULT_REGISTRY
+
+            reg = self._registry or DEFAULT_REGISTRY
+            self._gauges = (
+                reg.gauge(
+                    "bst_device_bytes_in_use",
+                    "Device (HBM) bytes currently allocated, summed over "
+                    "local devices (device.memory_stats sampler; absent "
+                    "on CPU)",
+                ),
+                reg.gauge(
+                    "bst_device_peak_bytes",
+                    "Peak device (HBM) bytes allocated since process "
+                    "start, summed over local devices",
+                ),
+                reg.gauge(
+                    "bst_device_bytes_limit",
+                    "Device (HBM) byte capacity visible to the "
+                    "allocator, summed over local devices",
+                ),
+            )
+        in_use, peak, limit = self._gauges
+        in_use.set(float(totals["bytes_in_use"]))
+        peak.set(float(totals["peak_bytes_in_use"]))
+        limit.set(float(totals["bytes_limit"]))
+        return totals
+
+    def _loop(self) -> None:
+        if self.sample_once() is None:
+            return  # CPU no-op: no stats now means no stats ever
+        while not self._stop.wait(self.interval_s):
+            if self.sample_once() is None:
+                return
+
+    def stop(self, timeout: float = 5.0) -> bool:
+        self._stop.set()
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+
+_sampler_lock = threading.Lock()
+_sampler: List[Optional[DeviceMemorySampler]] = [None]  # guarded-by: _sampler_lock
+
+
+def start_memory_sampler() -> DeviceMemorySampler:
+    """Process-wide sampler singleton (sim + serve both call this at
+    startup; the second call is a no-op returning the live one)."""
+    with _sampler_lock:
+        if _sampler[0] is None:
+            _sampler[0] = DeviceMemorySampler()
+        return _sampler[0]
+
+
+# ---------------------------------------------------------------------------
+# the compile ledger
+# ---------------------------------------------------------------------------
+
+
+class CompileLedger:
+    """Bounded in-memory ring + persistent JSONL of jit-cache misses.
+
+    One entry per detected compile on the serving dispatch path, keyed
+    (g_bucket, n_bucket, rung, donated) with the dispatch wall-clock
+    that absorbed it. ``BST_COMPILE_LEDGER`` overrides the JSONL path
+    (``off``/``0``/empty disables persistence; the in-memory view and
+    the counter keep working)."""
+
+    _MAX_ENTRIES = 512
+
+    def __init__(self, path: Optional[str] = None, registry=None):
+        self._lock = threading.Lock()
+        self._entries: List[dict] = []  # guarded-by: _lock
+        self._totals: Dict[tuple, dict] = {}  # guarded-by: _lock
+        self._path = path
+        self._path_resolved = False  # guarded-by: _lock
+        self._registry = registry
+
+    def _counter(self):
+        from .metrics import DEFAULT_REGISTRY
+
+        return (self._registry or DEFAULT_REGISTRY).counter(
+            "bst_compile_ledger_entries_total",
+            "Jit-cache misses recorded by the compile ledger (one per "
+            "executable built on a dispatch path)",
+        )
+
+    def _resolve_path(self) -> Optional[str]:
+        """Env resolved lazily (tests swap it), once per ledger. Takes
+        the lock itself — callers must NOT hold it."""
+        with self._lock:
+            if self._path_resolved:
+                return self._path
+            self._path_resolved = True
+            if self._path is None:
+                env = os.environ.get("BST_COMPILE_LEDGER", "").strip()
+                if env.lower() in ("off", "0"):
+                    self._path = None
+                elif env:
+                    self._path = env
+                else:
+                    self._path = os.path.join(
+                        os.path.expanduser("~"), ".cache",
+                        "bst-compile-ledger.jsonl",
+                    )
+            return self._path
+
+    def record(
+        self,
+        g_bucket: int,
+        n_bucket: int,
+        rung: str,
+        donated: bool,
+        seconds: float,
+        **extra,
+    ) -> dict:
+        entry = {
+            "ts": round(time.time(), 3),
+            "pid": os.getpid(),
+            "g_bucket": int(g_bucket),
+            "n_bucket": int(n_bucket),
+            "rung": str(rung),
+            "donated": bool(donated),
+            "dispatch_seconds": round(float(seconds), 4),
+        }
+        entry.update(extra)
+        key = (entry["g_bucket"], entry["n_bucket"], entry["rung"],
+               entry["donated"])
+        path = self._resolve_path()
+        with self._lock:
+            self._entries.append(entry)
+            del self._entries[:-self._MAX_ENTRIES]
+            tot = self._totals.setdefault(
+                key, {"compiles": 0, "dispatch_seconds": 0.0}
+            )
+            tot["compiles"] += 1
+            tot["dispatch_seconds"] = round(
+                tot["dispatch_seconds"] + entry["dispatch_seconds"], 4
+            )
+        self._counter().inc()
+        if path:
+            try:
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                with open(path, "a") as f:
+                    f.write(json.dumps(entry) + "\n")
+            except OSError:
+                pass  # persistence is evidence, never the batch path
+        return entry
+
+    def report(self) -> dict:
+        """Per-shape totals + the recent entries — the /debug/perf and
+        TRACE_INFO payload."""
+        with self._lock:
+            totals = {
+                f"{g}x{n}/{rung}{'/donated' if don else ''}": dict(tot)
+                for (g, n, rung, don), tot in sorted(self._totals.items())
+            }
+            recent = [dict(e) for e in self._entries[-16:]]
+            path = self._path if self._path_resolved else None
+        return {"totals": totals, "recent": recent, "jsonl": path}
+
+    def entry_count(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+COMPILE_LEDGER = CompileLedger()
+
+
+# ---------------------------------------------------------------------------
+# /debug/perf
+# ---------------------------------------------------------------------------
+
+# The rolling-latency phases surfaced at /debug/perf: every histogram the
+# serving paths observe into, client and sidecar side.
+_PHASE_HISTOGRAMS = (
+    "bst_oracle_pack_seconds",
+    "bst_oracle_batch_seconds",
+    "bst_oracle_device_seconds",
+    "bst_oracle_server_batch_seconds",
+    "bst_schedule_cycle_seconds",
+)
+
+
+def perf_report(registry=None) -> dict:
+    """The /debug/perf payload: per-phase rolling p50/p95, the compile
+    ledger, device-memory watermarks, and the scan-rung mix."""
+    from .metrics import DEFAULT_REGISTRY, Histogram
+
+    reg = registry or DEFAULT_REGISTRY
+    phases: Dict[str, dict] = {}
+    for name in _PHASE_HISTOGRAMS:
+        h = reg.get(name)
+        if not isinstance(h, Histogram):
+            continue
+        _, total, count = h.snapshot()
+        if count == 0:
+            continue
+        phases[name] = {
+            "count": count,
+            "mean_s": round(total / count, 6),
+            "p50_s": round(h.quantile(0.5), 6),
+            "p95_s": round(h.quantile(0.95), 6),
+        }
+    scan_mix: Dict[str, float] = {}
+    batches = reg.get("bst_scan_batches_total")
+    values_fn = getattr(batches, "values", None)
+    if callable(values_fn):
+        for key, v in values_fn().items():
+            label = dict(key).get("path", "")
+            if label:
+                scan_mix[label] = v
+    memory = sample_device_memory()
+    return {
+        "phases": phases,
+        "scan_rung_mix": scan_mix,
+        "device_memory": memory,  # None on CPU: no memory_stats
+        "compile_ledger": COMPILE_LEDGER.report(),
+        "profiler": profile_state(),
+    }
+
+
+def shutdown(timeout: float = 30.0) -> bool:
+    """Teardown hook: stop the memory sampler (if one was started) and
+    wait out any in-flight /debug/profile capture, so no profiler-owned
+    work outlives the XLA runtime (stop_trace serializing on a handler
+    thread at interpreter exit segfaults in XLA teardown). New captures
+    are refused from here on (the daemon metrics server may keep serving
+    /debug/profile past CLI teardown); ``configure()`` reopens."""
+    with _state_lock:
+        _closed[0] = True
+    ok = _capture_idle.wait(timeout)
+    with _sampler_lock:
+        sampler, _sampler[0] = _sampler[0], None
+    if sampler is not None:
+        ok = sampler.stop(min(timeout, 5.0)) and ok
+    return ok

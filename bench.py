@@ -301,17 +301,24 @@ def bench_serial(nodes, groups):
 
 
 def emit(value, vs_baseline, detail):
-    print(
-        json.dumps(
-            {
-                "metric": METRIC,
-                "value": value,
-                "unit": "s",
-                "vs_baseline": vs_baseline,
-                "detail": detail,
-            }
-        )
-    )
+    result = {
+        "metric": METRIC,
+        "value": value,
+        "unit": "s",
+        "vs_baseline": vs_baseline,
+        "detail": detail,
+    }
+    # the unified bench envelope (benchmarks/artifact.py): legacy keys
+    # stay top-level (the driver's parse is unchanged), the envelope adds
+    # host/knobs/metrics, and the run lands in PERF_LEDGER.jsonl. Any
+    # envelope failure falls back to the bare legacy line — the driver
+    # must ALWAYS get its one JSON line.
+    try:
+        from benchmarks import artifact
+
+        artifact.emit(result)
+    except Exception:  # noqa: BLE001 — the JSON line must still go out
+        print(json.dumps(result))
 
 
 def _tpu_bench_records():

@@ -1,0 +1,202 @@
+"""The unified bench envelope + perf ledger.
+
+Before this module every benchmark and gate invented its own JSON shape:
+20+ ``BENCH_*/SHARDING_*/LADDER_*`` artifacts with incompatible schemas,
+no host fingerprint, no knob capture, and no way to ask "did this change
+make it slower" without a human diffing numbers by eye. This defines ONE
+versioned envelope that ``bench.py`` and every gate in ``benchmarks/``
+emits, and one append-only ledger (``PERF_LEDGER.jsonl`` at the repo
+root) every run lands in.
+
+The envelope is ADDITIVE over the legacy ``{metric, value, unit,
+detail}`` line: all legacy keys stay at the top level (so every existing
+grep/parse in the capture scripts keeps working) and the envelope adds
+
+- ``schema``    — ``bst-bench-envelope/v1`` (the version gate)
+- ``ts``        — epoch seconds of emission
+- ``host``      — platform fingerprint: jax backend + device count,
+  python, OS, cpu count; perf numbers are only comparable within one
+  fingerprint (benchmarks/perf_regress.py enforces exactly that)
+- ``knobs``     — every ``BST_*``/``JAX_PLATFORMS`` env knob live at
+  emission, so a regression can be blamed on a knob diff
+- ``metrics``   — flat name -> number dict (the regression gate's
+  comparison surface); defaults to ``{metric: value}`` + every numeric
+  ``detail`` entry
+- ``repeats``   — optional raw draws behind a median, for noise audits
+
+``validate(doc)`` is the schema check ``make validate-artifacts`` runs
+over the repo-root artifacts (legacy shapes pass via its grandfather
+list, benchmarks/validate_artifacts.py).
+
+Ledger knob: ``BST_PERF_LEDGER`` overrides the path (``off``/``0``
+disables). Appending never fails an emitting benchmark.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+SCHEMA = "bst-bench-envelope/v1"
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_LEDGER = os.path.join(_REPO_ROOT, "PERF_LEDGER.jsonl")
+
+# env knobs captured into every envelope: the full BST_* namespace plus
+# the platform pins that change what a number means
+_KNOB_PREFIXES = ("BST_", "BSP_")
+_KNOB_EXTRAS = ("JAX_PLATFORMS", "XLA_FLAGS")
+
+
+def capture_knobs() -> Dict[str, str]:
+    knobs = {
+        k: v
+        for k, v in os.environ.items()
+        if k.startswith(_KNOB_PREFIXES) or k in _KNOB_EXTRAS
+    }
+    return dict(sorted(knobs.items()))
+
+
+def host_fingerprint() -> dict:
+    """The comparability key: perf numbers mean nothing across hosts or
+    backends, so every envelope records where it was measured. The jax
+    probe degrades to "unknown" rather than import-failing an emitter."""
+    import platform as _platform
+
+    fp = {
+        "python": _platform.python_version(),
+        "machine": _platform.machine(),
+        "system": _platform.system(),
+        "cpu_count": os.cpu_count(),
+    }
+    try:
+        import jax
+
+        fp["jax_backend"] = jax.default_backend()
+        fp["jax_device_count"] = len(jax.devices())
+        fp["jax_version"] = jax.__version__
+    except Exception:  # noqa: BLE001 — fingerprint must never crash a bench
+        fp["jax_backend"] = "unknown"
+    return fp
+
+
+def fingerprint_key(fp: dict) -> str:
+    """The subset of the fingerprint that must MATCH for two envelopes'
+    numbers to be comparable (the regression gate's guard): backend,
+    device count, machine, cpu count."""
+    return "/".join(
+        str(fp.get(k, "?"))
+        for k in ("jax_backend", "jax_device_count", "machine", "cpu_count")
+    )
+
+
+def _numeric_details(detail: dict) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for k, v in (detail or {}).items():
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        out[k] = v
+    return out
+
+
+def envelope(
+    result: dict,
+    metrics: Optional[Dict[str, float]] = None,
+    repeats: Optional[dict] = None,
+) -> dict:
+    """Wrap one legacy-shaped result dict ({metric, value, unit, detail}
+    or a gate's {ok, checks, detail}) into the versioned envelope.
+    The input keys stay top-level; envelope fields are added."""
+    doc = dict(result)
+    doc["schema"] = SCHEMA
+    doc["ts"] = round(time.time(), 3)
+    doc["host"] = host_fingerprint()
+    doc["knobs"] = capture_knobs()
+    if metrics is None:
+        metrics = {}
+        if isinstance(doc.get("value"), (int, float)) and not isinstance(
+            doc.get("value"), bool
+        ):
+            metrics[str(doc.get("metric", "value"))] = doc["value"]
+        if isinstance(doc.get("detail"), dict):
+            metrics.update(_numeric_details(doc["detail"]))
+    doc["metrics"] = metrics
+    if repeats:
+        doc["repeats"] = repeats
+    return doc
+
+
+def ledger_path() -> Optional[str]:
+    env = os.environ.get("BST_PERF_LEDGER", "").strip()
+    if env.lower() in ("off", "0"):
+        return None
+    return env or DEFAULT_LEDGER
+
+
+def append_ledger(doc: dict, path: Optional[str] = None) -> Optional[str]:
+    """Append one envelope line to the perf ledger; returns the path or
+    None. Best-effort: a read-only checkout must never fail a bench."""
+    path = path or ledger_path()
+    if not path:
+        return None
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(doc, default=str) + "\n")
+        return path
+    except OSError as e:
+        print(f"perf ledger append failed ({e!r})", file=sys.stderr)
+        return None
+
+
+def emit(result: dict, ledger: bool = True, indent: Optional[int] = None,
+         **envelope_kwargs) -> dict:
+    """The one-call form every gate uses: envelope the result, append it
+    to the perf ledger, print the JSON line, return the envelope."""
+    doc = envelope(result, **envelope_kwargs)
+    if ledger:
+        append_ledger(doc)
+    print(json.dumps(doc, default=str, indent=indent, sort_keys=bool(indent)))
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# validation (make validate-artifacts, benchmarks/perf_regress.py)
+# ---------------------------------------------------------------------------
+
+_REQUIRED = ("schema", "ts", "host", "knobs", "metrics")
+
+
+def validate(doc: dict) -> List[str]:
+    """Schema errors for one envelope document (empty list = valid)."""
+    errors: List[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not a JSON object"]
+    if doc.get("schema") != SCHEMA:
+        errors.append(
+            f"schema is {doc.get('schema')!r}, expected {SCHEMA!r}"
+        )
+    for key in _REQUIRED:
+        if key not in doc:
+            errors.append(f"missing required field {key!r}")
+    host = doc.get("host")
+    if not isinstance(host, dict) or "jax_backend" not in host:
+        errors.append("host fingerprint missing or lacks jax_backend")
+    knobs = doc.get("knobs")
+    if not isinstance(knobs, dict):
+        errors.append("knobs is not an object")
+    metrics = doc.get("metrics")
+    if not isinstance(metrics, dict):
+        errors.append("metrics is not an object")
+    else:
+        for k, v in metrics.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                errors.append(f"metrics[{k!r}] is not a number")
+    ts = doc.get("ts")
+    if not isinstance(ts, (int, float)) or isinstance(ts, bool) or ts <= 0:
+        errors.append("ts is not a positive epoch timestamp")
+    if "repeats" in doc and not isinstance(doc["repeats"], dict):
+        errors.append("repeats is not an object")
+    return errors

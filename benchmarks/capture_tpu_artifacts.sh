@@ -193,20 +193,43 @@ echo "== lockcheck-enabled sim cycle (LOCKCHECK_${TAG}) =="
 # executor vs deadline-abandoned workers — so the race detector must also
 # ride real hardware once per tunnel. Pass = the sim completes with no
 # LockDisciplineError; the note file records the verdict either way.
+# --dispatch-ahead --compile-warmer is back in the cycle now that the
+# exit-time teardown abort is fixed (shutdown joins the warmer + the
+# telemetry compile threads; README known-issues).
 if BST_LOCKCHECK=1 timeout 600 python -m batch_scheduler_tpu sim \
         --scenario synthetic --nodes 200 --groups 40 \
-        --oracle-background-refresh \
+        --dispatch-ahead --compile-warmer \
         > /tmp/lockcheck_sim.out 2>&1; then
-    echo "{\"tag\": \"${TAG}\", \"lockcheck\": \"clean\"}" > "LOCKCHECK_${TAG}.json"
+    python -c "from benchmarks import artifact; import json; print(json.dumps(artifact.envelope({'tag': '${TAG}', 'lockcheck': 'clean'})))" > "LOCKCHECK_${TAG}.json"
     echo "lockcheck sim cycle clean: LOCKCHECK_${TAG}.json"
 else
     if grep -q "LockDisciplineError" /tmp/lockcheck_sim.out; then
-        echo "{\"tag\": \"${TAG}\", \"lockcheck\": \"RACE\"}" > "LOCKCHECK_${TAG}.json"
+        python -c "from benchmarks import artifact; import json; print(json.dumps(artifact.envelope({'tag': '${TAG}', 'lockcheck': 'RACE'})))" > "LOCKCHECK_${TAG}.json"
         echo "lockcheck sim cycle caught a race — stacks in /tmp/lockcheck_sim.out:"
         grep -A 6 "LockDisciplineError" /tmp/lockcheck_sim.out | head -20
         fail=1
     else
         echo "lockcheck sim cycle failed (not a race):"; tail -3 /tmp/lockcheck_sim.out; fail=1
+    fi
+fi
+
+echo "== perf-ledger emission on hardware (PERF_${TAG}) =="
+# the perf-regression probe set measured on the real device, emitted as
+# an envelope (host fingerprint + knobs + median-of-k) into
+# PERF_LEDGER.jsonl AND the PERF_${TAG}.json artifact: the hardware
+# point of the cross-run perf trajectory (docs/observability.md "Perf
+# ledger & regression gate"). The committed baseline is CPU-fingerprinted,
+# so on TPU the gate self-references (measured-local) — the artifact is
+# the evidence, not the pass/fail.
+if timeout 900 python benchmarks/perf_regress.py --out "PERF_${TAG}.json" \
+        > /tmp/perf_regress.out 2>&1; then
+    echo "perf ledger captured: PERF_${TAG}.json"
+else
+    if [ -s "PERF_${TAG}.json" ]; then
+        echo "perf regress reported regression — blame kept: PERF_${TAG}.json"
+        tail -2 /tmp/perf_regress.out
+    else
+        echo "perf ledger capture failed:"; tail -3 /tmp/perf_regress.out; fail=1
     fi
 fi
 

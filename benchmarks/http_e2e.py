@@ -309,15 +309,15 @@ def main() -> int:
         ),
     }
 
-    print(
-        json.dumps(
-            {
-                "metric": "http_e2e_100gang_50node_with_gateway_restart",
-                "value": round(elapsed, 3),
-                "unit": "s",
-                "detail": detail,
-            }
-        )
+    from benchmarks import artifact
+
+    artifact.emit(
+        {
+            "metric": "http_e2e_100gang_50node_with_gateway_restart",
+            "value": round(elapsed, 3),
+            "unit": "s",
+            "detail": detail,
+        }
     )
     assert ok, f"headline run incomplete: {detail}"
     assert res_b.get("ok") and res_p.get("ok"), (

@@ -51,18 +51,20 @@ GPU = "nvidia.com/gpu"
 
 
 def _emit(config: int, metric: str, value: float, unit: str, **detail):
-    print(
-        json.dumps(
-            {
-                "config": config,
-                "metric": metric,
-                "value": round(value, 5),
-                "unit": unit,
-                "detail": detail,
-            }
-        ),
-        flush=True,
+    # every ladder config line is one envelope (benchmarks/artifact.py):
+    # LADDER_* artifacts stay JSONL, each line schema-tagged + ledgered
+    from benchmarks import artifact
+
+    artifact.emit(
+        {
+            "config": config,
+            "metric": metric,
+            "value": round(value, 5),
+            "unit": unit,
+            "detail": detail,
+        }
     )
+    sys.stdout.flush()
 
 
 def config1_race_e2e():

@@ -1,0 +1,339 @@
+"""Perf-regression gate: re-run a small fixed probe set and compare
+median-of-k against the committed baseline envelope.
+
+``make bench-regress`` (CPU-pinned, wired into ``make all``). The probes
+are deliberately tiny — the point is a fast "did this change make it
+slower" tripwire on every build, not a hardware benchmark (that's
+``bench.py`` and the capture suite):
+
+- ``oracle_steady_batch_s``   one fused oracle batch, jit-hot, small
+  bucket (the serving hot path end to end)
+- ``oracle_wavefront_batch_s`` the same batch pinned to the wavefront
+  rung (ops.oracle.forced_scan_rung) — catches regressions the serial
+  rung hides
+- ``snapshot_pack_s``         host-side ClusterSnapshot packing (the
+  host bottleneck the ROADMAP's device-resident item attacks)
+- ``metrics_render_s``        the /metrics exposition render at a
+  realistic series count (observability must not become the overhead)
+
+Comparison contract (benchmarks/artifact.py): numbers are only
+comparable within one host fingerprint. When the committed baseline
+(``benchmarks/perf_baseline.json``) matches this host's fingerprint key,
+it is the reference; otherwise a fresh local baseline is measured first
+in-process (``baseline_source: measured-local``) so the gate still
+catches in-run injection/regression without cross-host false alarms.
+
+Per-metric noise tolerances ride in the baseline (fallbacks in
+``TOLERANCES``; ``BST_PERF_REGRESS_TOLERANCE`` overrides globally). On
+regression the gate exits 1 with structured blame: metric, baseline,
+observed, ratio, tolerance, and the knob diff between the two envelopes.
+
+Test hook: ``BST_PERF_REGRESS_INJECT="<probe>=<factor>"`` stretches that
+probe's observed wall-clock by ``factor`` (a real sleep inside the timed
+region) — how the gate's own failure path is CI-tested without breaking
+real code.
+
+Flags: ``--update-baseline`` rewrites the committed baseline from this
+host; ``--out PATH`` additionally writes the full report JSON (the
+``PERF_<tag>`` capture artifact).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks import artifact  # noqa: E402
+
+BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "perf_baseline.json"
+)
+
+REPEATS = 7
+
+# fallback per-metric ratio ceilings (observed/baseline) when the
+# baseline envelope carries none; sized to CPU CI noise on tiny probes
+TOLERANCES = {
+    "oracle_steady_batch_s": 1.6,
+    "oracle_wavefront_batch_s": 1.6,
+    "snapshot_pack_s": 1.6,
+    "metrics_render_s": 1.6,
+}
+
+
+def _injections() -> dict:
+    """{probe: factor} from BST_PERF_REGRESS_INJECT ("p=2.0[,q=3]")."""
+    raw = os.environ.get("BST_PERF_REGRESS_INJECT", "").strip()
+    out = {}
+    for part in raw.split(","):
+        if "=" not in part:
+            continue
+        name, _, factor = part.partition("=")
+        try:
+            out[name.strip()] = max(float(factor), 1.0)
+        except ValueError:
+            print(
+                f"ignoring malformed BST_PERF_REGRESS_INJECT part {part!r}",
+                file=sys.stderr,
+            )
+    return out
+
+
+def _median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def _timed(fn, repeats: int, inject_factor: float = 1.0):
+    """(median_s, draws) of ``fn`` over ``repeats`` runs; the injection
+    sleep happens INSIDE the timed region so an injected slowdown is a
+    real observed slowdown, not arithmetic."""
+    draws = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        dt = time.perf_counter() - t0
+        if inject_factor > 1.0:
+            time.sleep(dt * (inject_factor - 1.0))
+            dt = time.perf_counter() - t0
+        draws.append(dt)
+    return _median(draws), [round(d, 6) for d in draws]
+
+
+# ---------------------------------------------------------------------------
+# the probe set
+# ---------------------------------------------------------------------------
+
+
+def _build_snapshot(nodes_n: int, groups_n: int):
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot, GroupDemand
+    from batch_scheduler_tpu.sim.scenarios import make_sim_node
+
+    nodes = [
+        make_sim_node(f"n{i:04d}", {"cpu": "32", "memory": "128Gi",
+                                    "pods": "110"})
+        for i in range(nodes_n)
+    ]
+    groups = [
+        GroupDemand(
+            full_name=f"default/gang-{g:03d}",
+            min_member=4,
+            member_request={"cpu": 2000, "memory": 4 * 1024**3},
+            creation_ts=float(g),
+        )
+        for g in range(groups_n)
+    ]
+    return nodes, groups, ClusterSnapshot(nodes, {}, groups)
+
+
+def probe_set():
+    """[(name, warmup_fn_or_None, probe_fn)] — fixed shapes, CPU-fast."""
+    from batch_scheduler_tpu.ops.oracle import (
+        execute_batch_host,
+        forced_scan_rung,
+    )
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot
+    from batch_scheduler_tpu.utils.metrics import Registry
+
+    nodes, groups, snap = _build_snapshot(128, 32)
+    batch_args = snap.device_args()
+    progress_args = snap.progress_args()
+
+    def steady():
+        execute_batch_host(batch_args, progress_args)
+
+    def wavefront():
+        with forced_scan_rung(False, 8):
+            execute_batch_host(batch_args, progress_args)
+
+    big_nodes, big_groups, _ = _build_snapshot(512, 64)
+
+    def pack():
+        ClusterSnapshot(big_nodes, {}, big_groups)
+
+    reg = Registry()
+    for i in range(40):
+        reg.counter(f"bst_probe_counter_{i}_total", "probe").inc(
+            i, path=f"p{i % 5}"
+        )
+        h = reg.histogram(f"bst_probe_hist_{i}_seconds", "probe")
+        for j in range(20):
+            h.observe(0.001 * j, op=f"o{j % 3}")
+
+    def render():
+        reg.render()
+
+    return [
+        ("oracle_steady_batch_s", steady, steady),
+        ("oracle_wavefront_batch_s", wavefront, wavefront),
+        ("snapshot_pack_s", pack, pack),
+        ("metrics_render_s", render, render),
+    ]
+
+
+def measure(probes, repeats: int = REPEATS, injections=None):
+    """{metric: median_s}, {metric: draws} over the probe set."""
+    injections = injections or {}
+    metrics, repeats_out = {}, {}
+    for name, warmup, fn in probes:
+        if warmup is not None:
+            warmup()  # compiles / first-touch outside the clock
+            warmup()  # and once hot, so async dispatch state is steady
+        med, draws = _timed(fn, repeats, injections.get(name, 1.0))
+        metrics[name] = round(med, 6)
+        repeats_out[name] = draws
+    return metrics, repeats_out
+
+
+# ---------------------------------------------------------------------------
+# baseline + comparison
+# ---------------------------------------------------------------------------
+
+
+def load_baseline():
+    try:
+        with open(BASELINE_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def knob_diff(baseline_knobs: dict, current_knobs: dict) -> dict:
+    """{knob: [baseline, current]} for every differing knob."""
+    diff = {}
+    for k in sorted(set(baseline_knobs) | set(current_knobs)):
+        b, c = baseline_knobs.get(k), current_knobs.get(k)
+        if b != c:
+            diff[k] = [b, c]
+    return diff
+
+
+def compare(baseline_doc: dict, observed: dict, tolerance_override=None):
+    """(regressions, comparisons): per-metric ratio vs tolerance."""
+    base_metrics = baseline_doc.get("metrics") or {}
+    base_tol = baseline_doc.get("tolerances") or {}
+    kdiff = knob_diff(
+        baseline_doc.get("knobs") or {}, artifact.capture_knobs()
+    )
+    regressions, comparisons = [], []
+    for name, obs in sorted(observed.items()):
+        base = base_metrics.get(name)
+        if not isinstance(base, (int, float)) or base <= 0:
+            continue
+        tol = (
+            tolerance_override
+            if tolerance_override is not None
+            else base_tol.get(name, TOLERANCES.get(name, 1.6))
+        )
+        ratio = obs / base
+        row = {
+            "metric": name,
+            "baseline": base,
+            "observed": obs,
+            "ratio": round(ratio, 3),
+            "tolerance": tol,
+        }
+        comparisons.append(row)
+        if ratio > tol:
+            regressions.append({**row, "knob_diff": kdiff})
+    return regressions, comparisons
+
+
+def main() -> int:
+    update = "--update-baseline" in sys.argv
+    out_path = None
+    if "--out" in sys.argv:
+        i = sys.argv.index("--out")
+        if i + 1 >= len(sys.argv) or sys.argv[i + 1].startswith("--"):
+            print(
+                "usage: perf_regress.py [--update-baseline] [--out PATH]",
+                file=sys.stderr,
+            )
+            return 2
+        out_path = sys.argv[i + 1]
+    tol_override = None
+    raw_tol = os.environ.get("BST_PERF_REGRESS_TOLERANCE", "").strip()
+    if raw_tol:
+        try:
+            tol_override = float(raw_tol)
+        except ValueError:
+            print(
+                f"ignoring malformed BST_PERF_REGRESS_TOLERANCE={raw_tol!r}",
+                file=sys.stderr,
+            )
+
+    probes = probe_set()
+    fp_key = artifact.fingerprint_key(artifact.host_fingerprint())
+
+    if update:
+        metrics, repeats = measure(probes)
+        doc = artifact.envelope(
+            {
+                "metric": "perf_regress_baseline",
+                "value": metrics["oracle_steady_batch_s"],
+                "unit": "s",
+                "detail": {"repeats": REPEATS},
+            },
+            metrics=metrics,
+            repeats=repeats,
+        )
+        doc["tolerances"] = dict(TOLERANCES)
+        doc["fingerprint_key"] = fp_key
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True, default=str)
+            f.write("\n")
+        print(json.dumps({"updated": BASELINE_PATH, "metrics": metrics}))
+        return 0
+
+    baseline = load_baseline()
+    baseline_source = "committed"
+    if baseline is None or baseline.get("fingerprint_key") != fp_key:
+        # a different host/backend: the committed numbers are not
+        # comparable, so measure a local reference first (injection-free
+        # by construction — the knob only stretches the observed pass)
+        base_metrics, _ = measure(probes)
+        baseline = artifact.envelope(
+            {"metric": "perf_regress_baseline", "value": 0.0, "unit": "s"},
+            metrics=base_metrics,
+        )
+        baseline_source = "measured-local"
+
+    metrics, repeats = measure(probes, injections=_injections())
+    regressions, comparisons = compare(baseline, metrics, tol_override)
+    report = {
+        "metric": "perf_regress_gate",
+        "value": max((c["ratio"] for c in comparisons), default=1.0),
+        "unit": "worst_ratio_vs_baseline",
+        "detail": {
+            "ok": not regressions,
+            "baseline_source": baseline_source,
+            "fingerprint_key": fp_key,
+            "comparisons": comparisons,
+            "regressions": regressions,
+        },
+    }
+    doc = artifact.emit(report, metrics=metrics, repeats=repeats)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True, default=str)
+            f.write("\n")
+    if regressions:
+        print(
+            "PERF REGRESSION: "
+            + "; ".join(
+                f"{r['metric']} {r['baseline']}s -> {r['observed']}s "
+                f"(x{r['ratio']}, tolerance x{r['tolerance']})"
+                for r in regressions
+            ),
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -294,7 +294,9 @@ def main() -> int:
             detail[f"{name}_error"] = repr(e)[:300]
             results[name] = False
     ok = all(results.values())
-    print(json.dumps({"ok": ok, "checks": results, "detail": detail}))
+    from benchmarks import artifact
+
+    artifact.emit({"ok": ok, "checks": results, "detail": detail})
     return 0 if ok else 1
 
 

@@ -230,10 +230,16 @@ def main() -> int:
 
     report["failures"] = failures
     report["ok"] = not failures
+    # the POLICY_* artifact carries the envelope too (host fingerprint,
+    # knobs) so a hardware capture is self-describing
+    from benchmarks import artifact
+
+    doc = artifact.envelope(report)
+    artifact.append_ledger(doc)
     with open(out_path, "w") as f:
-        json.dump(report, f, indent=2, sort_keys=True)
+        json.dump(doc, f, indent=2, sort_keys=True, default=str)
         f.write("\n")
-    print(json.dumps(report, indent=2, sort_keys=True))
+    print(json.dumps(doc, indent=2, sort_keys=True, default=str))
     if failures:
         print(f"POLICY GATE FAILED: {failures}", file=sys.stderr)
         return 1

@@ -435,7 +435,9 @@ def main() -> int:
         if FAILURES:
             summary["ok"] = False
             summary["failures"] = FAILURES
-        print(json.dumps(summary, default=str))
+        from benchmarks import artifact
+
+        artifact.emit(summary)
         return 0 if summary["ok"] else 1
     finally:
         shutil.rmtree(base, ignore_errors=True)

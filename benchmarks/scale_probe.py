@@ -70,8 +70,10 @@ def main() -> int:
     t_batch = (time.perf_counter() - t2) / PIPELINE_N
 
     g_b, n_b, r = snap.shape
-    print(
-        json.dumps(
+    from benchmarks import artifact
+
+    artifact.emit(
+        (
             {
                 "metric": "scale_probe_50kpod_20knode_batch",
                 "value": round(t_batch, 4),

@@ -210,8 +210,10 @@ def main() -> int:
     if wave_t is not None:
         scan_frac_wave = wave_t / (t_score + wave_t)
 
-    print(
-        json.dumps(
+    from benchmarks import artifact
+
+    artifact.emit(
+        (
             {
                 "metric": "oracle_scan_vs_scoring_split_10kpod_5knode",
                 "value": round(scan_frac, 4),

@@ -100,8 +100,10 @@ def main() -> int:
     # O(pods x (groups + nodes)): scaling 2k/1k -> 10k/5k multiplies the
     # per-pod scan by ~5 and the pod count by 5
     extrapolated_10k_s = elapsed * 5 * 5
-    print(
-        json.dumps(
+    from benchmarks import artifact
+
+    artifact.emit(
+        (
             {
                 "metric": "framework_e2e_serial_scorer_2kpod_1knode",
                 "value": round(elapsed, 2),

@@ -322,7 +322,9 @@ def main() -> int:
             ),
         },
     }
-    print(json.dumps(result))
+    from benchmarks import artifact
+
+    artifact.emit(result)
     # rc=1 whenever the partitioned scan cannot beat single-device — on
     # the real backend too, so capture_tpu_artifacts.sh's "kept, no win"
     # branch actually distinguishes a losing mesh from a crash.

@@ -177,8 +177,10 @@ def main() -> int:
     tails["saturated_count_exact"] = 66000
 
     ok = not mismatches
-    print(
-        json.dumps(
+    from benchmarks import artifact
+
+    artifact.emit(
+        (
             {
                 "metric": "pallas_tpu_smoke",
                 "value": 1 if ok else 0,

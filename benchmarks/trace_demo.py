@@ -139,13 +139,15 @@ def main() -> int:
                 sides={t: sorted(p) for t, p in list(by_side.items())[:5]},
             )
 
-        print(json.dumps({
+        from benchmarks import artifact
+
+        artifact.emit({
             "ok": True,
             "trace_path": path,
             "spans": len(events),
             "stitched_traces": len(stitched),
             "verdicts": sorted(verdicts),
-        }))
+        })
         return 0
     finally:
         metrics_srv.shutdown()

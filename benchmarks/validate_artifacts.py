@@ -1,0 +1,178 @@
+"""``make validate-artifacts`` — schema-check every repo-root bench
+artifact against the unified envelope (benchmarks/artifact.py).
+
+Every ``*_r*.json`` artifact at the repo root must either
+
+1. carry the versioned envelope (``schema: bst-bench-envelope/v1``) and
+   validate cleanly against it (per document; JSONL artifacts like the
+   LADDER captures validate line by line), or
+2. be one of the GRANDFATHERED pre-envelope artifacts below — the
+   closed list of files that existed before the envelope did, checked
+   only for being parseable JSON of a recognizable legacy shape.
+
+The grandfather list is frozen: a FUTURE capture (a filename not on the
+list) without the envelope fails the build, so artifact schemas can
+never drift silently again. Exit 1 with a per-file error report.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks import artifact  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Pre-envelope artifacts, frozen at the envelope's introduction (PR 11).
+# Do NOT add new names here — new captures must emit the envelope.
+GRANDFATHERED = {
+    "BENCH_XL_r07.json",
+    "BENCH_r01.json",
+    "BENCH_r02.json",
+    "BENCH_r03.json",
+    "BENCH_r03_early.json",
+    "BENCH_r03_mid.json",
+    "BENCH_r04.json",
+    "BENCH_r05.json",
+    "BENCH_r05_late.json",
+    "HTTP_E2E_r04.json",
+    "HTTP_E2E_r05.json",
+    "LADDER_r02.json",
+    "LADDER_r03_tpu.json",
+    "LADDER_r04_cpu.json",
+    "LADDER_r05_cpu.json",
+    "LADDER_r05_tpu.json",
+    "MULTICHIP_r01.json",
+    "MULTICHIP_r02.json",
+    "MULTICHIP_r03.json",
+    "MULTICHIP_r04.json",
+    "MULTICHIP_r05.json",
+    "SCAN_SPLIT_r05.json",
+    "SCAN_SPLIT_r06_cpu.json",
+    "SERIAL_E2E_r04.json",
+    "SERIAL_E2E_r05.json",
+    "SHARDING_r03.json",
+    "SHARDING_r04.json",
+    "SHARDING_r05.json",
+    "SHARDING_r06.json",
+    "TPU_SMOKE_r03.json",
+    "TPU_SMOKE_r05.json",
+}
+
+
+def _parse_docs(path: str):
+    """Parsed JSON documents in the file: one, or one per JSONL line.
+    Raises ValueError if neither parse works."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        return [json.loads(text)]
+    except ValueError:
+        docs = []
+        for i, line in enumerate(text.splitlines(), 1):
+            if not line.strip():
+                continue
+            try:
+                docs.append(json.loads(line))
+            except ValueError as e:
+                raise ValueError(f"line {i}: {e}") from None
+        if not docs:
+            raise ValueError("no JSON documents")
+        return docs
+
+
+def _nonbench_ok(doc) -> bool:
+    """Artifact families that are NOT bench lines and so never carry the
+    envelope, accepted under ANY filename: Chrome-trace exports
+    (TRACE_<tag>.json), replay-CLI summaries and lockcheck notes written
+    by pre-envelope builds, and driver-written dryrun records."""
+    if not isinstance(doc, dict):
+        return False
+    keys = set(doc)
+    return (
+        "traceEvents" in keys
+        or {"audit_dir", "against", "replayed"} <= keys
+        or {"tag", "lockcheck"} <= keys
+        or {"ok", "rc"} <= keys
+    )
+
+
+def _legacy_ok(doc) -> bool:
+    """The recognizable pre-envelope shapes (grandfathered files only):
+    a bench line ({metric, value, unit}), a subprocess-wrapper record
+    ({rc, tail}), a dryrun record ({ok, rc}), or a note ({tag})."""
+    if not isinstance(doc, dict):
+        return False
+    keys = set(doc)
+    return (
+        {"metric", "value", "unit"} <= keys
+        or {"rc", "tail"} <= keys
+        or {"ok", "rc"} <= keys
+        or "tag" in keys
+        # the r02 ladder wrapper: {round, results: [bench lines]}
+        or ({"round", "results"} <= keys and isinstance(doc["results"], list))
+    )
+
+
+def validate_file(path: str):
+    """Error strings for one artifact (empty list = valid)."""
+    name = os.path.basename(path)
+    try:
+        docs = _parse_docs(path)
+    except (OSError, ValueError) as e:
+        return [f"unparseable: {e}"]
+    errors = []
+    for i, doc in enumerate(docs):
+        where = f"doc {i + 1}: " if len(docs) > 1 else ""
+        if isinstance(doc, dict) and "schema" in doc:
+            errors.extend(where + e for e in artifact.validate(doc))
+        elif _nonbench_ok(doc):
+            continue
+        elif name in GRANDFATHERED:
+            if not _legacy_ok(doc):
+                errors.append(
+                    where + "grandfathered file with an unrecognized "
+                    "legacy shape"
+                )
+        else:
+            errors.append(
+                where + "no envelope (schema field) and not on the "
+                "grandfather list — new artifacts must emit "
+                "benchmarks/artifact.py envelopes"
+            )
+    return errors
+
+
+def main() -> int:
+    paths = sorted(glob.glob(os.path.join(REPO_ROOT, "*_r*.json")))
+    ledger = os.path.join(REPO_ROOT, "PERF_LEDGER.jsonl")
+    if os.path.exists(ledger):
+        paths.append(ledger)
+    report, failed = {}, 0
+    for path in paths:
+        errors = validate_file(path)
+        if errors:
+            failed += 1
+            report[os.path.basename(path)] = errors
+    print(
+        json.dumps(
+            {
+                "ok": failed == 0,
+                "checked": len(paths),
+                "failed": failed,
+                "errors": report,
+            },
+            indent=2,
+            sort_keys=True,
+        )
+    )
+    return 0 if failed == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -279,7 +279,9 @@ def main() -> int:
                 "passed": bool(gate_ok),
             },
         }
-        print(json.dumps(result))
+        from benchmarks import artifact
+
+        artifact.emit(result)
         return 0 if gate_ok else 1
 
     # the small bucket charts demotion cost vs K (serial included for the
@@ -332,7 +334,9 @@ def main() -> int:
             ),
         },
     }
-    print(json.dumps(result))
+    from benchmarks import artifact
+
+    artifact.emit(result)
     return 0 if xl_ok else 1
 
 

@@ -19,6 +19,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # background compile load across a suite that builds hundreds of tiny
 # shapes. Tests that exercise it re-enable via monkeypatch.
 os.environ.setdefault("BST_BUCKET_COST", "0")
+# Same class of background side effect: every jit-cache miss in the suite
+# would append a test-shape line to the user's persistent compile ledger
+# (~/.cache/bst-compile-ledger.jsonl, utils/profiler.py), polluting the
+# cross-run attribution data it exists for. Tests that exercise the
+# ledger pass an explicit path.
+os.environ.setdefault("BST_COMPILE_LEDGER", "off")
 
 import jax  # noqa: E402
 

@@ -219,6 +219,146 @@ def test_debug_trace_and_decisions_endpoints():
         trace_mod.DEFAULT_FLIGHT_RECORDER.clear()
 
 
+def test_label_value_escaping_in_exposition():
+    """Prometheus text-format escaping: backslash, double quote, and
+    newline in a label VALUE must render escaped — one bad node name
+    must not corrupt the whole exposition for every scraper."""
+    reg = Registry()
+    g = reg.gauge("esc_gauge", "help")
+    g.set(1.0, node='say "hi"', path="a\\b", reason="line1\nline2")
+    body = g.render()
+    assert 'node="say \\"hi\\""' in body
+    assert 'path="a\\\\b"' in body
+    assert 'reason="line1\\nline2"' in body
+    # exactly one physical line per sample: the newline never leaks raw
+    sample_lines = [
+        line for line in body.splitlines() if not line.startswith("#")
+    ]
+    assert len(sample_lines) == 1
+    # counters and histogram bucket labels share the same escaping path
+    c = reg.counter("esc_total", "help")
+    c.inc(op='x"y')
+    assert 'op="x\\"y"' in c.render()
+    h = reg.histogram("esc_seconds", "help", buckets=(1.0,))
+    h.observe(0.5, op="p\\q")
+    assert 'op="p\\\\q"' in h.render()
+
+
+def test_help_line_escaping_and_type_lines():
+    """HELP text with backslashes/newlines renders escaped; every metric
+    renders exactly one HELP and one TYPE line of the declared kind."""
+    reg = Registry()
+    reg.counter("h_total", "first line\nsecond \\ line").inc()
+    reg.gauge("h_gauge", "plain").set(2)
+    reg.histogram("h_seconds", "hist help").observe(0.01)
+    body = reg.render()
+    assert "# HELP h_total first line\\nsecond \\\\ line" in body
+    for name, kind in (
+        ("h_total", "counter"), ("h_gauge", "gauge"), ("h_seconds", "histogram")
+    ):
+        assert body.count(f"# HELP {name} ") == 1
+        assert body.count(f"# TYPE {name} {kind}") == 1
+    # no raw newline from HELP text broke a line into a fake sample
+    for line in body.splitlines():
+        assert line.startswith(("#", "h_")), line
+
+
+def test_metrics_content_type_and_index_endpoint():
+    """/metrics answers the Prometheus text content type; /debug/ serves
+    the machine-readable endpoint index and every indexed GET-able
+    surface answers 200 JSON (profile capture excluded — the bare GET
+    reports state only)."""
+    import json
+    import urllib.error
+
+    from batch_scheduler_tpu.utils.metrics import DEBUG_ENDPOINTS
+
+    reg = Registry()
+    reg.counter("ct_total", "h").inc()
+    server = serve_metrics(reg, port=0)
+    try:
+        port = server.server_address[1]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ) as r:
+            assert r.headers["Content-Type"] == "text/plain; version=0.0.4"
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/", timeout=5
+        ) as r:
+            assert "application/json" in r.headers["Content-Type"]
+            index = json.loads(r.read())["endpoints"]
+        assert set(index) == set(DEBUG_ENDPOINTS)
+        for path in index:
+            if path in ("/metrics", "/healthz"):
+                continue
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30
+            ) as r:
+                assert r.status == 200, path
+                assert "application/json" in r.headers["Content-Type"], path
+                json.loads(r.read())
+        # unknown paths still 404
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/nope", timeout=5
+            )
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        server.shutdown()
+
+
+def test_metric_kind_stability_under_concurrent_writes():
+    """The Registry must resolve a name to ONE kind no matter how many
+    threads race the first registration: every same-kind caller gets the
+    same instance, every wrong-kind caller gets TypeError (never a
+    wrong-kind instance), and the rendered exposition carries a single
+    TYPE line for the name."""
+    import threading
+
+    reg = Registry()
+    results, errors = [], []
+    start = threading.Event()
+
+    def register(kind):
+        start.wait(5)
+        for i in range(50):
+            try:
+                m = getattr(reg, kind)(f"race_metric_{i % 10}", "h")
+                if kind == "counter":
+                    m.inc()
+                else:
+                    m.set(1.0)
+                results.append((kind, i % 10, m))
+            except TypeError as e:
+                errors.append((kind, i % 10, e))
+
+    threads = [
+        threading.Thread(target=register, args=(kind,))
+        for kind in ("counter", "gauge", "counter", "gauge")
+    ]
+    for t in threads:
+        t.start()
+    start.set()
+    for t in threads:
+        t.join(10)
+    # per name: one winning kind, all same-kind instances identical, and
+    # every cross-kind attempt raised (never returned the wrong class)
+    for i in range(10):
+        name = f"race_metric_{i}"
+        winners = {id(m) for kind, j, m in results if j == i}
+        kinds = {kind for kind, j, _ in results if j == i}
+        assert len(winners) == 1, name
+        assert len(kinds) == 1, name
+        losing_kinds = {kind for kind, j, _ in errors if j == i}
+        assert kinds.isdisjoint(losing_kinds)
+        body = reg.render()
+        assert body.count(f"# TYPE {name} ") == 1
+    # and writes survived: the winner rendered with nonzero value
+    assert "race_metric_0" in reg.render()
+
+
 def test_cli_metrics_port_flag():
     """--metrics-port 0 on sim binds an ephemeral /metrics endpoint."""
     import argparse

@@ -1,0 +1,130 @@
+"""The unified bench envelope (benchmarks/artifact.py), the artifact
+schema validator, and the perf-regression comparison logic
+(benchmarks/perf_regress.py) — the host-side halves that need no
+benchmark run."""
+
+from __future__ import annotations
+
+import json
+
+from benchmarks import artifact
+from benchmarks.perf_regress import _injections, _timed, compare, knob_diff
+from benchmarks.validate_artifacts import GRANDFATHERED, validate_file
+
+
+def test_envelope_is_additive_and_valid(tmp_path, monkeypatch):
+    monkeypatch.setenv("BST_PERF_LEDGER", str(tmp_path / "ledger.jsonl"))
+    monkeypatch.setenv("BST_TEST_KNOB", "42")
+    result = {
+        "metric": "probe_s",
+        "value": 0.5,
+        "unit": "s",
+        "detail": {"platform": "cpu", "draws": 3, "note": "text"},
+    }
+    doc = artifact.envelope(result)
+    # additive: every legacy key survives at the top level (capture-
+    # script greps keep working)
+    for k in result:
+        assert doc[k] == result[k]
+    assert doc["schema"] == artifact.SCHEMA
+    assert doc["host"]["jax_backend"]
+    assert doc["knobs"].get("BST_TEST_KNOB") == "42"
+    # metrics default: the headline value + numeric detail entries only
+    assert doc["metrics"] == {"probe_s": 0.5, "draws": 3}
+    assert artifact.validate(doc) == []
+    # the ledger append lands one parseable envelope line
+    path = artifact.append_ledger(doc)
+    assert path == str(tmp_path / "ledger.jsonl")
+    line = json.loads((tmp_path / "ledger.jsonl").read_text())
+    assert line["metric"] == "probe_s" and line["schema"] == artifact.SCHEMA
+    # BST_PERF_LEDGER=off disables
+    monkeypatch.setenv("BST_PERF_LEDGER", "off")
+    assert artifact.ledger_path() is None
+
+
+def test_envelope_validation_catches_drift():
+    doc = artifact.envelope({"metric": "m", "value": 1.0, "unit": "s"})
+    assert artifact.validate(doc) == []
+    bad = dict(doc)
+    bad["schema"] = "bst-bench-envelope/v999"
+    assert any("schema" in e for e in artifact.validate(bad))
+    bad = dict(doc)
+    del bad["host"]
+    assert any("host" in e for e in artifact.validate(bad))
+    bad = dict(doc)
+    bad["metrics"] = {"m": "not-a-number"}
+    assert any("metrics" in e for e in artifact.validate(bad))
+    assert artifact.validate([1, 2]) == ["document is not a JSON object"]
+
+
+def test_validate_artifacts_grandfather_and_new_files(tmp_path):
+    # a grandfathered legacy artifact passes as-is
+    legacy = tmp_path / "BENCH_r01.json"
+    legacy.write_text(json.dumps({"metric": "m", "value": 1, "unit": "s"}))
+    assert "BENCH_r01.json" in GRANDFATHERED
+    assert validate_file(str(legacy)) == []
+    # a NEW capture without the envelope fails (no silent drift)
+    new = tmp_path / "BENCH_r99.json"
+    new.write_text(json.dumps({"metric": "m", "value": 1, "unit": "s"}))
+    errors = validate_file(str(new))
+    assert errors and "grandfather" in errors[0]
+    # the same file with the envelope passes
+    new.write_text(
+        json.dumps(artifact.envelope({"metric": "m", "value": 1, "unit": "s"}))
+    )
+    assert validate_file(str(new)) == []
+    # JSONL artifacts validate per line, with line-indexed blame
+    jl = tmp_path / "LADDER_r99.json"
+    good = artifact.envelope({"metric": "m", "value": 1, "unit": "s"})
+    jl.write_text(json.dumps(good) + "\n" + json.dumps({"metric": "m"}) + "\n")
+    errors = validate_file(str(jl))
+    assert errors and errors[0].startswith("doc 2: ")
+    # unparseable files are one clear error, not a crash
+    broken = tmp_path / "SMASH_r01.json"
+    broken.write_text("{not json")
+    assert "unparseable" in validate_file(str(broken))[0]
+
+
+def test_perf_regress_compare_blames_regressions():
+    baseline = {
+        "metrics": {"probe_a_s": 0.100, "probe_b_s": 0.200},
+        "tolerances": {"probe_a_s": 1.5, "probe_b_s": 1.5},
+        "knobs": {"BST_X": "1"},
+    }
+    observed = {"probe_a_s": 0.105, "probe_b_s": 0.500}
+    regressions, comparisons = compare(baseline, observed)
+    assert len(comparisons) == 2
+    assert [r["metric"] for r in regressions] == ["probe_b_s"]
+    blame = regressions[0]
+    # structured blame: metric, baseline, observed, ratio, knob diff
+    assert blame["baseline"] == 0.200 and blame["observed"] == 0.500
+    assert blame["ratio"] == 2.5 and blame["tolerance"] == 1.5
+    assert "knob_diff" in blame
+    # the knob diff names what changed between the two envelopes
+    diff = knob_diff({"BST_X": "1", "BST_Y": "a"}, {"BST_X": "2"})
+    assert diff == {"BST_X": ["1", "2"], "BST_Y": ["a", None]}
+    # a global tolerance override wins over per-metric ones
+    regressions, _ = compare(baseline, observed, tolerance_override=3.0)
+    assert regressions == []
+    # unknown metrics in the baseline are skipped, never divide-by-zero
+    regressions, comparisons = compare({"metrics": {"z": 0}}, {"z": 1.0})
+    assert regressions == [] and comparisons == []
+
+
+def test_perf_regress_injection_hook(monkeypatch):
+    """BST_PERF_REGRESS_INJECT stretches the timed region itself — the
+    observed slowdown is real wall-clock, which is what makes the gate's
+    failure path honestly testable."""
+    monkeypatch.setenv(
+        "BST_PERF_REGRESS_INJECT", "probe_a_s=3.0,junk,bad=x"
+    )
+    inj = _injections()
+    assert inj == {"probe_a_s": 3.0}
+
+    med_plain, draws = _timed(lambda: None, repeats=3)
+    assert len(draws) == 3
+    base = 0.005
+    med_inj, _ = _timed(
+        lambda: __import__("time").sleep(base), repeats=3, inject_factor=3.0
+    )
+    assert med_inj >= base * 2.5  # ~3x the probe's own wall-clock

@@ -1,0 +1,315 @@
+"""The device profiler + perf observatory (utils/profiler.py): on-demand
+jax.profiler capture, device-memory sampling, the compile ledger, the
+perf report, and the teardown drain for the telemetry daemon threads.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+from batch_scheduler_tpu.utils import profiler
+
+
+def _small_snapshot(n_nodes: int = 16, resource: str = "cpu"):
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot, GroupDemand
+    from batch_scheduler_tpu.sim.scenarios import make_sim_node
+
+    nodes = [
+        make_sim_node(
+            f"n{i:03d}",
+            {"cpu": "8", "memory": "32Gi", "pods": "110"},
+        )
+        for i in range(n_nodes)
+    ]
+    groups = [
+        GroupDemand(
+            "default/probe", 2, member_request={resource: 1000}
+        )
+    ]
+    return ClusterSnapshot(nodes, {}, groups)
+
+
+def test_capture_profile_writes_bounded_trace_dir(tmp_path):
+    """/debug/profile's engine: a capture on CPU produces a loadable
+    (non-empty) trace dir under the configured --profile-dir, the
+    capture counter advances, and old captures are pruned oldest-first
+    so the dir stays bounded."""
+    profiler.configure(profile_dir=str(tmp_path))
+    try:
+        out = profiler.capture_profile(0.1)
+        assert out["ok"], out
+        assert out["trace_dir"].startswith(str(tmp_path))
+        assert os.path.isdir(out["trace_dir"])
+        assert out["files"] >= 1  # the profiler wrote real trace files
+        state = profiler.profile_state()
+        assert state["captures"] >= 1 and not state["busy"]
+        assert state["last_capture"]["trace_dir"] == out["trace_dir"]
+
+        # bounded-size dir: with keep=1 the first capture is pruned
+        out2 = profiler.capture_profile(0.1)
+        assert out2["ok"], out2
+        profiler._prune_captures(str(tmp_path), keep=1)
+        assert not os.path.exists(out["trace_dir"])
+        assert os.path.isdir(out2["trace_dir"])
+    finally:
+        profiler.configure(profile_dir=None)
+
+
+def test_capture_profile_rejects_concurrent_capture(tmp_path, monkeypatch):
+    """The jax profiler is a global singleton: a second capture while one
+    is in flight answers busy instead of corrupting it."""
+    profiler.configure(profile_dir=str(tmp_path))
+    try:
+        started = threading.Event()
+        release = threading.Event()
+        real_sleep = profiler.time.sleep
+
+        def slow_sleep(_s):
+            started.set()
+            release.wait(10)
+
+        monkeypatch.setattr(profiler.time, "sleep", slow_sleep)
+        results = []
+        t = threading.Thread(
+            target=lambda: results.append(profiler.capture_profile(0.1))
+        )
+        t.start()
+        assert started.wait(10)
+        second = profiler.capture_profile(0.1)
+        assert second == {"ok": False, "error": "capture already in progress"}
+        release.set()
+        monkeypatch.setattr(profiler.time, "sleep", real_sleep)
+        t.join(30)
+        assert results and results[0]["ok"]
+        # shutdown() with no capture in flight is immediate — and it
+        # CLOSES the profiler: a capture starting after teardown would
+        # re-create the exit-abort class, so it must be refused until
+        # the next configure() (the bring-up call) reopens
+        assert profiler.shutdown(timeout=5.0)
+        assert profiler.capture_profile(0.1) == {
+            "ok": False, "error": "profiler shut down"
+        }
+        assert profiler.profile_state()["closed"] is True
+        profiler.configure(profile_dir=str(tmp_path))
+        assert profiler.profile_state()["closed"] is False
+    finally:
+        profiler.configure(profile_dir=None)
+
+
+def test_device_memory_sampler_is_cpu_noop():
+    """On a backend with no memory_stats (CPU) the sampler thread exits
+    after its first empty pass — a no-op, not a spinning daemon — and
+    the bst_device_* gauges stay UNREGISTERED ("absent on CPU" means
+    absent from /metrics too: a registered-but-never-set gauge renders
+    as 0, which would read as bytes_limit==0 to the HBM-headroom
+    consumers this sampler feeds)."""
+    import jax
+
+    from batch_scheduler_tpu.utils.metrics import Registry
+
+    reg = Registry()
+    sampler = profiler.DeviceMemorySampler(interval_s=0.5, registry=reg)
+    assert sampler.stop(timeout=5.0)
+    if jax.default_backend() == "cpu":
+        assert sampler.sample_once() is None
+        assert profiler.sample_device_memory() is None
+        assert reg.get("bst_device_bytes_in_use") is None
+        assert reg.get("bst_device_peak_bytes") is None
+        assert reg.get("bst_device_bytes_limit") is None
+        assert "bst_device" not in reg.render()
+
+
+def test_compile_ledger_records_and_persists(tmp_path):
+    ledger = profiler.CompileLedger(path=str(tmp_path / "ledger.jsonl"))
+    ledger.record(64, 1024, "serial", False, 1.25, backend="cpu")
+    ledger.record(64, 1024, "serial", False, 0.75, backend="cpu")
+    ledger.record(64, 1024, "wavefront", True, 2.0, backend="cpu")
+    rep = ledger.report()
+    assert rep["totals"]["64x1024/serial"]["compiles"] == 2
+    assert rep["totals"]["64x1024/serial"]["dispatch_seconds"] == 2.0
+    assert rep["totals"]["64x1024/wavefront/donated"]["compiles"] == 1
+    assert len(rep["recent"]) == 3
+    # persisted JSONL: one parseable line per entry, cross-run evidence
+    lines = [
+        json.loads(line)
+        for line in (tmp_path / "ledger.jsonl").read_text().splitlines()
+    ]
+    assert len(lines) == 3
+    assert lines[0]["g_bucket"] == 64 and lines[0]["rung"] == "serial"
+    assert lines[2]["donated"] is True
+    assert all("ts" in e and "pid" in e for e in lines)
+
+
+def test_compile_ledger_disabled_path(tmp_path, monkeypatch):
+    monkeypatch.setenv("BST_COMPILE_LEDGER", "off")
+    ledger = profiler.CompileLedger()
+    ledger.record(8, 16, "serial", False, 0.5)
+    assert ledger.entry_count() == 1  # in-memory view still works
+    assert ledger.report()["jsonl"] is None
+
+
+def test_dispatch_feeds_compile_ledger_and_drain(tmp_path, monkeypatch):
+    """A jit-cache miss on the serving dispatch path lands one compile-
+    ledger entry keyed by bucket shape + rung, and the telemetry daemon
+    threads it spawns join cleanly (the teardown drain)."""
+    from batch_scheduler_tpu.ops import oracle as oracle_mod
+
+    fresh = profiler.CompileLedger(path=str(tmp_path / "cl.jsonl"))
+    monkeypatch.setattr(profiler, "COMPILE_LEDGER", fresh)
+    # an exotic resource name changes the lane schema -> a jit signature
+    # this test process has never compiled -> a guaranteed cache miss
+    snap = _small_snapshot(resource="example.com/profiler-probe")
+    host, _ = oracle_mod.execute_batch_host(
+        snap.device_args(), snap.progress_args()
+    )
+    telemetry = host["telemetry"]
+    if telemetry.get("compiled"):
+        assert fresh.entry_count() >= 1
+        entry = fresh.report()["recent"][-1]
+        assert entry["g_bucket"] == telemetry["g_bucket"]
+        assert entry["n_bucket"] == telemetry["n_bucket"]
+        assert entry["dispatch_seconds"] > 0
+        assert (tmp_path / "cl.jsonl").exists()
+    # the bucket-cost analysis thread the compile spawned must join
+    assert oracle_mod.drain_telemetry_threads(timeout=120.0)
+
+
+def test_perf_report_shape():
+    from batch_scheduler_tpu.utils.metrics import DEFAULT_REGISTRY
+
+    # ensure at least one phase histogram + the scan mix have data
+    DEFAULT_REGISTRY.histogram(
+        "bst_oracle_pack_seconds", "Host snapshot-pack time per batch"
+    ).observe(0.01)
+    DEFAULT_REGISTRY.counter(
+        "bst_scan_batches_total", "Oracle batches by assignment-scan path"
+    ).inc(path="serial")
+    report = profiler.perf_report()
+    assert set(report) >= {
+        "phases", "scan_rung_mix", "device_memory", "compile_ledger",
+        "profiler",
+    }
+    pack = report["phases"]["bst_oracle_pack_seconds"]
+    assert pack["count"] >= 1 and pack["p95_s"] >= pack["p50_s"] >= 0
+    assert report["scan_rung_mix"].get("serial", 0) >= 1
+    assert "totals" in report["compile_ledger"]
+
+
+def test_perf_and_profile_endpoints(tmp_path):
+    """The acceptance wiring: /debug/perf serves the report and
+    /debug/profile?seconds=N produces a loadable trace dir on CPU, over
+    HTTP on the metrics endpoint."""
+    from batch_scheduler_tpu.utils.metrics import Registry, serve_metrics
+
+    profiler.configure(profile_dir=str(tmp_path))
+    server = serve_metrics(Registry(), port=0)
+    try:
+        port = server.server_address[1]
+
+        def get(path, timeout=120):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout
+            ) as r:
+                return r.headers["Content-Type"], json.loads(r.read())
+
+        ctype, perf = get("/debug/perf")
+        assert "application/json" in ctype
+        assert "phases" in perf and "compile_ledger" in perf
+
+        ctype, state = get("/debug/profile")
+        assert "application/json" in ctype
+        assert state["busy"] is False
+
+        _, capture = get("/debug/profile?seconds=0.1")
+        assert capture["ok"], capture
+        assert os.path.isdir(capture["trace_dir"])
+        assert capture["files"] >= 1
+
+        # a malformed duration answers 400 and runs NO capture (it would
+        # block a handler thread and consume the global profiler slot);
+        # nan parses as a float but is junk — same treatment
+        before = profiler.profile_state()["captures"]
+        for bad in ("5s", "nan", "inf"):
+            try:
+                get(f"/debug/profile?seconds={bad}")
+                assert False, f"expected 400 for {bad}"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400, bad
+                assert json.loads(e.read())["ok"] is False
+        assert profiler.profile_state()["captures"] == before
+    finally:
+        server.shutdown()
+        profiler.shutdown(timeout=60.0)
+        profiler.configure(profile_dir=None)
+
+
+def test_sim_dispatch_ahead_with_compile_warmer_exits_cleanly():
+    """The README known-issue regression: ``sim --dispatch-ahead
+    --compile-warmer`` used to abort at interpreter exit ("terminate
+    called without an active exception") after a successful run — the
+    warmer's precompiles each spawned an unjoined bucket-cost-analysis
+    daemon thread that died inside XLA teardown. The combination must
+    now exit 0 with no abort, in a real subprocess (the abort only
+    fires at interpreter exit)."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "batch_scheduler_tpu", "sim",
+            "--scenario", "synthetic", "--nodes", "8", "--groups", "2",
+            "--members", "2", "--dispatch-ahead", "--compile-warmer",
+            "--timeout", "90", "--settle", "2",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "terminate called" not in proc.stderr
+    assert "Aborted" not in proc.stderr
+
+
+def test_scorer_drain_joins_warmer_and_telemetry_threads():
+    """The --dispatch-ahead --compile-warmer exit-abort fix: a scorer
+    draining with a live warmer stops the warmer FIRST and then joins
+    the telemetry threads its precompiles spawned — drain must return
+    True (nothing left racing XLA teardown)."""
+    from batch_scheduler_tpu.core.oracle_scorer import OracleScorer
+    from batch_scheduler_tpu.sim.harness import SimCluster
+    from batch_scheduler_tpu.sim.scenarios import (
+        make_member_pods,
+        make_sim_group,
+        make_sim_node,
+    )
+
+    cluster = SimCluster(
+        scorer="oracle",
+        oracle_dispatch_ahead=True,
+        oracle_compile_warmer=True,
+    )
+    cluster.add_nodes(
+        [make_sim_node(f"d{i}", {"cpu": "8", "memory": "32Gi",
+                                 "pods": "110"}) for i in range(8)]
+    )
+    cluster.create_group(make_sim_group("drain-g", 2))
+    cluster.start()
+    try:
+        cluster.create_pods(make_member_pods("drain-g", 2, {"cpu": "1"}))
+        assert cluster.wait_for(
+            lambda: cluster.scheduler.stats["binds"] >= 2, timeout=60.0
+        )
+        oracle = cluster.runtime.operation.oracle
+        assert isinstance(oracle, OracleScorer)
+        assert oracle._warmer is not None
+        assert oracle.drain_background(timeout=120.0) is True
+        # idempotent: a second drain (factory.stop calls it again) holds
+        assert oracle.drain_background(timeout=30.0) is True
+    finally:
+        cluster.stop()
