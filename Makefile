@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all build vet analyze stamp-coupling test test-cpu test-tier1 bench bench-scan bench-pipeline bench-policy bench-sharding bench-xl bench-regress validate-artifacts native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
+.PHONY: all build vet analyze stamp-coupling test test-cpu test-tier1 bench bench-scan bench-pipeline bench-delta bench-policy bench-sharding bench-xl bench-regress validate-artifacts native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
 
 all: vet analyze native test bench-regress validate-artifacts
 
@@ -66,6 +66,15 @@ bench-scan:
 # transition (docs/pipelining.md)
 bench-pipeline:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/pipeline_gate.py
+
+# device-resident state CI gate (CPU): a churned refresh via jit'd
+# scatter-updates must beat the host full-repack refresh path at the
+# 5k-node/10k-pod shape, plan digests bit-identical across
+# delta-applied / keyframe-resynced / full-repack state (local AND over
+# the wire), and a forced generation mismatch must resync from a
+# keyframe (docs/pipelining.md "Device-resident state")
+bench-delta:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/delta_gate.py
 
 # BASELINE.json measurement ladder, configs 1-6 (asserts regressions)
 ladder:

@@ -53,6 +53,16 @@ COUPLED_GROUPS: Dict[str, List[str]] = {
         "batch_scheduler_tpu/ops/oracle.py::_member_capacity",
         "batch_scheduler_tpu/ops/pallas_assign.py::_cap_t",
     ],
+    # the device-resident state spine: the rows the host-side delta packer
+    # rewrites must be exactly the rows the device holder scatter-applies
+    # (same indices, same packed values) — delta-applied state diverging
+    # from a full repack is the one failure bench-delta exists to forbid
+    "delta-row-scatter": [
+        "batch_scheduler_tpu/ops/snapshot.py::DeltaSnapshotPacker._delta_rows",
+        "batch_scheduler_tpu/ops/snapshot.py::DeltaSnapshotPacker._group_rows",
+        "batch_scheduler_tpu/ops/device_state.py::_scatter_impl",
+        "batch_scheduler_tpu/ops/device_state.py::DeviceStateHolder.apply_rows",
+    ],
 }
 
 

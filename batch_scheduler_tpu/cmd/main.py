@@ -222,6 +222,15 @@ def build_parser() -> argparse.ArgumentParser:
              "to `serve` instead)",
     )
     sim.add_argument(
+        "--device-state", choices=["on", "off"], default=None,
+        help="device-resident cluster state (docs/pipelining.md): keep "
+             "the packed [N,R]/[G,R] buffers on device across batches and "
+             "apply churned rows as jit'd scatter-updates (with "
+             "--oracle-addr: ship only churned-row wire deltas + "
+             "generation to the sidecar). Equivalent to BST_DEVICE_STATE; "
+             "default on",
+    )
+    sim.add_argument(
         "--policy", default=None, metavar="TERMS",
         help="enable the vectorized policy engine (docs/policy.md): a "
              "comma list of terms from "
@@ -642,6 +651,14 @@ def cmd_sim(args) -> int:
     cfg = load_scheduler_config(args.config)
     if args.scorer:
         cfg.plugin_config.scorer = args.scorer
+    if args.device_state is not None:
+        # the flag is sugar over the knob: scorers (and any subprocesses)
+        # resolve BST_DEVICE_STATE at construction
+        import os
+
+        os.environ["BST_DEVICE_STATE"] = (
+            "1" if args.device_state == "on" else "0"
+        )
 
     tracing = _maybe_configure_trace(args)
     _maybe_serve_metrics(args)

@@ -391,6 +391,7 @@ class OracleScorer:
         audit_log=None,
         identity_audit_every: int = 0,
         policy_engine=None,
+        device_state: Optional[bool] = None,
     ):
         # Dirty tracking is a GENERATION pair, not a bool: refresh() clears
         # staleness by recording the generation it observed BEFORE packing
@@ -448,6 +449,25 @@ class OracleScorer:
         self.policy_engine = policy_engine
         self._packer = DeltaSnapshotPacker(policy_engine=policy_engine)
         self._schema = None
+        # Device-resident cluster state (ops.device_state, docs/
+        # pipelining.md "Device-resident state"): the packed [N,R]/[G,R]
+        # buffers stay committed on device across batches and each pack's
+        # churned rows apply as one jit'd scatter-update, so the refresh
+        # path stops re-uploading a full snapshot per batch. BST_DEVICE_
+        # STATE=0 (or device_state=False) restores the upload-per-batch
+        # path. RemoteScorer nulls this out: its device lives behind the
+        # sidecar, which keeps the mirror (wire deltas).
+        if device_state is None:
+            from ..ops.device_state import device_state_enabled
+
+            device_state = device_state_enabled()
+        self._device_state = None
+        if device_state:
+            from ..ops.device_state import DeviceStateHolder
+
+            self._device_state = DeviceStateHolder(
+                mesh=scan_mesh, label="scorer"
+            )
         # Dispatch-ahead (docs/pipelining.md): after each published batch,
         # a daemon thread packs and dispatches the NEXT batch speculatively
         # so a later ensure_fresh can publish it without a blocking device
@@ -550,7 +570,22 @@ class OracleScorer:
         with trace_mod.span("oracle.snapshot_pack", cat="oracle"):
             snap = self._packer.pack(nodes, node_req, demands)
         self._schema = self._packer.schema
+        self._note_pack(snap)
         return snap, dirty_gen, version_base, time.perf_counter() - t0
+
+    def _note_pack(self, snap) -> None:  # lock-held: _refresh_lock
+        """Per-pack hook, under the refresh lock: bring the device-resident
+        state up to this pack (EVERY pack, including dispatch-ahead packs
+        whose batch is later discarded — the holder mirrors the PACKER's
+        buffers, so generation contiguity survives a discarded batch).
+        RemoteScorer overrides this to feed its wire-delta cursors."""
+        if self._device_state is None:
+            return
+        with trace_mod.span("oracle.device_state_sync", cat="oracle"):
+            snap.device_state_args = self._device_state.sync(snap)
+            snap.device_state_policy_cols = (
+                self._device_state.sync_policy_cols(snap)
+            )
 
     def _refresh_traced(self, cluster, status_cache: PGStatusCache) -> None:
         snap, dirty_gen, version_base, pack_s = self._pack_current(
@@ -743,9 +778,14 @@ class OracleScorer:
         the scorer always dispatches from host numpy snapshots, so the
         donated buffer is fresh per batch; gated to the dispatch-ahead
         pipeline (where the warmer warms the matching donated signature)
-        and to backends where donation buys anything."""
+        and to backends where donation buys anything. Always False while
+        device-resident state is live: those dispatches run FROM the
+        resident buffers, which donation would consume (the donation
+        moved into the scatter-update; ops.device_state)."""
         from ..ops.oracle import donation_supported
 
+        if self._device_state is not None:
+            return False
         return self.dispatch_ahead and donation_supported()
 
     def _execute(self, snap: ClusterSnapshot):
@@ -755,9 +795,26 @@ class OracleScorer:
         policy = snap.policy_payload()
         if policy is not None and self.policy_engine is not None:
             self.policy_engine.note_batch()
+        # Device-resident path: dispatch from the resident buffers the
+        # _note_pack sync produced for exactly this pack. donate=False is
+        # load-bearing — a donated dispatch would consume the resident
+        # state the next delta scatters into (the donation lives in the
+        # scatter-update instead; ops.device_state module docstring).
+        batch_args = getattr(snap, "device_state_args", None)
+        donate = self._donate()
+        if batch_args is None:
+            batch_args = snap.device_args()
+        else:
+            donate = False
+            if policy is not None:
+                device_cols = getattr(
+                    snap, "device_state_policy_cols", None
+                )
+                if device_cols is not None:
+                    policy = (device_cols, policy[1], policy[2])
         host, device_result = execute_batch_host(
-            snap.device_args(), snap.progress_args(),
-            scan_mesh=self.scan_mesh, donate=self._donate(),
+            batch_args, snap.progress_args(),
+            scan_mesh=self.scan_mesh, donate=donate,
             policy=policy,
         )
 
@@ -1037,6 +1094,11 @@ class OracleScorer:
         if self.dispatch_ahead or self.spec_served or self.spec_discarded:
             out["spec_served"] = self.spec_served
             out["spec_discarded"] = self.spec_discarded
+        if self._device_state is not None:
+            ds = self._device_state.stats()
+            out["device_state_generation"] = ds["generation"]
+            out["device_rows_scattered"] = ds["rows_scattered"]
+            out["device_keyframes"] = ds["keyframes"]
         if self._warmer is not None:
             out.update(self._warmer.stats())
         if self.audit_log is not None:

@@ -1,0 +1,452 @@
+"""Device-resident cluster state: jit'd scatter-update deltas end-to-end.
+
+The pipelined oracle batch runs ~10ms at TPU speed while the host-side
+snapshot path costs 3-4x that per refresh (BENCH_r05_late) — the host
+became the bottleneck. This module keeps the packed ``[N, R]`` / ``[G, R]``
+lane buffers (and the node-side policy columns) RESIDENT on device across
+batches and applies each refresh's churned rows as one jit'd scatter-update
+(donated where the backend supports it, per the PR-4 donation discipline),
+instead of re-uploading a freshly host-packed snapshot every batch — the
+inference-server pattern of keeping hot state device-resident and shipping
+only deltas.
+
+``DeviceStateHolder`` is the state owner, used in two places:
+
+- the in-process scorer (core.oracle_scorer.OracleScorer) syncs it from
+  every ``DeltaSnapshotPacker`` pack under the refresh lock and dispatches
+  batches from the resident buffers;
+- the sidecar (service.server) keeps one per connection as its mirror of
+  the client's state, fed by DELTA_SCHEDULE_REQ wire frames
+  (service/protocol.py) so ``RemoteScorer`` ships only churned rows +
+  generation.
+
+Residency invalidation (docs/pipelining.md "Device-resident state"): any
+generation gap, schema change, node-list change, group-set change, bucket
+change, or layout flip resyncs from a full keyframe — the audit-log
+keyframe+delta discipline applied to live state. Bit-identity of
+delta-applied state against a full repack is gated by ``make bench-delta``
+and re-verified in production by the identity auditor.
+
+Donation interaction: a batch dispatched FROM resident buffers must never
+donate them (``donate_argnums`` would consume the state the next delta
+scatters into), so the scorer and executor force ``donate=False`` on this
+path — the donation moves into the scatter-update itself, whose input
+buffer is superseded by its output by construction.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from functools import lru_cache
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+__all__ = [
+    "DeviceStateHolder",
+    "device_state_enabled",
+    "device_state_report",
+]
+
+
+# ---------------------------------------------------------------------------
+# env knob
+# ---------------------------------------------------------------------------
+
+_ENV = "BST_DEVICE_STATE"
+_env_warned = [False]
+
+
+def device_state_enabled() -> bool:
+    """Parse-guarded BST_DEVICE_STATE read: default ON; ``0``/``off``/
+    ``false`` disables, anything unrecognised warns once and keeps the
+    default (a typo'd knob must never crash — the BST_SCAN_WAVE idiom)."""
+    import os
+
+    raw = os.environ.get(_ENV, "").strip().lower()
+    if raw in ("", "1", "on", "true", "yes"):
+        return True
+    if raw in ("0", "off", "false", "no"):
+        return False
+    if not _env_warned[0]:
+        _env_warned[0] = True
+        import sys
+
+        print(
+            f"ignoring unrecognised {_ENV}={raw!r}; device-resident "
+            "state stays enabled",
+            file=sys.stderr,
+        )
+    return True
+
+
+# ---------------------------------------------------------------------------
+# the jit'd scatter-update
+# ---------------------------------------------------------------------------
+
+_ROWS_BUCKET_MIN = 8
+
+
+def _rows_bucket(m: int) -> int:
+    """Power-of-two bucket for the churned-row count so scatter jit
+    signatures stay bounded (same rationale as ops.bucketing)."""
+    return max(_ROWS_BUCKET_MIN, 1 << max(m - 1, 0).bit_length())
+
+
+def _scatter_impl(buf, idx, rows):
+    """THE row-application formula: resident buffer rows at ``idx`` become
+    ``rows`` — it must mirror exactly the host-side rewrites of
+    ops.snapshot.DeltaSnapshotPacker._delta_rows / _group_rows (the
+    analysis/coupling.py "delta-row-scatter" group): same indices, same
+    packed values, or delta-applied state diverges from a full repack."""
+    return buf.at[idx].set(rows)
+
+
+@lru_cache(maxsize=None)
+def _scatter_fn(donated: bool, sharding):
+    """Jitted scatter variant per (donation, output sharding). The donated
+    form hands the resident buffer to XLA for in-place reuse — the caller
+    rebinds the holder's reference to the returned array and never touches
+    the donated handle again. ``sharding`` (a NamedSharding, hashable)
+    pins the output layout so sharded resident buffers stay node-sharded
+    across scatters instead of drifting to whatever GSPMD infers."""
+    if sharding is not None:
+        if donated:
+            return jax.jit(
+                _scatter_impl, donate_argnums=(0,), out_shardings=sharding
+            )
+        return jax.jit(_scatter_impl, out_shardings=sharding)
+    if donated:
+        return jax.jit(_scatter_impl, donate_argnums=(0,))
+    return jax.jit(_scatter_impl)
+
+
+def _pad_update(idx: np.ndarray, rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Bucket-pad a scatter update by REPEATING the last (index, row) pair:
+    duplicate indices all write the same value, so the result is
+    deterministic under any scatter ordering and no padding sentinel can
+    alias a real row (an out-of-range pad index would need masking; a
+    repeated real one needs nothing)."""
+    m = int(idx.shape[0])
+    b = _rows_bucket(m)
+    if b == m:
+        return idx, rows
+    pad = b - m
+    idx = np.concatenate([idx, np.repeat(idx[-1:], pad)])
+    rows = np.concatenate([rows, np.repeat(rows[-1:], pad, axis=0)])
+    return idx, rows
+
+
+# ---------------------------------------------------------------------------
+# holder registry (the /debug/perf device-state section)
+# ---------------------------------------------------------------------------
+
+_holders_lock = threading.Lock()
+_holders: "weakref.WeakSet" = weakref.WeakSet()  # guarded-by: _holders_lock
+
+
+def device_state_report() -> list:
+    """Per-holder state summary for /debug/perf (utils.profiler)."""
+    with _holders_lock:
+        live = list(_holders)
+    return [h.stats() for h in live]
+
+
+class DeviceStateHolder:
+    """Owner of one set of device-resident oracle buffers.
+
+    Thread contract: every method that touches resident state takes
+    ``_lock``. In the scorer the callers already serialize under the
+    refresh lock (the dispatch-ahead thread packs/executes inside it), and
+    on the sidecar the per-connection worker serializes requests while the
+    DeviceExecutor thread runs the closures — the holder's own lock makes
+    the object safe regardless of which of those threads touches it.
+    """
+
+    def __init__(self, mesh=None, label: str = "local"):
+        self.mesh = mesh
+        self.label = label
+        self._lock = threading.Lock()
+        self.generation = 0  # guarded-by: _lock
+        # resident device arrays; None until the first keyframe
+        self._alloc = None  # guarded-by: _lock
+        self._requested = None  # guarded-by: _lock
+        self._group_req = None  # guarded-by: _lock
+        self._shardings: Optional[dict] = None  # guarded-by: _lock
+        self._flat_nodes = False  # guarded-by: _lock
+        # node-side policy columns (docs/policy.md), single-device only
+        self._policy_hash = None  # guarded-by: _lock
+        self._policy_dom = None  # guarded-by: _lock
+        self.rows_scattered = 0  # guarded-by: _lock
+        self.keyframes: Dict[str, int] = {}  # guarded-by: _lock
+        self.deltas_applied = 0  # guarded-by: _lock
+        with _holders_lock:
+            _holders.add(self)
+
+    # -- internals ----------------------------------------------------------
+
+    def _donate(self) -> bool:
+        from .oracle import donation_supported
+
+        return donation_supported()
+
+    def _place(self, name: str, host: np.ndarray):  # lock-held: _lock
+        if self._shardings is not None and name in self._shardings:
+            return jax.device_put(host, self._shardings[name])
+        return jax.device_put(host)
+
+    def _note_keyframe(self, reason: str) -> None:  # lock-held: _lock
+        self.keyframes[reason] = self.keyframes.get(reason, 0) + 1
+        from ..utils.metrics import DEFAULT_REGISTRY
+
+        DEFAULT_REGISTRY.counter(
+            "bst_device_keyframe_resyncs_total",
+            "Device-resident state resyncs from a full keyframe, by reason",
+        ).inc(reason=reason)
+        DEFAULT_REGISTRY.gauge(
+            "bst_device_state_generation",
+            "Generation of the device-resident cluster state (per holder)",
+        ).set(float(self.generation), holder=self.label)
+
+    def _scatter(self, buf, idx: np.ndarray, rows: np.ndarray):  # lock-held: _lock
+        idx = np.ascontiguousarray(idx, dtype=np.int32)
+        rows = np.ascontiguousarray(rows)
+        idx, rows = _pad_update(idx, rows)
+        sharding = None
+        if self._shardings is not None:
+            try:
+                sharding = buf.sharding
+            except AttributeError:
+                sharding = None
+        return _scatter_fn(self._donate(), sharding)(buf, idx, rows)
+
+    # -- state transitions --------------------------------------------------
+
+    def current_generation(self) -> int:
+        """Locked read for cross-thread reporting (the sidecar handler
+        reads it while the executor thread applies deltas)."""
+        with self._lock:
+            return self.generation
+
+    def reset(self) -> None:
+        """Drop residency (the next sync/apply keyframes)."""
+        with self._lock:
+            self._alloc = self._requested = self._group_req = None
+            self._policy_hash = self._policy_dom = None
+            self.generation = 0
+
+    def keyframe(self, batch_args: tuple, generation: int, reason: str) -> tuple:
+        """Install a full snapshot as the resident state and return the
+        device-ready batch args. ``batch_args`` is the canonical padded
+        7-tuple (ops.bucketing.pad_oracle_batch order); the big [N,R] /
+        [G,R] buffers are committed to device (node-sharded on a mesh, per
+        parallel.mesh.snapshot_specs), the O(G) tail stays host — it is
+        refresh-fresh by definition and tiny."""
+        (alloc, requested, group_req, remaining, fit_mask, group_valid,
+         order) = batch_args
+        with self._lock:
+            if self.mesh is not None:
+                from ..parallel.mesh import snapshot_shardings
+                from .oracle import scan_sharded_active
+
+                self._flat_nodes = scan_sharded_active()
+                self._shardings = snapshot_shardings(
+                    self.mesh,
+                    broadcast_mask=np.asarray(fit_mask).shape[0] == 1,
+                    flat_nodes=self._flat_nodes,
+                )
+            self._alloc = self._place("alloc", np.asarray(alloc))
+            self._requested = self._place("requested", np.asarray(requested))
+            self._group_req = self._place("group_req", np.asarray(group_req))
+            self._policy_hash = self._policy_dom = None
+            self.generation = int(generation)
+            self._note_keyframe(reason)
+            return (
+                self._alloc, self._requested, self._group_req,
+                remaining, fit_mask, group_valid, order,
+            )
+
+    def apply_rows(
+        self,
+        base_generation: int,
+        generation: int,
+        node_update: Optional[Tuple[np.ndarray, np.ndarray]],
+        group_update: Optional[Tuple[np.ndarray, np.ndarray]],
+        small_args: tuple,
+    ) -> Optional[tuple]:
+        """Scatter churned rows into the resident buffers and return the
+        device-ready batch args, or None when the delta is NOT applicable —
+        no resident state, a generation gap (a dropped/duplicated delta
+        must resync, never silently score stale rows), or a padded-shape
+        mismatch (bucket growth). ``small_args`` is the padded
+        ``(remaining, fit_mask, group_valid, order)`` tail."""
+        remaining, fit_mask, group_valid, order = small_args
+        with self._lock:
+            if self._requested is None or self._group_req is None:
+                return None
+            if int(base_generation) != self.generation:
+                return None
+            node_shape = tuple(self._requested.shape)
+            group_shape = tuple(self._group_req.shape)
+            scattered = 0
+            if node_update is not None and len(node_update[0]):
+                idx, rows = node_update
+                # both bounds: a negative index would WRAP in .at[].set and
+                # silently corrupt an unrelated resident row — refuse with
+                # a resync instead, exactly like an out-of-range one
+                if (
+                    rows.shape[1:] != node_shape[1:]
+                    or int(np.max(idx)) >= node_shape[0]
+                    or int(np.min(idx)) < 0
+                ):
+                    return None
+                self._requested = self._scatter(self._requested, idx, rows)
+                scattered += int(len(idx))
+            if group_update is not None and len(group_update[0]):
+                idx, rows = group_update
+                if (
+                    rows.shape[1:] != group_shape[1:]
+                    or int(np.max(idx)) >= group_shape[0]
+                    or int(np.min(idx)) < 0
+                ):
+                    return None
+                self._group_req = self._scatter(self._group_req, idx, rows)
+                scattered += int(len(idx))
+            self.generation = int(generation)
+            self.deltas_applied += 1
+            self.rows_scattered += scattered
+            from ..utils.metrics import DEFAULT_REGISTRY
+
+            if scattered:
+                DEFAULT_REGISTRY.counter(
+                    "bst_device_rows_scattered_total",
+                    "Churned rows applied to device-resident state via "
+                    "jit'd scatter-updates (vs a full re-upload)",
+                ).inc(scattered)
+            DEFAULT_REGISTRY.gauge(
+                "bst_device_state_generation",
+                "Generation of the device-resident cluster state (per "
+                "holder)",
+            ).set(float(self.generation), holder=self.label)
+            return (
+                self._alloc, self._requested, self._group_req,
+                remaining, fit_mask, group_valid, order,
+            )
+
+    # -- the scorer-side entry point ---------------------------------------
+
+    def sync(self, snap) -> tuple:
+        """Bring the resident state up to ``snap`` (a DeltaSnapshotPacker
+        product) and return device-ready batch args. Scatter-applies the
+        pack's churned rows when the delta record is contiguous with the
+        resident generation; otherwise resyncs from a keyframe with the
+        reason counted (bst_device_keyframe_resyncs_total)."""
+        batch_args = snap.device_args()
+        delta = getattr(snap, "delta", None)
+        if delta is None:
+            return self.keyframe(batch_args, 0, "untracked")
+        if delta.kind != "delta":
+            return self.keyframe(batch_args, delta.generation, delta.reason)
+        with self._lock:
+            resident = self._requested is not None
+            gen = self.generation
+            shape_ok = resident and (
+                tuple(self._requested.shape) == snap.requested.shape
+                and tuple(self._group_req.shape) == snap.group_req.shape
+            )
+            layout_ok = True
+            if resident and self.mesh is not None:
+                from .oracle import scan_sharded_active
+
+                layout_ok = self._flat_nodes == scan_sharded_active()
+        if not resident:
+            return self.keyframe(batch_args, delta.generation, "first")
+        if delta.generation != gen + 1:
+            return self.keyframe(batch_args, delta.generation, "generation")
+        if not shape_ok:
+            return self.keyframe(batch_args, delta.generation, "bucket")
+        if not layout_ok:
+            return self.keyframe(batch_args, delta.generation, "layout")
+        out = self.apply_rows(
+            gen,
+            delta.generation,
+            (delta.node_rows, np.asarray(snap.requested)[delta.node_rows]),
+            (delta.group_rows, np.asarray(snap.group_req)[delta.group_rows]),
+            (snap.remaining, snap.fit_mask, snap.group_valid, snap.order),
+        )
+        if out is None:  # raced invalidation: resync, never stale rows
+            return self.keyframe(batch_args, delta.generation, "generation")
+        return out
+
+    def sync_policy_cols(self, snap) -> Optional[tuple]:
+        """Device-resident node policy columns (single-device only — the
+        policy rung demotes the mesh layouts anyway, docs/policy.md): the
+        [N,H] label-hash and [N] spread-domain columns ride the same
+        generation stream; the O(G) group columns rebuild per pack and
+        stay host. Returns the snapshot's policy_cols tuple with the node
+        arrays swapped for resident device buffers, or the host tuple
+        untouched when residency does not apply."""
+        cols = snap.policy_cols
+        if cols is None:
+            with self._lock:
+                self._policy_hash = self._policy_dom = None
+            return None
+        if self.mesh is not None:
+            return cols
+        prio, aff, anti, gang_dom, node_hash, node_dom = cols
+        delta = getattr(snap, "delta", None)
+        with self._lock:
+            resident = (
+                self._policy_hash is not None
+                and tuple(self._policy_hash.shape) == node_hash.shape
+                and tuple(self._policy_dom.shape) == node_dom.shape
+            )
+            if (
+                not resident
+                or delta is None
+                or delta.kind != "delta"
+            ):
+                self._policy_hash = jax.device_put(np.asarray(node_hash))
+                self._policy_dom = jax.device_put(np.asarray(node_dom))
+            elif len(delta.policy_node_rows):
+                idx = delta.policy_node_rows
+                self._policy_hash = self._scatter(
+                    self._policy_hash, idx, np.asarray(node_hash)[idx]
+                )
+                self._policy_dom = self._scatter(
+                    self._policy_dom, idx, np.asarray(node_dom)[idx]
+                )
+                self.rows_scattered += int(len(idx))
+                from ..utils.metrics import DEFAULT_REGISTRY
+
+                DEFAULT_REGISTRY.counter(
+                    "bst_device_rows_scattered_total",
+                    "Churned rows applied to device-resident state via "
+                    "jit'd scatter-updates (vs a full re-upload)",
+                ).inc(int(len(idx)))
+            return (
+                prio, aff, anti, gang_dom, self._policy_hash,
+                self._policy_dom,
+            )
+
+    # -- observability ------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {
+                "label": self.label,
+                "generation": self.generation,
+                "resident": self._requested is not None,
+                "deltas_applied": self.deltas_applied,
+                "rows_scattered": self.rows_scattered,
+                "keyframes": dict(self.keyframes),
+            }
+            if self._requested is not None:
+                out["n_bucket"] = int(self._requested.shape[0])
+                out["g_bucket"] = int(self._group_req.shape[0])
+            if self.mesh is not None:
+                out["mesh"] = True
+                out["flat_nodes"] = self._flat_nodes
+        return out

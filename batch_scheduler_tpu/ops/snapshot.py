@@ -29,8 +29,51 @@ __all__ = [
     "GroupDemand",
     "ClusterSnapshot",
     "DeltaSnapshotPacker",
+    "SnapshotDelta",
     "node_requested_from_pods",
 ]
+
+
+_EMPTY_IDX = np.zeros(0, dtype=np.int32)
+
+
+@dataclass
+class SnapshotDelta:
+    """What changed between two consecutive packs — the churned-row record
+    the device-resident state layer (ops.device_state, docs/pipelining.md
+    "Device-resident state") applies as jit'd scatter-updates instead of
+    re-uploading a full snapshot.
+
+    ``kind`` is ``"delta"`` when the packer's cached schema, node list and
+    group set all held, so the previous pack's packed ``[N, R]`` /
+    ``[G, R]`` buffers become this pack's by rewriting exactly the listed
+    rows (the row VALUES live in the emitted ClusterSnapshot's padded
+    arrays at the same indices — padding appends, so unpadded indices are
+    valid in padded space). ``kind == "keyframe"`` means the buffers must
+    be replaced wholesale; ``reason`` says why (the invalidation rules of
+    docs/pipelining.md, extended to residency):
+
+    - ``first``      — no previous pack
+    - ``node-list``  — node names/order changed (positional keys broke)
+    - ``node-churn`` — a node OBJECT changed or a churned row stopped
+                       packing under the cached schema (the packer's
+                       full-repack rules; the lane shifts may have moved)
+    - ``group-set``  — the group name set/order changed (group row
+                       indices are positional)
+
+    ``generation`` increments once per pack; consumers verify contiguity
+    (``generation == applied + 1``) before scattering, and resync from a
+    keyframe on any gap — never silently score stale rows.
+    """
+
+    generation: int
+    kind: str  # "delta" | "keyframe"
+    reason: str = ""  # keyframe reason, "" for deltas
+    # churned REQUESTED node rows / group demand rows / node policy rows,
+    # unpadded row indices (int32); empty on keyframes
+    node_rows: np.ndarray = field(default_factory=lambda: _EMPTY_IDX)
+    group_rows: np.ndarray = field(default_factory=lambda: _EMPTY_IDX)
+    policy_node_rows: np.ndarray = field(default_factory=lambda: _EMPTY_IDX)
 
 
 @dataclass
@@ -236,6 +279,9 @@ class ClusterSnapshot:
         # pre-policy code (the zero-policy identity of docs/policy.md).
         self.policy_engine = policy_engine
         self.policy_cols = None
+        # churned-row record stamped by DeltaSnapshotPacker.pack (None on
+        # directly-constructed snapshots: no previous pack to delta from)
+        self.delta: Optional["SnapshotDelta"] = None
         if policy_engine is not None and policy_engine.enabled:
             from ..policy.terms import (
                 DOMAIN_BUCKETS,
@@ -409,6 +455,14 @@ class DeltaSnapshotPacker:
         self.full_repacks = 0
         self.delta_packs = 0
         self.last_rows_rewritten = 0
+        # Churned-row delta emission (SnapshotDelta): one record per pack,
+        # consumed by the device-resident state layer (ops.device_state)
+        # and the wire delta path (service.client RemoteScorer). The
+        # generation increments on EVERY pack — consumers detect gaps.
+        self.generation = 0
+        self.last_delta: Optional[SnapshotDelta] = None
+        self._group_names: Optional[tuple] = None
+        self._group_prev: Optional[np.ndarray] = None  # last [G, R] rows
         # Policy column persistence (docs/policy.md "Packing"): node
         # label-hash / spread-domain rows keyed by each node's label dict,
         # so label churn rewrites only touched rows — independent of the
@@ -422,6 +476,7 @@ class DeltaSnapshotPacker:
         self._policy_hash: Optional[np.ndarray] = None
         self._policy_dom: Optional[np.ndarray] = None
         self.policy_rows_rewritten = 0
+        self._policy_rows_idx: list = []
 
     # -- internals ----------------------------------------------------------
 
@@ -454,19 +509,25 @@ class DeltaSnapshotPacker:
         self.full_repacks += 1
         self.last_rows_rewritten = 2 * len(nodes)
 
-    def _delta_rows(self, nodes, req_dicts) -> int:
-        """Rewrite churned REQUESTED rows in place; raises _SchemaMiss when
-        a churned row stops packing exactly under the cached schema — or
-        when any node OBJECT changed (resource_version bump). Alloc-side
-        churn always full-repacks: the lane shifts are sized from the
-        observed alloc peaks, and a delta rewrite under the cached shifts
-        could keep a stale (coarser) granularity after the peak node
-        shrank — the old per-batch schema reuse re-collected on exactly
-        this key, and the packer must not weaken that. Node updates are
-        rare (scheduler-side accounting moves ``requested``, not the node
-        object), so the steady state stays on the delta path."""
+    def _delta_rows(self, nodes, req_dicts) -> list:
+        """Rewrite churned REQUESTED rows in place and return their row
+        indices; raises _SchemaMiss when a churned row stops packing
+        exactly under the cached schema — or when any node OBJECT changed
+        (resource_version bump). Alloc-side churn always full-repacks: the
+        lane shifts are sized from the observed alloc peaks, and a delta
+        rewrite under the cached shifts could keep a stale (coarser)
+        granularity after the peak node shrank — the old per-batch schema
+        reuse re-collected on exactly this key, and the packer must not
+        weaken that. Node updates are rare (scheduler-side accounting
+        moves ``requested``, not the node object), so the steady state
+        stays on the delta path.
+
+        Coupled with ops.device_state.DeviceStateHolder.apply_rows: the
+        rows this method rewrites host-side are exactly the rows the
+        device holder scatter-updates (analysis/coupling.py
+        "delta-row-scatter" group)."""
         schema = self.schema
-        rewritten = 0
+        rewritten: list = []
         req_memo = self._req_row_memo
         for i, n in enumerate(nodes):
             if (n.metadata.name, n.metadata.resource_version) != self._alloc_keys[i]:
@@ -482,7 +543,7 @@ class DeltaSnapshotPacker:
                     req_memo[key] = row
                 self._requested[i] = row
                 self._req_dicts[i] = dict(d)
-                rewritten += 1
+                rewritten.append(i)
         return rewritten
 
     def _group_rows(self, groups) -> np.ndarray:
@@ -526,6 +587,7 @@ class DeltaSnapshotPacker:
             self._policy_labels = [None] * n
         rewritten = 0
         truncated = 0
+        rewritten_idx: list = []
         for i, node in enumerate(nodes):
             labels = node.metadata.labels or {}
             key = tuple(sorted(labels.items()))
@@ -536,8 +598,10 @@ class DeltaSnapshotPacker:
             self._policy_dom[i] = dom
             self._policy_labels[i] = key
             rewritten += 1
+            rewritten_idx.append(i)
             truncated += trunc
         self.policy_rows_rewritten = rewritten
+        self._policy_rows_idx = rewritten_idx
         from ..utils.metrics import DEFAULT_REGISTRY
 
         if rewritten:
@@ -565,23 +629,68 @@ class DeltaSnapshotPacker:
         alloc_dicts = [n.status.allocatable for n in nodes]
         req_dicts = [node_requested.get(n.metadata.name, {}) for n in nodes]
         names = tuple(n.metadata.name for n in nodes)
+        group_names = tuple(g.full_name for g in groups)
 
         if names != self._node_names:
             # node list changed: the policy row cache is positionally keyed
             self._policy_hash = None
 
+        had_prev = self._alloc is not None
+        keyframe_reason = None
+        node_idx: list = []
         group_req = None
-        if self._alloc is not None and names == self._node_names:
+        if had_prev and names == self._node_names:
             try:
-                rewritten = self._delta_rows(nodes, req_dicts)
+                node_idx = self._delta_rows(nodes, req_dicts)
                 group_req = self._group_rows(groups)
                 self.delta_packs += 1
-                self.last_rows_rewritten = rewritten
+                self.last_rows_rewritten = len(node_idx)
             except self._SchemaMiss:
                 group_req = None
+                keyframe_reason = "node-churn"
+        elif had_prev:
+            keyframe_reason = "node-list"
+        else:
+            keyframe_reason = "first"
         if group_req is None:
             self._full_repack(nodes, alloc_dicts, req_dicts, groups)
             group_req = self._group_rows(groups)
+
+        # group-side churn: with the group NAME SET stable, row indices are
+        # positional and the per-row diff against the previous pack is the
+        # scatter list; a changed set invalidates positional indices (and
+        # the lane-side delta stays host-valid — only CONSUMERS of the
+        # record must resync from a keyframe)
+        group_idx: list = []
+        if keyframe_reason is None:
+            if (
+                group_names != self._group_names
+                or self._group_prev is None
+                or self._group_prev.shape != group_req.shape
+            ):
+                keyframe_reason = "group-set"
+            elif len(groups):
+                group_idx = np.nonzero(
+                    (group_req != self._group_prev).any(axis=1)
+                )[0].tolist()
+        self._group_names = group_names
+        self._group_prev = group_req  # read-only on both sides; no copy
+
+        node_policy = self._policy_node_rows(nodes)
+        self.generation += 1
+        if keyframe_reason is None:
+            delta = SnapshotDelta(
+                self.generation,
+                "delta",
+                node_rows=np.asarray(node_idx, np.int32),
+                group_rows=np.asarray(group_idx, np.int32),
+                policy_node_rows=np.asarray(self._policy_rows_idx, np.int32),
+            )
+        else:
+            delta = SnapshotDelta(
+                self.generation, "keyframe", reason=keyframe_reason
+            )
+        self.last_delta = delta
 
         from ..utils.metrics import DEFAULT_REGISTRY
 
@@ -590,7 +699,7 @@ class DeltaSnapshotPacker:
             "Node lane rows rewritten by the delta snapshot packer "
             "(2N on a full repack)",
         ).inc(self.last_rows_rewritten)
-        return ClusterSnapshot(
+        snap = ClusterSnapshot(
             nodes,
             node_requested,
             groups,
@@ -599,5 +708,7 @@ class DeltaSnapshotPacker:
             requested_lanes=self._requested,  # ClusterSnapshot copies
             group_req_lanes=group_req,  # freshly allocated per pack
             policy_engine=self.policy_engine,
-            node_policy_lanes=self._policy_node_rows(nodes),
+            node_policy_lanes=node_policy,
         )
+        snap.delta = delta
+        return snap

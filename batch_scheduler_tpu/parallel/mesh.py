@@ -26,6 +26,7 @@ from ..ops import oracle as okern
 
 __all__ = [
     "make_mesh",
+    "snapshot_shardings",
     "shard_snapshot_args",
     "sharded_schedule_batch",
     "sharded_collective_counts",
@@ -178,6 +179,45 @@ def make_mesh(
     return Mesh(np.asarray(devs).reshape(grid), axis_names=("groups", "nodes"))
 
 
+def snapshot_specs(
+    mesh: Mesh, broadcast_mask: bool, flat_nodes: bool = False
+) -> dict:
+    """The canonical per-array PartitionSpecs of one oracle batch — THE
+    single source for ``shard_snapshot_args`` and the device-resident
+    state holder (ops.device_state), so a resident buffer scattered in
+    place keeps exactly the layout a freshly placed snapshot would get."""
+    nodes_axes = tuple(mesh.axis_names) if flat_nodes else "nodes"
+    # A broadcast [1,N] fit mask (uniform-feasibility fast path) has no
+    # group extent to split — shard its node axis only.
+    if broadcast_mask:
+        mask_spec = P(None, nodes_axes)
+    else:
+        mask_spec = (
+            P(None, nodes_axes) if flat_nodes else P("groups", "nodes")
+        )
+    return {
+        "alloc": P(nodes_axes, None),
+        "requested": P(nodes_axes, None),
+        "group_req": P("groups", None),
+        "remaining": P("groups"),
+        "fit_mask": mask_spec,
+        "group_valid": P("groups"),
+        "order": P(),
+    }
+
+
+def snapshot_shardings(
+    mesh: Mesh, broadcast_mask: bool, flat_nodes: bool = False
+) -> dict:
+    """``snapshot_specs`` resolved to NamedShardings on ``mesh``."""
+    return {
+        k: NamedSharding(mesh, s)
+        for k, s in snapshot_specs(
+            mesh, broadcast_mask, flat_nodes=flat_nodes
+        ).items()
+    }
+
+
 def shard_snapshot_args(
     mesh: Mesh, args: tuple, flat_nodes: bool = False
 ) -> tuple:
@@ -194,24 +234,9 @@ def shard_snapshot_args(
     resharding collective for the leftover lanes.
     """
     (alloc, requested, group_req, remaining, fit_mask, group_valid, order) = args
-    nodes_axes = tuple(mesh.axis_names) if flat_nodes else "nodes"
-    # A broadcast [1,N] fit mask (uniform-feasibility fast path) has no
-    # group extent to split — shard its node axis only.
-    if fit_mask.shape[0] == 1:
-        mask_spec = P(None, nodes_axes)
-    else:
-        mask_spec = (
-            P(None, nodes_axes) if flat_nodes else P("groups", "nodes")
-        )
-    spec = {
-        "alloc": P(nodes_axes, None),
-        "requested": P(nodes_axes, None),
-        "group_req": P("groups", None),
-        "remaining": P("groups"),
-        "fit_mask": mask_spec,
-        "group_valid": P("groups"),
-        "order": P(),
-    }
+    spec = snapshot_specs(
+        mesh, broadcast_mask=fit_mask.shape[0] == 1, flat_nodes=flat_nodes
+    )
     named = dict(
         alloc=alloc,
         requested=requested,

@@ -25,6 +25,7 @@ from ..core.oracle_scorer import OracleScorer, conservative_cpu_batch
 from ..ops.snapshot import ClusterSnapshot
 from ..utils.errors import (
     CircuitOpenError,
+    DeltaResyncRequired,
     OracleDeadlineError,
     OracleTransportError,
     StaleBatchError,
@@ -217,6 +218,51 @@ class OracleClient:
         except ValueError as e:  # truncated/garbled payload: stream damage
             raise OracleTransportError(f"undecodable response: {e}") from e
 
+    def delta_schedule(
+        self,
+        kind: int,
+        base_generation: int,
+        new_generation: int,
+        body,
+        deadline_ms: Optional[int] = None,
+        audit_id: Optional[str] = None,
+        policy_fp: Optional[str] = None,
+    ) -> proto.ScheduleResponse:
+        """One device-resident-state batch (docs/pipelining.md
+        "Device-resident state"): ``body`` is a full ScheduleRequest when
+        ``kind`` is DELTA_KEYFRAME (installs/refreshes the server's
+        per-connection mirror at ``new_generation``) or a
+        DeltaScheduleRequest of churned rows on top of
+        ``base_generation``. A DELTA_RESYNC answer raises
+        DeltaResyncRequired — in-band, never retried: the caller resends
+        a keyframe."""
+        trace_ctx = trace_mod.current_context() if trace_mod.enabled() else None
+        self.last_telemetry = None
+        if kind == proto.DELTA_KEYFRAME:
+            payload = proto.pack_delta_keyframe(new_generation, body)
+        else:
+            payload = proto.pack_delta_rows(
+                base_generation, new_generation, body
+            )
+        resp_type, resp = self._round_trip(
+            proto.MsgType.DELTA_SCHEDULE_REQ,
+            payload,
+            deadline_ms=deadline_ms,
+            trace_ctx=trace_ctx,
+            audit_id=audit_id,
+            policy_fp=policy_fp,
+        )
+        if resp_type == proto.MsgType.DELTA_RESYNC:
+            raise DeltaResyncRequired(proto.unpack_delta_resync(resp))
+        if resp_type != proto.MsgType.SCHEDULE_RESP:
+            raise OracleTransportError(
+                f"unexpected response type {resp_type} (desynced stream)"
+            )
+        try:
+            return proto.unpack_schedule_response(resp)
+        except ValueError as e:  # truncated/garbled payload: stream damage
+            raise OracleTransportError(f"undecodable response: {e}") from e
+
     def row(
         self,
         kind: str,
@@ -280,6 +326,21 @@ class _ClientSlot:
         return self._parent.schedule(
             req, deadline_ms, audit_id=audit_id, policy_fp=policy_fp,
             _slot=self._idx,
+        )
+
+    def delta_schedule(
+        self,
+        kind: int,
+        base_generation: int,
+        new_generation: int,
+        body,
+        deadline_ms: Optional[int] = None,
+        audit_id: Optional[str] = None,
+        policy_fp: Optional[str] = None,
+    ) -> proto.ScheduleResponse:
+        return self._parent.delta_schedule(
+            kind, base_generation, new_generation, body, deadline_ms,
+            audit_id=audit_id, policy_fp=policy_fp, _slot=self._idx,
         )
 
     def row(
@@ -545,6 +606,31 @@ class ResilientOracleClient:
             slot=_slot,
         )
 
+    def delta_schedule(
+        self,
+        kind: int,
+        base_generation: int,
+        new_generation: int,
+        body,
+        deadline_ms: Optional[int] = None,
+        audit_id: Optional[str] = None,
+        policy_fp: Optional[str] = None,
+        _slot: int = 0,
+    ) -> proto.ScheduleResponse:
+        d = (
+            self.deadline_ms
+            if deadline_ms is None
+            else self._check_deadline(deadline_ms)
+        )
+        return self._call(
+            "delta_schedule",
+            lambda c: c.delta_schedule(
+                kind, base_generation, new_generation, body, deadline_ms=d,
+                audit_id=audit_id, policy_fp=policy_fp,
+            ),
+            slot=_slot,
+        )
+
     def row(
         self,
         kind: str,
@@ -563,6 +649,51 @@ class ResilientOracleClient:
             lambda c: c.row(kind, group_index, batch_seq, deadline_ms=d),
             slot=_slot,
         )
+
+
+class _DeltaCursor:
+    """Per-connection-lane wire-delta state (docs/pipelining.md
+    "Device-resident state"): which generation the server's mirror on THIS
+    lane holds, and the union of churned rows packed since — batches
+    alternate lanes, so each lane's delta spans every pack since that lane
+    last synced. Touched only under the scorer's refresh lock (_note_pack
+    and _execute both run inside it)."""
+
+    __slots__ = ("server_gen", "synced", "pending_nodes", "pending_groups",
+                 "need_keyframe")
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        """Forget the server's state (reconnect, resync, fallback, any
+        error whose server-side effect is unknown): the next batch on this
+        lane is a keyframe."""
+        self.server_gen = 0
+        self.synced = False
+        self.need_keyframe = True
+        self.pending_nodes: set = set()
+        self.pending_groups: set = set()
+
+    def note(self, delta) -> None:
+        """Fold one pack's SnapshotDelta in. A keyframe-kind record (full
+        repack / node-list / group-set change) invalidates positional row
+        indices — this lane must resync from a keyframe."""
+        if delta is None or delta.kind != "delta":
+            self.need_keyframe = True
+            self.pending_nodes.clear()
+            self.pending_groups.clear()
+            return
+        if self.synced and not self.need_keyframe:
+            self.pending_nodes.update(delta.node_rows.tolist())
+            self.pending_groups.update(delta.group_rows.tolist())
+
+    def mark_synced(self, generation: int) -> None:
+        self.server_gen = generation
+        self.synced = True
+        self.need_keyframe = False
+        self.pending_nodes.clear()
+        self.pending_groups.clear()
 
 
 class RemoteScorer(OracleScorer):
@@ -608,7 +739,10 @@ class RemoteScorer(OracleScorer):
         background_client: OracleClient = None,
         fallback: str = "deny",
     ):
-        super().__init__()
+        # device_state=False: this process's device lives behind the
+        # sidecar — the server keeps the resident mirror, fed by the wire
+        # deltas below, so a local holder would only duplicate the upload
+        super().__init__(device_state=False)
         if fallback not in self.FALLBACK_MODES:
             raise ValueError(
                 f"unknown fallback {fallback!r} (use one of {self.FALLBACK_MODES})"
@@ -638,6 +772,32 @@ class RemoteScorer(OracleScorer):
             "bst_oracle_degraded",
             "1 while the remote scorer serves the conservative CPU fallback",
         )
+        # Wire deltas (docs/pipelining.md "Device-resident state"): ship
+        # only churned rows + generation; the sidecar keeps the
+        # device-resident mirror per connection. Gated to resilient
+        # transports (``would_attempt`` — resync recovery closes the lane
+        # and must be able to re-dial; a plain OracleClient keeps full
+        # snapshots) and to BST_DEVICE_STATE. Disproven once against an
+        # old peer (in-band "unknown message type"), the process falls
+        # back to full snapshots permanently — bit-identical either way.
+        from ..ops.device_state import device_state_enabled
+
+        self._cursors = [_DeltaCursor() for _ in self._clients]
+        self._wire_delta_ok = device_state_enabled() and all(
+            hasattr(c, "delta_schedule") and hasattr(c, "would_attempt")
+            for c in self._clients
+        )
+        self._wire_delta_counter = DEFAULT_REGISTRY.counter(
+            "bst_oracle_wire_delta_batches_total",
+            "Remote batches by wire encoding: churned-row delta, full "
+            "keyframe (mirror install/resync), or plain full snapshot "
+            "(delta path off or peer without it)",
+        )
+        self._wire_resyncs = DEFAULT_REGISTRY.counter(
+            "bst_oracle_wire_delta_resyncs_total",
+            "DELTA_RESYNC answers received (sidecar mirror refused a "
+            "delta: generation gap / reconnect) — each forces a keyframe",
+        )
 
     def close(self) -> None:
         for c in self._clients:
@@ -656,6 +816,118 @@ class RemoteScorer(OracleScorer):
             self._fallback_batches.inc()
         self.degraded = flag
         self._degraded_gauge.set(1 if flag else 0)
+
+    def _note_pack(self, snap) -> None:  # lock-held: _refresh_lock
+        """Feed each lane's wire-delta cursor with this pack's churned-row
+        record (the local device-state sync the base class does here is
+        the sidecar's job on this path)."""
+        delta = getattr(snap, "delta", None)
+        for cursor in self._cursors:
+            cursor.note(delta)
+
+    def _build_delta(self, snap, cursor) -> proto.DeltaScheduleRequest:
+        """The churned rows this lane's mirror is missing, read from the
+        snapshot's padded arrays (indices are unpadded-space, a prefix of
+        padded space — same row values; the server scatters them into its
+        padded mirror at the same indices)."""
+        node_idx = np.asarray(sorted(cursor.pending_nodes), dtype=np.int32)
+        group_idx = np.asarray(sorted(cursor.pending_groups), dtype=np.int32)
+        return proto.DeltaScheduleRequest(
+            node_idx=node_idx,
+            node_rows=np.asarray(snap.requested)[node_idx],
+            group_idx=group_idx,
+            group_rows=np.asarray(snap.group_req)[group_idx],
+            remaining=snap.remaining,
+            fit_mask=snap.fit_mask,
+            group_valid=snap.group_valid,
+            order=snap.order,
+            min_member=snap.min_member,
+            scheduled=snap.scheduled,
+            matched=snap.matched,
+            ineligible=snap.ineligible,
+            creation_rank=snap.creation_rank,
+            n=int(snap.alloc.shape[0]),
+            g=int(snap.group_req.shape[0]),
+            r=int(snap.alloc.shape[1]),
+        )
+
+    def _drop_lane(self, client, cursor) -> None:
+        """Close a lane whose stream may carry stale replies (a resync
+        after a generation gap) so the next call re-dials clean, and
+        forget the server state that died with it."""
+        try:
+            client.close()
+        except Exception:  # noqa: BLE001 — already tearing the lane down
+            pass
+        cursor.reset()
+
+    def _wire_schedule(self, client, cursor, snap, req, audit_id, policy_fp):
+        """One remote batch, delta-encoded when this lane's mirror can
+        take it: churned rows + generation (DELTA_ROWS), a full keyframe
+        when the mirror needs (re)installing, or a plain full snapshot
+        when the delta path is off / the peer predates it. Every encoding
+        yields the same executed batch server-side — bit-identity is the
+        bench-delta gate's claim, not an optimisation hope."""
+        delta = getattr(snap, "delta", None)
+        if not self._wire_delta_ok or delta is None:
+            self._wire_delta_counter.inc(kind="full")
+            return client.schedule(req, audit_id=audit_id, policy_fp=policy_fp)
+        gen = delta.generation
+        if cursor.synced and not cursor.need_keyframe:
+            n, g = int(snap.alloc.shape[0]), int(snap.group_req.shape[0])
+            # a delta wider than half the state costs more than a
+            # keyframe (rows + indices vs rows): send the keyframe
+            if (
+                len(cursor.pending_nodes) <= max(n // 2, 1)
+                and len(cursor.pending_groups) <= max(g // 2, 1)
+            ):
+                try:
+                    resp = client.delta_schedule(
+                        proto.DELTA_ROWS, cursor.server_gen, gen,
+                        self._build_delta(snap, cursor),
+                        audit_id=audit_id, policy_fp=policy_fp,
+                    )
+                    cursor.mark_synced(gen)
+                    self._wire_delta_counter.inc(kind="delta")
+                    return resp
+                except DeltaResyncRequired:
+                    # the mirror refused (generation gap — dropped or
+                    # duplicated frame, or a reconnect emptied it). The
+                    # stream beyond a gap may carry stale replies: drop
+                    # the lane, then resync from a keyframe below.
+                    self._wire_resyncs.inc()
+                    self._drop_lane(client, cursor)
+                except RuntimeError as e:
+                    if "unknown message type" not in str(e):
+                        raise
+                    # old peer: no MsgType 14 — full snapshots, forever
+                    self._wire_delta_ok = False
+                    self._wire_delta_counter.inc(kind="full")
+                    return client.schedule(
+                        req, audit_id=audit_id, policy_fp=policy_fp
+                    )
+        try:
+            resp = client.delta_schedule(
+                proto.DELTA_KEYFRAME, 0, gen, req,
+                audit_id=audit_id, policy_fp=policy_fp,
+            )
+            cursor.mark_synced(gen)
+            self._wire_delta_counter.inc(kind="keyframe")
+            return resp
+        except DeltaResyncRequired:
+            # a keyframe is unconditionally applicable; an answer here
+            # means the stream itself is desynced — re-dial and fall
+            # back to the plain full snapshot for this batch
+            self._wire_resyncs.inc()
+            self._drop_lane(client, cursor)
+            self._wire_delta_counter.inc(kind="full")
+            return client.schedule(req, audit_id=audit_id, policy_fp=policy_fp)
+        except RuntimeError as e:
+            if "unknown message type" not in str(e):
+                raise
+            self._wire_delta_ok = False
+            self._wire_delta_counter.inc(kind="full")
+            return client.schedule(req, audit_id=audit_id, policy_fp=policy_fp)
 
     def _execute(self, snap: ClusterSnapshot):
         # fit_mask may be the [1,N] broadcast fast path; the wire carries
@@ -678,7 +950,9 @@ class RemoteScorer(OracleScorer):
         # _execute calls are serialized by the scorer's _refresh_lock;
         # alternating here means a background batch runs on the connection
         # the CURRENT batch's rows are not being read from
-        client = self._clients[self._next]
+        slot = self._next
+        client = self._clients[slot]
+        cursor = self._cursors[slot]
         self._next = (self._next + 1) % len(self._clients)
         # audit correlation: when this scorer records audit evidence, the
         # batch's ID is minted HERE (before the round-trip) and sent as the
@@ -698,10 +972,14 @@ class RemoteScorer(OracleScorer):
         policy_fp = getattr(self, "policy_fingerprint", None)
         try:
             with trace_mod.span("oracle.wire_round_trip", cat="oracle"):
-                resp = client.schedule(
-                    req, audit_id=audit_id, policy_fp=policy_fp
+                resp = self._wire_schedule(
+                    client, cursor, snap, req, audit_id, policy_fp
                 )
         except _TRANSPORT_ERRORS + (OracleDeadlineError,):
+            # whether the server applied anything is unknown (a deadline
+            # may abandon a half-applied delta): forget this lane's
+            # mirror state so the next batch on it keyframes
+            cursor.reset()
             # raw OSError/EOFError included, not just the resilient
             # client's wrapped OracleTransportError: a plain OracleClient
             # is a supported transport here, and its bare socket errors

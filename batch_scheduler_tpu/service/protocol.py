@@ -78,6 +78,14 @@ __all__ = [
     "MsgType",
     "ScheduleRequest",
     "ScheduleResponse",
+    "DeltaScheduleRequest",
+    "DELTA_KEYFRAME",
+    "DELTA_ROWS",
+    "pack_delta_keyframe",
+    "pack_delta_rows",
+    "unpack_delta_schedule_request",
+    "pack_delta_resync",
+    "unpack_delta_resync",
     "write_frame",
     "read_frame",
     "pack_schedule_request",
@@ -121,6 +129,21 @@ class MsgType:
     TRACE_INFO = 11
     AUDIT_ID = 12
     POLICY_INFO = 13
+    # Device-resident state deltas (docs/pipelining.md "Device-resident
+    # state"): a DELTA_SCHEDULE_REQ is a SCHEDULE_REQ whose big [N,R]/[G,R]
+    # buffers are already resident in the server's per-connection device
+    # mirror — the payload carries only churned rows + generations (or a
+    # full keyframe installing/refreshing the mirror). Answered with a
+    # normal SCHEDULE_RESP, or DELTA_RESYNC when the mirror cannot apply
+    # it (no state, generation gap, shape mismatch) — the client then
+    # resends a keyframe. Old servers answer MsgType 14 with an in-band
+    # ERROR ("unknown message type"); the client detects that and falls
+    # back to full SCHEDULE_REQ snapshots permanently — bit-identical
+    # plans either way, so mixed fleets stay correct (the
+    # AUDIT_ID/POLICY_INFO compatibility pattern: new frames are opt-in
+    # and never change existing layouts).
+    DELTA_SCHEDULE_REQ = 14
+    DELTA_RESYNC = 15
 
 
 ROW_KINDS = ("capacity", "scores")
@@ -414,6 +437,139 @@ def pack_policy_info(fingerprint: str) -> bytes:
 
 def unpack_policy_info(payload: bytes) -> str:
     return _POLICY.unpack(payload)[0].decode("ascii", errors="replace")
+
+
+# -- device-resident state deltas -------------------------------------------
+
+# kind, base_generation, new_generation. base_generation is the mirror
+# generation this delta applies ON TOP OF (ignored for keyframes); the
+# server refuses any mismatch with DELTA_RESYNC — a dropped or duplicated
+# delta frame must force a keyframe resync, never silently score stale rows.
+_DELTA_HEADER = struct.Struct("<BQQ")
+DELTA_KEYFRAME = 1
+DELTA_ROWS = 2
+
+# counts of a rows-delta body: n, g, r, mask_rows (the padded request
+# space, same convention as the full request), churned node rows, churned
+# group rows
+_DELTA_COUNTS = struct.Struct("<IIIIII")
+
+
+@dataclass
+class DeltaScheduleRequest:
+    """Churned-row refresh of a connection's device-resident mirror: the
+    [N,R] requested / [G,R] group-demand rows that changed since the
+    mirror's generation, plus the full (tiny) O(G) tail — which is
+    refresh-fresh by definition. ``alloc`` is never delta'd: alloc churn
+    full-repacks host-side (the lane shifts may move), which forces a
+    keyframe."""
+
+    node_idx: np.ndarray  # i32 [Mn] churned requested-row indices
+    node_rows: np.ndarray  # i32 [Mn, R]
+    group_idx: np.ndarray  # i32 [Mg] churned group-demand row indices
+    group_rows: np.ndarray  # i32 [Mg, R]
+    remaining: np.ndarray  # i32 [G]
+    fit_mask: np.ndarray  # bool [mask_rows, N]
+    group_valid: np.ndarray  # bool [G]
+    order: np.ndarray  # i32 [G]
+    min_member: np.ndarray  # i32 [G]
+    scheduled: np.ndarray  # i32 [G]
+    matched: np.ndarray  # i32 [G]
+    ineligible: np.ndarray  # bool [G]
+    creation_rank: np.ndarray  # i32 [G]
+    n: int = 0
+    g: int = 0
+    r: int = 0
+
+
+def pack_delta_keyframe(new_generation: int, req: ScheduleRequest) -> bytes:
+    """A full snapshot that (re)installs the server's mirror at
+    ``new_generation`` — byte-wise the keyframe body IS a schedule
+    request, so the two paths can never drift."""
+    return _DELTA_HEADER.pack(
+        DELTA_KEYFRAME, 0, new_generation
+    ) + pack_schedule_request(req)
+
+
+def pack_delta_rows(
+    base_generation: int, new_generation: int, d: DeltaScheduleRequest
+) -> bytes:
+    node_idx = _i32(d.node_idx)
+    group_idx = _i32(d.group_idx)
+    parts = [
+        _DELTA_HEADER.pack(DELTA_ROWS, base_generation, new_generation),
+        _DELTA_COUNTS.pack(
+            d.n, d.g, d.r, np.asarray(d.fit_mask).shape[0],
+            node_idx.shape[0], group_idx.shape[0],
+        ),
+        node_idx.tobytes(),
+        _i32(d.node_rows).tobytes(),
+        group_idx.tobytes(),
+        _i32(d.group_rows).tobytes(),
+        _i32(d.remaining).tobytes(),
+        _u8(d.fit_mask).tobytes(),
+        _u8(d.group_valid).tobytes(),
+        _i32(d.order).tobytes(),
+        _i32(d.min_member).tobytes(),
+        _i32(d.scheduled).tobytes(),
+        _i32(d.matched).tobytes(),
+        _u8(d.ineligible).tobytes(),
+        _i32(d.creation_rank).tobytes(),
+    ]
+    return b"".join(parts)
+
+
+def unpack_delta_schedule_request(payload: bytes):
+    """Returns ``(kind, base_generation, new_generation, body)`` where
+    ``body`` is a ScheduleRequest (keyframe) or DeltaScheduleRequest."""
+    kind, base_gen, new_gen = _DELTA_HEADER.unpack_from(payload, 0)
+    rest = payload[_DELTA_HEADER.size:]
+    if kind == DELTA_KEYFRAME:
+        return kind, base_gen, new_gen, unpack_schedule_request(rest)
+    if kind != DELTA_ROWS:
+        raise ValueError(f"unknown delta kind {kind}")
+    n, g, r, mask_rows, m_nodes, m_groups = _DELTA_COUNTS.unpack_from(rest, 0)
+    if mask_rows not in (1, g):
+        raise ValueError(f"fit_mask rows must be 1 or G={g}, got {mask_rows}")
+    off = _DELTA_COUNTS.size
+
+    def take(count, dtype, shape):
+        nonlocal off
+        arr = np.frombuffer(rest, dtype=dtype, count=count, offset=off)
+        off += count * np.dtype(dtype).itemsize
+        return arr.reshape(shape)
+
+    d = DeltaScheduleRequest(
+        node_idx=take(m_nodes, "<i4", (m_nodes,)),
+        node_rows=take(m_nodes * r, "<i4", (m_nodes, r)),
+        group_idx=take(m_groups, "<i4", (m_groups,)),
+        group_rows=take(m_groups * r, "<i4", (m_groups, r)),
+        remaining=take(g, "<i4", (g,)),
+        fit_mask=take(mask_rows * n, np.uint8, (mask_rows, n)).astype(bool),
+        group_valid=take(g, np.uint8, (g,)).astype(bool),
+        order=take(g, "<i4", (g,)),
+        min_member=take(g, "<i4", (g,)),
+        scheduled=take(g, "<i4", (g,)),
+        matched=take(g, "<i4", (g,)),
+        ineligible=take(g, np.uint8, (g,)).astype(bool),
+        creation_rank=take(g, "<i4", (g,)),
+        n=n,
+        g=g,
+        r=r,
+    )
+    if off != len(rest):
+        raise ValueError(
+            f"trailing bytes in delta schedule request: {len(rest) - off}"
+        )
+    return kind, base_gen, new_gen, d
+
+
+def pack_delta_resync(reason: str) -> bytes:
+    return reason.encode()
+
+
+def unpack_delta_resync(payload: bytes) -> str:
+    return payload.decode(errors="replace")
 
 
 # -- row request/response --------------------------------------------------

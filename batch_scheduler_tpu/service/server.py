@@ -75,6 +75,60 @@ def _pad_request(req: proto.ScheduleRequest):
     return batch_args, progress_args, (n, g)
 
 
+def _pad_delta_request(d: proto.DeltaScheduleRequest):
+    """Pad a rows-delta's O(G) tail via THE canonical pad_oracle_batch, so
+    the wire delta path can never drift from the full-request padding.
+
+    The real lane buffers are device-resident in the connection's mirror,
+    so the [N,R]/[G,R] positions get ZERO-WIDTH placeholders — they carry
+    the n/g extents the bucket sizes derive from without re-materialising
+    (or lane-scanning) full-size zero arrays per delta. The lane-domain
+    check pad_oracle_batch would have run over full snapshots is applied
+    to the CHURNED ROWS instead — the only lane values this frame carries
+    — so an out-of-domain lane raises the same OverflowError (-> in-band
+    ERROR) the full-snapshot wire path raises, never a silently wrong
+    ``_exact_floordiv``. Returns the padded ``(remaining, fit_mask,
+    group_valid, order)`` tail + progress args."""
+    from ..ops.lanes import LANE_MAX
+
+    for name, arr in (
+        ("node_rows", d.node_rows), ("group_rows", d.group_rows)
+    ):
+        a = np.asarray(arr)
+        if a.size and (np.abs(a.astype(np.int64)) > int(LANE_MAX)).any():
+            raise OverflowError(
+                f"delta {name} lanes exceed LANE_MAX (2**30): max abs "
+                f"{int(np.abs(a.astype(np.int64)).max())}"
+            )
+    zeros_n = np.zeros((d.n, 0), np.int32)
+    zeros_g = np.zeros((d.g, 0), np.int32)
+    batch_args, progress_args = pad_oracle_batch(
+        alloc=zeros_n,
+        requested=zeros_n,
+        group_req=zeros_g,
+        remaining=d.remaining,
+        fit_mask=d.fit_mask,
+        group_valid=d.group_valid,
+        order=d.order,
+        min_member=d.min_member,
+        scheduled=d.scheduled,
+        matched=d.matched,
+        ineligible=d.ineligible,
+        creation_rank=d.creation_rank,
+    )
+    return batch_args[3:], progress_args
+
+
+class _ResyncNeeded:
+    """Sentinel outcome of a delta request the mirror could not apply —
+    answered with a DELTA_RESYNC frame so the client resends a keyframe."""
+
+    __slots__ = ("reason",)
+
+    def __init__(self, reason: str):
+        self.reason = reason
+
+
 _DEADLINE_HIT = object()
 
 _EXEC_STOP = object()
@@ -88,13 +142,19 @@ class _ExecJob:
     consistent no matter which side gave up."""
 
     __slots__ = ("kind", "args", "progress_args", "fn", "enqueued",
-                 "queue_wait", "run_seconds", "_done", "_result", "_error")
+                 "queue_wait", "run_seconds", "donate", "_done", "_result",
+                 "_error")
 
-    def __init__(self, kind, args=None, progress_args=None, fn=None):
+    def __init__(self, kind, args=None, progress_args=None, fn=None,
+                 donate=None):
         self.kind = kind
         self.args = args
         self.progress_args = progress_args
         self.fn = fn
+        # None = executor default (donate single-device host-numpy
+        # batches); False is forced for batches dispatched FROM a
+        # device-resident mirror, whose buffers donation would consume
+        self.donate = donate
         self.enqueued = time.perf_counter()
         self.queue_wait = 0.0
         self.run_seconds = 0.0
@@ -164,16 +224,20 @@ class DeviceExecutor:
         self._depth.set(float(self._q.qsize()))
         return job
 
-    def submit_batch(self, batch_args, progress_args) -> _ExecJob:
+    def submit_batch(self, batch_args, progress_args, donate=None) -> _ExecJob:
         return self._submit(
-            _ExecJob("batch", args=batch_args, progress_args=progress_args)
+            _ExecJob(
+                "batch", args=batch_args, progress_args=progress_args,
+                donate=donate,
+            )
         )
 
-    def run_batch(self, batch_args, progress_args):
+    def run_batch(self, batch_args, progress_args, donate=None):
         """Blocking convenience: returns (host, batch, queue_wait_s,
         run_s). The caller's thread (a per-connection worker) may be
-        abandoned on deadline — see class docstring."""
-        job = self.submit_batch(batch_args, progress_args)
+        abandoned on deadline — see class docstring. ``donate=False``
+        forces non-donating dispatch (device-resident mirror batches)."""
+        job = self.submit_batch(batch_args, progress_args, donate=donate)
         host, batch = job.wait()
         return host, batch, job.queue_wait, job.run_seconds
 
@@ -237,10 +301,18 @@ class DeviceExecutor:
                     # single-device batches arrive as host numpy (fresh H2D
                     # per dispatch) — safe to donate; sharded args are
                     # pre-placed device arrays, which the donation
-                    # contract forbids re-dispatching (docs/pipelining.md)
+                    # contract forbids re-dispatching (docs/pipelining.md).
+                    # Jobs dispatched from a device-resident mirror pin
+                    # donate=False themselves — donation would consume
+                    # the mirror the next delta scatters into.
+                    donate = (
+                        self.scan_mesh is None
+                        if job.donate is None
+                        else job.donate
+                    )
                     pending = dispatch_batch(
                         job.args, job.progress_args, scan_mesh=self.scan_mesh,
-                        donate=self.scan_mesh is None,
+                        donate=donate,
                     )
                 except BaseException as e:  # noqa: BLE001 — compile/lowering
                     job.finish(error=e)
@@ -370,21 +442,29 @@ class _Handler(socketserver.BaseRequestHandler):
         }
 
     def handle(self) -> None:
-        last_batch: Optional[dict] = None
-        last_counts = (0, 0)
-        batch_seq = 0
         deadline_ms: Optional[int] = None  # armed for the NEXT request
         trace_ctx: Optional[tuple] = None  # armed for the NEXT request
         audit_ctx: Optional[str] = None  # armed for the NEXT request
         policy_ctx: Optional[str] = None  # armed for the NEXT request
         self._worker: Optional[_ConnWorker] = None
-        batch_seconds = DEFAULT_REGISTRY.histogram(
+        # per-connection batch state (handler instances are per-connection;
+        # requests serialize through _run, so these need no lock)
+        self._last_batch: Optional[dict] = None
+        self._last_counts = (0, 0)
+        self._batch_seq = 0
+        # device-resident mirror of the client's packed state
+        # (ops.device_state), fed by DELTA_SCHEDULE_REQ frames; dies with
+        # the connection — a reconnecting client must keyframe, which the
+        # DELTA_RESYNC answer forces
+        self._mirror = None
+        self._mirror_counts = None
+        self._batch_seconds = DEFAULT_REGISTRY.histogram(
             "bst_oracle_server_batch_seconds",
             "Sidecar-side wall-clock per schedule batch (unpack + pad + "
             "device), compile stalls included",
             buckets=LONG_OP_BUCKETS,
         )
-        batches_total = DEFAULT_REGISTRY.counter(
+        self._batches_total = DEFAULT_REGISTRY.counter(
             "bst_oracle_server_batches_total",
             "Schedule batches executed by the sidecar, by traced",
         )
@@ -492,6 +572,11 @@ class _Handler(socketserver.BaseRequestHandler):
                             }
                             return host, batch, (n, g), timings, audit_args
 
+                        # a full request supersedes any delta mirror: the
+                        # client's cursor keyframes after a fallback, and a
+                        # stale mirror would only pin device memory
+                        self._mirror = None
+                        self._mirror_counts = None
                         outcome = self._run(run_schedule, budget_ms)
                         if outcome is _DEADLINE_HIT:
                             proto.write_frame(
@@ -500,187 +585,50 @@ class _Handler(socketserver.BaseRequestHandler):
                                 f"schedule exceeded deadline of {budget_ms}ms".encode(),
                             )
                             continue
-                        host, last_batch, (n, g), timings, audit_args = outcome
-                        last_counts = (n, g)
-                        batch_seq += 1
-                        if audit_args is not None:
-                            # sidecar-side audit record, stamped with the
-                            # CLIENT's audit ID (the AUDIT_ID annotation)
-                            # so both sides' records of this batch join
-                            # one evidence chain; enqueue only — the
-                            # daemon writer owns serialization and disk
-                            try:
-                                from ..utils import audit as audit_mod
+                        self._finish_schedule(outcome, req_trace, req_audit)
+                    elif msg_type == proto.MsgType.DELTA_SCHEDULE_REQ:
 
-                                self.server.audit_log.record_batch(
-                                    batch_args=audit_args[0],
-                                    progress_args=audit_args[1],
-                                    result=host,
-                                    plan_digest=audit_mod.plan_digest(host),
-                                    audit_id=req_audit,
-                                    trace_id=(
-                                        req_trace[0] if req_trace else None
-                                    ),
-                                    telemetry=host.get("telemetry") or {},
-                                    extra={
-                                        "side": "server",
-                                        "batch_seq": batch_seq,
-                                        "n": n,
-                                        "g": g,
-                                    },
-                                )
-                            except Exception:  # noqa: BLE001 — evidence only
-                                pass
-                        total_s = (
-                            timings["unpack_pad"]
-                            + timings["lock_wait"]
-                            + timings["device"]
-                        )
-                        batch_seconds.observe(total_s)
-                        batches_total.inc(
-                            traced="yes" if req_trace else "no"
-                        )
-                        if req_trace is not None:
-                            telemetry = dict(host.get("telemetry") or {})
-                            telemetry.update(
-                                device_seconds=round(timings["device"], 6),
-                                lock_wait_seconds=round(
-                                    timings["lock_wait"], 6
-                                ),
-                                unpack_pad_seconds=round(
-                                    timings["unpack_pad"], 6
-                                ),
-                                batch_seq=batch_seq,
-                                n=n,
-                                g=g,
-                                # pipelining evidence (docs/pipelining.md):
-                                # in-flight depth at collect time and the
-                                # warmer's absorption counters ride back to
-                                # the client with the device telemetry
-                                inflight_batches=int(
-                                    DEFAULT_REGISTRY.gauge(
-                                        "bst_oracle_inflight_batches"
-                                    ).value()
-                                ),
-                            )
-                            if telemetry.get("waves_per_batch"):
-                                # per-wave merge cost: on the sharded scan
-                                # rung this is the tree-reduce cadence the
-                                # collective budget is written against
-                                # (docs/scan_parallelism.md)
-                                telemetry["per_wave_device_seconds"] = round(
-                                    timings["device"]
-                                    / telemetry["waves_per_batch"],
-                                    6,
-                                )
-                            if req_audit is not None:
-                                telemetry["audit_id"] = req_audit
-                            if self.server.warmer is not None:
-                                telemetry.update(
-                                    self.server.warmer.stats()
-                                )
-                            # sidecar HBM + compile-ledger evidence rides
-                            # back with the device telemetry: the client
-                            # (whose own process has no accelerator) sees
-                            # the server's memory watermarks and cold-
-                            # compile count per traced batch
-                            # (docs/observability.md "Device profiling")
-                            try:
-                                from ..utils import profiler as prof_mod
+                        def run_delta(payload=payload):
+                            return self._run_delta_body(payload)
 
-                                mem = prof_mod.sample_device_memory()
-                                if mem is not None:
-                                    telemetry["device_memory"] = mem
-                                ledger_n = (
-                                    prof_mod.COMPILE_LEDGER.entry_count()
-                                )
-                                if ledger_n:
-                                    telemetry["compile_ledger_entries"] = (
-                                        ledger_n
-                                    )
-                            except Exception:  # noqa: BLE001 — telemetry
-                                pass
-                            ts0 = timings["ts0"]
-                            spans = [
-                                self._mk_span(
-                                    "oracle.schedule", ts0, total_s,
-                                    req_trace, n=n, g=g,
-                                ),
-                                self._mk_span(
-                                    "oracle.unpack_pad", ts0,
-                                    timings["unpack_pad"], req_trace,
-                                ),
-                                self._mk_span(
-                                    "oracle.lock_wait",
-                                    ts0 + timings["unpack_pad"],
-                                    timings["lock_wait"], req_trace,
-                                ),
-                                self._mk_span(
-                                    "oracle.device_batch",
-                                    ts0 + timings["unpack_pad"]
-                                    + timings["lock_wait"],
-                                    timings["device"], req_trace,
-                                    compiled=telemetry.get("compiled"),
-                                ),
-                            ]
-                            if trace_mod.enabled():
-                                # server-side local ring (serve --trace):
-                                # the same spans land in this process's
-                                # /debug/trace too
-                                trace_mod.record_remote_spans(
-                                    spans, pid="oracle-server"
-                                )
+                        outcome = self._run(run_delta, budget_ms)
+                        if outcome is _DEADLINE_HIT:
+                            # the abandoned job may still advance the
+                            # mirror generation; the client resets its
+                            # cursor on any error and keyframes next, so
+                            # no stale-row window opens
                             proto.write_frame(
                                 self.request,
-                                proto.MsgType.TRACE_INFO,
-                                proto.pack_trace_info(
-                                    req_trace[0], spans, telemetry
-                                ),
+                                proto.MsgType.DEADLINE_ERROR,
+                                f"schedule exceeded deadline of {budget_ms}ms".encode(),
                             )
-                        # Map assignment node indexes back into the
-                        # CLIENT's node space before packing: the batch ran
-                        # in the server's bucket-padded (and, on a mesh,
-                        # shard-placed) node space, whose first n indexes
-                        # are the client's nodes and whose tail is padding.
-                        # Real takes can only land on the first n (pad
-                        # nodes are masked, zero-capacity), but top_k
-                        # backfills zero-count rows with arbitrary pad
-                        # indexes — zero those out so a client stamping a
-                        # whole-gang plan never sees an out-of-space index
-                        # (the PR-1 multi-device empty-plan bug; see
-                        # docs/scan_parallelism.md).
-                        a_nodes = np.asarray(host["assignment_nodes"])[:g]
-                        a_counts = np.asarray(host["assignment_counts"])[:g]
-                        in_space = a_nodes < n
-                        a_nodes = np.where(in_space, a_nodes, 0)
-                        a_counts = np.where(in_space, a_counts, 0)
-                        resp = proto.ScheduleResponse(
-                            gang_feasible=np.asarray(host["gang_feasible"])[:g],
-                            placed=np.asarray(host["placed"])[:g],
-                            progress=np.asarray(host["progress"])[:g],
-                            best=int(host["best"]),
-                            best_exists=bool(host["best_exists"]),
-                            assignment_nodes=a_nodes,
-                            assignment_counts=a_counts,
-                            batch_seq=batch_seq,
-                        )
-                        proto.write_frame(
-                            self.request,
-                            proto.MsgType.SCHEDULE_RESP,
-                            proto.pack_schedule_response(resp),
-                        )
+                            continue
+                        if isinstance(outcome, _ResyncNeeded):
+                            DEFAULT_REGISTRY.counter(
+                                "bst_device_delta_resyncs_total",
+                                "Wire deltas the sidecar mirror refused "
+                                "(generation gap / no state / shape "
+                                "mismatch) — the client resends a keyframe",
+                            ).inc()
+                            proto.write_frame(
+                                self.request,
+                                proto.MsgType.DELTA_RESYNC,
+                                proto.pack_delta_resync(outcome.reason),
+                            )
+                            continue
+                        self._finish_schedule(outcome, req_trace, req_audit)
                     elif msg_type == proto.MsgType.ROW_REQ:
                         kind, gidx, req_seq = proto.unpack_row_request(payload)
-                        if last_batch is None:
+                        if self._last_batch is None:
                             raise ValueError("row request before any batch")
-                        if req_seq != batch_seq:
+                        if req_seq != self._batch_seq:
                             raise ValueError(
-                                f"stale batch: row for seq {req_seq}, current {batch_seq}"
+                                f"stale batch: row for seq {req_seq}, current {self._batch_seq}"
                             )
-                        n, g = last_counts
+                        n, g = self._last_counts
                         if not 0 <= gidx < g:
                             raise ValueError(f"row index {gidx} out of range {g}")
-                        batch = last_batch
+                        batch = self._last_batch
 
                         def run_row(batch=batch, kind=kind, gidx=gidx, n=n):
                             # issued by the executor thread, in the same
@@ -721,6 +669,270 @@ class _Handler(socketserver.BaseRequestHandler):
         finally:
             if self._worker is not None:
                 self._worker.close()
+
+
+    def _finish_schedule(self, outcome, req_trace, req_audit) -> None:
+        """Shared tail of the full and delta schedule paths: install
+        the batch as connection state, record the sidecar-side audit
+        evidence, emit metrics/spans/TRACE_INFO, and answer the
+        SCHEDULE_RESP in the client's node space."""
+        host, batch, (n, g), timings, audit_args = outcome
+        self._last_batch = batch
+        self._last_counts = (n, g)
+        self._batch_seq += 1
+        if audit_args is not None:
+            # sidecar-side audit record, stamped with the
+            # CLIENT's audit ID (the AUDIT_ID annotation)
+            # so both sides' records of this batch join
+            # one evidence chain; enqueue only — the
+            # daemon writer owns serialization and disk
+            try:
+                from ..utils import audit as audit_mod
+
+                self.server.audit_log.record_batch(
+                    batch_args=audit_args[0],
+                    progress_args=audit_args[1],
+                    result=host,
+                    plan_digest=audit_mod.plan_digest(host),
+                    audit_id=req_audit,
+                    trace_id=(
+                        req_trace[0] if req_trace else None
+                    ),
+                    telemetry=host.get("telemetry") or {},
+                    extra={
+                        "side": "server",
+                        "batch_seq": self._batch_seq,
+                        "n": n,
+                        "g": g,
+                    },
+                )
+            except Exception:  # noqa: BLE001 — evidence only
+                pass
+        total_s = (
+            timings["unpack_pad"]
+            + timings["lock_wait"]
+            + timings["device"]
+        )
+        self._batch_seconds.observe(total_s)
+        self._batches_total.inc(
+            traced="yes" if req_trace else "no"
+        )
+        if req_trace is not None:
+            telemetry = dict(host.get("telemetry") or {})
+            telemetry.update(
+                device_seconds=round(timings["device"], 6),
+                lock_wait_seconds=round(
+                    timings["lock_wait"], 6
+                ),
+                unpack_pad_seconds=round(
+                    timings["unpack_pad"], 6
+                ),
+                batch_seq=self._batch_seq,
+                n=n,
+                g=g,
+                # pipelining evidence (docs/pipelining.md):
+                # in-flight depth at collect time and the
+                # warmer's absorption counters ride back to
+                # the client with the device telemetry
+                inflight_batches=int(
+                    DEFAULT_REGISTRY.gauge(
+                        "bst_oracle_inflight_batches"
+                    ).value()
+                ),
+            )
+            if telemetry.get("waves_per_batch"):
+                # per-wave merge cost: on the sharded scan
+                # rung this is the tree-reduce cadence the
+                # collective budget is written against
+                # (docs/scan_parallelism.md)
+                telemetry["per_wave_device_seconds"] = round(
+                    timings["device"]
+                    / telemetry["waves_per_batch"],
+                    6,
+                )
+            if req_audit is not None:
+                telemetry["audit_id"] = req_audit
+            if self.server.warmer is not None:
+                telemetry.update(
+                    self.server.warmer.stats()
+                )
+            # sidecar HBM + compile-ledger evidence rides
+            # back with the device telemetry: the client
+            # (whose own process has no accelerator) sees
+            # the server's memory watermarks and cold-
+            # compile count per traced batch
+            # (docs/observability.md "Device profiling")
+            try:
+                from ..utils import profiler as prof_mod
+
+                mem = prof_mod.sample_device_memory()
+                if mem is not None:
+                    telemetry["device_memory"] = mem
+                ledger_n = (
+                    prof_mod.COMPILE_LEDGER.entry_count()
+                )
+                if ledger_n:
+                    telemetry["compile_ledger_entries"] = (
+                        ledger_n
+                    )
+            except Exception:  # noqa: BLE001 — telemetry
+                pass
+            ts0 = timings["ts0"]
+            spans = [
+                self._mk_span(
+                    "oracle.schedule", ts0, total_s,
+                    req_trace, n=n, g=g,
+                ),
+                self._mk_span(
+                    "oracle.unpack_pad", ts0,
+                    timings["unpack_pad"], req_trace,
+                ),
+                self._mk_span(
+                    "oracle.lock_wait",
+                    ts0 + timings["unpack_pad"],
+                    timings["lock_wait"], req_trace,
+                ),
+                self._mk_span(
+                    "oracle.device_batch",
+                    ts0 + timings["unpack_pad"]
+                    + timings["lock_wait"],
+                    timings["device"], req_trace,
+                    compiled=telemetry.get("compiled"),
+                ),
+            ]
+            if trace_mod.enabled():
+                # server-side local ring (serve --trace):
+                # the same spans land in this process's
+                # /debug/trace too
+                trace_mod.record_remote_spans(
+                    spans, pid="oracle-server"
+                )
+            proto.write_frame(
+                self.request,
+                proto.MsgType.TRACE_INFO,
+                proto.pack_trace_info(
+                    req_trace[0], spans, telemetry
+                ),
+            )
+        # Map assignment node indexes back into the
+        # CLIENT's node space before packing: the batch ran
+        # in the server's bucket-padded (and, on a mesh,
+        # shard-placed) node space, whose first n indexes
+        # are the client's nodes and whose tail is padding.
+        # Real takes can only land on the first n (pad
+        # nodes are masked, zero-capacity), but top_k
+        # backfills zero-count rows with arbitrary pad
+        # indexes — zero those out so a client stamping a
+        # whole-gang plan never sees an out-of-space index
+        # (the PR-1 multi-device empty-plan bug; see
+        # docs/scan_parallelism.md).
+        a_nodes = np.asarray(host["assignment_nodes"])[:g]
+        a_counts = np.asarray(host["assignment_counts"])[:g]
+        in_space = a_nodes < n
+        a_nodes = np.where(in_space, a_nodes, 0)
+        a_counts = np.where(in_space, a_counts, 0)
+        resp = proto.ScheduleResponse(
+            gang_feasible=np.asarray(host["gang_feasible"])[:g],
+            placed=np.asarray(host["placed"])[:g],
+            progress=np.asarray(host["progress"])[:g],
+            best=int(host["best"]),
+            best_exists=bool(host["best_exists"]),
+            assignment_nodes=a_nodes,
+            assignment_counts=a_counts,
+            batch_seq=self._batch_seq,
+        )
+        proto.write_frame(
+            self.request,
+            proto.MsgType.SCHEDULE_RESP,
+            proto.pack_schedule_response(resp),
+        )
+
+    def _run_delta_body(self, payload: bytes):
+        """One DELTA_SCHEDULE_REQ: bring the connection's device-resident
+        mirror (ops.device_state.DeviceStateHolder) up to the client's
+        generation — scatter-applying churned rows, or installing a full
+        keyframe — then dispatch the batch FROM the resident buffers
+        (donate=False: donation would consume the mirror). Returns the
+        same outcome tuple as the full path so ``_finish_schedule`` is
+        shared, or a ``_ResyncNeeded`` when the mirror cannot apply the
+        delta (generation gap / no state / shape mismatch) — the client
+        must resend a keyframe, never have stale rows scored silently."""
+        ts0 = time.time()
+        t0 = time.perf_counter()
+        kind, base_gen, new_gen, body = proto.unpack_delta_schedule_request(
+            payload
+        )
+        mesh = self.server.scan_mesh
+        executor = self.server.executor
+        if self._mirror is None:
+            from ..ops.device_state import DeviceStateHolder
+
+            self._mirror = DeviceStateHolder(mesh=mesh, label="server")
+        holder = self._mirror
+        want_audit = self.server.audit_log is not None
+        audit_args = None
+        if kind == proto.DELTA_KEYFRAME:
+            args, progress_args, (n, g) = _pad_request(body)
+            if want_audit:
+                audit_args = (args, progress_args)
+            # placement is device work: it rides the executor queue so it
+            # can never interleave with a mesh batch's collectives
+            device_args = executor.run(
+                lambda: holder.keyframe(args, new_gen, "wire-keyframe")
+            )
+            self._mirror_counts = (n, g, int(body.alloc.shape[1]))
+        else:
+            n, g = body.n, body.g
+            if self._mirror_counts != (n, g, body.r):
+                return _ResyncNeeded(
+                    f"shape mismatch: mirror {self._mirror_counts}, "
+                    f"delta ({n}, {g}, {body.r})"
+                )
+            small_args, progress_args = _pad_delta_request(body)
+
+            def apply():
+                return holder.apply_rows(
+                    base_gen,
+                    new_gen,
+                    (body.node_idx, body.node_rows),
+                    (body.group_idx, body.group_rows),
+                    small_args,
+                )
+
+            device_args = executor.run(apply)
+            if device_args is None:
+                return _ResyncNeeded(
+                    f"generation gap: mirror at "
+                    f"{holder.current_generation()}, delta base {base_gen}"
+                )
+            if want_audit:
+                # the audit record must replay on any backend: read the
+                # delta-applied lane buffers back to host numpy (evidence
+                # cost, paid only when the sidecar runs --audit-dir)
+                audit_args = (
+                    tuple(np.asarray(a) for a in device_args), progress_args
+                )
+        t1 = time.perf_counter()
+        host, batch, queue_wait, run_s = executor.run_batch(
+            device_args, progress_args, donate=False
+        )
+        telemetry = host.get("telemetry")
+        if isinstance(telemetry, dict):
+            telemetry["device_state"] = {
+                "generation": holder.current_generation(),
+                "applied": "keyframe" if kind == proto.DELTA_KEYFRAME
+                else "delta",
+                "rows": int(
+                    len(body.node_idx) + len(body.group_idx)
+                ) if kind == proto.DELTA_ROWS else 0,
+            }
+        timings = {
+            "ts0": ts0,
+            "unpack_pad": t1 - t0,
+            "lock_wait": queue_wait,
+            "device": run_s,
+        }
+        return host, batch, (n, g), timings, audit_args
 
 
 class OracleServer(socketserver.ThreadingTCPServer):

@@ -19,7 +19,16 @@ probability schedule (or deterministically via ``limit``):
 Faults are injected at FRAME granularity on the server->client direction
 (the request made it out; the response is what suffers — exercising the
 client's read/recovery path, which is where the resilient client lives).
-The client->server direction relays raw bytes untouched.
+The client->server direction relays raw bytes untouched by default; with
+``c2s_frames=True`` it relays at frame granularity too and supports two
+request-direction faults aimed at the device-resident-state delta stream
+(docs/pipelining.md "Device-resident state"):
+
+- ``drop_c2s`` : one request frame silently vanishes (lossy middlebox) —
+                 the connection stays up, the client's read times out
+- ``dup_c2s``  : one request frame is delivered twice (retransmit bug) —
+                 the server sees the same delta again and must refuse it
+                 on the generation check, never apply it twice
 
 Used by tests/test_chaos_oracle.py to prove ResilientOracleClient survives
 every class, and by the chaos-enabled fuzz e2e (tests/test_fuzz_e2e.py).
@@ -36,9 +45,16 @@ from typing import Dict, Optional, Union
 
 from ..service import protocol as proto
 
-__all__ = ["ChaosProxy", "FAULT_KINDS"]
+__all__ = ["ChaosProxy", "FAULT_KINDS", "C2S_FAULT_KINDS"]
 
+# response-direction faults (the original classes; tests parametrize over
+# exactly these — each implies a client-visible failure mode)
 FAULT_KINDS = ("reset", "hang", "delay", "truncate", "garbage")
+# request-direction faults (frame-granular c2s relay only); a draw on one
+# pump only considers its own kinds, so arming a c2s fault never perturbs
+# responses and vice versa
+C2S_FAULT_KINDS = ("drop_c2s", "dup_c2s")
+_ALL_KINDS = FAULT_KINDS + C2S_FAULT_KINDS
 
 
 class ChaosProxy:
@@ -48,8 +64,13 @@ class ChaosProxy:
         upstream_port: int,
         host: str = "127.0.0.1",
         seed: int = 0,
+        c2s_frames: bool = False,
     ):
         self._upstream = (upstream_host, upstream_port)
+        # frame-granular client->server relay (needed for the drop_c2s /
+        # dup_c2s faults); off by default — raw relay is cheaper and the
+        # original five faults only touch the response direction
+        self._c2s_frames = c2s_frames
         self._listener = socket.create_server((host, 0))
         self._listener.settimeout(0.2)
         self._stop = threading.Event()
@@ -60,7 +81,7 @@ class ChaosProxy:
         self._limit: Optional[int] = None  # guarded-by: _lock
         self.delay_s = 0.05
         self.hang_s = 30.0
-        self.injected: Dict[str, int] = {k: 0 for k in FAULT_KINDS}  # guarded-by: _lock
+        self.injected: Dict[str, int] = {k: 0 for k in _ALL_KINDS}  # guarded-by: _lock
         self._socks: list = [self._listener]  # guarded-by: _lock
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="chaos-accept", daemon=True
@@ -90,13 +111,13 @@ class ChaosProxy:
             if kind is None:
                 self._faults = {}
             elif isinstance(kind, str):
-                if kind not in FAULT_KINDS:
-                    raise ValueError(f"unknown fault {kind!r} (use {FAULT_KINDS})")
+                if kind not in _ALL_KINDS:
+                    raise ValueError(f"unknown fault {kind!r} (use {_ALL_KINDS})")
                 self._faults = {kind: probability}
             else:
-                bad = set(kind) - set(FAULT_KINDS)
+                bad = set(kind) - set(_ALL_KINDS)
                 if bad:
-                    raise ValueError(f"unknown faults {bad} (use {FAULT_KINDS})")
+                    raise ValueError(f"unknown faults {bad} (use {_ALL_KINDS})")
                 self._faults = dict(kind)
             self._limit = limit
             if delay_s is not None:
@@ -114,11 +135,11 @@ class ChaosProxy:
         with self._lock:
             return dict(self.injected)
 
-    def _draw(self) -> Optional[str]:
+    def _draw(self, kinds=FAULT_KINDS) -> Optional[str]:
         with self._lock:
             if not self._faults or self._limit == 0:
                 return None
-            for kind in FAULT_KINDS:
+            for kind in kinds:
                 p = self._faults.get(kind, 0.0)
                 if p > 0 and self._rng.random() < p:
                     self.injected[kind] += 1
@@ -145,7 +166,11 @@ class ChaosProxy:
             with self._lock:
                 self._socks += [client, upstream]
             threading.Thread(
-                target=self._pump_raw, args=(client, upstream),
+                target=(
+                    self._pump_frames_c2s if self._c2s_frames
+                    else self._pump_raw
+                ),
+                args=(client, upstream),
                 name="chaos-c2s", daemon=True,
             ).start()
             threading.Thread(
@@ -185,6 +210,32 @@ class ChaosProxy:
                 if not data:
                     break
                 dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            self._close_pair(src, dst)
+
+    def _pump_frames_c2s(self, src: socket.socket, dst: socket.socket) -> None:
+        """client -> server: relay at frame granularity, injecting the
+        request-direction faults (drop/duplicate one frame, connection
+        kept alive) — the delta-stream chaos of docs/pipelining.md."""
+        try:
+            while not self._stop.is_set():
+                header = self._read_exact(src, proto._HEADER.size)
+                if header is None:
+                    break
+                _, _, length = proto._HEADER.unpack(header)
+                payload = b""
+                if length:
+                    payload = self._read_exact(src, length)
+                    if payload is None:
+                        break
+                fault = self._draw(C2S_FAULT_KINDS)
+                if fault == "drop_c2s":
+                    continue  # the frame never arrives; the stream lives
+                dst.sendall(header + payload)
+                if fault == "dup_c2s":
+                    dst.sendall(header + payload)
         except OSError:
             pass
         finally:

@@ -51,6 +51,16 @@ class CircuitOpenError(OracleTransportError):
     half-open ping probe succeeds (utils.retry.CircuitBreaker)."""
 
 
+class DeltaResyncRequired(RuntimeError):
+    """The sidecar answered DELTA_RESYNC: its device-resident mirror could
+    not apply the churned-row delta (no state on this connection, a
+    generation gap from a dropped/duplicated frame, or a shape mismatch).
+    An in-band answer over a live transport — never retried, never
+    advances the breaker; the client reconnects the lane (the stream may
+    carry stale replies after a gap) and resends a full keyframe
+    (docs/pipelining.md "Device-resident state")."""
+
+
 class OracleDeadlineError(RuntimeError):
     """The sidecar answered an in-band deadline-exceeded frame: the request
     was received but its ``deadline_ms`` budget elapsed before the batch

@@ -299,7 +299,8 @@ DEBUG_ENDPOINTS = {
     "/debug/buckets": "per-bucket compiled HLO cost telemetry (ops.oracle)",
     "/debug/policy": "the active policy engine's terms/weights/counters",
     "/debug/perf": "rolling per-phase p50/p95, scan-rung mix, device "
-                   "memory, compile ledger (utils.profiler)",
+                   "memory, device-resident state holders, compile "
+                   "ledger (utils.profiler)",
     "/debug/profile": "?seconds=N runs a jax.profiler capture and "
                       "returns the trace dir; bare GET reports state",
 }

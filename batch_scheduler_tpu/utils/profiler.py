@@ -489,10 +489,20 @@ def perf_report(registry=None) -> dict:
             if label:
                 scan_mix[label] = v
     memory = sample_device_memory()
+    # device-resident state holders (ops.device_state): generation,
+    # scatter/keyframe counts per holder — [] when none live. Guarded:
+    # the report must render even before the ops layer ever loaded.
+    try:
+        from ..ops.device_state import device_state_report
+
+        device_state = device_state_report()
+    except Exception:  # noqa: BLE001 — reporting never fatal
+        device_state = []
     return {
         "phases": phases,
         "scan_rung_mix": scan_mix,
         "device_memory": memory,  # None on CPU: no memory_stats
+        "device_state": device_state,
         "compile_ledger": COMPILE_LEDGER.report(),
         "profiler": profile_state(),
     }

@@ -170,6 +170,21 @@ else
     echo "audit-recorded sim failed:"; tail -3 /tmp/audit_sim.out; fail=1
 fi
 
+echo "== device-resident state gate on hardware (DELTA_${TAG}) =="
+# the bench-delta gate on the real backend: on TPU the full-repack
+# baseline pays the real host->HBM upload per refresh, so this is the
+# capture that prices the ROADMAP's "host costs 3-4x the device" claim —
+# scatter-update refresh vs full repack, with the same bit-identity and
+# forced-generation-mismatch checks as CI (docs/pipelining.md)
+if BST_DELTA_GATE_PLATFORM=default timeout 900 \
+        python benchmarks/delta_gate.py "DELTA_${TAG}.json" \
+        > /tmp/delta_gate.out 2>&1; then
+    echo "delta gate captured: DELTA_${TAG}.json"
+    tail -1 /tmp/delta_gate.out
+else
+    echo "delta gate failed:"; tail -4 /tmp/delta_gate.out; fail=1
+fi
+
 echo "== policy gate on hardware (zero-policy identity + preempt-pass cost) =="
 # the bench-policy gate on the real backend: zero-policy plans must stay
 # bit-identical to the pre-policy scan on the hardware rungs, the policy

@@ -1,0 +1,354 @@
+"""CI gate for device-resident cluster state (make bench-delta).
+
+Pins the claims the device-resident refactor rests on, all on CPU so it
+runs anywhere (docs/pipelining.md "Device-resident state"):
+
+1. **refresh speedup** — at the 5k-node/10k-pod shape, a churned refresh
+   through the delta packer + jit'd device scatter-update must beat the
+   host full-repack refresh path (fresh ClusterSnapshot pack + full
+   device upload) by ``DELTA_REFRESH_FLOOR``x. This is the ROADMAP
+   bottleneck item: refresh latency tracking device_batch_s, not
+   snapshot_pack_s.
+2. **bit-identity** — plan digests identical across the full-repack path,
+   the delta-applied device-resident path, and a keyframe-resync-every-
+   batch path, across churned refreshes.
+3. **forced generation mismatch** — a delta record withheld from the
+   holder (the dropped-frame class) must force a keyframe resync
+   (bst_device_keyframe_resyncs_total{reason="generation"}) and still
+   produce the identical plan — stale rows are never scored silently.
+4. **wire identity** — against a live sidecar, a RemoteScorer shipping
+   churned-row deltas + generation produces plans bit-identical to a
+   full-snapshot RemoteScorer and to the local scorer, with the delta
+   encoding actually exercised (bst_oracle_wire_delta_batches_total).
+
+Prints one JSON line with ``"ok"`` + per-check details (the bst-bench
+envelope; the ``DELTA_<tag>`` capture artifact); exits non-zero on any
+failure. Run from the repo root: ``make bench-delta``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# CPU by default (CI gate); the hardware capture sets
+# BST_DELTA_GATE_PLATFORM=default to keep the probed backend
+try:
+    _platform = os.environ.get("BST_DELTA_GATE_PLATFORM", "cpu")
+except Exception:  # noqa: BLE001 — env read only
+    _platform = "cpu"
+if _platform == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+os.environ.setdefault("BST_BUCKET_COST", "0")  # no teardown-racing compiles
+
+import numpy as np  # noqa: E402
+
+DELTA_REFRESH_FLOOR = 2.5  # measured ~3.7x on the 1-core CI box
+REFRESH_NODES = 5120
+REFRESH_GROUPS = 2048
+REFRESH_MEMBERS = 5  # 2048 gangs x 5 members = 10240 pods
+CHURN_ROWS = 16
+IDENTITY_NODES = 256
+IDENTITY_GROUPS = 64
+
+
+def build_inputs(n, g, members=REFRESH_MEMBERS):
+    from batch_scheduler_tpu.ops.snapshot import GroupDemand
+    from batch_scheduler_tpu.sim.scenarios import make_sim_node
+
+    nodes = [
+        make_sim_node(
+            f"n{i:05d}", {"cpu": "64", "memory": "256Gi", "pods": "110"}
+        )
+        for i in range(n)
+    ]
+    groups = [
+        GroupDemand(
+            full_name=f"default/gang-{i:04d}",
+            min_member=members,
+            member_request={"cpu": 4000, "memory": 8 * 1024**3},
+            creation_ts=float(i),
+        )
+        for i in range(g)
+    ]
+    node_req = {
+        nd.metadata.name: {
+            "cpu": 2000 * (i % 3 + 1),
+            "memory": (4 + i % 7) * 1024**3,
+            "pods": i % 5 + 1,
+            "ephemeral-storage": (1 + i % 3) * 1024**3,
+        }
+        for i, nd in enumerate(nodes)
+    }
+    return nodes, groups, node_req
+
+
+def check_refresh_speedup(detail):
+    """Full-repack refresh (host pack + full device upload) vs the
+    device-resident delta refresh (delta pack + scatter-update) at the
+    north-star shape, under a realistic per-refresh churn of
+    ``CHURN_ROWS`` node rows."""
+    from batch_scheduler_tpu.ops.device_state import DeviceStateHolder
+    from batch_scheduler_tpu.ops.snapshot import (
+        ClusterSnapshot,
+        DeltaSnapshotPacker,
+    )
+
+    nodes, groups, node_req = build_inputs(REFRESH_NODES, REFRESH_GROUPS)
+
+    def churn(i):
+        for k in range(CHURN_ROWS):
+            name = f"n{(i * CHURN_ROWS + k) % REFRESH_NODES:05d}"
+            node_req[name] = {"cpu": 1000 + i, "pods": 1 + (i + k) % 4}
+
+    def upload(snap):
+        for arr in (
+            jax.device_put(snap.alloc),
+            jax.device_put(snap.requested),
+            jax.device_put(snap.group_req),
+        ):
+            arr.block_until_ready()
+
+    # full-repack refresh: what every batch paid before residency
+    full_draws = []
+    for i in range(4):
+        churn(i)
+        t0 = time.perf_counter()
+        snap = ClusterSnapshot(nodes, node_req, groups)
+        upload(snap)
+        full_draws.append(time.perf_counter() - t0)
+
+    # device-resident refresh: delta pack + scatter
+    packer = DeltaSnapshotPacker()
+    holder = DeviceStateHolder(label="delta-gate")
+    holder.sync(packer.pack(nodes, node_req, groups))  # cold keyframe
+    # warm the scatter jit outside the clock
+    churn(100)
+    holder.sync(packer.pack(nodes, node_req, groups))
+    delta_draws = []
+    for i in range(4):
+        churn(200 + i)
+        t0 = time.perf_counter()
+        args = holder.sync(packer.pack(nodes, node_req, groups))
+        args[1].block_until_ready()
+        delta_draws.append(time.perf_counter() - t0)
+    assert holder.stats()["deltas_applied"] >= 5
+
+    full_s = sorted(full_draws)[len(full_draws) // 2]
+    delta_s = sorted(delta_draws)[len(delta_draws) // 2]
+    speedup = full_s / max(delta_s, 1e-9)
+    detail["refresh_full_repack_s"] = round(full_s, 5)
+    detail["refresh_device_delta_s"] = round(delta_s, 5)
+    detail["refresh_speedup"] = round(speedup, 1)
+    detail["refresh_churn_rows"] = CHURN_ROWS
+    ok = speedup >= DELTA_REFRESH_FLOOR
+    if not ok:
+        detail["refresh_fail"] = (
+            f"device-delta refresh {delta_s:.4f}s vs full repack "
+            f"{full_s:.4f}s = {speedup:.1f}x (floor {DELTA_REFRESH_FLOOR}x)"
+        )
+    return ok
+
+
+def _digest(batch_args, progress_args):
+    from batch_scheduler_tpu.ops.oracle import execute_batch_host
+    from batch_scheduler_tpu.utils import audit as audit_mod
+
+    host, _ = execute_batch_host(batch_args, progress_args)
+    return audit_mod.plan_digest(host)
+
+
+def check_identity_and_resync(detail):
+    """Digest identity across full-repack / delta-applied / keyframe-
+    resynced state, plus the forced generation mismatch."""
+    from batch_scheduler_tpu.ops.device_state import DeviceStateHolder
+    from batch_scheduler_tpu.ops.snapshot import (
+        ClusterSnapshot,
+        DeltaSnapshotPacker,
+    )
+    from batch_scheduler_tpu.utils.metrics import DEFAULT_REGISTRY
+
+    nodes, groups, node_req = build_inputs(IDENTITY_NODES, IDENTITY_GROUPS)
+    packer = DeltaSnapshotPacker()
+    delta_holder = DeviceStateHolder(label="gate-delta")
+    resync_holder = DeviceStateHolder(label="gate-resync")
+
+    rounds = []
+    for i in range(4):
+        node_req[f"n{i:05d}"] = {"cpu": 500 + i, "pods": 2}
+        groups[i % len(groups)].member_request = {"cpu": 3000 + i}
+        full_snap = ClusterSnapshot(nodes, node_req, groups)
+        d_full = _digest(full_snap.device_args(), full_snap.progress_args())
+        snap = packer.pack(nodes, node_req, groups)
+        d_delta = _digest(delta_holder.sync(snap), snap.progress_args())
+        resync_holder.reset()  # keyframe-resync-every-batch path
+        d_key = _digest(resync_holder.sync(snap), snap.progress_args())
+        rounds.append((d_full, d_delta, d_key))
+    identical = all(a == b == c for a, b, c in rounds)
+    detail["identity_rounds"] = len(rounds)
+    detail["identity_ok"] = identical
+    detail["identity_digest"] = rounds[-1][0][:16]
+    stats = delta_holder.stats()
+    detail["identity_rows_scattered"] = stats["rows_scattered"]
+    used_delta = stats["deltas_applied"] >= 3
+
+    # forced generation mismatch: a pack withheld from the holder (the
+    # dropped-delta class) — the next sync must resync via keyframe
+    node_req["n00000"] = {"cpu": 9999}
+    packer.pack(nodes, node_req, groups)  # never synced: the gap
+    node_req["n00001"] = {"cpu": 8888}
+    snap = packer.pack(nodes, node_req, groups)
+    d_gap = _digest(delta_holder.sync(snap), snap.progress_args())
+    full_snap = ClusterSnapshot(nodes, node_req, groups)
+    d_gap_full = _digest(full_snap.device_args(), full_snap.progress_args())
+    gap_keyframes = delta_holder.stats()["keyframes"].get("generation", 0)
+    counter = DEFAULT_REGISTRY.counter(
+        "bst_device_keyframe_resyncs_total"
+    ).value(reason="generation")
+    detail["generation_mismatch_keyframes"] = gap_keyframes
+    detail["generation_mismatch_identical"] = d_gap == d_gap_full
+    ok = (
+        identical
+        and used_delta
+        and gap_keyframes >= 1
+        and counter >= 1
+        and d_gap == d_gap_full
+    )
+    if not ok:
+        detail["identity_fail"] = (
+            f"identical={identical} used_delta={used_delta} "
+            f"gap_keyframes={gap_keyframes} gap_identical={d_gap == d_gap_full}"
+        )
+    return ok
+
+
+def check_wire_identity(detail):
+    """Delta-encoded remote batches vs full-snapshot remote batches vs the
+    local scorer, against a live sidecar, across churned refreshes."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+    from batch_scheduler_tpu.cache import PGStatusCache
+    from batch_scheduler_tpu.core.oracle_scorer import OracleScorer
+    from batch_scheduler_tpu.service.client import (
+        RemoteScorer,
+        ResilientOracleClient,
+    )
+    from batch_scheduler_tpu.service.server import serve_background
+    from batch_scheduler_tpu.utils.metrics import DEFAULT_REGISTRY
+    from helpers import FakeCluster, make_group, make_node, make_pod, status_for
+
+    server = serve_background()
+    host, port = server.address
+    delta_remote = RemoteScorer(
+        ResilientOracleClient(host, port, timeout=60, window=2)
+    )
+    full_remote = RemoteScorer(
+        ResilientOracleClient(host, port, timeout=60, window=2)
+    )
+    full_remote._wire_delta_ok = False  # pinned to full snapshots
+    local = OracleScorer(device_state=True)
+
+    nodes = [
+        make_node(f"n{i}", {"cpu": "8", "memory": "32Gi", "pods": "110"})
+        for i in range(8)
+    ]
+    cluster = FakeCluster(nodes)
+    cache = PGStatusCache()
+    gang_names = []
+    for i in range(5):
+        name = f"gang{i}"
+        pg = make_group(name, 3, creation_ts=float(i))
+        members = [
+            make_pod(f"{name}-{m}", group=name, requests={"cpu": "1"})
+            for m in range(3)
+        ]
+        status_for(pg, cache, rep_pod=members[0])
+        gang_names.append(f"default/{name}")
+
+    counter = DEFAULT_REGISTRY.counter("bst_oracle_wire_delta_batches_total")
+    deltas_before = counter.value(kind="delta")
+    mismatches = []
+    for rnd in range(4):
+        for s in (delta_remote, full_remote, local):
+            s.mark_dirty()
+            s.ensure_fresh(cluster, cache, group=gang_names[0])
+        for gname in gang_names:
+            plans = [
+                (
+                    s.placed(gname),
+                    s.gang_feasible(gname),
+                    tuple(sorted(s.assignment(gname).items())),
+                )
+                for s in (delta_remote, full_remote, local)
+            ]
+            if not plans[0] == plans[1] == plans[2]:
+                mismatches.append((rnd, gname, plans))
+        cluster.bind(
+            make_pod(f"filler-{rnd}", requests={"cpu": "2"}),
+            nodes[rnd].metadata.name,
+        )
+    wire_deltas = counter.value(kind="delta") - deltas_before
+    detail["wire_rounds"] = 4
+    detail["wire_delta_batches"] = wire_deltas
+    detail["wire_mismatches"] = len(mismatches)
+    delta_remote.close()
+    full_remote.close()
+    server.shutdown()
+    server.server_close()
+    ok = not mismatches and wire_deltas >= 2
+    if not ok:
+        detail["wire_fail"] = (
+            f"mismatches={mismatches[:2]} wire_deltas={wire_deltas}"
+        )
+    return ok
+
+
+def main() -> int:
+    detail = {}
+    checks = {
+        "refresh_speedup": check_refresh_speedup,
+        "identity_resync": check_identity_and_resync,
+        "wire_identity": check_wire_identity,
+    }
+    results = {}
+    for name, fn in checks.items():
+        try:
+            results[name] = bool(fn(detail))
+        except Exception as e:  # noqa: BLE001 — the JSON line must go out
+            import traceback
+
+            traceback.print_exc()
+            detail[f"{name}_error"] = repr(e)[:300]
+            results[name] = False
+    ok = all(results.values())
+    from benchmarks import artifact
+
+    doc = artifact.emit(
+        {
+            "metric": "delta_gate",
+            "value": detail.get("refresh_speedup", 0.0),
+            "unit": "x_vs_full_repack_refresh",
+            "detail": {"ok": ok, "checks": results, **detail},
+        },
+        metrics={
+            k: v
+            for k, v in detail.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        },
+    )
+    if len(sys.argv) > 1 and not sys.argv[1].startswith("-"):
+        # capture mode (DELTA_<tag>.json): persist the envelope
+        with open(sys.argv[1], "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True, default=str)
+            f.write("\n")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

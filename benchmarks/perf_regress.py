@@ -13,6 +13,9 @@ slower" tripwire on every build, not a hardware benchmark (that's
   rung hides
 - ``snapshot_pack_s``         host-side ClusterSnapshot packing (the
   host bottleneck the ROADMAP's device-resident item attacks)
+- ``refresh_device_delta_s``  one churned refresh through the
+  device-resident path: delta pack + jit'd scatter-update
+  (ops.device_state) — the hot path that replaced the full repack
 - ``metrics_render_s``        the /metrics exposition render at a
   realistic series count (observability must not become the overhead)
 
@@ -61,6 +64,7 @@ TOLERANCES = {
     "oracle_steady_batch_s": 1.6,
     "oracle_wavefront_batch_s": 1.6,
     "snapshot_pack_s": 1.6,
+    "refresh_device_delta_s": 1.6,
     "metrics_render_s": 1.6,
 }
 
@@ -155,6 +159,26 @@ def probe_set():
     def pack():
         ClusterSnapshot(big_nodes, {}, big_groups)
 
+    # device-resident refresh (ops.device_state): one churned refresh
+    # through the delta packer + jit'd scatter — the hot path that
+    # replaced the per-refresh full repack, guarded from day one
+    from batch_scheduler_tpu.ops.device_state import DeviceStateHolder
+    from batch_scheduler_tpu.ops.snapshot import DeltaSnapshotPacker
+
+    packer = DeltaSnapshotPacker()
+    holder = DeviceStateHolder(label="perf-probe")
+    delta_req = {
+        nd.metadata.name: {"cpu": 1000, "pods": 1} for nd in big_nodes
+    }
+    holder.sync(packer.pack(big_nodes, delta_req, big_groups))
+    tick = [0]
+
+    def device_delta():
+        tick[0] += 1
+        name = big_nodes[tick[0] % len(big_nodes)].metadata.name
+        delta_req[name] = {"cpu": 1000 + tick[0], "pods": 1}
+        holder.sync(packer.pack(big_nodes, delta_req, big_groups))
+
     reg = Registry()
     for i in range(40):
         reg.counter(f"bst_probe_counter_{i}_total", "probe").inc(
@@ -171,6 +195,7 @@ def probe_set():
         ("oracle_steady_batch_s", steady, steady),
         ("oracle_wavefront_batch_s", wavefront, wavefront),
         ("snapshot_pack_s", pack, pack),
+        ("refresh_device_delta_s", device_delta, device_delta),
         ("metrics_render_s", render, render),
     ]
 

@@ -359,3 +359,117 @@ def test_fallback_deny_mode_surfaces_transport_error(proxy):
         op.pre_filter(ok_members[0])
     assert not scorer.degraded
     scorer.close()
+
+
+# -- device-resident state deltas under chaos ------------------------------
+
+
+def _delta_world(n_nodes=6, n_gangs=4):
+    """A small live cluster + reference scorer world for the wire-delta
+    chaos cases."""
+    from batch_scheduler_tpu.core.oracle_scorer import OracleScorer
+
+    nodes = [
+        make_node(f"n{i}", {"cpu": "8", "memory": "32Gi", "pods": "110"})
+        for i in range(n_nodes)
+    ]
+    cluster = FakeCluster(nodes)
+    cache = PGStatusCache()
+    gang_names = []
+    for i in range(n_gangs):
+        name = f"gang{i}"
+        pg = make_group(name, 3, creation_ts=float(i))
+        members = [
+            make_pod(f"{name}-{m}", group=name, requests={"cpu": "1"})
+            for m in range(3)
+        ]
+        status_for(pg, cache, rep_pod=members[0])
+        gang_names.append(f"default/{name}")
+    reference = OracleScorer(device_state=False)
+    return cluster, cache, gang_names, nodes, reference
+
+
+def test_wire_delta_survives_dropped_and_duplicated_frames(server):
+    """The delta-stream chaos case (docs/pipelining.md "Device-resident
+    state"): the proxy drops one delta frame mid-stream, then duplicates
+    one. Either way the sidecar must detect the generation gap and refuse
+    to apply stale/duplicate rows (DELTA_RESYNC), the client must resync
+    through a full keyframe, and every published plan must stay
+    bit-identical to an independent full-repack scorer — a silently
+    stale-row plan is the one forbidden outcome."""
+    chaos = ChaosProxy(*server.address, c2s_frames=True)
+    reg = Registry()
+    client = ResilientOracleClient(
+        *chaos.address,
+        timeout=2.0,
+        registry=reg,
+        retry_policy=RetryPolicy(max_attempts=4, base_delay=0.01, max_delay=0.05),
+        breaker=CircuitBreaker(failure_threshold=16, reset_timeout=0.3),
+    )
+    remote = RemoteScorer(client, fallback="deny")
+    assert remote._wire_delta_ok  # resilient transport: delta path live
+    cluster, cache, gang_names, nodes, reference = _delta_world()
+
+    def refresh_and_compare():
+        for s in (remote, reference):
+            s.mark_dirty()
+            s.ensure_fresh(cluster, cache, group=gang_names[0])
+        for full_name in gang_names:
+            assert remote.placed(full_name) == reference.placed(full_name)
+            assert remote.gang_feasible(full_name) == reference.gang_feasible(
+                full_name
+            )
+            assert remote.assignment(full_name) == reference.assignment(
+                full_name
+            )
+
+    wire_kinds = DEFAULT_REGISTRY.counter(
+        "bst_oracle_wire_delta_batches_total"
+    )
+
+    def kind_count(kind):
+        return wire_kinds.value(kind=kind)
+
+    resyncs = DEFAULT_REGISTRY.counter("bst_oracle_wire_delta_resyncs_total")
+
+    # healthy baseline: keyframe installs the mirror, churn rides deltas
+    refresh_and_compare()
+    cluster.bind(make_pod("warm-filler", requests={"cpu": "2"}), "n0")
+    deltas_before = kind_count("delta")
+    refresh_and_compare()
+    assert kind_count("delta") == deltas_before + 1
+
+    # 1) DROPPED delta frame: the request vanishes, the socket read times
+    # out, the resilient client retries on a fresh connection — where the
+    # sidecar has no mirror and answers DELTA_RESYNC; the client resyncs
+    # through a keyframe and the plan is still exact
+    resyncs_before = resyncs.value()
+    keyframes_before = kind_count("keyframe")
+    chaos.set_fault("drop_c2s", probability=1.0, limit=1)
+    cluster.bind(make_pod("drop-filler", requests={"cpu": "2"}), "n1")
+    refresh_and_compare()
+    assert chaos.injected_counts()["drop_c2s"] == 1
+    assert resyncs.value() >= resyncs_before + 1
+    assert kind_count("keyframe") >= keyframes_before + 1
+
+    # steady state returns to deltas after the resync
+    cluster.bind(make_pod("steady-filler", requests={"cpu": "2"}), "n2")
+    deltas_before = kind_count("delta")
+    refresh_and_compare()
+    assert kind_count("delta") == deltas_before + 1
+
+    # 2) DUPLICATED delta frame: the sidecar applies the first copy and
+    # must REFUSE the second on the generation check (never scatter the
+    # same delta twice); the stale DELTA_RESYNC left in the stream makes
+    # the client drop the lane and keyframe — plans stay exact throughout
+    chaos.set_fault("dup_c2s", probability=1.0, limit=1)
+    cluster.bind(make_pod("dup-filler", requests={"cpu": "2"}), "n3")
+    refresh_and_compare()
+    assert chaos.injected_counts()["dup_c2s"] == 1
+    cluster.bind(make_pod("post-dup-filler", requests={"cpu": "2"}), "n4")
+    refresh_and_compare()
+    cluster.bind(make_pod("tail-filler", requests={"cpu": "2"}), "n5")
+    refresh_and_compare()
+
+    remote.close()
+    chaos.stop()

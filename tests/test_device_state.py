@@ -1,0 +1,365 @@
+"""Device-resident cluster state (ops.device_state, docs/pipelining.md
+"Device-resident state"): the packer's churned-row delta records, the
+holder's scatter-apply vs keyframe-resync transitions (bit-identity against
+the host-packed snapshot at every step), the BST_DEVICE_STATE knob, the
+wire delta protocol frames, and the RemoteScorer fallback matrix (old
+peers, plain clients)."""
+
+import numpy as np
+import pytest
+
+from batch_scheduler_tpu.ops.device_state import (
+    DeviceStateHolder,
+    device_state_enabled,
+    device_state_report,
+)
+from batch_scheduler_tpu.ops.snapshot import DeltaSnapshotPacker, GroupDemand
+from batch_scheduler_tpu.service import protocol as proto
+
+from helpers import make_node
+
+
+def _world(n=8, g=4):
+    nodes = [
+        make_node(f"n{i:02d}", {"cpu": "16", "memory": "64Gi", "pods": "110"})
+        for i in range(n)
+    ]
+    groups = [
+        GroupDemand(
+            full_name=f"default/gang-{i}",
+            min_member=3,
+            member_request={"cpu": 2000, "memory": 4 * 1024**3},
+            creation_ts=float(i),
+        )
+        for i in range(g)
+    ]
+    node_req = {
+        nd.metadata.name: {"cpu": 1000 * (i % 3), "pods": i % 4}
+        for i, nd in enumerate(nodes)
+    }
+    return nodes, groups, node_req
+
+
+def _args_equal(device_args, snap):
+    host = snap.device_args()
+    return all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(device_args, host)
+    )
+
+
+# -- packer delta records ---------------------------------------------------
+
+
+def test_packer_emits_keyframe_then_deltas():
+    nodes, groups, node_req = _world()
+    packer = DeltaSnapshotPacker()
+    snap = packer.pack(nodes, node_req, groups)
+    assert snap.delta.kind == "keyframe"
+    assert snap.delta.reason == "first"
+    assert snap.delta.generation == 1
+
+    node_req["n03"] = {"cpu": 9000, "pods": 3}
+    snap2 = packer.pack(nodes, node_req, groups)
+    assert snap2.delta.kind == "delta"
+    assert snap2.delta.generation == 2
+    assert snap2.delta.node_rows.tolist() == [3]
+    assert snap2.delta.group_rows.tolist() == []
+
+    # group demand churn: positional group row listed
+    groups[1].member_request = {"cpu": 3000}
+    snap3 = packer.pack(nodes, node_req, groups)
+    assert snap3.delta.kind == "delta"
+    assert snap3.delta.group_rows.tolist() == [1]
+
+
+def test_packer_keyframe_reasons():
+    nodes, groups, node_req = _world()
+    packer = DeltaSnapshotPacker()
+    packer.pack(nodes, node_req, groups)
+
+    # node OBJECT churn (resource_version bump) -> full repack -> keyframe
+    nodes[2].metadata.resource_version = "bumped"
+    snap = packer.pack(nodes, node_req, groups)
+    assert (snap.delta.kind, snap.delta.reason) == ("keyframe", "node-churn")
+
+    # group set change -> positional indices break -> keyframe
+    groups.append(
+        GroupDemand(
+            full_name="default/late", min_member=1,
+            member_request={"cpu": 100}, creation_ts=99.0,
+        )
+    )
+    snap = packer.pack(nodes, node_req, groups)
+    assert (snap.delta.kind, snap.delta.reason) == ("keyframe", "group-set")
+
+    # node list change
+    nodes2 = nodes[:-1]
+    snap = packer.pack(nodes2, node_req, groups)
+    assert (snap.delta.kind, snap.delta.reason) == ("keyframe", "node-list")
+
+
+# -- holder transitions -----------------------------------------------------
+
+
+def test_holder_scatter_matches_host_pack_bitwise():
+    nodes, groups, node_req = _world()
+    packer = DeltaSnapshotPacker()
+    holder = DeviceStateHolder(label="test")
+    snap = packer.pack(nodes, node_req, groups)
+    args = holder.sync(snap)
+    assert _args_equal(args, snap)
+    assert holder.stats()["keyframes"] == {"first": 1}
+
+    for round_no in range(3):
+        node_req[f"n{round_no:02d}"] = {"cpu": 500 + round_no, "pods": 1}
+        groups[round_no % len(groups)].member_request = {
+            "cpu": 1000 + round_no
+        }
+        snap = packer.pack(nodes, node_req, groups)
+        args = holder.sync(snap)
+        assert snap.delta.kind == "delta"
+        assert _args_equal(args, snap), f"divergence at round {round_no}"
+    stats = holder.stats()
+    assert stats["deltas_applied"] == 3
+    assert stats["rows_scattered"] >= 6  # one node + one group row per round
+    assert stats["generation"] == snap.delta.generation
+
+
+def test_holder_generation_gap_forces_keyframe():
+    """A pack whose delta never reached the holder (the forbidden silent
+    case) must resync from a keyframe — never scatter a later delta on top
+    of a stale base."""
+    nodes, groups, node_req = _world()
+    packer = DeltaSnapshotPacker()
+    holder = DeviceStateHolder(label="test")
+    holder.sync(packer.pack(nodes, node_req, groups))
+
+    node_req["n01"] = {"cpu": 777}
+    packer.pack(nodes, node_req, groups)  # delta NOT synced: the gap
+    node_req["n02"] = {"cpu": 888}
+    snap = packer.pack(nodes, node_req, groups)
+    args = holder.sync(snap)
+    assert _args_equal(args, snap)  # exact anyway — via keyframe
+    assert holder.stats()["keyframes"].get("generation") == 1
+
+
+def test_holder_apply_rows_refuses_stale_base():
+    """The wire-mirror form of the same contract: apply_rows with a
+    mismatched base generation returns None (the server answers
+    DELTA_RESYNC on it), and a duplicate application is refused."""
+    nodes, groups, node_req = _world()
+    packer = DeltaSnapshotPacker()
+    holder = DeviceStateHolder(label="test")
+    snap = packer.pack(nodes, node_req, groups)
+    holder.keyframe(snap.device_args(), 7, "wire-keyframe")
+
+    node_req["n04"] = {"cpu": 4242}
+    snap2 = packer.pack(nodes, node_req, groups)
+    idx = snap2.delta.node_rows
+    update = (idx, np.asarray(snap2.requested)[idx])
+    small = (snap2.remaining, snap2.fit_mask, snap2.group_valid, snap2.order)
+    out = holder.apply_rows(7, 8, update, None, small)
+    assert out is not None
+    # the duplicate: same delta again — base 7 no longer matches mirror 8
+    assert holder.apply_rows(7, 8, update, None, small) is None
+    # and a gapped future delta is refused too
+    assert holder.apply_rows(9, 10, update, None, small) is None
+
+
+def test_holder_bucket_growth_keyframes():
+    nodes, groups, node_req = _world(n=8)
+    packer = DeltaSnapshotPacker()
+    holder = DeviceStateHolder(label="test")
+    holder.sync(packer.pack(nodes, node_req, groups))
+    # enough new nodes to cross the padded node bucket -> shapes change
+    big_nodes = nodes + [
+        make_node(f"x{i}", {"cpu": "16", "memory": "64Gi", "pods": "110"})
+        for i in range(32)
+    ]
+    snap = packer.pack(big_nodes, node_req, groups)
+    args = holder.sync(snap)
+    assert _args_equal(args, snap)
+    assert snap.delta.reason == "node-list"
+    assert holder.stats()["keyframes"].get("node-list") == 1
+
+
+def test_holder_report_registry():
+    holder = DeviceStateHolder(label="report-probe")
+    labels = [h["label"] for h in device_state_report()]
+    assert "report-probe" in labels
+    del holder
+
+
+# -- knob ------------------------------------------------------------------
+
+
+def test_device_state_knob_parse_guard(monkeypatch):
+    monkeypatch.delenv("BST_DEVICE_STATE", raising=False)
+    assert device_state_enabled() is True
+    monkeypatch.setenv("BST_DEVICE_STATE", "0")
+    assert device_state_enabled() is False
+    monkeypatch.setenv("BST_DEVICE_STATE", "off")
+    assert device_state_enabled() is False
+    # unparseable degrades to the default, never raises
+    monkeypatch.setenv("BST_DEVICE_STATE", "bananas")
+    assert device_state_enabled() is True
+
+
+# -- wire frames ------------------------------------------------------------
+
+
+def _delta_request(n=6, g=3, r=4):
+    rng = np.random.RandomState(0)
+    return proto.DeltaScheduleRequest(
+        node_idx=np.array([1, 4], np.int32),
+        node_rows=rng.randint(0, 99, (2, r)).astype(np.int32),
+        group_idx=np.array([2], np.int32),
+        group_rows=rng.randint(0, 99, (1, r)).astype(np.int32),
+        remaining=rng.randint(0, 5, g).astype(np.int32),
+        fit_mask=np.ones((1, n), bool),
+        group_valid=np.ones(g, bool),
+        order=np.arange(g, dtype=np.int32),
+        min_member=np.full(g, 3, np.int32),
+        scheduled=np.zeros(g, np.int32),
+        matched=np.zeros(g, np.int32),
+        ineligible=np.zeros(g, bool),
+        creation_rank=np.arange(g, dtype=np.int32),
+        n=n,
+        g=g,
+        r=r,
+    )
+
+
+def test_delta_rows_frame_roundtrip():
+    d = _delta_request()
+    payload = proto.pack_delta_rows(41, 42, d)
+    kind, base_gen, new_gen, out = proto.unpack_delta_schedule_request(payload)
+    assert (kind, base_gen, new_gen) == (proto.DELTA_ROWS, 41, 42)
+    for field in (
+        "node_idx", "node_rows", "group_idx", "group_rows", "remaining",
+        "fit_mask", "group_valid", "order", "min_member", "scheduled",
+        "matched", "ineligible", "creation_rank",
+    ):
+        assert np.array_equal(getattr(out, field), getattr(d, field)), field
+    assert (out.n, out.g, out.r) == (d.n, d.g, d.r)
+
+
+def test_delta_keyframe_frame_is_a_schedule_request():
+    nodes, groups, node_req = _world()
+    snap = DeltaSnapshotPacker().pack(nodes, node_req, groups)
+    req = proto.ScheduleRequest(
+        alloc=snap.alloc, requested=snap.requested, group_req=snap.group_req,
+        remaining=snap.remaining, fit_mask=snap.fit_mask,
+        group_valid=snap.group_valid, order=snap.order,
+        min_member=snap.min_member, scheduled=snap.scheduled,
+        matched=snap.matched, ineligible=snap.ineligible,
+        creation_rank=snap.creation_rank,
+    )
+    payload = proto.pack_delta_keyframe(9, req)
+    kind, _, new_gen, out = proto.unpack_delta_schedule_request(payload)
+    assert (kind, new_gen) == (proto.DELTA_KEYFRAME, 9)
+    assert np.array_equal(out.alloc, np.asarray(snap.alloc))
+    assert np.array_equal(out.requested, np.asarray(snap.requested))
+
+
+def test_delta_resync_roundtrip():
+    reason = "generation gap: mirror at 3, delta base 1"
+    assert proto.unpack_delta_resync(proto.pack_delta_resync(reason)) == reason
+
+
+def test_delta_rows_frame_rejects_trailing_bytes():
+    payload = proto.pack_delta_rows(1, 2, _delta_request()) + b"x"
+    with pytest.raises(ValueError):
+        proto.unpack_delta_schedule_request(payload)
+
+
+# -- RemoteScorer fallback matrix ------------------------------------------
+
+
+class _FakeResilient:
+    """Just enough surface for RemoteScorer's wire-delta gating."""
+
+    window = 1
+
+    def would_attempt(self):
+        return True
+
+    def delta_schedule(self, *a, **k):
+        raise RuntimeError("oracle server error: unknown message type 14")
+
+    def schedule(self, *a, **k):
+        raise AssertionError("not exercised here")
+
+    def close(self):
+        pass
+
+
+def test_wire_delta_gating():
+    from batch_scheduler_tpu.service.client import OracleClient, RemoteScorer
+
+    # a resilient-shaped transport gets the delta path
+    scorer = RemoteScorer(_FakeResilient())
+    assert scorer._wire_delta_ok
+    # a plain OracleClient (no reconnect: resync recovery needs re-dial)
+    # stays on full snapshots
+    plain = OracleClient.__new__(OracleClient)  # no real socket
+    scorer2 = RemoteScorer(plain)
+    assert not scorer2._wire_delta_ok
+
+
+def test_old_peer_falls_back_to_full_snapshots(monkeypatch):
+    """A peer without MsgType 14 answers an in-band unknown-message-type
+    error: the scorer must permanently drop to full snapshots (bit-
+    identical path) instead of erroring every batch."""
+    from batch_scheduler_tpu.service.client import RemoteScorer
+
+    sent = []
+
+    class _OldPeer(_FakeResilient):
+        def schedule(self, req, **k):
+            sent.append("full")
+            raise RuntimeError("stub transport: no real server")
+
+    scorer = RemoteScorer(_OldPeer())
+    nodes, groups, node_req = _world()
+    snap = DeltaSnapshotPacker().pack(nodes, node_req, groups)
+    scorer._note_pack(snap)
+    with pytest.raises(RuntimeError, match="stub transport"):
+        scorer._execute(snap)
+    assert sent == ["full"]
+    assert not scorer._wire_delta_ok
+
+
+def test_apply_rows_refuses_negative_indices():
+    """A negative scatter index would WRAP in .at[].set and corrupt an
+    unrelated resident row — it must be refused (resync), like any other
+    out-of-range index (review finding)."""
+    nodes, groups, node_req = _world()
+    packer = DeltaSnapshotPacker()
+    holder = DeviceStateHolder(label="test")
+    snap = packer.pack(nodes, node_req, groups)
+    holder.keyframe(snap.device_args(), 1, "wire-keyframe")
+    rows = np.asarray(snap.requested)[:1]
+    small = (snap.remaining, snap.fit_mask, snap.group_valid, snap.order)
+    bad = (np.array([-1], np.int32), rows)
+    assert holder.apply_rows(1, 2, bad, None, small) is None
+    assert holder.apply_rows(1, 2, None, bad, small) is None
+    # the refusal must not have advanced the generation
+    assert holder.current_generation() == 1
+
+
+def test_wire_delta_rows_lane_domain_enforced():
+    """The delta path must enforce the same LANE_MAX boundary the
+    full-snapshot wire path enforces in pad_oracle_batch — an
+    out-of-domain lane raises OverflowError instead of reaching
+    _exact_floordiv (review finding)."""
+    from batch_scheduler_tpu.service.server import _pad_delta_request
+
+    d = _delta_request()
+    small, progress = _pad_delta_request(d)  # in-domain: fine
+    assert small[0].shape[0] >= d.g and len(progress) == 5
+    d.node_rows = d.node_rows.copy()
+    d.node_rows[0, 0] = 2**30 + 1
+    with pytest.raises(OverflowError, match="LANE_MAX"):
+        _pad_delta_request(d)
