@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all build vet analyze stamp-coupling test test-cpu test-tier1 bench bench-scan bench-pipeline bench-delta bench-policy bench-sharding bench-xl bench-regress validate-artifacts native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
+.PHONY: all build vet analyze stamp-coupling test test-cpu test-tier1 bench bench-scan bench-pipeline bench-delta bench-policy bench-whatif bench-sharding bench-xl bench-regress validate-artifacts native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
 
 all: vet analyze native test bench-regress validate-artifacts
 
@@ -108,6 +108,16 @@ trace-demo:
 # bit-identically on steady + cpu-ladder (docs/policy.md)
 bench-policy:
 	$(PY) benchmarks/policy_gate.py
+
+# explain/what-if observatory CI gate (CPU): each counterfactual kind's
+# forked what-if plan bit-identical to a cluster that actually applied
+# it; an interleaved what-if storm leaves the live device-resident
+# holder's generation/digests untouched; explain's blame byte-matches
+# the flight recorder on every denied gang of a recorded run; warm
+# what-if query <= 2x one steady batch at the 5k-node/10k-pod bucket
+# (docs/observability.md "Explain" / "What-if")
+bench-whatif:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/whatif_gate.py
 
 # audit/replay/health CI gate (CPU): records a short sim into an audit
 # ring, replays every batch bit-identically (steady + cpu-ladder rungs),

@@ -63,6 +63,15 @@ COUPLED_GROUPS: Dict[str, List[str]] = {
         "batch_scheduler_tpu/ops/device_state.py::_scatter_impl",
         "batch_scheduler_tpu/ops/device_state.py::DeviceStateHolder.apply_rows",
     ],
+    # the explain kernel's entry-leftover capture replays the serial scan
+    # body (base and policy-composite forms): its captured leftover IS
+    # the explanation's evidence, so the step formula must change
+    # together with the scans it mirrors
+    "explain-entry-capture": [
+        "batch_scheduler_tpu/ops/oracle.py::assign_gangs",
+        "batch_scheduler_tpu/ops/oracle.py::assign_gangs_policy",
+        "batch_scheduler_tpu/ops/explain.py::_scan_take",
+    ],
 }
 
 

@@ -303,6 +303,71 @@ def build_parser() -> argparse.ArgumentParser:
         help="also write the summary JSON (with full blame reports) here",
     )
 
+    exp = sub.add_parser(
+        "explain",
+        help="why is this gang pending — structured denial breakdown "
+             "(per-lane deficits, binding lane, near-miss nodes, "
+             "preemption candidacy) from a live scheduler's "
+             "/debug/explain or offline from an audit ring "
+             "(docs/observability.md 'Explain')",
+    )
+    exp.add_argument("gang", help="the gang's full name (namespace/name)")
+    exp_src = exp.add_mutually_exclusive_group(required=True)
+    exp_src.add_argument(
+        "--addr", metavar="HOST:PORT",
+        help="a live scheduler's --metrics-port endpoint "
+             "(queries /debug/explain)",
+    )
+    exp_src.add_argument(
+        "--audit-dir", metavar="DIR",
+        help="explain offline from a recorded audit ring (the exact "
+             "packed inputs of a recorded batch; lane names degrade to "
+             "lane<i> — the record carries no schema)",
+    )
+    exp.add_argument(
+        "--batch", type=int, default=None, metavar="K",
+        help="with --audit-dir: explain the record with seq K "
+             "(default: the newest reconstructable record)",
+    )
+
+    wi = sub.add_parser(
+        "whatif",
+        help="score a counterfactual against a live scheduler's cluster "
+             "state on a forked device-resident buffer and print the "
+             "placement diff (docs/observability.md 'What-if')",
+    )
+    wi.add_argument(
+        "--addr", required=True, metavar="HOST:PORT",
+        help="a live scheduler's --metrics-port endpoint "
+             "(queries /debug/whatif)",
+    )
+    wi_kind = wi.add_mutually_exclusive_group(required=True)
+    wi_kind.add_argument("--drain", metavar="NODE",
+                         help="remove NODE (and its load) from the cluster")
+    wi_kind.add_argument("--cordon", metavar="NODE",
+                         help="mark NODE unschedulable, load kept")
+    wi_kind.add_argument("--add-nodes", type=int, metavar="N",
+                         help="add N nodes of --node-cpu/--node-memory")
+    wi_kind.add_argument("--bump-gang", metavar="NS/NAME",
+                         help="set a gang's priority tier to --tier")
+    wi_kind.add_argument("--remove-gang", metavar="NS/NAME",
+                         help="remove a gang from the queue")
+    wi.add_argument("--tier", type=int, default=None,
+                    help="the priority tier for --bump-gang")
+    wi.add_argument("--node-cpu", default="32",
+                    help="shape of --add-nodes nodes (default 32)")
+    wi.add_argument("--node-memory", default="128Gi",
+                    help="shape of --add-nodes nodes (default 128Gi)")
+    wi.add_argument("--node-pods", default="110",
+                    help="pod capacity of --add-nodes nodes (default 110)")
+    wi.add_argument(
+        "--rung", default="steady",
+        choices=("steady", "wavefront", "cpu-ladder", "topk"),
+        help="the scan rung the what-if scores on (non-steady rungs are "
+             "thread-locally pinned — the replay discipline; plans are "
+             "bit-identical across rungs by construction)",
+    )
+
     chk = sub.add_parser("check-config", help="validate a scheduler config JSON")
     _add_config_flag(chk)
 
@@ -578,6 +643,117 @@ def cmd_replay(args) -> int:
         )
         return 2
     return 0
+
+
+def _debug_get(addr: str, path: str, params: Dict[str, str]) -> tuple:
+    """GET a /debug endpoint on a live --metrics-port; returns
+    (payload dict, http status)."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    host, _, port = addr.rpartition(":")
+    if not port.isdigit():
+        return {"error": f"--addr {addr!r} is not HOST:PORT"}, 0
+    url = (
+        f"http://{host or '127.0.0.1'}:{port}{path}"
+        f"?{urllib.parse.urlencode(params)}"
+    )
+    try:
+        with urllib.request.urlopen(url, timeout=120) as resp:
+            return json.loads(resp.read().decode()), resp.status
+    except urllib.error.HTTPError as e:
+        try:
+            return json.loads(e.read().decode()), e.code
+        except ValueError:
+            return {"error": f"HTTP {e.code}"}, e.code
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        # connection refused / unreachable endpoint: a clean error (and
+        # exit 2 in the callers), never a traceback — this is the
+        # ready-to-paste command line sim's exit verdict prints
+        return {"error": f"cannot reach {addr}: {e}"}, 0
+
+
+def cmd_explain(args) -> int:
+    """Why is this gang pending. Live mode queries /debug/explain on a
+    running scheduler; offline mode re-derives the breakdown from a
+    recorded audit batch (the replay machinery's inputs). Exit 0 on a
+    structured answer, 2 when the gang/record cannot be found."""
+    if args.addr:
+        payload, status = _debug_get(
+            args.addr, "/debug/explain", {"gang": args.gang}
+        )
+        print(json.dumps(payload, indent=2, default=str))
+        return 0 if status == 200 and "error" not in payload else 2
+    from ..core.explain import explain_arrays
+    from ..utils.audit import AuditReader
+
+    _resolve_backend_or_degrade()
+    _enable_compilation_cache()
+    batches, _skipped = AuditReader(args.audit_dir).batches()
+    if args.batch is not None:
+        batches = [r for r in batches if r.get("seq") == args.batch]
+    if not batches:
+        print(
+            f"error: no reconstructable batch record in {args.audit_dir}"
+            + (f" with seq {args.batch}" if args.batch is not None else ""),
+            file=sys.stderr,
+        )
+        return 2
+    record = batches[-1]
+    names = record.get("names") or {}
+    groups = names.get("groups") or []
+    if args.gang not in groups:
+        print(
+            f"error: gang {args.gang!r} not in record seq="
+            f"{record.get('seq')} ({len(groups)} gangs)",
+            file=sys.stderr,
+        )
+        return 2
+    out = explain_arrays(
+        record["batch_args"], groups.index(args.gang),
+        node_names=names.get("nodes"),
+        policy=record.get("policy_args"),
+    )
+    out["gang"] = args.gang
+    out["source"] = {
+        "audit_dir": args.audit_dir,
+        "seq": record.get("seq"),
+        "audit_id": record.get("audit_id"),
+    }
+    print(json.dumps(out, indent=2, default=str))
+    from ..ops.oracle import drain_telemetry_threads
+
+    drain_telemetry_threads(timeout=60.0)  # same teardown rule as replay
+    return 0
+
+
+def cmd_whatif(args) -> int:
+    """Score one counterfactual against live cluster state (the
+    /debug/whatif endpoint's CLI face). Exit 0 on a diff, 2 on error."""
+    # `is not None`, not truthiness: argparse guarantees exactly one of
+    # the group was provided, and `--add-nodes 0` must reach the server's
+    # own range validation (a 400) instead of silently sending nothing
+    params: Dict[str, str] = {"rung": args.rung}
+    if args.drain is not None:
+        params["drain"] = args.drain
+    elif args.cordon is not None:
+        params["cordon"] = args.cordon
+    elif args.add_nodes is not None:
+        params.update(
+            add_nodes=str(args.add_nodes), node_cpu=args.node_cpu,
+            node_memory=args.node_memory, node_pods=args.node_pods,
+        )
+    elif args.bump_gang is not None:
+        if args.tier is None:
+            print("error: --bump-gang requires --tier", file=sys.stderr)
+            return 2
+        params.update(bump_gang=args.bump_gang, tier=str(args.tier))
+    elif args.remove_gang is not None:
+        params["remove_gang"] = args.remove_gang
+    payload, status = _debug_get(args.addr, "/debug/whatif", params)
+    print(json.dumps(payload, indent=2, default=str))
+    return 0 if status == 200 and "error" not in payload else 2
 
 
 def cmd_serve(args) -> int:
@@ -935,6 +1111,18 @@ def cmd_sim(args) -> int:
             f"slo health: {health['verdict']}"
             + (f" ({bad})" if bad else "")
         )
+        # pending-gang aging in the exit verdict: who is starving and how
+        # long (the live form is the /debug/health "pending" signal)
+        pend = health["signals"].get("pending") or {}
+        if pend.get("pending_gangs"):
+            print(
+                f"pending gangs: {pend['pending_gangs']} "
+                f"(oldest {pend.get('oldest_gang')} "
+                f"{pend.get('oldest_age_s', 0):.1f}s, max deny streak "
+                f"{pend.get('max_deny_streak', 0)}) — explain with: "
+                f"python -m batch_scheduler_tpu explain "
+                f"{pend.get('oldest_gang')} --addr <metrics-port>"
+            )
         if tracing:
             from ..utils.trace import DEFAULT_FLIGHT_RECORDER
 
@@ -962,6 +1150,8 @@ COMMANDS = {
     "serve": cmd_serve,
     "sim": cmd_sim,
     "replay": cmd_replay,
+    "explain": cmd_explain,
+    "whatif": cmd_whatif,
 }
 
 

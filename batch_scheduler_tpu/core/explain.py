@@ -1,0 +1,865 @@
+"""The gang observatory: explain ("why is my gang pending") and what-if
+("what change would place it") over the live oracle.
+
+Two product surfaces over machinery earlier PRs built (docs/observability.md
+"Explain" / "What-if"):
+
+- **Explain** (``Observatory.explain``, ``/debug/explain?gang=NS/NAME``,
+  the ``explain`` CLI subcommand): runs the jit'd ``ops.explain``
+  breakdown kernel on the CURRENT batch's packed inputs and assembles the
+  human answer — denial verdict with the EXACT PreFilter blame string
+  (core.operation's deny-reason builders, so explanation and recorded
+  denial can never drift), per-lane deficits + the binding lane, hard-mask
+  vs capacity exclusion counts, near-miss nodes with per-term policy
+  penalties (policy.engine.PolicyEngine.explain), preemption candidacy
+  (policy.preempt.PreemptionPlanner dry-run), all cross-stamped against
+  the flight recorder's decision records (``recorded_agrees``).
+
+- **What-if** (``Observatory.whatif``, ``/debug/whatif``, the ``whatif``
+  CLI subcommand): forks the device-resident state copy-on-write
+  (ops.device_state.DeviceStateHolder.fork — NEVER the live holder, which
+  concurrent batches keep scoring), applies a counterfactual (drain /
+  cordon node, add N nodes of a shape, bump a gang's priority tier,
+  remove a gang) to a fresh read of the live cluster inputs, re-runs the
+  EXACT scoring path on the forked state (the replay rung-pinning
+  discipline: a non-steady rung runs under ops.oracle.forced_scan_rung,
+  so a what-if can never flip a process gate or demote a serving
+  feature), and returns a placement diff — newly-placeable gangs,
+  displaced seats, per-lane headroom delta. Counterfactual correctness is
+  gated by ``make bench-whatif``: applying C through the engine is
+  bit-identical (plan digest) to a cluster that actually applied C and
+  rescheduled, and the live holder's generation/digests are untouched.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import replace
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "Observatory",
+    "WhatIfEngine",
+    "COUNTERFACTUAL_KINDS",
+    "WHATIF_RUNGS",
+    "parse_counterfactual",
+    "apply_counterfactual",
+    "explain_arrays",
+    "baseline_inputs_key",
+    "set_active_observatory",
+    "active_observatory",
+    "explain_debug_view",
+    "whatif_debug_view",
+]
+
+COUNTERFACTUAL_KINDS = (
+    "drain", "cordon", "add-nodes", "bump-gang", "remove-gang",
+)
+
+# The rungs a what-if may score on — REPLAY_RUNGS minus nothing: "steady"
+# executes exactly what this process would dispatch now; the others are
+# thread-locally pinned (forced_scan_rung), so a what-if failure never
+# permanently demotes a serving feature.
+WHATIF_RUNGS = ("steady", "wavefront", "cpu-ladder", "topk")
+
+
+# ---------------------------------------------------------------------------
+# counterfactuals
+# ---------------------------------------------------------------------------
+
+
+def parse_counterfactual(params: Dict[str, str]) -> dict:
+    """Normalize the /debug/whatif query grammar (one counterfactual per
+    query) into the canonical dict form; raises ValueError with the full
+    grammar on anything malformed. Grammar (docs/observability.md):
+
+      ?drain=NODE
+      ?cordon=NODE
+      ?add_nodes=N[&node_cpu=32][&node_memory=128Gi][&node_pods=110]
+      ?bump_gang=NS/NAME&tier=T
+      ?remove_gang=NS/NAME
+    """
+    present = [
+        k for k in ("drain", "cordon", "add_nodes", "bump_gang",
+                    "remove_gang")
+        if params.get(k)
+    ]
+    if len(present) != 1:
+        raise ValueError(
+            "exactly one counterfactual per query: ?drain=NODE | "
+            "?cordon=NODE | ?add_nodes=N[&node_cpu=..][&node_memory=..]"
+            "[&node_pods=..] | ?bump_gang=NS/NAME&tier=T | "
+            "?remove_gang=NS/NAME"
+        )
+    key = present[0]
+    if key == "drain":
+        return {"kind": "drain", "node": params["drain"]}
+    if key == "cordon":
+        return {"kind": "cordon", "node": params["cordon"]}
+    if key == "add_nodes":
+        try:
+            count = int(params["add_nodes"])
+        except ValueError:
+            raise ValueError(
+                f"add_nodes={params['add_nodes']!r} is not an integer"
+            ) from None
+        if not 0 < count <= 4096:
+            raise ValueError("add_nodes must be in [1, 4096]")
+        return {
+            "kind": "add-nodes",
+            "count": count,
+            "shape": {
+                "cpu": params.get("node_cpu", "32"),
+                "memory": params.get("node_memory", "128Gi"),
+                "pods": params.get("node_pods", "110"),
+            },
+        }
+    if key == "bump_gang":
+        try:
+            tier = int(params.get("tier", ""))
+        except ValueError:
+            raise ValueError(
+                "bump_gang requires &tier=T (an integer priority class)"
+            ) from None
+        return {"kind": "bump-gang", "gang": params["bump_gang"],
+                "tier": tier}
+    return {"kind": "remove-gang", "gang": params["remove_gang"]}
+
+
+def apply_counterfactual(nodes: list, node_req: dict, demands: list,
+                         cf: dict) -> Tuple[list, dict, list]:
+    """Apply one counterfactual to host-side cluster inputs, returning
+    NEW (nodes, node_requested, demands) — the live objects are never
+    mutated (cordon deep-copies its node). This is deliberately the same
+    surface a real cluster change flows through (the inputs
+    ``core.oracle_scorer.read_cluster_inputs`` reads), which is what makes
+    the what-if plan bit-identical to a cluster that actually applied the
+    change: both feed the identical pack + scoring path."""
+    kind = cf.get("kind")
+    if kind == "drain":
+        name = cf["node"]
+        out = [n for n in nodes if n.metadata.name != name]
+        if len(out) == len(nodes):
+            raise ValueError(f"unknown node {name!r}")
+        return out, {k: v for k, v in node_req.items() if k != name}, demands
+    if kind == "cordon":
+        name = cf["node"]
+        out = []
+        found = False
+        for n in nodes:
+            if n.metadata.name == name:
+                n = n.deepcopy()
+                n.spec.unschedulable = True
+                found = True
+            out.append(n)
+        if not found:
+            raise ValueError(f"unknown node {name!r}")
+        return out, node_req, demands
+    if kind == "add-nodes":
+        from ..sim.scenarios import make_sim_node
+
+        added = [
+            make_sim_node(f"whatif-node-{i:04d}", dict(cf["shape"]))
+            for i in range(int(cf["count"]))
+        ]
+        return list(nodes) + added, node_req, demands
+    if kind == "bump-gang":
+        gang = cf["gang"]
+        out = [
+            replace(d, priority=int(cf["tier"]))
+            if d.full_name == gang else d
+            for d in demands
+        ]
+        if all(d is demands[i] for i, d in enumerate(out)):
+            raise ValueError(f"unknown gang {gang!r}")
+        return nodes, node_req, out
+    if kind == "remove-gang":
+        gang = cf["gang"]
+        out = [d for d in demands if d.full_name != gang]
+        if len(out) == len(demands):
+            raise ValueError(f"unknown gang {gang!r}")
+        return nodes, node_req, out
+    raise ValueError(
+        f"unknown counterfactual kind {kind!r} (use one of "
+        f"{COUNTERFACTUAL_KINDS})"
+    )
+
+
+# ---------------------------------------------------------------------------
+# rung-pinned execution (the replay discipline applied to the future)
+# ---------------------------------------------------------------------------
+
+
+def _execute_rung(batch_args, progress_args, rung: str, policy=None):
+    """Run one batch on ``rung`` and return the host result.
+
+    ``steady`` dispatches exactly what this process would serve right now
+    (device-resident fork args ride through untouched). Every other rung
+    runs under the thread-local ``forced_scan_rung`` pin replay uses —
+    never the process gates, never the disable-on-failure policy."""
+    from ..ops.oracle import execute_batch_host, forced_scan_rung
+
+    if rung == "steady":
+        host, _ = execute_batch_host(batch_args, progress_args,
+                                     policy=policy)
+        return host
+    if rung == "wavefront":
+        from ..ops.bucketing import wave_width_bucket
+
+        with forced_scan_rung(False, wave_width_bucket(8)):
+            host, _ = execute_batch_host(batch_args, progress_args,
+                                         policy=policy)
+        return host
+    if rung == "topk":
+        from ..ops.bucketing import topk_bucket, wave_width_bucket
+
+        with forced_scan_rung(False, wave_width_bucket(8),
+                              topk_bucket(16)):
+            host, _ = execute_batch_host(batch_args, progress_args,
+                                         policy=policy)
+        return host
+    if rung == "cpu-ladder":
+        import jax
+
+        batch_args = tuple(np.asarray(a) for a in batch_args)
+        progress_args = tuple(np.asarray(a) for a in progress_args)
+        cpu = jax.local_devices(backend="cpu")[0]
+        with forced_scan_rung(False, 0), jax.default_device(cpu):
+            host, _ = execute_batch_host(batch_args, progress_args,
+                                         policy=policy)
+        return host
+    raise ValueError(
+        f"unknown what-if rung {rung!r} (use one of {WHATIF_RUNGS})"
+    )
+
+
+# ---------------------------------------------------------------------------
+# what-if engine
+# ---------------------------------------------------------------------------
+
+
+def _placement_map(snap, host) -> Dict[str, Dict[str, int]]:
+    """gang -> {node: seats} for the batch's placed gangs (compact top-K
+    assignment; exact for gangs spanning <= K nodes — the same readback
+    OracleScorer.assignment serves)."""
+    out: Dict[str, Dict[str, int]] = {}
+    names = snap.node_names
+    placed = np.asarray(host["placed"])
+    nodes_rows = np.asarray(host["assignment_nodes"])
+    counts_rows = np.asarray(host["assignment_counts"])
+    for gi, gang in enumerate(snap.group_names):
+        if not bool(placed[gi]):
+            continue
+        seats: Dict[str, int] = {}
+        for idx, cnt in zip(nodes_rows[gi], counts_rows[gi]):
+            if cnt > 0 and int(idx) < len(names):
+                seats[names[int(idx)]] = int(cnt)
+        out[gang] = seats
+    return out
+
+
+def _feasible_set(snap, host) -> set:
+    feas = np.asarray(host["gang_feasible"])
+    return {
+        gang for gi, gang in enumerate(snap.group_names) if bool(feas[gi])
+    }
+
+
+def baseline_inputs_key(version, nodes, demands) -> tuple:
+    """The what-if baseline-cache key: a fingerprint of the INPUTS the
+    baseline was packed from. ``cluster.version()`` alone is not enough —
+    it bumps on node/pod capacity events but NOT on pod-group/demand
+    churn (a created gang flows through ``mark_dirty``/ensure_fresh, not
+    the version counter), and a baseline diffed against fresher demands
+    would attribute cluster churn to the counterfactual. O(G·R) host
+    hashing, trivia next to the batch it guards."""
+    return (
+        version,
+        len(nodes),
+        hash(
+            tuple(
+                (
+                    d.full_name, d.priority, d.min_member, d.scheduled,
+                    d.matched, d.released,
+                    tuple(sorted(d.member_request.items())),
+                )
+                for d in demands
+            )
+        ),
+    )
+
+
+def _headroom_by_lane(snap) -> Dict[str, int]:
+    """Per-lane schedulable headroom (device units): sum over valid nodes
+    of clip(alloc - requested, 0)."""
+    valid = np.asarray(snap.node_valid)
+    left = np.clip(
+        snap.alloc.astype(np.int64) - snap.requested.astype(np.int64),
+        0, None,
+    )
+    return {
+        name: int(left[valid, i].sum())
+        for i, name in enumerate(snap.schema.names)
+    }
+
+
+class WhatIfEngine:
+    """Counterfactual scorer over copy-on-write device-state forks.
+
+    One query = pack the (baseline, counterfactual) snapshots from the
+    SAME cluster read, install the baseline on a fork of the live
+    device-resident holder (keyframe — the live holder is never written),
+    apply the counterfactual to a fork-of-the-fork as row scatters
+    (copy-on-write: shared buffers, fresh arrays), execute both on the
+    requested rung, and diff. The baseline (snapshot + result + resident
+    fork) is cached per ``baseline_key`` so a what-if storm against an
+    unchanged cluster pays ONE extra batch per query — the <= 2x-steady
+    latency bound ``make bench-whatif`` enforces.
+    """
+
+    def __init__(self, holder_source=None, policy_engine=None):
+        # serializes queries end-to-end: the fork chain and baseline
+        # cache are single-writer, and the endpoint is a debug surface
+        self._lock = threading.Lock()
+        # callable -> the live DeviceStateHolder (or None); resolved per
+        # query so a scorer constructed later is still picked up
+        self._holder_source = holder_source
+        self.policy_engine = policy_engine
+        # (key, snap, host, digest, fork, device_args) of the cached
+        # baseline
+        self._baseline: Optional[tuple] = None  # guarded-by: _lock
+        self.queries = 0  # guarded-by: _lock
+
+    def _fork(self):
+        from ..ops.device_state import DeviceStateHolder
+
+        live = self._holder_source() if self._holder_source else None
+        if live is not None and live.mesh is None:
+            return live.fork()
+        # No live single-device holder: detached fork (keyframes
+        # everything; same semantics, no shared state). Covers
+        # BST_DEVICE_STATE=0, remote scorers (the device lives behind
+        # the sidecar), and MESH holders — their resident buffers are
+        # node-sharded for the sharded scan while the what-if executes
+        # replicated single-device; plans are bit-identical across those
+        # layouts by construction (docs/scan_parallelism.md), so nothing
+        # is lost but the buffer sharing.
+        return DeviceStateHolder(label="whatif").fork()
+
+    def _pack(self, nodes, node_req, demands):
+        from ..ops.snapshot import ClusterSnapshot
+
+        engine = self.policy_engine
+        if engine is not None and not engine.enabled:
+            engine = None
+        return ClusterSnapshot(
+            nodes, node_req, demands, policy_engine=engine
+        )
+
+    def _digest(self, host) -> str:
+        from ..utils import audit as audit_mod
+
+        return audit_mod.plan_digest(host)
+
+    def query_on(self, nodes, node_req, demands, cf: dict,
+                 rung: str = "steady",
+                 baseline_key=None) -> dict:
+        """Score one counterfactual against explicit cluster inputs (the
+        Observatory passes a live read; gates pass synthetic ones).
+        Raises ValueError on a malformed counterfactual or unknown
+        node/gang."""
+        if rung not in WHATIF_RUNGS:
+            raise ValueError(
+                f"unknown what-if rung {rung!r} (use one of {WHATIF_RUNGS})"
+            )
+        t0 = time.perf_counter()
+        cf_nodes, cf_req, cf_demands = apply_counterfactual(
+            nodes, node_req, demands, cf
+        )
+        with self._lock:
+            self.queries += 1
+            cached = self._baseline
+            use_cache = (
+                cached is not None
+                and baseline_key is not None
+                and cached[0] == (baseline_key, rung)
+            )
+            if use_cache:
+                _, base_snap, base_host, base_digest, fork, base_args = (
+                    cached
+                )
+            else:
+                base_snap = self._pack(nodes, node_req, demands)
+                fork = self._fork()
+                base_args = fork.keyframe(
+                    base_snap.device_args(), 0, "whatif-base"
+                )
+                base_host = _execute_rung(
+                    base_args, base_snap.progress_args(), rung,
+                    policy=base_snap.policy_payload(),
+                )
+                base_digest = self._digest(base_host)
+                if baseline_key is not None:
+                    self._baseline = (
+                        (baseline_key, rung), base_snap, base_host,
+                        base_digest, fork, base_args,
+                    )
+            cf_snap = self._pack(cf_nodes, cf_req, cf_demands)
+            cf_fork = fork.fork()
+            cf_args = cf_fork.apply_batch(
+                cf_snap.device_args(), base_snap.device_args()
+            )
+            cf_host = _execute_rung(
+                cf_args, cf_snap.progress_args(), rung,
+                policy=cf_snap.policy_payload(),
+            )
+            cf_digest = self._digest(cf_host)
+        elapsed = time.perf_counter() - t0
+
+        base_place = _placement_map(base_snap, base_host)
+        cf_place = _placement_map(cf_snap, cf_host)
+        base_feas = _feasible_set(base_snap, base_host)
+        cf_feas = _feasible_set(cf_snap, cf_host)
+        moved: Dict[str, Dict[str, int]] = {}
+        displaced_seats = 0
+        for gang in sorted(set(base_place) & set(cf_place)):
+            b, c = base_place[gang], cf_place[gang]
+            if b == c:
+                continue
+            delta = {
+                node: c.get(node, 0) - b.get(node, 0)
+                for node in sorted(set(b) | set(c))
+                if c.get(node, 0) != b.get(node, 0)
+            }
+            moved[gang] = delta
+            displaced_seats += sum(-v for v in delta.values() if v < 0)
+        base_head = _headroom_by_lane(base_snap)
+        cf_head = _headroom_by_lane(cf_snap)
+
+        from ..utils.metrics import DEFAULT_REGISTRY
+
+        DEFAULT_REGISTRY.counter(
+            "bst_whatif_queries_total",
+            "What-if counterfactual queries by kind (/debug/whatif)",
+        ).inc(kind=cf["kind"])
+        DEFAULT_REGISTRY.histogram(
+            "bst_whatif_query_seconds",
+            "End-to-end what-if query time (pack + forked batch + diff)",
+        ).observe(elapsed)
+        return {
+            "kind": cf["kind"],
+            "counterfactual": dict(cf),
+            "rung": rung,
+            "elapsed_s": round(elapsed, 6),
+            "baseline_cached": bool(use_cache),
+            "base": {
+                "plan_digest": base_digest,
+                "groups": len(base_snap.group_names),
+                "nodes": len(base_snap.node_names),
+                "placed": len(base_place),
+                "feasible": len(base_feas),
+            },
+            "whatif": {
+                "plan_digest": cf_digest,
+                "groups": len(cf_snap.group_names),
+                "nodes": len(cf_snap.node_names),
+                "placed": len(cf_place),
+                "feasible": len(cf_feas),
+            },
+            "newly_placeable": sorted(set(cf_place) - set(base_place)),
+            "no_longer_placeable": sorted(
+                set(base_place) - set(cf_place)
+            ),
+            "feasibility_gained": sorted(cf_feas - base_feas),
+            "feasibility_lost": sorted(base_feas - cf_feas),
+            "displaced_seats": displaced_seats,
+            "moved": moved,
+            "headroom_delta": {
+                lane: cf_head.get(lane, 0) - base_head.get(lane, 0)
+                for lane in sorted(set(base_head) | set(cf_head))
+                if cf_head.get(lane, 0) != base_head.get(lane, 0)
+            },
+        }
+
+
+# ---------------------------------------------------------------------------
+# explain assembly (shared by the live observatory and the offline CLI)
+# ---------------------------------------------------------------------------
+
+
+def explain_arrays(batch_args, g: int, node_names: Optional[List[str]] = None,
+                   lane_names: Optional[List[str]] = None,
+                   policy=None) -> dict:
+    """Run the ops.explain kernel on one batch's packed inputs and fold
+    the arrays into the structured host payload (names attached when
+    known — the offline audit-record path has node/group names but no
+    lane schema, so lanes fall back to ``lane<i>``)."""
+    from ..ops.explain import explain_gang
+
+    args = tuple(np.asarray(a) for a in batch_args)
+    n_bucket = args[0].shape[0]
+    lanes_n = args[0].shape[1]
+    n_real = len(node_names) if node_names else n_bucket
+    kwargs = {}
+    if policy is not None:
+        cols, terms, weights = policy
+        kwargs = {
+            "policy_cols": tuple(np.asarray(c) for c in cols),
+            "policy_terms": tuple(terms),
+            "policy_weights": tuple(weights),
+        }
+    res = explain_gang(
+        *args, np.int32(g), np.int32(n_real), **kwargs
+    )
+    res = {k: np.asarray(v) for k, v in res.items()}
+    lanes = (
+        list(lane_names)
+        if lane_names
+        else [f"lane{r}" for r in range(lanes_n)]
+    )
+
+    def node_name(i: int) -> str:
+        if node_names and 0 <= i < len(node_names):
+            return node_names[i]
+        return f"node{i}"
+
+    binding = {
+        lanes[r]: int(c)
+        for r, c in enumerate(res["binding_counts"])
+        if int(c) > 0
+    }
+    binding_lane = (
+        max(binding, key=binding.get) if binding else None
+    )
+    near = []
+    for j, idx in enumerate(res["near_idx"]):
+        idx = int(idx)
+        if idx >= n_real:
+            continue
+        deficit = {
+            lanes[r]: int(v)
+            for r, v in enumerate(res["near_deficit"][j])
+            if int(v) > 0
+        }
+        entry = {
+            "node": node_name(idx),
+            "capacity_entry": int(res["near_cap"][j]),
+            "capacity_alone": int(res["near_cap_indep"][j]),
+            "deficit": deficit,
+            "headroom": {
+                lanes[r]: int(v)
+                for r, v in enumerate(res["near_left"][j])
+            },
+        }
+        if policy is not None:
+            entry["policy_penalty"] = int(res["near_pen"][j])
+        near.append(entry)
+    return {
+        "gang_index": int(g),
+        "need": int(res["need"]),
+        "feasible_alone": bool(res["feasible_indep"]),
+        "feasible_at_entry": bool(res["feasible_entry"]),
+        "nodes_indep": int(res["nodes_indep"]),
+        "nodes_entry": int(res["nodes_entry"]),
+        "excluded": {
+            "fit_mask": int(res["masked_out"]),
+            "policy_mask": int(res["policy_masked"]),
+            "capacity": int(res["capacity_blocked"]),
+        },
+        "binding_lane": binding_lane,
+        "blocked_by_lane": binding,
+        "near_miss": near,
+        "headroom_entry": {
+            lanes[r]: round(float(v), 1)
+            for r, v in enumerate(res["headroom_entry"])
+        },
+        "headroom_after_batch": {
+            lanes[r]: round(float(v), 1)
+            for r, v in enumerate(res["headroom_after"])
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# the live observatory
+# ---------------------------------------------------------------------------
+
+
+class Observatory:
+    """The per-process explain/what-if surface, constructed by
+    ScheduleOperation in oracle mode and registered process-wide for the
+    /debug endpoints (utils.metrics) and the SimCluster harness views."""
+
+    def __init__(self, operation):
+        self.operation = operation
+        self.whatif_engine = WhatIfEngine(
+            holder_source=lambda: getattr(
+                operation.oracle, "_device_state", None
+            ),
+            policy_engine=operation.policy,
+        )
+
+    # -- explain ------------------------------------------------------------
+
+    def explain(self, gang: str) -> dict:
+        from ..utils.metrics import DEFAULT_REGISTRY
+        from ..utils.trace import DEFAULT_FLIGHT_RECORDER
+        from .operation import (
+            deny_degraded_reason,
+            deny_infeasible_reason,
+            deny_reserved_reason,
+        )
+
+        DEFAULT_REGISTRY.counter(
+            "bst_explain_queries_total",
+            "Gang explain queries (/debug/explain + the explain "
+            "subcommand)",
+        ).inc()
+        op = self.operation
+        oracle = op.oracle
+        if oracle is None:
+            return {"error": "no oracle scorer in this process"}
+        state = oracle._state
+        if state is None:
+            return {"error": "no oracle batch published yet"}
+        snap = state.snapshot
+        g = snap.group_index(gang)
+        if g is None:
+            return {
+                "error": f"unknown gang {gang!r}",
+                "known_gangs": len(snap.group_names),
+            }
+        out = explain_arrays(
+            snap.device_args(), g, node_names=snap.node_names,
+            lane_names=snap.schema.names, policy=snap.policy_payload(),
+        )
+        out["gang"] = gang
+        out["batch"] = oracle.batches_run
+        out["degraded"] = bool(getattr(oracle, "degraded", False))
+        # the recorded-blame count: PreFilter's denial records carry the
+        # capacity-row feasible-node count, which is the INDEPENDENT
+        # count by construction (both read cap vs the batch-head leftover)
+        out["feasible_nodes"] = out["nodes_indep"]
+
+        placed = bool(state.result["placed"][g])
+        feasible = bool(state.result["gang_feasible"][g])
+        pgs = op.status_cache.get(gang)
+        min_member = (
+            pgs.pod_group.spec.min_member
+            if pgs is not None
+            else int(snap.groups[g].min_member)
+        )
+        if placed:
+            verdict, reason = "placed", ""
+            out["assignment"] = oracle.assignment(gang)
+        elif out["degraded"]:
+            # the conservative fallback batch denies ONLY provably-
+            # infeasible gangs; a feasible gang PASSES to the per-pod
+            # scan (docs/resilience.md) — explain must not fabricate a
+            # "reserved" denial the degraded PreFilter can never emit
+            if not feasible:
+                verdict = "denied"
+                reason = deny_degraded_reason(gang, min_member)
+            else:
+                verdict, reason = "pass", ""
+                out["note"] = (
+                    "degraded oracle: feasible gangs bypass PreFilter "
+                    "and place through the per-pod scan"
+                )
+        elif feasible:
+            verdict, reason = "denied", deny_reserved_reason(gang)
+        else:
+            verdict = "denied"
+            reason = deny_infeasible_reason(gang, min_member)
+        out["verdict"] = verdict
+        out["deny_reason"] = reason
+
+        # flight-recorder cross-stamp: the explanation must AGREE with
+        # the recorded decision (same blame string, same feasible count)
+        recs = DEFAULT_FLIGHT_RECORDER.snapshot(gang).get(gang, [])
+        recorded = next(
+            (r for r in reversed(recs) if r.get("phase") == "pre_filter"),
+            None,
+        )
+        if recorded is not None:
+            out["recorded"] = {
+                "reason": recorded.get("reason"),
+                "feasible_nodes": recorded.get("feasible_nodes"),
+                "batch": recorded.get("batch"),
+                "ts": recorded.get("ts"),
+            }
+            if verdict == "denied":
+                out["recorded_agrees"] = (
+                    recorded.get("reason") == reason
+                    and (
+                        recorded.get("feasible_nodes") is None
+                        or recorded.get("feasible_nodes")
+                        == out["feasible_nodes"]
+                    )
+                )
+        if op.policy is not None and snap.policy_cols is not None:
+            try:
+                idx = [
+                    snap.node_index(n["node"])
+                    for n in out["near_miss"]
+                    if snap.node_index(n["node"]) is not None
+                ]
+                terms = op.policy.explain(snap.policy_cols, g, idx)
+                if terms:
+                    out["policy_terms"] = terms
+            except Exception:  # noqa: BLE001 — blame is evidence only
+                pass
+        if verdict == "denied":
+            out["preemption"] = self._preempt_candidacy(
+                gang, pgs, min_member
+            )
+        return out
+
+    def _preempt_candidacy(self, gang: str, pgs, min_member: int) -> dict:
+        """Would the vectorized preemption pass place this gang, and at
+        whose expense — a DRY RUN of policy.preempt.PreemptionPlanner
+        (no eviction, no counters beyond the planner's own)."""
+        op = self.operation
+        planner = op.preempt_planner
+        if planner is None:
+            return {
+                "available": False,
+                "reason": "policy preemption off (BST_POLICY without "
+                          "'preempt')",
+            }
+        pod = pgs.pod if pgs is not None else None
+        if pod is None:
+            return {
+                "available": False,
+                "reason": "no representative pod observed yet",
+            }
+        if pod.spec.priority <= 0:
+            return {
+                "available": False,
+                "reason": "tier-0 gangs never preempt (nothing is lower)",
+            }
+        try:
+            need = max(
+                min_member
+                - pgs.pod_group.status.scheduled
+                - len(pgs.matched_pod_nodes.items()),
+                0,
+            )
+            plan = planner.plan(
+                pod, op.cluster, op.status_cache, gang, need
+            )
+        except Exception as e:  # noqa: BLE001 — candidacy is evidence only
+            return {"available": True, "error": f"{type(e).__name__}: {e}"}
+        if plan is None:
+            return {
+                "available": True,
+                "feasible": False,
+                "reason": "no strictly-lower-tier victim set covers the "
+                          "need",
+            }
+        return {
+            "available": True,
+            "feasible": True,
+            "victim_gangs": list(plan.gangs),
+            "evicted_pods": plan.evicted_pods,
+            "pooled_after": plan.pooled_after,
+        }
+
+    # -- what-if ------------------------------------------------------------
+
+    def whatif(self, cf: dict, rung: str = "steady") -> dict:
+        from .oracle_scorer import read_cluster_inputs
+
+        op = self.operation
+        # version BEFORE the read (the _pack_current discipline): a
+        # change landing mid-read leaves the cache keyed with the OLDER
+        # version, so the next query at the new version rebuilds the
+        # baseline instead of diffing fresh inputs against stale state.
+        # The key also fingerprints the demands (baseline_inputs_key):
+        # gang churn does not bump the version counter.
+        version_fn = getattr(op.cluster, "version", None)
+        version = version_fn() if callable(version_fn) else None
+        nodes, node_req, demands = read_cluster_inputs(
+            op.cluster, op.status_cache
+        )
+        return self.whatif_engine.query_on(
+            nodes, node_req, demands, cf, rung=rung,
+            baseline_key=baseline_inputs_key(version, nodes, demands),
+        )
+
+
+# ---------------------------------------------------------------------------
+# process-wide registry (the /debug endpoints + CLI harness views)
+# ---------------------------------------------------------------------------
+
+_active: list = [None]
+
+
+def set_active_observatory(obs: Optional[Observatory]) -> None:
+    _active[0] = obs
+
+
+def active_observatory() -> Optional[Observatory]:
+    return _active[0]
+
+
+def explain_debug_view(gang: Optional[str]) -> Tuple[dict, int]:
+    """(payload, http status) for /debug/explain. A bare GET is
+    self-describing (the /debug/profile precedent — the /debug/ index
+    probe walks every endpoint parameterless)."""
+    if not gang:
+        return {
+            "usage": "/debug/explain?gang=<namespace/name>",
+            "serves": "structured denial breakdown for one gang "
+                      "(docs/observability.md 'Explain')",
+        }, 200
+    obs = _active[0]
+    if obs is None:
+        return {
+            "error": "no observatory in this process (explain serves the "
+                     "oracle-mode scheduler; the sidecar has no gang "
+                     "state)"
+        }, 200
+    try:
+        return obs.explain(gang), 200
+    except Exception as e:  # noqa: BLE001 — a debug surface never crashes
+        return {"error": f"{type(e).__name__}: {e}"}, 500
+
+
+def whatif_debug_view(params: Dict[str, str]) -> Tuple[dict, int]:
+    """(payload, http status) for /debug/whatif. A bare GET answers the
+    query grammar (200, self-describing); a malformed counterfactual
+    answers 400."""
+    if not any(
+        params.get(k)
+        for k in ("drain", "cordon", "add_nodes", "bump_gang",
+                  "remove_gang")
+    ):
+        return {
+            "usage": "?drain=NODE | ?cordon=NODE | ?add_nodes=N"
+                     "[&node_cpu=..][&node_memory=..][&node_pods=..] | "
+                     "?bump_gang=NS/NAME&tier=T | ?remove_gang=NS/NAME "
+                     "[&rung=steady|wavefront|cpu-ladder|topk]",
+            "kinds": list(COUNTERFACTUAL_KINDS),
+            "serves": "placement diff of one counterfactual scored on a "
+                      "forked device-state copy (docs/observability.md "
+                      "'What-if')",
+        }, 200
+    obs = _active[0]
+    if obs is None:
+        return {
+            "error": "no observatory in this process (what-if serves the "
+                     "oracle-mode scheduler; the sidecar has no cluster "
+                     "state)"
+        }, 200
+    rung = params.get("rung") or "steady"
+    try:
+        cf = parse_counterfactual(params)
+        return obs.whatif(cf, rung=rung), 200
+    except ValueError as e:
+        return {"error": str(e), "kinds": list(COUNTERFACTUAL_KINDS)}, 400
+    except Exception as e:  # noqa: BLE001 — a debug surface never crashes
+        return {"error": f"{type(e).__name__}: {e}"}, 500

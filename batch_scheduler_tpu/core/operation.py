@@ -27,7 +27,39 @@ from ..utils.ttl_cache import TTLCache
 from . import resources as rmath
 from .oracle_scorer import OracleScorer
 
-__all__ = ["ScheduleOperation", "PermitOutcome", "ClusterStateProvider", "MAX_SCORE"]
+__all__ = [
+    "ScheduleOperation",
+    "PermitOutcome",
+    "ClusterStateProvider",
+    "MAX_SCORE",
+    "deny_reserved_reason",
+    "deny_infeasible_reason",
+    "deny_degraded_reason",
+]
+
+
+# THE PreFilter denial blame strings — built here and ONLY here, shared
+# by the denial raise sites below and by /debug/explain's re-derivation
+# (core.explain), so the explanation and the recorded decision can never
+# drift apart (the cross-stamp invariant tests/test_explain.py pins).
+
+
+def deny_reserved_reason(full_name: str) -> str:
+    """Feasible alone, but earlier gangs consume the space in this batch."""
+    return f"{full_name}: cluster capacity reserved for earlier gangs"
+
+
+def deny_infeasible_reason(full_name: str, min_member: int) -> str:
+    """Provably cannot fit even alone (per-node-capacity feasibility)."""
+    return f"{full_name}: cluster cannot fit gang ({min_member} members)"
+
+
+def deny_degraded_reason(full_name: str, min_member: int) -> str:
+    """The conservative fallback batch's only denial (docs/resilience.md)."""
+    return (
+        f"{full_name}: provably infeasible "
+        f"({min_member} members; degraded oracle)"
+    )
 
 # Score stub ceiling (reference core.go:46).
 MAX_SCORE = 2**31 - 1
@@ -206,6 +238,26 @@ class ScheduleOperation:
         # (reference core.go:58-59,118-127).
         self.max_finished_pg: str = ""
         self.max_pg_status: Optional[PodGroupMatchStatus] = None
+        # pending-gang aging (utils.health): per-operation so gangs from
+        # a torn-down harness never age into a later harness's health
+        # verdict; registered as the process's active tracker
+        from ..utils.health import PendingGangTracker, set_active_pending
+
+        self.pending_tracker = PendingGangTracker()
+        set_active_pending(self.pending_tracker)
+        # the explain/what-if observatory (core.explain): process-wide so
+        # /debug/explain + /debug/whatif and the CLI harness views reach
+        # the live operation without extra wiring. A non-oracle operation
+        # registers None — a stale observatory answering from a torn-down
+        # oracle harness would violate the same isolation the pending
+        # tracker's re-registration above guarantees.
+        from .explain import Observatory, set_active_observatory
+
+        set_active_observatory(
+            Observatory(self)
+            if self.scorer_kind == "oracle" and self.oracle is not None
+            else None
+        )
 
     # ------------------------------------------------------------------
     # scorer lifecycle
@@ -275,21 +327,51 @@ class ScheduleOperation:
             if feasible:
                 return
             self.add_to_deny_cache(full_name)
-            raise errs.ResourceNotEnoughError(
-                f"{full_name}: provably infeasible "
-                f"({pgs.pod_group.spec.min_member} members; degraded oracle)"
+            reason = deny_degraded_reason(
+                full_name, pgs.pod_group.spec.min_member
             )
+            self._record_denial(full_name, reason, oracle)
+            raise errs.ResourceNotEnoughError(reason)
         self.add_to_deny_cache(full_name)
         if oracle.gang_feasible(full_name):
             # Feasible alone, but higher-priority gangs consume the space in
             # this batch — the exact form of the reference's 0.7 reserve
             # heuristic (core.go:157-165).
-            raise errs.ResourceNotEnoughError(
-                f"{full_name}: cluster capacity reserved for earlier gangs"
-            )
-        raise errs.ResourceNotEnoughError(
-            f"{full_name}: cluster cannot fit gang ({pgs.pod_group.spec.min_member} members)"
+            reason = deny_reserved_reason(full_name)
+            self._record_denial(full_name, reason, oracle)
+            raise errs.ResourceNotEnoughError(reason)
+        reason = deny_infeasible_reason(
+            full_name, pgs.pod_group.spec.min_member
         )
+        self._record_denial(full_name, reason, oracle)
+        raise errs.ResourceNotEnoughError(reason)
+
+    def _record_denial(
+        self, full_name: str, reason: str, oracle: OracleScorer
+    ) -> None:
+        """One pre_filter flight record per oracle denial: the blame
+        string PLUS the capacity-row feasible-node count — the evidence
+        /debug/explain cross-stamps against (core.explain). Evidence
+        only, never the decision path; the deny-cache fast path does NOT
+        re-record, so the original blame stays the gang's last
+        pre_filter record through the 20s backoff."""
+        try:
+            from ..utils.trace import DEFAULT_FLIGHT_RECORDER
+
+            fields = {"batch": oracle.batches_run}
+            count = oracle.feasible_node_count(full_name)
+            if count is not None:
+                fields["feasible_nodes"] = count
+            DEFAULT_FLIGHT_RECORDER.record(
+                full_name,
+                phase="pre_filter",
+                verdict="denied",
+                reason=reason,
+                coalesce=True,  # one record per distinct blame, not per retry
+                **fields,
+            )
+        except Exception:  # noqa: BLE001 — evidence, never the decision
+            pass
 
     def _pre_filter_serial(
         self, full_name: str, pgs: PodGroupMatchStatus, pod: Pod
@@ -467,6 +549,7 @@ class ScheduleOperation:
         # through the gang's plan (the bulk form of on_assume's credit)
         if self.oracle is not None:
             self.oracle.credit_expected_change(len(members))
+        self.pending_tracker.note_placed(full_name)
         return True
 
     def post_bind_gang(self, full_name: str, bound: int) -> None:
@@ -903,6 +986,7 @@ class ScheduleOperation:
         matched = len(pgs.matched_pod_nodes.items())
         if matched >= pg.spec.min_member - pg.status.scheduled:
             pgs.scheduled = True
+            self.pending_tracker.note_placed(full_name)
             return PermitOutcome(True, pg_name, None)
         return PermitOutcome(False, pg_name, errs.WaitingError())
 
@@ -1017,6 +1101,9 @@ class ScheduleOperation:
         self._creation_tombstones[(ns, name)] = (
             self._clock() + self.CREATION_TOMBSTONE_S
         )
+        # a deleted gang is no longer pending; its age never resolves
+        # into the placement histogram (utils.health)
+        self.pending_tracker.forget(full_name)
 
     def sort_key(self, info) -> tuple:
         """Total-order queue key equivalent to :meth:`compare` (reference
@@ -1065,6 +1152,9 @@ class ScheduleOperation:
 
     def add_to_deny_cache(self, full_name: str) -> None:
         self.last_denied_pg.add(full_name, "", DENY_TTL)
+        # pending-gang aging (utils.health): every denial extends the
+        # gang's pending window and its deny streak; placement resolves it
+        self.pending_tracker.note_deny(full_name)
 
     def get_pod_node_pairs(self, full_name: str) -> Optional[TTLCache]:
         pgs = self.status_cache.get(full_name)

@@ -36,10 +36,30 @@ __all__ = [
     "OracleScorer",
     "demand_from_status",
     "conservative_cpu_batch",
+    "read_cluster_inputs",
     "replay_batch",
     "replay_audit_record",
     "REPLAY_RUNGS",
 ]
+
+
+def read_cluster_inputs(cluster, status_cache: PGStatusCache):
+    """ONE consistent read of the oracle's cluster inputs: (nodes,
+    node_requested, demands) — the exact projection every snapshot pack
+    consumes. Shared by the refresh path (_pack_current) and the what-if
+    observatory (core.explain), so a counterfactual scores the same
+    inputs a real refresh would read."""
+    statuses = status_cache.snapshot()
+    demands: List[GroupDemand] = [
+        demand_from_status(name, pgs)
+        for name, pgs in sorted(statuses.items())
+    ]
+    nodes = cluster.list_nodes()
+    node_req = {
+        n.metadata.name: cluster.node_requested(n.metadata.name)
+        for n in nodes
+    }
+    return nodes, node_req, demands
 
 
 # ---------------------------------------------------------------------------
@@ -559,14 +579,9 @@ class OracleScorer:
         dirty_gen = self._dirty_gen
         version_fn = getattr(cluster, "version", None)
         version_base = version_fn() if callable(version_fn) else None
-        statuses = status_cache.snapshot()
-        demands: List[GroupDemand] = [
-            demand_from_status(name, pgs) for name, pgs in sorted(statuses.items())
-        ]
-        nodes = cluster.list_nodes()
-        node_req = {
-            n.metadata.name: cluster.node_requested(n.metadata.name) for n in nodes
-        }
+        nodes, node_req, demands = read_cluster_inputs(
+            cluster, status_cache
+        )
         with trace_mod.span("oracle.snapshot_pack", cat="oracle"):
             snap = self._packer.pack(nodes, node_req, demands)
         self._schema = self._packer.schema
@@ -1142,6 +1157,25 @@ class OracleScorer:
             # exactly the failure mode to avoid.
             self.mark_dirty()
             return 0
+
+    def feasible_node_count(self, full_name: str) -> Optional[int]:
+        """How many (real) nodes could hold at least one member of this
+        gang, per the served batch's capacity row — the evidence count
+        PreFilter denial records carry and /debug/explain re-derives
+        (core.explain; both read capacity vs the batch-head leftover, so
+        the two counts byte-match by construction). One lazy row fetch;
+        None when the gang/batch is unknown or the row raced away."""
+        state = self._state
+        g = state.snapshot.group_index(full_name) if state else None
+        if g is None:
+            return None
+        try:
+            row = state.row("capacity", g)
+        except StaleBatchError:
+            self.mark_dirty()  # see node_capacity
+            return None
+        n_real = len(state.snapshot.node_names)
+        return int((np.asarray(row)[:n_real] > 0).sum())
 
     def node_score(self, full_name: str, node_name: str) -> int:
         state = self._state

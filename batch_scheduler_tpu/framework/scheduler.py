@@ -1006,11 +1006,15 @@ class Scheduler:
         rec = {"pod": info.name}
         if reason == "no feasible node":
             rec["feasible_nodes"] = self._last_scan_feasible
+        # coalesce: a parked gang's deny-backoff retries repeat the same
+        # blame every ~0.2-2s — as distinct records they roll the
+        # authoritative pre_filter decision out of the 32-deep ring
         DEFAULT_FLIGHT_RECORDER.record(
             _gang_key(info) or info.name,
             phase="cycle",
             verdict="denied",
             reason=reason,
+            coalesce=True,
             **rec,
         )
         self.queue.push_backoff(info)

@@ -169,6 +169,13 @@ class DeviceStateHolder:
         self.mesh = mesh
         self.label = label
         self._lock = threading.Lock()
+        # A forked holder (the what-if observatory's copy-on-write view,
+        # core.explain) shares the live holder's resident device arrays.
+        # Jax arrays are immutable and every scatter produces a NEW array
+        # bound only on the fork, so sharing is safe — EXCEPT donation,
+        # which consumes the input buffer in place: _donate() is pinned
+        # False on forks (docs/pipelining.md "Fork semantics").
+        self._forked = False
         self.generation = 0  # guarded-by: _lock
         # resident device arrays; None until the first keyframe
         self._alloc = None  # guarded-by: _lock
@@ -188,6 +195,11 @@ class DeviceStateHolder:
     # -- internals ----------------------------------------------------------
 
     def _donate(self) -> bool:
+        if self._forked:
+            # a donated scatter would consume a buffer the live holder
+            # (or a sibling fork) still reads — copy-on-write means the
+            # fork always pays the copy
+            return False
         from .oracle import donation_supported
 
         return donation_supported()
@@ -431,12 +443,95 @@ class DeviceStateHolder:
                 self._policy_dom,
             )
 
+    # -- copy-on-write forks (core.explain what-if, docs/pipelining.md) -----
+
+    def fork(self, label: Optional[str] = None) -> "DeviceStateHolder":
+        """A copy-on-write fork of this holder: the fork STARTS from the
+        same resident device arrays (zero-copy — jax arrays are
+        immutable), and every subsequent scatter/keyframe binds NEW arrays
+        on the fork only. The live holder's buffers, generation, and
+        counters are never touched through a fork; a fork never donates
+        (see _donate). This is the what-if engine's state container: apply
+        a counterfactual to the fork, score it, throw the fork away."""
+        out = DeviceStateHolder(
+            mesh=self.mesh, label=label or f"{self.label}~fork"
+        )
+        out._forked = True
+        with self._lock:
+            out._alloc = self._alloc
+            out._requested = self._requested
+            out._group_req = self._group_req
+            out._shardings = self._shardings
+            out._flat_nodes = self._flat_nodes
+            out._policy_hash = self._policy_hash
+            out._policy_dom = self._policy_dom
+            out.generation = self.generation
+        return out
+
+    def apply_batch(self, batch_args: tuple, base_args: tuple) -> tuple:
+        """Counterfactual apply for a FORK: bring the resident buffers
+        from ``base_args`` (the host arrays the residency currently
+        mirrors) to ``batch_args`` by scattering only the rows that
+        differ — the copy-on-write fast path — falling back to a full
+        keyframe when the padded shapes changed (added nodes grow the
+        bucket) or nothing is resident. Returns device-ready batch args
+        like ``sync``; refuses on a non-fork (the live holder's state
+        transitions are ``sync``/``apply_rows`` only, generation-checked)."""
+        if not self._forked:
+            raise RuntimeError(
+                "apply_batch is fork-only; the live holder syncs from the "
+                "packer's generation stream"
+            )
+        (alloc, requested, group_req, remaining, fit_mask, group_valid,
+         order) = batch_args
+        with self._lock:
+            resident = (
+                self._alloc is not None
+                and tuple(self._alloc.shape) == np.asarray(alloc).shape
+                and tuple(self._requested.shape)
+                == np.asarray(requested).shape
+                and tuple(self._group_req.shape)
+                == np.asarray(group_req).shape
+            )
+        if not resident:
+            return self.keyframe(batch_args, self.current_generation(),
+                                 "fork-shape")
+        with self._lock:
+            scattered = 0
+            for i, (new, base) in enumerate(
+                ((alloc, base_args[0]), (requested, base_args[1]),
+                 (group_req, base_args[2]))
+            ):
+                new = np.asarray(new)
+                base = np.asarray(base)
+                idx = np.nonzero((new != base).any(axis=1))[0].astype(
+                    np.int32
+                )
+                if not len(idx):
+                    continue
+                buf = (self._alloc, self._requested, self._group_req)[i]
+                buf = self._scatter(buf, idx, new[idx])
+                if i == 0:
+                    self._alloc = buf
+                elif i == 1:
+                    self._requested = buf
+                else:
+                    self._group_req = buf
+                scattered += int(len(idx))
+            self.deltas_applied += 1
+            self.rows_scattered += scattered
+            return (
+                self._alloc, self._requested, self._group_req,
+                remaining, fit_mask, group_valid, order,
+            )
+
     # -- observability ------------------------------------------------------
 
     def stats(self) -> dict:
         with self._lock:
             out = {
                 "label": self.label,
+                "forked": self._forked,
                 "generation": self.generation,
                 "resident": self._requested is not None,
                 "deltas_applied": self.deltas_applied,

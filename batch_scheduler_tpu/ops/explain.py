@@ -1,0 +1,222 @@
+"""Gang denial explanation: the jit'd kernel behind /debug/explain.
+
+The oracle already scores every gang x every node per batch, but when a
+gang sits Pending the control plane surfaces one sentence
+(``ResourceNotEnoughError``) and a feasible-node count. This kernel turns
+the same device-resident ``[N, R]`` / ``[G, R]`` buffers into a structured
+denial breakdown for ONE gang:
+
+- **entry-leftover capture**: the serial assignment scan re-runs with the
+  carried leftover CAPTURED at the target gang's step, so the explanation
+  distinguishes "infeasible even alone" (independent capacity, what
+  PreFilter's ``cluster cannot fit gang`` means) from "feasible alone but
+  consumed by earlier gangs" (entry capacity, the ``reserved for earlier
+  gangs`` denial). The scan body calls the SAME ``_member_capacity`` /
+  ``_select_best_fit`` helpers as ``assign_gangs`` — the captured leftover
+  is exactly what the serving scan carried, on every rung (all rungs are
+  bit-identical to the serial scan by construction).
+- **per-lane blame**: per-node one-member deficits
+  (``max(req - left, 0)`` on demanded lanes), and the binding lane — for
+  each capacity-blocked node, the lane whose per-lane fit is smallest;
+  the histogram over lanes names the resource that blocks the most nodes.
+- **exclusion split**: nodes excluded by the hard fit mask
+  (selector/taints/cordon), by a hard policy mask (anti-affinity — the
+  policy variant), and by capacity, counted separately over REAL (unpadded)
+  nodes.
+- **near-miss nodes**: the top-K nodes ranked best capacity first, then
+  smallest total deficit — where an operator (or the what-if engine)
+  should look first.
+
+The policy variant mirrors ``assign_gangs_policy``'s composite scan body
+(penalty shift + keep mask) so explanations of policy-rung batches see the
+same entry leftovers the serving scan produced.
+
+Host-side assembly (names, flight-recorder cross-stamp, policy term blame,
+preemption candidacy) lives in ``core.explain``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .oracle import (
+    _BIG,
+    _BINS,
+    _exact_floordiv,
+    _member_capacity,
+    _select_best_fit,
+    left_resources,
+)
+
+__all__ = ["explain_gang", "NEAR_MISS_K"]
+
+# How many near-miss nodes the kernel ranks and returns per query. Static
+# (one jit signature), small (the payload is human-facing), and far below
+# every node bucket's floor.
+NEAR_MISS_K = 8
+
+
+def _scan_take(left, req, mask, need, pen_keep):
+    """One serial-scan step's take vector against carried ``left`` — the
+    EXACT body of ops.oracle.assign_gangs (and, with ``pen_keep``, of
+    assign_gangs_policy): same helpers, same composite-key clipping, so
+    the captured entry leftover is bit-identical to what the serving scan
+    carried. Change those bodies and this one together."""
+    if pen_keep is None:
+        cap = _member_capacity(left, req[None, :]) * mask
+        capc = jnp.minimum(cap, need)
+        take2d, _ = _select_best_fit(cap[None, :], capc[None, :], need)
+    else:
+        pen, keep = pen_keep
+        cap = _member_capacity(left, req[None, :]) * mask * keep
+        capc = jnp.minimum(cap, need)
+        base = jnp.minimum(cap, _BINS - 1)
+        key = jnp.where(cap > 0, jnp.clip(base + pen, 1, _BINS - 1), 0)
+        take2d, _ = _select_best_fit(
+            cap[None, :], capc[None, :], need, key=key[None, :]
+        )
+    return take2d[0]
+
+
+@partial(jax.jit, static_argnames=("policy_terms", "policy_weights"))
+def explain_gang(alloc, requested, group_req, remaining, fit_mask,
+                 group_valid, order, g, n_real, policy_cols=None,
+                 policy_terms: tuple = (), policy_weights: tuple = ()):
+    """Structured denial breakdown for gang index ``g`` of one batch.
+
+    Inputs are the canonical padded 7-tuple (ops.bucketing.pad_oracle_batch
+    order) splatted, plus the gang index, the REAL node count (padded rows
+    are excluded from every count), and optionally the packed policy
+    columns + static term config (the policy-rung composite). Returns a
+    dict of device arrays; see core.explain for the host assembly.
+    """
+    policy_on = policy_cols is not None and bool(policy_terms)
+    pen_fn = None
+    if policy_on:
+        from ..policy.terms import compose_terms
+
+        prio, aff, anti, gang_dom, node_hash, node_dom = policy_cols
+        pen_fn = compose_terms(policy_terms, policy_weights)
+
+    left0 = left_resources(alloc, requested)
+    n = left0.shape[0]
+    mask_rows = fit_mask.shape[0]
+
+    def gang_pen_keep(gi):
+        if not policy_on:
+            return None
+        return pen_fn(
+            jnp.take(aff, gi), jnp.take(anti, gi),
+            jnp.take(gang_dom, gi, axis=0), node_hash, node_dom,
+        )
+
+    def body(carry, gi):
+        left, captured = carry
+        req = jnp.take(group_req, gi, axis=0)
+        mask = jnp.take(
+            fit_mask, jnp.minimum(gi, mask_rows - 1), axis=0
+        ).astype(jnp.int32)
+        need = jnp.take(remaining, gi)
+        captured = jnp.where(gi == g, left, captured)
+        take = _scan_take(left, req, mask, need, gang_pen_keep(gi))
+        return (left - take[:, None] * req[None, :], captured), None
+
+    (left_fin, left_entry), _ = jax.lax.scan(
+        body, (left0, left0), order, unroll=4
+    )
+
+    # -- the target gang's view at its scan entry (and independently) ------
+    req = jnp.take(group_req, g, axis=0)
+    mask = jnp.take(
+        fit_mask, jnp.minimum(g, mask_rows - 1), axis=0
+    ).astype(jnp.int32)
+    need = jnp.take(remaining, g)
+    real = jax.lax.broadcasted_iota(jnp.int32, (n,), 0) < n_real
+    if policy_on:
+        pen, keep = gang_pen_keep(g)
+        keep = keep.astype(jnp.int32)
+    else:
+        pen = jnp.zeros((n,), jnp.int32)
+        keep = jnp.ones((n,), jnp.int32)
+    maskk = mask * keep
+    cap_entry = _member_capacity(left_entry, req[None, :]) * maskk
+    cap_indep = _member_capacity(left0, req[None, :]) * maskk
+
+    # per-lane one-member deficits + the binding lane per blocked node
+    safe_req = jnp.clip(req, 1, _BIG)
+    lpos = jnp.clip(left_entry, 0, _BIG)
+    per_lane = jnp.where(
+        req[None, :] > 0, _exact_floordiv(lpos, safe_req[None, :]), _BIG
+    )  # [N, R] members each lane alone would allow
+    deficit = jnp.where(
+        req[None, :] > 0, jnp.clip(req[None, :] - left_entry, 0, _BIG), 0
+    )  # [N, R] shortfall to fit ONE member
+    block_lane = jnp.argmin(per_lane, axis=1)  # [N] tightest demanded lane
+    blocked = (real & (maskk > 0) & (cap_entry == 0)).astype(jnp.int32)
+    lanes = req.shape[0]
+    lane_iota = jax.lax.broadcasted_iota(jnp.int32, (lanes,), 0)
+    binding_counts = jnp.sum(
+        blocked[:, None] * (block_lane[:, None] == lane_iota[None, :]),
+        axis=0,
+    )  # [R] blocked nodes per binding lane
+
+    masked_out = jnp.sum((real & (mask == 0)).astype(jnp.int32))
+    policy_masked = jnp.sum(
+        (real & (mask > 0) & (keep == 0)).astype(jnp.int32)
+    )
+    capacity_blocked = jnp.sum(blocked)
+    nodes_entry = jnp.sum((real & (cap_entry > 0)).astype(jnp.int32))
+    nodes_indep = jnp.sum((real & (cap_indep > 0)).astype(jnp.int32))
+    feasible_entry = jnp.sum(jnp.minimum(cap_entry, need) * real) >= need
+    feasible_indep = jnp.sum(jnp.minimum(cap_indep, need) * real) >= need
+
+    # near-miss ranking: best entry capacity first, then smallest total
+    # deficit. The composite stays inside int32: the capacity term is
+    # bucket-clipped (< 2**7) * 2**23 and the deficit term < 2**22.
+    total_deficit = jnp.sum(jnp.minimum(deficit, 2**18), axis=1)
+    score = jnp.where(
+        real & (maskk > 0),
+        jnp.minimum(cap_entry, _BINS - 1) * (2**23)
+        - jnp.minimum(total_deficit, 2**22 - 1),
+        -(2**30),
+    )
+    k = min(NEAR_MISS_K, n)
+    _, near_idx = jax.lax.top_k(score, k)
+    near_cap = jnp.take(cap_entry, near_idx)
+    near_cap_indep = jnp.take(cap_indep, near_idx)
+    near_deficit = jnp.take(deficit, near_idx, axis=0)  # [K, R]
+    near_left = jnp.take(jnp.clip(left_entry, 0, _BIG), near_idx, axis=0)
+    near_pen = jnp.take(pen, near_idx)
+
+    # per-lane cluster headroom (device units, float to dodge the int32
+    # 5k-node sum overflow): at the gang's entry and after the full batch
+    realf = real.astype(jnp.float32)[:, None]
+    headroom_entry = jnp.sum(
+        jnp.clip(left_entry, 0, _BIG).astype(jnp.float32) * realf, axis=0
+    )
+    headroom_after = jnp.sum(
+        jnp.clip(left_fin, 0, _BIG).astype(jnp.float32) * realf, axis=0
+    )
+
+    return {
+        "need": need,
+        "feasible_entry": feasible_entry,
+        "feasible_indep": feasible_indep,
+        "nodes_entry": nodes_entry,
+        "nodes_indep": nodes_indep,
+        "masked_out": masked_out,
+        "policy_masked": policy_masked,
+        "capacity_blocked": capacity_blocked,
+        "binding_counts": binding_counts,
+        "near_idx": near_idx,
+        "near_cap": near_cap,
+        "near_cap_indep": near_cap_indep,
+        "near_deficit": near_deficit,
+        "near_left": near_left,
+        "near_pen": near_pen,
+        "headroom_entry": headroom_entry,
+        "headroom_after": headroom_after,
+    }

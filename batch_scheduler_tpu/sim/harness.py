@@ -246,6 +246,31 @@ class SimCluster:
 
         return DEFAULT_HEALTH.evaluate()
 
+    def explain(self, group: str) -> Dict:
+        """Why is this gang pending (core.explain) — the harness-side
+        view of /debug/explain. ``group`` may be "name" (default
+        namespace assumed) or "namespace/name"."""
+        from ..core.explain import active_observatory
+
+        if "/" not in group:
+            group = f"default/{group}"
+        obs = active_observatory()
+        if obs is None:
+            return {"error": "no observatory (oracle mode required)"}
+        return obs.explain(group)
+
+    def whatif(self, counterfactual: Dict, rung: str = "steady") -> Dict:
+        """Score one counterfactual against live cluster state on a
+        forked device-state buffer (core.explain) — the harness-side view
+        of /debug/whatif. ``counterfactual`` is the canonical dict form
+        (e.g. ``{"kind": "drain", "node": "sim-node-0000"}``)."""
+        from ..core.explain import active_observatory
+
+        obs = active_observatory()
+        if obs is None:
+            return {"error": "no observatory (oracle mode required)"}
+        return obs.whatif(dict(counterfactual), rung=rung)
+
     def wait_for(
         self,
         predicate: Callable[[], bool],

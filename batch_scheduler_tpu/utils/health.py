@@ -53,7 +53,16 @@ from typing import Dict, Optional
 
 from .metrics import DEFAULT_REGISTRY, LONG_OP_BUCKETS, Registry
 
-__all__ = ["HealthModel", "IdentityAuditor", "DEFAULT_HEALTH", "worst"]
+__all__ = [
+    "HealthModel",
+    "IdentityAuditor",
+    "PendingGangTracker",
+    "DEFAULT_HEALTH",
+    "DEFAULT_PENDING",
+    "set_active_pending",
+    "active_pending",
+    "worst",
+]
 
 # (signal, metric, default p95 target seconds, bucket preset or None for
 # the registry default). The bucket preset MUST match what the metric's
@@ -91,6 +100,113 @@ def _target(signal: str, default: float) -> float:
         except ValueError:
             pass
     return default
+
+
+class PendingGangTracker:
+    """Pending-gang aging: how long denied gangs have been waiting, and
+    how many consecutive denials each has eaten.
+
+    Fed by the control plane's single denial choke point
+    (core.operation.ScheduleOperation.add_to_deny_cache) and resolved at
+    permit-quorum time; a deleted gang is forgotten without resolving (its
+    age is censored, not a placement). Surfaces:
+
+    - ``bst_gang_pending_seconds`` (histogram) — deny-to-placement age,
+      observed once per gang at resolution;
+    - ``bst_gang_pending_oldest_seconds`` (gauge) — the oldest
+      still-pending gang's age, set on every ``report()``;
+    - ``bst_gang_deny_streak_max`` (gauge) — the largest consecutive-deny
+      streak among still-pending gangs.
+
+    ``report()`` also feeds the ``pending`` health signal: a gang pending
+    past ``BST_SLO_PENDING_P95_S`` (default 120 s) is a WARN — starvation
+    is an operator signal, not a process failure (never a breach)."""
+
+    DEFAULT_TARGET_S = 120.0
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self._lock = threading.Lock()
+        # gang -> (first_deny_monotonic, consecutive denials)
+        self._pending: Dict[str, tuple] = {}  # guarded-by: _lock
+        self.resolved = 0  # guarded-by: _lock
+        reg = registry or DEFAULT_REGISTRY
+        self._hist = reg.histogram(
+            "bst_gang_pending_seconds",
+            "Gang pending age from first denial to placement "
+            "(deleted-unplaced gangs are censored, never observed)",
+            buckets=LONG_OP_BUCKETS,
+        )
+        self._oldest = reg.gauge(
+            "bst_gang_pending_oldest_seconds",
+            "Age of the oldest still-pending (denied, unplaced) gang",
+        )
+        self._streak = reg.gauge(
+            "bst_gang_deny_streak_max",
+            "Largest consecutive-denial streak among pending gangs",
+        )
+
+    def note_deny(self, gang: str) -> None:
+        now = time.monotonic()
+        with self._lock:
+            first, streak = self._pending.get(gang, (now, 0))
+            self._pending[gang] = (first, streak + 1)
+
+    def note_placed(self, gang: str) -> None:
+        with self._lock:
+            entry = self._pending.pop(gang, None)
+            if entry is not None:
+                self.resolved += 1
+        if entry is not None:
+            self._hist.observe(time.monotonic() - entry[0])
+
+    def forget(self, gang: str) -> None:
+        with self._lock:
+            self._pending.pop(gang, None)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._pending.clear()
+            self.resolved = 0
+        self._oldest.set(0.0)
+        self._streak.set(0.0)
+
+    def report(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            pending = dict(self._pending)
+            resolved = self.resolved
+        oldest_gang, oldest_age, max_streak = None, 0.0, 0
+        for gang, (first, streak) in pending.items():
+            age = now - first
+            if age > oldest_age:
+                oldest_gang, oldest_age = gang, age
+            max_streak = max(max_streak, streak)
+        self._oldest.set(round(oldest_age, 3))
+        self._streak.set(float(max_streak))
+        return {
+            "pending_gangs": len(pending),
+            "resolved_gangs": resolved,
+            "oldest_gang": oldest_gang,
+            "oldest_age_s": round(oldest_age, 3),
+            "max_deny_streak": max_streak,
+        }
+
+
+DEFAULT_PENDING = PendingGangTracker()
+
+# The tracker the health model reports: each ScheduleOperation registers
+# its own at construction (the set_active_engine pattern), so gangs from
+# a torn-down harness never age into a later harness's verdict — one
+# process can run many sims (the test suite does).
+_active_pending: list = [DEFAULT_PENDING]
+
+
+def set_active_pending(tracker: PendingGangTracker) -> None:
+    _active_pending[0] = tracker
+
+
+def active_pending() -> PendingGangTracker:
+    return _active_pending[0]
 
 
 class HealthModel:
@@ -252,6 +368,31 @@ class HealthModel:
                 "reason": "served plan diverged from its CPU-rung replay"
                 if mismatch else "",
             }
+
+        # -- pending-gang aging (PendingGangTracker) ------------------------
+        # starvation is an operator signal, never a process failure: a
+        # gang pending past the target WARNS, it does not breach
+        pending = active_pending().report()
+        target = _target("pending", PendingGangTracker.DEFAULT_TARGET_S)
+        verdict = (
+            "warn"
+            if pending["pending_gangs"] and pending["oldest_age_s"] > target
+            else "ok"
+        )
+        with self._lock:
+            self._note_transition("pending", verdict)
+        signals["pending"] = {
+            "kind": "state",
+            "verdict": verdict,
+            "target_age_s": target,
+            **pending,
+            "reason": (
+                f"gang {pending['oldest_gang']} pending "
+                f"{pending['oldest_age_s']:.0f}s (target {target:.0f}s, "
+                f"deny streak {pending['max_deny_streak']})"
+                if verdict != "ok" else ""
+            ),
+        }
 
         return {
             "verdict": worst(s["verdict"] for s in signals.values()),

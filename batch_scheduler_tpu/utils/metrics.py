@@ -303,6 +303,13 @@ DEBUG_ENDPOINTS = {
                    "ledger (utils.profiler)",
     "/debug/profile": "?seconds=N runs a jax.profiler capture and "
                       "returns the trace dir; bare GET reports state",
+    "/debug/explain": "?gang=NS/NAME structured denial breakdown for one "
+                      "gang — deficits, binding lane, near-miss nodes, "
+                      "preemption candidacy (core.explain)",
+    "/debug/whatif": "score a counterfactual on a forked device-state "
+                     "copy: ?drain=N | ?cordon=N | ?add_nodes=K | "
+                     "?bump_gang=G&tier=T | ?remove_gang=G "
+                     "(core.explain; docs/observability.md grammar)",
 }
 
 
@@ -418,6 +425,33 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                     }
                 if seconds is not None:
                     payload = profiler_mod.capture_profile(seconds)
+            body = json.dumps(payload, default=str).encode()
+            ctype = "application/json"
+        elif path == "/debug/explain":
+            # the gang observatory's explain surface (core.explain):
+            # why is this gang pending — structured denial breakdown,
+            # cross-stamped against the flight recorder's decision
+            import json
+            from urllib.parse import parse_qs, urlparse
+
+            from ..core.explain import explain_debug_view
+
+            q = parse_qs(urlparse(self.path).query)
+            payload, status = explain_debug_view((q.get("gang") or [None])[0])
+            body = json.dumps(payload, default=str).encode()
+            ctype = "application/json"
+        elif path == "/debug/whatif":
+            # the what-if capacity observatory (core.explain): score one
+            # counterfactual on a copy-on-write fork of the device-
+            # resident state and answer the placement diff
+            import json
+            from urllib.parse import parse_qs, urlparse
+
+            from ..core.explain import whatif_debug_view
+
+            q = parse_qs(urlparse(self.path).query)
+            params = {k: v[0] for k, v in q.items() if v}
+            payload, status = whatif_debug_view(params)
             body = json.dumps(payload, default=str).encode()
             ctype = "application/json"
         elif path in ("/debug", "/debug/"):

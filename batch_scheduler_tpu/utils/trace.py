@@ -354,8 +354,16 @@ class FlightRecorder:
         phase: str,
         verdict: str,
         reason: str = "",
+        coalesce: bool = False,
         **fields,
     ) -> None:
+        """Append one decision record. ``coalesce=True`` collapses an
+        exact repeat of the gang's LAST record (same phase + verdict +
+        reason) into a ``repeats`` bump on it instead of a new entry —
+        the denial paths use it so a parked gang's 20s-backoff retries
+        ("denied recently") cannot flood the 32-deep ring and roll the
+        authoritative blame record out (the /debug/explain cross-stamp
+        reads that record; docs/observability.md "Explain")."""
         rec = {
             "ts": time.time(),
             "gang": gang,
@@ -377,6 +385,19 @@ class FlightRecorder:
                     self.dropped_gangs += 1
             else:
                 self._gangs.move_to_end(gang)
+            if coalesce and ring:
+                last = ring[-1]
+                if (
+                    last.get("phase") == phase
+                    and last.get("verdict") == verdict
+                    and last.get("reason") == reason
+                ):
+                    last["repeats"] = last.get("repeats", 1) + 1
+                    last["ts"] = rec["ts"]
+                    # evidence fields refresh to the newest observation
+                    # (batch seq, feasible count) — the blame is the same
+                    last.update(fields)
+                    return
             ring.append(rec)
 
     def snapshot(self, gang: Optional[str] = None) -> Dict[str, List[dict]]:

@@ -129,6 +129,26 @@ def envelope(
     return doc
 
 
+def measure_median(fn, repeats: int = 7, warmup: int = 1):
+    """(median_seconds, draws) of ``fn`` over ``repeats`` timed runs —
+    the repeats machinery every gate's noise-sensitive bound should use
+    (a single draw on a loaded 1-core CI box routinely lands 2-3x off
+    its own median; the bench-policy preemption bound shipped exactly
+    that flake). ``draws`` is rounded for the envelope's ``repeats``
+    field."""
+    import time as _time
+
+    for _ in range(max(warmup, 0)):
+        fn()
+    draws = []
+    for _ in range(max(repeats, 1)):
+        t0 = _time.perf_counter()
+        fn()
+        draws.append(_time.perf_counter() - t0)
+    ordered = sorted(draws)
+    return ordered[len(ordered) // 2], [round(d, 6) for d in draws]
+
+
 def ledger_path() -> Optional[str]:
     env = os.environ.get("BST_PERF_LEDGER", "").strip()
     if env.lower() in ("off", "0"):

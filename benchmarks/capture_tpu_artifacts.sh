@@ -201,6 +201,23 @@ else
     echo "policy gate failed:"; tail -4 /tmp/policy_gate.out; fail=1
 fi
 
+echo "== explain/what-if observatory gate on hardware (WHATIF_${TAG}) =="
+# the bench-whatif gate on the real backend: counterfactual plans must
+# stay bit-identical to actually-applied clusters on the hardware rungs,
+# the copy-on-write fork must leave the device-resident holder's HBM
+# state untouched under an interleaved storm, and the <=2x-steady query
+# bound prices what-if against REAL device batch times (~10ms steady on
+# TPU — the capture that decides whether what-if is interactive at the
+# north-star shape). docs/observability.md "What-if".
+if BST_WHATIF_GATE_PLATFORM=default timeout 900 \
+        python benchmarks/whatif_gate.py "WHATIF_${TAG}.json" \
+        > /tmp/whatif_gate.out 2>&1; then
+    echo "whatif gate captured: WHATIF_${TAG}.json"
+    tail -1 /tmp/whatif_gate.out
+else
+    echo "whatif gate failed:"; tail -4 /tmp/whatif_gate.out; fail=1
+fi
+
 echo "== lockcheck-enabled sim cycle (LOCKCHECK_${TAG}) =="
 # one short sim cycle with the runtime lock-discipline checker armed
 # (BST_LOCKCHECK=1, docs/static_analysis.md): TPU batch times shift every

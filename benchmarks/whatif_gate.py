@@ -1,0 +1,382 @@
+"""Explain/what-if observatory CI gate (make bench-whatif,
+docs/observability.md "Explain" / "What-if").
+
+Four phases, every one a hard assertion:
+
+1. **Counterfactual correctness** — for EACH counterfactual kind
+   (drain, cordon, add-nodes, bump-gang, remove-gang), the what-if
+   engine's plan digest is bit-identical to a cluster that ACTUALLY
+   applied the counterfactual and rescheduled (the gate applies the
+   change itself, packs a fresh snapshot through the same path, and
+   executes it directly) — and the baseline digest matches a direct
+   baseline execution.
+2. **Fork isolation** — an interleaved what-if storm (4 threads x mixed
+   kinds) against a live device-resident holder leaves the holder's
+   generation, scatter counters, and next-batch plan digest bit-identical
+   (the copy-on-write fork never writes through).
+3. **Explain agrees with recorded blame** — a short recorded sim with
+   denied gangs; for EVERY denied gang in the flight recorder,
+   /debug/explain's deny reason and feasible-node count byte-match the
+   recorded pre_filter decision.
+4. **Query latency** — at the 5k-node/10k-pod bucket, a warm what-if
+   query (baseline cached) costs <= ``WHATIF_LATENCY_CEILING`` x one
+   steady oracle batch, median-of-``MEASURE_REPEATS``.
+
+Writes WHATIF_gate.json (or argv[1]) with the bst-bench envelope and
+appends to PERF_LEDGER.jsonl; exits non-zero on any failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("BST_BUCKET_COST", "0")
+# CPU by default (CI gate); the hardware capture sets
+# BST_WHATIF_GATE_PLATFORM=default to keep the probed backend
+_platform = os.environ.get("BST_WHATIF_GATE_PLATFORM", "cpu")
+
+import jax  # noqa: E402
+
+if _platform == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from batch_scheduler_tpu.core.explain import (  # noqa: E402
+    WhatIfEngine,
+    apply_counterfactual,
+)
+from batch_scheduler_tpu.ops.device_state import DeviceStateHolder  # noqa: E402
+from batch_scheduler_tpu.ops.oracle import execute_batch_host  # noqa: E402
+from batch_scheduler_tpu.ops.snapshot import (  # noqa: E402
+    ClusterSnapshot,
+    DeltaSnapshotPacker,
+    GroupDemand,
+)
+from batch_scheduler_tpu.sim.scenarios import make_sim_node  # noqa: E402
+from batch_scheduler_tpu.utils import audit as audit_mod  # noqa: E402
+
+WHATIF_LATENCY_CEILING = 2.0
+MEASURE_REPEATS = 3
+# the acceptance bucket: 5k nodes / 10k pods (2048 gangs x 5 members)
+LAT_NODES = 5120
+LAT_GROUPS = 2048
+LAT_MEMBERS = 5
+SMALL_NODES = 48
+SMALL_GROUPS = 24
+
+
+def _inputs(n_nodes: int, n_groups: int, members: int = 3, seed: int = 7):
+    rng = np.random.default_rng(seed)
+    nodes = [
+        make_sim_node(
+            f"node-{i:04d}", {"cpu": "32", "memory": "128Gi", "pods": "110"}
+        )
+        for i in range(n_nodes)
+    ]
+    node_req = {
+        n.metadata.name: {"cpu": int(rng.integers(0, 16000)), "pods": 2}
+        for n in nodes[: n_nodes // 2]
+    }
+    demands = [
+        GroupDemand(
+            f"default/gang-{g:04d}",
+            members,
+            member_request={
+                "cpu": int(rng.integers(1000, 8000)),
+                "memory": int(rng.integers(1, 8)) * 1024**3,
+            },
+            priority=int(rng.integers(0, 3)),
+            creation_ts=float(g),
+        )
+        for g in range(n_groups)
+    ]
+    return nodes, node_req, demands
+
+
+def _direct_digest(nodes, node_req, demands):
+    snap = ClusterSnapshot(nodes, node_req, demands)
+    host, _ = execute_batch_host(snap.device_args(), snap.progress_args())
+    return audit_mod.plan_digest(host)
+
+
+def _counterfactuals(nodes, demands):
+    return [
+        {"kind": "drain", "node": nodes[1].metadata.name},
+        {"kind": "cordon", "node": nodes[2].metadata.name},
+        {
+            "kind": "add-nodes",
+            "count": 4,
+            "shape": {"cpu": "32", "memory": "128Gi", "pods": "110"},
+        },
+        {"kind": "bump-gang", "gang": demands[-1].full_name, "tier": 9},
+        {"kind": "remove-gang", "gang": demands[0].full_name},
+    ]
+
+
+def phase_identity(report, failures):
+    nodes, node_req, demands = _inputs(SMALL_NODES, SMALL_GROUPS)
+    engine = WhatIfEngine()
+    results = {}
+    base_direct = _direct_digest(nodes, node_req, demands)
+    for cf in _counterfactuals(nodes, demands):
+        res = engine.query_on(
+            nodes, node_req, demands, cf, baseline_key="identity"
+        )
+        applied = apply_counterfactual(nodes, node_req, demands, cf)
+        direct = _direct_digest(*applied)
+        ok_cf = res["whatif"]["plan_digest"] == direct
+        ok_base = res["base"]["plan_digest"] == base_direct
+        results[cf["kind"]] = {
+            "whatif_digest": res["whatif"]["plan_digest"],
+            "applied_digest": direct,
+            "identical": ok_cf,
+            "base_identical": ok_base,
+        }
+        if not ok_cf:
+            failures.append(
+                f"{cf['kind']}: whatif digest != actually-applied digest"
+            )
+        if not ok_base:
+            failures.append(
+                f"{cf['kind']}: baseline digest != direct baseline"
+            )
+    report["phases"]["counterfactual_identity"] = results
+
+
+def phase_isolation(report, failures):
+    nodes, node_req, demands = _inputs(SMALL_NODES, SMALL_GROUPS, seed=11)
+    packer = DeltaSnapshotPacker()
+    holder = DeviceStateHolder(label="whatif-gate-live")
+    snap = packer.pack(nodes, node_req, demands)
+    live_args = holder.sync(snap)
+    host, _ = execute_batch_host(live_args, snap.progress_args())
+    digest0 = audit_mod.plan_digest(host)
+    gen0 = holder.current_generation()
+    stats0 = holder.stats()
+    engine = WhatIfEngine(holder_source=lambda: holder)
+    cfs = _counterfactuals(nodes, demands)
+    errors = []
+
+    def storm(widx: int) -> None:
+        try:
+            for i in range(3):
+                engine.query_on(
+                    nodes, node_req, demands, cfs[(widx + i) % len(cfs)],
+                    baseline_key="storm",
+                )
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(f"worker {widx}: {type(e).__name__}: {e}")
+
+    threads = [
+        threading.Thread(target=storm, args=(w,), daemon=True)
+        for w in range(4)
+    ]
+    for t in threads:
+        t.start()
+    # interleave: re-execute the LIVE batch from the resident buffers
+    # while the storm runs; every digest must stay bit-identical
+    mid_digests = []
+    for _ in range(4):
+        host, _ = execute_batch_host(live_args, snap.progress_args())
+        mid_digests.append(audit_mod.plan_digest(host))
+    for t in threads:
+        t.join(120)
+    stats1 = holder.stats()
+    checks = {
+        "storm_errors": errors,
+        "generation_unchanged": holder.current_generation() == gen0,
+        "rows_scattered_unchanged": (
+            stats1["rows_scattered"] == stats0["rows_scattered"]
+        ),
+        "live_digests_unchanged": all(d == digest0 for d in mid_digests),
+        "interleaved_executions": len(mid_digests),
+    }
+    report["phases"]["fork_isolation"] = checks
+    if errors:
+        failures.append(f"whatif storm raised: {errors[:2]}")
+    for name in (
+        "generation_unchanged", "rows_scattered_unchanged",
+        "live_digests_unchanged",
+    ):
+        if not checks[name]:
+            failures.append(f"fork isolation broken: {name} is False")
+
+
+def phase_explain_agrees(report, failures):
+    from batch_scheduler_tpu.core.explain import active_observatory
+    from batch_scheduler_tpu.sim import (
+        SimCluster,
+        make_member_pods,
+        make_sim_group,
+        make_sim_node as sim_node,
+    )
+    from batch_scheduler_tpu.utils.trace import DEFAULT_FLIGHT_RECORDER
+
+    DEFAULT_FLIGHT_RECORDER.clear()
+    cluster = SimCluster(scorer="oracle")
+    cluster.add_nodes(
+        [
+            sim_node(f"sim-node-{i}", {"cpu": "8", "memory": "32Gi",
+                                       "pods": "110"})
+            for i in range(3)
+        ]
+    )
+    pods = []
+    for name, members, cpu in (
+        ("fits", 3, "1"),
+        ("too-big", 40, "4"),
+        ("too-wide", 500, "1"),
+    ):
+        cluster.create_group(make_sim_group(name, members))
+        pods += make_member_pods(name, members, {"cpu": cpu})
+    cluster.start()
+    try:
+        cluster.create_pods(pods)
+        if not cluster.wait_for_bound("fits", 3, timeout=60):
+            failures.append("recorded sim never bound the feasible gang")
+        if not cluster.wait_for(
+            lambda: any(
+                r.get("phase") == "pre_filter"
+                and r.get("verdict") == "denied"
+                for recs in DEFAULT_FLIGHT_RECORDER.snapshot().values()
+                for r in recs
+            ),
+            timeout=30,
+        ):
+            failures.append("recorded sim produced no pre_filter denials")
+    finally:
+        cluster.stop()
+    obs = active_observatory()
+    denied = {
+        gang: rec
+        for gang, recs in DEFAULT_FLIGHT_RECORDER.snapshot().items()
+        for rec in recs
+        if rec.get("phase") == "pre_filter" and rec.get("verdict") == "denied"
+    }
+    results = {}
+    if obs is None:
+        failures.append("no active observatory after an oracle-mode sim")
+    if not denied:
+        failures.append("recorded run produced no denied gangs to check")
+    for gang, rec in sorted(denied.items()):
+        exp = obs.explain(gang) if obs is not None else {}
+        reason_match = exp.get("deny_reason") == rec.get("reason")
+        count_match = (
+            rec.get("feasible_nodes") is None
+            or exp.get("feasible_nodes") == rec.get("feasible_nodes")
+        )
+        results[gang] = {
+            "recorded_reason": rec.get("reason"),
+            "explain_reason": exp.get("deny_reason"),
+            "recorded_feasible_nodes": rec.get("feasible_nodes"),
+            "explain_feasible_nodes": exp.get("feasible_nodes"),
+            "agrees": bool(reason_match and count_match),
+            "recorded_agrees_field": exp.get("recorded_agrees"),
+        }
+        if not (reason_match and count_match):
+            failures.append(
+                f"explain disagrees with recorded blame for {gang}: "
+                f"{results[gang]}"
+            )
+        if exp.get("recorded_agrees") is False:
+            failures.append(
+                f"explain's own cross-stamp flags disagreement for {gang}"
+            )
+    report["phases"]["explain_vs_recorded"] = results
+
+
+def phase_latency(report, failures):
+    from benchmarks.artifact import measure_median
+
+    nodes, node_req, demands = _inputs(
+        LAT_NODES, LAT_GROUPS, members=LAT_MEMBERS, seed=3
+    )
+    snap = ClusterSnapshot(nodes, node_req, demands)
+    args, prog = snap.device_args(), snap.progress_args()
+
+    steady_s, steady_draws = measure_median(
+        lambda: execute_batch_host(args, prog), repeats=MEASURE_REPEATS
+    )
+    engine = WhatIfEngine()
+    cf = {"kind": "drain", "node": nodes[1].metadata.name}
+    # warm: first query builds + caches the baseline (and compiles the
+    # bucket, already warm from the steady probe)
+    engine.query_on(nodes, node_req, demands, cf, baseline_key="lat")
+    whatif_s, whatif_draws = measure_median(
+        lambda: engine.query_on(
+            nodes, node_req, demands, cf, baseline_key="lat"
+        ),
+        repeats=MEASURE_REPEATS,
+        warmup=0,
+    )
+    ratio = whatif_s / max(steady_s, 1e-9)
+    report["phases"]["latency"] = {
+        "shape": {
+            "nodes": LAT_NODES,
+            "pods": LAT_GROUPS * LAT_MEMBERS,
+            "groups": LAT_GROUPS,
+        },
+        "steady_batch_s": round(steady_s, 6),
+        "whatif_query_s": round(whatif_s, 6),
+        "ratio": round(ratio, 4),
+        "ceiling": WHATIF_LATENCY_CEILING,
+    }
+    report.setdefault("repeats", {})
+    report["repeats"]["steady_batch_s"] = steady_draws
+    report["repeats"]["whatif_query_s"] = whatif_draws
+    report["metrics_extra"] = {
+        "whatif_steady_batch_s": round(steady_s, 6),
+        "whatif_query_s": round(whatif_s, 6),
+        "whatif_latency_ratio": round(ratio, 4),
+    }
+    if ratio > WHATIF_LATENCY_CEILING:
+        failures.append(
+            f"whatif query costs {ratio:.2f}x a steady batch at the "
+            f"{LAT_NODES}-node bucket (ceiling {WHATIF_LATENCY_CEILING}x)"
+        )
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "WHATIF_gate.json"
+    report = {
+        "gate": "whatif",
+        "platform": jax.default_backend(),
+        "devices": len(jax.devices()),
+        "phases": {},
+    }
+    failures: list = []
+    phase_identity(report, failures)
+    phase_isolation(report, failures)
+    phase_explain_agrees(report, failures)
+    phase_latency(report, failures)
+
+    report["failures"] = failures
+    report["ok"] = not failures
+    from benchmarks import artifact
+
+    metrics = report.pop("metrics_extra", {})
+    repeats = report.pop("repeats", {})
+    doc = artifact.envelope(report, metrics=metrics, repeats=repeats)
+    artifact.append_ledger(doc)
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True, default=str)
+        f.write("\n")
+    print(json.dumps(doc, indent=2, sort_keys=True, default=str))
+    from batch_scheduler_tpu.ops.oracle import drain_telemetry_threads
+
+    drain_telemetry_threads(timeout=60.0)
+    if failures:
+        print(f"WHATIF GATE FAILED: {failures}", file=sys.stderr)
+        return 1
+    print("whatif gate OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,595 @@
+"""Gang explainability + what-if observatory (ops/explain.py,
+core/explain.py, docs/observability.md "Explain" / "What-if").
+
+The invariants pinned here:
+
+- the explain kernel's breakdown is exact against hand-computed tiny
+  batches (deficits, binding lane, fit-mask vs policy-mask vs capacity
+  exclusion, entry vs independent capacity);
+- each counterfactual kind's forked what-if plan is bit-identical to a
+  cluster that ACTUALLY applied the change and rescheduled;
+- a copy-on-write device-state fork never perturbs the live holder —
+  generation and next-batch plan digest stay bit-identical under a
+  concurrent what-if storm interleaved with live delta scheduling
+  (lockcheck-instrumented: the storm doubles as a race sweep);
+- explain's blame for a denied gang byte-matches the flight recorder's
+  recorded decision reason and feasible-node count (the cross-stamp);
+- pending-gang aging: denials age into bst_gang_pending_* and the
+  /debug/health "pending" signal warns past the target.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from batch_scheduler_tpu.core.explain import (
+    WhatIfEngine,
+    apply_counterfactual,
+    explain_arrays,
+    parse_counterfactual,
+)
+from batch_scheduler_tpu.ops.device_state import DeviceStateHolder
+from batch_scheduler_tpu.ops.oracle import execute_batch_host
+from batch_scheduler_tpu.ops.snapshot import (
+    ClusterSnapshot,
+    DeltaSnapshotPacker,
+    GroupDemand,
+)
+from batch_scheduler_tpu.utils import audit as audit_mod
+
+from helpers import make_node
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _lockcheck():
+    """The what-if storm below doubles as a race sweep over the fork /
+    holder / engine guarded-by annotations (the chaos-suite pattern,
+    docs/static_analysis.md)."""
+    from batch_scheduler_tpu.analysis import lockcheck
+
+    prev = os.environ.get("BST_LOCKCHECK")
+    os.environ["BST_LOCKCHECK"] = "1"
+    lockcheck.install()
+    yield
+    if prev is None:
+        os.environ.pop("BST_LOCKCHECK", None)
+    else:
+        os.environ["BST_LOCKCHECK"] = prev
+
+
+def _demand(name, members, cpu, prio=0, ts=0.0, **kw):
+    return GroupDemand(
+        name, members, member_request={"cpu": cpu}, priority=prio,
+        creation_ts=ts, **kw,
+    )
+
+
+def _digest(host):
+    return audit_mod.plan_digest(host)
+
+
+# ---------------------------------------------------------------------------
+# the explain kernel
+# ---------------------------------------------------------------------------
+
+
+class TestExplainKernel:
+    def _snap(self):
+        # n0: cordoned; n1: nearly full (1 cpu left); n2/n3: empty 8-cpu
+        nodes = [
+            make_node("n0", {"cpu": "8", "memory": "16Gi", "pods": 110}),
+            make_node("n1", {"cpu": "8", "memory": "16Gi", "pods": 110}),
+            make_node("n2", {"cpu": "8", "memory": "16Gi", "pods": 110}),
+            make_node("n3", {"cpu": "8", "memory": "16Gi", "pods": 110}),
+        ]
+        nodes[0].spec.unschedulable = True
+        node_req = {"n1": {"cpu": 7000, "pods": 1}}
+        demands = [
+            _demand("default/early", 2, 4000, ts=1.0),
+            _demand("default/late", 5, 2000, ts=2.0),
+        ]
+        return ClusterSnapshot(nodes, node_req, demands)
+
+    def test_breakdown_counts_and_binding_lane(self):
+        snap = self._snap()
+        out = explain_arrays(
+            snap.device_args(), snap.group_index("default/late"),
+            node_names=snap.node_names, lane_names=snap.schema.names,
+        )
+        # n0 is cordoned (fit mask); capacity exclusion is ENTRY-based:
+        # n1 blocked on cpu (1000 left < 2000) plus n2, which the earlier
+        # gang consumed before this gang's scan turn
+        assert out["excluded"]["fit_mask"] == 1
+        assert out["excluded"]["policy_mask"] == 0
+        assert out["excluded"]["capacity"] == 2
+        assert out["binding_lane"] == "cpu"
+        assert out["blocked_by_lane"] == {"cpu": 2}
+        # independent: n2+n3 hold 4 members a piece... 8//2 = 4 each = 8 >= 5
+        assert out["nodes_indep"] == 2
+        assert out["feasible_alone"] is True
+        # early (prio-equal, earlier creation) takes 4000x2 first: one of
+        # n2/n3 drops to 0 left... early fits both members on n2 (tightest
+        # first: all equal -> node-index order), leaving n2 at 0
+        assert out["nodes_entry"] == 1
+        assert out["feasible_at_entry"] is False
+        assert out["need"] == 5
+        # near-miss deficits name the missing cpu on the blocked node
+        by_node = {e["node"]: e for e in out["near_miss"]}
+        assert by_node["n1"]["deficit"] == {"cpu": 1000}
+        assert by_node["n1"]["capacity_entry"] == 0
+
+    def test_verdict_matches_batch_result(self):
+        snap = self._snap()
+        host, _ = execute_batch_host(
+            snap.device_args(), snap.progress_args()
+        )
+        g_late = snap.group_index("default/late")
+        g_early = snap.group_index("default/early")
+        assert bool(host["placed"][g_early])
+        assert not bool(host["placed"][g_late])
+        out = explain_arrays(
+            snap.device_args(), g_late, node_names=snap.node_names,
+            lane_names=snap.schema.names,
+        )
+        # the kernel's independent feasibility equals the batch's
+        # gang_feasible and its entry verdict explains the denial
+        assert out["feasible_alone"] == bool(host["gang_feasible"][g_late])
+        assert out["feasible_at_entry"] is False
+
+    def test_policy_hard_mask_counted_separately(self):
+        from batch_scheduler_tpu.policy.terms import (
+            DOMAIN_BUCKETS,
+            HASH_LANES,
+            label_hash,
+        )
+
+        snap = self._snap()
+        g = snap.group_index("default/late")
+        nb = snap.alloc.shape[0]
+        gb = snap.group_req.shape[0]
+        h = label_hash("team", "red")
+        anti = np.zeros(gb, np.int32)
+        anti[g] = h
+        node_hash = np.zeros((nb, HASH_LANES), np.int32)
+        node_hash[3, 0] = h  # n3 carries the anti-affinity target
+        cols = (
+            np.zeros(gb, np.int32), np.zeros(gb, np.int32), anti,
+            np.zeros((gb, DOMAIN_BUCKETS), np.int32), node_hash,
+            np.zeros(nb, np.int32),
+        )
+        out = explain_arrays(
+            snap.device_args(), g, node_names=snap.node_names,
+            lane_names=snap.schema.names,
+            policy=(cols, ("anti-affinity",), (32, 8, 3)),
+        )
+        assert out["excluded"]["policy_mask"] == 1  # n3, hard-masked
+        assert out["excluded"]["fit_mask"] == 1     # n0 still cordon
+        assert out["nodes_indep"] == 1              # only n2 remains
+
+    def test_offline_lane_names_degrade(self):
+        snap = self._snap()
+        out = explain_arrays(snap.device_args(), 0)
+        assert any(k.startswith("lane") for k in out["headroom_entry"])
+
+
+# ---------------------------------------------------------------------------
+# counterfactual grammar
+# ---------------------------------------------------------------------------
+
+
+class TestCounterfactuals:
+    def test_parse_grammar(self):
+        assert parse_counterfactual({"drain": "n1"}) == {
+            "kind": "drain", "node": "n1",
+        }
+        cf = parse_counterfactual({"add_nodes": "4", "node_cpu": "16"})
+        assert cf["count"] == 4 and cf["shape"]["cpu"] == "16"
+        cf = parse_counterfactual({"bump_gang": "d/g", "tier": "7"})
+        assert cf == {"kind": "bump-gang", "gang": "d/g", "tier": 7}
+
+    @pytest.mark.parametrize(
+        "params",
+        [
+            {},  # nothing
+            {"drain": "a", "cordon": "b"},  # two at once
+            {"add_nodes": "zap"},  # non-integer
+            {"add_nodes": "0"},  # out of range
+            {"bump_gang": "d/g"},  # missing tier
+        ],
+    )
+    def test_parse_rejects(self, params):
+        with pytest.raises(ValueError):
+            parse_counterfactual(params)
+
+    def test_apply_unknown_targets(self):
+        nodes = [make_node("n0")]
+        demands = [_demand("default/g", 1, 1000)]
+        for cf in (
+            {"kind": "drain", "node": "ghost"},
+            {"kind": "cordon", "node": "ghost"},
+            {"kind": "bump-gang", "gang": "ghost", "tier": 1},
+            {"kind": "remove-gang", "gang": "ghost"},
+        ):
+            with pytest.raises(ValueError):
+                apply_counterfactual(nodes, {}, demands, cf)
+
+    def test_cordon_never_mutates_live_node(self):
+        nodes = [make_node("n0"), make_node("n1")]
+        out_nodes, _, _ = apply_counterfactual(
+            nodes, {}, [], {"kind": "cordon", "node": "n1"}
+        )
+        assert out_nodes[1].spec.unschedulable is True
+        assert nodes[1].spec.unschedulable is False  # live object untouched
+
+
+# ---------------------------------------------------------------------------
+# what-if identity + fork isolation
+# ---------------------------------------------------------------------------
+
+
+def _inputs(n=12, g=6, seed=5):
+    rng = np.random.default_rng(seed)
+    nodes = [
+        make_node(f"n{i:02d}", {"cpu": "16", "memory": "64Gi", "pods": 110})
+        for i in range(n)
+    ]
+    node_req = {
+        f"n{i:02d}": {"cpu": int(rng.integers(0, 8000)), "pods": 1}
+        for i in range(n // 2)
+    }
+    demands = [
+        _demand(
+            f"default/gang-{i:02d}", 3, int(rng.integers(1000, 6000)),
+            prio=int(rng.integers(0, 3)), ts=float(i),
+        )
+        for i in range(g)
+    ]
+    return nodes, node_req, demands
+
+
+class TestWhatIfIdentity:
+    @pytest.mark.parametrize(
+        "kind",
+        ["drain", "cordon", "add-nodes", "bump-gang", "remove-gang"],
+    )
+    def test_counterfactual_bit_identical_to_applied_cluster(self, kind):
+        nodes, node_req, demands = _inputs()
+        cf = {
+            "drain": {"kind": "drain", "node": "n01"},
+            "cordon": {"kind": "cordon", "node": "n02"},
+            "add-nodes": {
+                "kind": "add-nodes", "count": 2,
+                "shape": {"cpu": "16", "memory": "64Gi", "pods": "110"},
+            },
+            "bump-gang": {
+                "kind": "bump-gang", "gang": "default/gang-05", "tier": 9,
+            },
+            "remove-gang": {
+                "kind": "remove-gang", "gang": "default/gang-00",
+            },
+        }[kind]
+        engine = WhatIfEngine()
+        res = engine.query_on(
+            nodes, node_req, demands, cf, baseline_key="t"
+        )
+        applied = apply_counterfactual(nodes, node_req, demands, cf)
+        direct = ClusterSnapshot(*applied)
+        host, _ = execute_batch_host(
+            direct.device_args(), direct.progress_args()
+        )
+        assert res["whatif"]["plan_digest"] == _digest(host)
+        base = ClusterSnapshot(nodes, node_req, demands)
+        bhost, _ = execute_batch_host(
+            base.device_args(), base.progress_args()
+        )
+        assert res["base"]["plan_digest"] == _digest(bhost)
+
+    def test_bump_gang_reorders_queue(self):
+        # a starved low-priority gang jumps the queue when bumped: the
+        # what-if reports it newly placeable (the capacity-planning use)
+        nodes = [make_node(f"n{i}", {"cpu": "8", "memory": "32Gi",
+                                     "pods": 110}) for i in range(2)]
+        demands = [
+            _demand("default/whale", 4, 4000, prio=5, ts=1.0),
+            _demand("default/starved", 4, 4000, prio=0, ts=2.0),
+        ]
+        engine = WhatIfEngine()
+        res = engine.query_on(
+            nodes, {}, demands,
+            {"kind": "bump-gang", "gang": "default/starved", "tier": 9},
+        )
+        assert "default/starved" in res["newly_placeable"]
+        assert "default/whale" in res["no_longer_placeable"]
+
+    def test_rung_rejected(self):
+        nodes, node_req, demands = _inputs(4, 2)
+        with pytest.raises(ValueError):
+            WhatIfEngine().query_on(
+                nodes, node_req, demands,
+                {"kind": "drain", "node": "n01"}, rung="warp-speed",
+            )
+
+
+class TestForkIsolation:
+    def test_fork_is_copy_on_write(self):
+        nodes, node_req, demands = _inputs()
+        packer = DeltaSnapshotPacker()
+        holder = DeviceStateHolder(label="live-t")
+        snap = packer.pack(nodes, node_req, demands)
+        live_args = holder.sync(snap)
+        gen0 = holder.current_generation()
+        live_requested = np.asarray(live_args[1]).copy()
+
+        fork = holder.fork()
+        assert fork.current_generation() == gen0
+        # mutate through the fork: scatter a changed row
+        cf_nodes, cf_req, cf_dem = apply_counterfactual(
+            nodes, node_req, demands, {"kind": "cordon", "node": "n01"}
+        )
+        cf_snap = ClusterSnapshot(
+            cf_nodes, cf_req, cf_dem, schema=snap.schema
+        )
+        fork.apply_batch(cf_snap.device_args(), snap.device_args())
+        # live holder untouched: same generation, same buffer contents
+        assert holder.current_generation() == gen0
+        assert holder.stats()["rows_scattered"] == 0
+        np.testing.assert_array_equal(
+            np.asarray(live_args[1]), live_requested
+        )
+
+    def test_fork_never_donates(self):
+        holder = DeviceStateHolder(label="live-d")
+        fork = holder.fork()
+        assert fork._donate() is False
+
+    def test_apply_batch_refused_on_live_holder(self):
+        holder = DeviceStateHolder(label="live-r")
+        with pytest.raises(RuntimeError):
+            holder.apply_batch((None,) * 7, (None,) * 7)
+
+    def test_storm_leaves_live_state_bit_identical(self):
+        """The acceptance invariant: a what-if fork must leave the live
+        holder's generation and next-batch plan digest bit-identical
+        under CONCURRENT scheduling (live churn deltas keep landing while
+        the storm runs) — lockcheck-instrumented via the module fixture."""
+        nodes, node_req, demands = _inputs(16, 8, seed=9)
+        packer = DeltaSnapshotPacker()
+        holder = DeviceStateHolder(label="live-storm")
+        control = DeltaSnapshotPacker()  # fork-free reference pipeline
+        engine = WhatIfEngine(holder_source=lambda: holder)
+        cfs = [
+            {"kind": "drain", "node": "n03"},
+            {"kind": "add-nodes", "count": 2,
+             "shape": {"cpu": "16", "memory": "64Gi", "pods": "110"}},
+            {"kind": "remove-gang", "gang": "default/gang-01"},
+        ]
+        errors = []
+        stop = threading.Event()
+
+        def storm(widx):
+            try:
+                i = 0
+                while not stop.is_set() and i < 6:
+                    engine.query_on(
+                        nodes, node_req, demands, cfs[(widx + i) % 3],
+                        baseline_key="storm",
+                    )
+                    i += 1
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [
+            threading.Thread(target=storm, args=(w,), daemon=True)
+            for w in range(3)
+        ]
+        for t in threads:
+            t.start()
+        # concurrent live scheduling: churn one node's requested row per
+        # tick, sync the holder, execute from the resident buffers, and
+        # bit-compare against a fork-free control pipeline
+        live_req = dict(node_req)
+        for tick in range(5):
+            live_req["n08"] = {"cpu": 1000 + tick, "pods": 1}
+            snap = packer.pack(nodes, live_req, demands)
+            live_args = holder.sync(snap)
+            host, _ = execute_batch_host(live_args, snap.progress_args())
+            csnap = control.pack(nodes, live_req, demands)
+            chost, _ = execute_batch_host(
+                csnap.device_args(), csnap.progress_args()
+            )
+            assert _digest(host) == _digest(chost), f"tick {tick} diverged"
+        stop.set()
+        for t in threads:
+            t.join(60)
+        assert not errors, errors
+        # the live holder advanced by exactly its own syncs
+        assert holder.current_generation() == packer.generation
+
+
+# ---------------------------------------------------------------------------
+# the live observatory: cross-stamp + pending aging (SimCluster e2e)
+# ---------------------------------------------------------------------------
+
+
+class TestObservatoryE2E:
+    def test_explain_byte_matches_recorded_blame_and_pending_ages(self):
+        from batch_scheduler_tpu.core.explain import active_observatory
+        from batch_scheduler_tpu.sim import SimCluster
+        from batch_scheduler_tpu.sim.scenarios import (
+            make_member_pods,
+            make_sim_group,
+            make_sim_node,
+        )
+        from batch_scheduler_tpu.utils.health import active_pending
+        from batch_scheduler_tpu.utils.trace import DEFAULT_FLIGHT_RECORDER
+
+        DEFAULT_FLIGHT_RECORDER.clear()
+        cluster = SimCluster(scorer="oracle")
+        cluster.add_nodes(
+            [
+                make_sim_node(
+                    f"sim-node-{i}",
+                    {"cpu": "8", "memory": "32Gi", "pods": "110"},
+                )
+                for i in range(2)
+            ]
+        )
+        pods = []
+        for name, members, cpu in (("fits", 2, "1"), ("too-big", 30, "4")):
+            cluster.create_group(make_sim_group(name, members))
+            pods += make_member_pods(name, members, {"cpu": cpu})
+        cluster.start()
+        try:
+            cluster.create_pods(pods)
+            assert cluster.wait_for_bound("fits", 2, timeout=60)
+            assert cluster.wait_for(
+                lambda: any(
+                    r.get("phase") == "pre_filter"
+                    and r.get("verdict") == "denied"
+                    for r in cluster.decisions("too-big").get(
+                        "default/too-big", []
+                    )
+                ),
+                timeout=30,
+            )
+        finally:
+            cluster.stop()
+
+        recorded = next(
+            r
+            for r in reversed(
+                cluster.decisions("too-big")["default/too-big"]
+            )
+            if r.get("phase") == "pre_filter"
+        )
+        obs = active_observatory()
+        assert obs is not None
+        exp = cluster.explain("too-big")
+        # the cross-stamp: explain's blame byte-matches the recorded
+        # decision reason AND feasible-node count
+        assert exp["verdict"] == "denied"
+        assert exp["deny_reason"] == recorded["reason"]
+        assert "cannot fit gang (30 members)" in exp["deny_reason"]
+        assert recorded.get("feasible_nodes") is not None
+        assert exp["feasible_nodes"] == recorded["feasible_nodes"]
+        assert exp["recorded_agrees"] is True
+        assert exp["recorded"]["reason"] == recorded["reason"]
+        # structural evidence is present and sane
+        assert exp["need"] > 0
+        assert exp["feasible_alone"] is False
+        assert isinstance(exp["near_miss"], list) and exp["near_miss"]
+        # a placed gang explains as placed, with its seats
+        exp_fit = cluster.explain("fits")
+        assert exp_fit["verdict"] == "placed"
+
+        # pending-gang aging: the denied gang is aging, the placed one
+        # resolved out of the tracker
+        rep = active_pending().report()
+        assert rep["pending_gangs"] >= 1
+        assert rep["oldest_gang"] == "default/too-big"
+        assert rep["oldest_age_s"] > 0
+        assert rep["max_deny_streak"] >= 1
+        health = cluster.health()
+        assert "pending" in health["signals"]
+        assert health["signals"]["pending"]["verdict"] == "ok"  # < target
+
+    def test_pending_warns_past_target(self, monkeypatch):
+        from batch_scheduler_tpu.utils.health import (
+            HealthModel,
+            PendingGangTracker,
+            set_active_pending,
+        )
+
+        tracker = PendingGangTracker()
+        set_active_pending(tracker)
+        try:
+            tracker.note_deny("default/starved")
+            # a negative target makes ANY pending age a warn (the gang
+            # was denied microseconds ago)
+            monkeypatch.setenv("BST_SLO_PENDING_P95_S", "-1")
+            health = HealthModel().evaluate()
+            sig = health["signals"]["pending"]
+            assert sig["verdict"] == "warn"
+            assert "default/starved" in sig["reason"]
+            # placement resolves it (and observes the age histogram)
+            tracker.note_placed("default/starved")
+            assert tracker.report()["pending_gangs"] == 0
+            assert tracker.resolved == 1
+            health = HealthModel().evaluate()
+            assert health["signals"]["pending"]["verdict"] == "ok"
+        finally:
+            from batch_scheduler_tpu.utils.health import DEFAULT_PENDING
+
+            set_active_pending(DEFAULT_PENDING)
+
+    def test_baseline_key_tracks_demand_churn(self):
+        """cluster.version() alone misses pod-group churn (a created
+        gang never bumps it): the baseline-cache key must fingerprint
+        the demands too, or a cached baseline diffs against fresher
+        inputs and attributes cluster churn to the counterfactual."""
+        from dataclasses import replace
+
+        from batch_scheduler_tpu.core.explain import baseline_inputs_key
+
+        nodes = [make_node("n0")]
+        demands = [_demand("default/a", 2, 1000)]
+        k0 = baseline_inputs_key(7, nodes, demands)
+        assert baseline_inputs_key(7, nodes, demands) == k0  # stable
+        assert baseline_inputs_key(8, nodes, demands) != k0  # version
+        assert (
+            baseline_inputs_key(7, nodes, demands + [_demand("default/b", 1, 1)])
+            != k0
+        )  # a NEW gang, invisible to the version counter
+        assert (
+            baseline_inputs_key(7, nodes, [replace(demands[0], priority=5)])
+            != k0
+        )  # a demand field changed
+
+    def test_backoff_spam_never_rolls_the_blame_record_out(self):
+        """The cross-stamp's lifeline: deny-backoff retries repeat one
+        blame string every ~0.2-2s; coalesced, they bump ``repeats`` on
+        the last record instead of appending — the authoritative
+        pre_filter decision stays in the 32-deep ring for the gang's
+        whole pending lifetime."""
+        from batch_scheduler_tpu.utils.trace import FlightRecorder
+
+        fr = FlightRecorder(per_gang=4)
+        fr.record("g", phase="pre_filter", verdict="denied",
+                  reason="real blame", coalesce=True, feasible_nodes=2)
+        for i in range(100):
+            fr.record("g", phase="cycle", verdict="denied",
+                      reason="backing off", coalesce=True, batch=i)
+        recs = fr.snapshot("g")["g"]
+        assert len(recs) == 2
+        assert recs[0]["reason"] == "real blame"
+        assert recs[0]["feasible_nodes"] == 2
+        assert recs[1]["repeats"] == 100
+        assert recs[1]["batch"] == 99  # evidence refreshes to the newest
+        # a DIFFERENT blame still appends (coalesce is exact-repeat only)
+        fr.record("g", phase="cycle", verdict="denied",
+                  reason="new blame", coalesce=True)
+        assert len(fr.snapshot("g")["g"]) == 3
+
+    def test_whatif_debug_view_grammar_errors(self):
+        from batch_scheduler_tpu.core.explain import (
+            explain_debug_view,
+            whatif_debug_view,
+        )
+
+        # bare GETs are self-describing 200s (the /debug/ index probe
+        # walks every endpoint parameterless)
+        payload, status = explain_debug_view(None)
+        assert status == 200 and "gang" in payload["usage"]
+        payload, status = whatif_debug_view({})
+        assert status == 200 and "kinds" in payload
+        payload, status = whatif_debug_view(
+            {"drain": "a", "cordon": "b"}
+        )
+        # an observatory may be live from the e2e above; either way a
+        # malformed counterfactual answers 400 with the grammar...
+        if "kinds" in payload:
+            assert status == 400
+        else:  # ...or the no-observatory explainer answers 200
+            assert status == 200
