@@ -5,9 +5,9 @@
 
 PY ?= python
 
-.PHONY: all build vet analyze stamp-coupling test test-cpu test-tier1 bench bench-scan bench-pipeline bench-delta bench-policy bench-whatif bench-sharding bench-xl bench-regress validate-artifacts native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
+.PHONY: all build vet analyze stamp-coupling test test-cpu test-tier1 bench bench-scan bench-pipeline bench-delta bench-policy bench-whatif bench-capacity bench-sharding bench-xl bench-regress validate-artifacts native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
 
-all: vet analyze native test bench-regress validate-artifacts
+all: vet analyze native test bench-regress bench-capacity validate-artifacts
 
 build: vet analyze native
 
@@ -118,6 +118,16 @@ bench-policy:
 # (docs/observability.md "Explain" / "What-if")
 bench-whatif:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/whatif_gate.py
+
+# capacity-observatory CI gate (CPU): the analytics hook's amortized
+# cost <= 2% of the 5k-node/10k-pod steady stream, an offline `capacity`
+# replay of a recorded sim bit-identical to the live series, per-tenant
+# shares summing <= 1 on every lane of every sample, and a chaos latency
+# storm flipping burn:batch to breach (recovery clears it) with the
+# bst_slo_burn_rate gauges elevated (docs/observability.md "Capacity
+# observatory & burn-rate alerts")
+bench-capacity:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/capacity_gate.py
 
 # audit/replay/health CI gate (CPU): records a short sim into an audit
 # ring, replays every batch bit-identically (steady + cpu-ladder rungs),

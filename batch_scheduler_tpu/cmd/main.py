@@ -368,6 +368,43 @@ def build_parser() -> argparse.ArgumentParser:
              "bit-identical across rungs by construction)",
     )
 
+    cap = sub.add_parser(
+        "capacity",
+        help="the capacity observatory: per-lane utilization/headroom "
+             "spectra, fragmentation index, stranded capacity, tenant "
+             "shares — live from a scheduler's /debug/capacity, or "
+             "offline by replaying a recorded audit ring through the "
+             "same analytics kernel (bit-identical to the live series — "
+             "docs/observability.md 'Capacity observatory')",
+    )
+    cap_src = cap.add_mutually_exclusive_group(required=True)
+    cap_src.add_argument(
+        "--addr", metavar="HOST:PORT",
+        help="a live scheduler's --metrics-port endpoint "
+             "(queries /debug/capacity)",
+    )
+    cap_src.add_argument(
+        "--audit-dir", metavar="DIR",
+        help="replay a recorded audit ring offline: recompute the "
+             "capacity summary of every reconstructable batch and "
+             "bit-compare against the ring's recorded capacity_sample "
+             "events (exit 1 on divergence)",
+    )
+    cap.add_argument(
+        "--batch", type=int, default=None, metavar="K",
+        help="with --audit-dir: only the record with seq K",
+    )
+    cap.add_argument(
+        "--points", type=int, default=None, metavar="K",
+        help="with --addr: trim the returned series to the newest K "
+             "points",
+    )
+    cap.add_argument(
+        "--json", default=None, metavar="PATH",
+        help="also write the summary JSON (offline mode: the replayed "
+             "series + comparison verdicts) here",
+    )
+
     chk = sub.add_parser("check-config", help="validate a scheduler config JSON")
     _add_config_flag(chk)
 
@@ -726,6 +763,116 @@ def cmd_explain(args) -> int:
 
     drain_telemetry_threads(timeout=60.0)  # same teardown rule as replay
     return 0
+
+
+def cmd_capacity(args) -> int:
+    """The capacity observatory's CLI face. Live mode proxies
+    /debug/capacity; offline mode replays a recorded audit ring through
+    the SAME analytics kernel (ops.capacity.capacity_summary) and
+    bit-compares each recomputed summary with the ring's recorded
+    ``capacity_sample`` event — the replay discipline applied to the
+    analytics series, so a post-mortem sees the identical numbers the
+    live process saw. Exit 0 = answered (and, offline, every compared
+    sample identical); 1 = divergence; 2 = nothing to answer."""
+    if args.addr:
+        params: Dict[str, str] = {}
+        if args.points is not None:
+            params["points"] = str(args.points)
+        payload, status = _debug_get(args.addr, "/debug/capacity", params)
+        print(json.dumps(payload, indent=2, default=str))
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(payload, f, indent=2, default=str)
+        # a no-sampler answer is self-describing ({"sampler": null,
+        # "hint": ...}) but it is NOT capacity data — honor the exit
+        # contract: 2 = nothing to answer
+        answered = (
+            status == 200
+            and "error" not in payload
+            and payload.get("sampler", "present") is not None
+        )
+        return 0 if answered else 2
+
+    from ..ops.capacity import capacity_summary
+    from ..utils.audit import AuditReader
+
+    _resolve_backend_or_degrade()
+    _enable_compilation_cache()
+    recorded: Dict[str, dict] = {}
+    batches: List[dict] = []
+    for rec in AuditReader(args.audit_dir).records():
+        if rec.get("kind") == "batch":
+            batches.append(rec)
+        elif (
+            rec.get("kind") == "event"
+            and rec.get("event") == "capacity_sample"
+            and rec.get("audit_id")
+        ):
+            recorded[rec["audit_id"]] = rec.get("summary")
+    if args.batch is not None:
+        batches = [r for r in batches if r.get("seq") == args.batch]
+    if not batches:
+        print(
+            f"error: no reconstructable batch record in {args.audit_dir}"
+            + (f" with seq {args.batch}" if args.batch is not None else ""),
+            file=sys.stderr,
+        )
+        return 2
+    series, divergent, compared = [], 0, 0
+    for rec in batches:
+        names = rec.get("names") or {}
+        policy = rec.get("policy_args")
+        summary = capacity_summary(
+            rec["batch_args"], rec["result_arrays"],
+            group_names=names.get("groups") or [],
+            scheduled=rec["progress_args"][1],
+            matched=rec["progress_args"][2],
+            policy_prio=policy[0][0] if policy else None,
+        )
+        # normalize through the same JSON round-trip the recorded event
+        # took, so the comparison is representation-for-representation
+        summary = json.loads(json.dumps(summary, sort_keys=True))
+        entry = {
+            "seq": rec.get("seq"),
+            "audit_id": rec.get("audit_id"),
+            "summary": summary,
+        }
+        live = recorded.get(rec.get("audit_id"))
+        if live is not None:
+            compared += 1
+            entry["identical"] = live == summary
+            if not entry["identical"]:
+                divergent += 1
+                entry["recorded_summary"] = live
+                print(
+                    f"batch seq={entry['seq']} audit_id="
+                    f"{entry['audit_id']} capacity DIVERGED from the "
+                    "recorded live sample",
+                    flush=True,
+                )
+        series.append(entry)
+    out = {
+        "audit_dir": args.audit_dir,
+        "replayed": len(series),
+        "compared": compared,
+        "divergent": divergent,
+        "series": series,
+    }
+    print(json.dumps(out, indent=2, default=str))
+    if args.json:
+        doc = out
+        try:
+            from benchmarks.artifact import envelope
+
+            doc = envelope(out)
+        except Exception:  # noqa: BLE001 — evidence formatting only
+            pass
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=2, default=str)
+    from ..ops.oracle import drain_telemetry_threads
+
+    drain_telemetry_threads(timeout=60.0)  # the replay teardown rule
+    return 1 if divergent else 0
 
 
 def cmd_whatif(args) -> int:
@@ -1111,6 +1258,19 @@ def cmd_sim(args) -> int:
             f"slo health: {health['verdict']}"
             + (f" ({bad})" if bad else "")
         )
+        # capacity observatory verdict beside the health line: how full,
+        # how fragmented, who is consuming it (live form: /debug/capacity)
+        from ..ops.capacity import active_sampler, format_capacity_verdict
+
+        sampler = active_sampler()
+        cap_last = sampler.last() if sampler is not None else None
+        if cap_last is not None:
+            print(format_capacity_verdict(cap_last, sampler.lane_names()))
+            burn = health["signals"].get("burn:capacity") or {}
+            if burn.get("verdict") not in (None, "ok"):
+                print(
+                    f"capacity burn: {burn['verdict']} ({burn['reason']})"
+                )
         # pending-gang aging in the exit verdict: who is starving and how
         # long (the live form is the /debug/health "pending" signal)
         pend = health["signals"].get("pending") or {}
@@ -1152,6 +1312,7 @@ COMMANDS = {
     "replay": cmd_replay,
     "explain": cmd_explain,
     "whatif": cmd_whatif,
+    "capacity": cmd_capacity,
 }
 
 

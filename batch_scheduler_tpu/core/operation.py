@@ -258,6 +258,15 @@ class ScheduleOperation:
             if self.scorer_kind == "oracle" and self.oracle is not None
             else None
         )
+        # same isolation rule for the capacity observatory (ops.capacity):
+        # a non-oracle operation must CLEAR a predecessor scorer's sampler
+        # or the dead harness's ring keeps answering /debug/capacity and
+        # feeding the burn:capacity health signal (OracleScorer registers
+        # its own — possibly None when BST_CAPACITY=0 — at construction)
+        if self.scorer_kind != "oracle" or self.oracle is None:
+            from ..ops.capacity import set_active_sampler
+
+            set_active_sampler(None)
 
     # ------------------------------------------------------------------
     # scorer lifecycle

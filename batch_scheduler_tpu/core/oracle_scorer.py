@@ -524,6 +524,25 @@ class OracleScorer:
         self.pack_seconds: list = []  # guarded-by: _stats_lock
         self.batch_seconds: list = []  # guarded-by: _stats_lock
         self._stats_lock = threading.Lock()
+        # Capacity observatory (ops.capacity, docs/observability.md
+        # "Capacity observatory"): a budget-gated analytics kernel run
+        # against the published batch's committed inputs — per-lane
+        # utilization/headroom spectra, fragmentation, stranded capacity,
+        # tenant shares — feeding /debug/capacity, the Prometheus gauges,
+        # and (via the audit ring) the offline `capacity` replay.
+        self._capacity = None
+        from ..ops.capacity import capacity_enabled, set_active_sampler
+
+        if capacity_enabled():
+            from ..ops.capacity import CapacitySampler
+
+            self._capacity = CapacitySampler(label="scorer")
+        # registered UNCONDITIONALLY (None when disabled): the newest
+        # scorer owns the observatory, so a torn-down harness's ring can
+        # never answer a later harness's /debug/capacity query or feed
+        # its burn:capacity health verdict (the set_active_pending
+        # pattern — a capacity-off scorer must CLEAR a predecessor's)
+        set_active_sampler(self._capacity)
         self.configure_audit(audit_log, identity_audit_every)
 
     def configure_audit(self, audit_log=None,
@@ -734,10 +753,74 @@ class OracleScorer:
             audit_id=audit_id_marker,
             telemetry=telemetry or {},
         )
+        # one audit ID for the whole evidence chain: the audit record,
+        # the identity audit, AND the capacity sample all correlate by it
+        # (the offline `capacity` replay matches samples to records on it)
+        aid = audit_id_marker
+        if aid is None and (
+            self.audit_log is not None
+            or self._identity is not None
+            or self._capacity is not None
+        ):
+            from ..utils import audit as audit_mod
+
+            aid = audit_mod.new_audit_id()
         if self.audit_log is not None or self._identity is not None:
-            self._audit_publish(
-                snap, host, audit_id_marker, speculative, telemetry
+            self._audit_publish(snap, host, aid, speculative, telemetry)
+        if self._capacity is not None:
+            self._capacity_sample(snap, host, aid)
+
+    @staticmethod
+    def _snapshot_tenancy(snap) -> tuple:
+        """One cached O(G) namespace pass per SNAPSHOT: ``(ns_counts,
+        dominant_ns)``, shared by the dispatch path's dominant-tenant
+        context and the audit record's tenant metadata — the hot paths
+        must not each re-walk 2048 gang names per batch."""
+        cached = getattr(snap, "_tenancy", None)
+        if cached is not None:
+            return cached
+        from ..utils import tenancy
+
+        ns_counts: Dict[str, int] = {}
+        for name in snap.group_names:
+            ns = tenancy.gang_namespace(name)
+            if ns:
+                ns_counts[ns] = ns_counts.get(ns, 0) + 1
+        dominant = (
+            min(ns_counts, key=lambda ns: (-ns_counts[ns], ns))
+            if ns_counts
+            else ""
+        )
+        snap._tenancy = (ns_counts, dominant)
+        return snap._tenancy
+
+    def _capacity_sample(self, snap, host, audit_id) -> None:
+        """Budget-gated capacity-observatory hook (ops.capacity): one
+        analytics kernel over exactly the committed inputs this batch
+        scored — the device-resident buffers when residency is live
+        (single-device), so the big arrays never leave HBM. Evidence
+        collection, never the decision path."""
+        try:
+            batch_args = None
+            if self.scan_mesh is None:
+                # mesh-sharded resident buffers would reshard under the
+                # single-device analytics jit; the host arrays are the
+                # bit-identical fallback there
+                batch_args = getattr(snap, "device_state_args", None)
+            if batch_args is None:
+                batch_args = snap.device_args()
+            progress = snap.progress_args()
+            cols = snap.policy_cols
+            self._capacity.note_batch(
+                batch_args, host,
+                group_names=snap.group_names,
+                lane_names=list(snap.schema.names),
+                scheduled=progress[1], matched=progress[2],
+                policy_prio=cols[0] if cols is not None else None,
+                audit_log=self.audit_log, audit_id=audit_id,
             )
+        except Exception:  # noqa: BLE001 — analytics never fail publish
+            pass
 
     def _audit_publish(
         self, snap, host, audit_id, speculative: bool, telemetry
@@ -758,6 +841,20 @@ class OracleScorer:
                 else None
             )
             if self.audit_log is not None:
+                # cardinality-capped tenant attribution rides the record
+                # metadata (the ROADMAP multi-tenant item's prep): gangs
+                # per tenant label, derived from this batch's names
+                from ..utils import tenancy
+
+                # the snapshot's cached namespace counts, then one
+                # registry hit per DISTINCT namespace — per-gang
+                # tenant_label calls would take the process-wide
+                # registry lock G times per audited batch
+                ns_counts, _ = self._snapshot_tenancy(snap)
+                tenants: Dict[str, int] = {}
+                for ns, count in ns_counts.items():
+                    label = tenancy.tenant_label(ns)
+                    tenants[label] = tenants.get(label, 0) + count
                 self.audit_log.record_batch(
                     batch_args=snap.device_args(),
                     progress_args=snap.progress_args(),
@@ -771,6 +868,7 @@ class OracleScorer:
                     degraded=bool(self.degraded),
                     telemetry=telemetry or {},
                     policy=policy_payload,
+                    extra={"tenants": tenants},
                 )
             if (
                 self._identity is not None
@@ -827,11 +925,26 @@ class OracleScorer:
                 )
                 if device_cols is not None:
                     policy = (device_cols, policy[1], policy[2])
-        host, device_result = execute_batch_host(
-            batch_args, snap.progress_args(),
-            scan_mesh=self.scan_mesh, donate=donate,
-            policy=policy,
+        # dominant-tenant context for the scan-path counter
+        # (bst_scan_batches_total{tenant=...}): derived from this batch's
+        # names, capped through the process registry (utils.tenancy) so
+        # the label set stays bounded; cleared in the finally — the
+        # dispatch-ahead thread must not leak its label into the next
+        # foreground batch on a reused thread
+        from ..utils import tenancy
+
+        _ns_counts, dominant = self._snapshot_tenancy(snap)
+        tenancy.set_batch_tenant(
+            tenancy.tenant_label(dominant) if dominant else ""
         )
+        try:
+            host, device_result = execute_batch_host(
+                batch_args, snap.progress_args(),
+                scan_mesh=self.scan_mesh, donate=donate,
+                policy=policy,
+            )
+        finally:
+            tenancy.set_batch_tenant(None)
 
         def row_fetcher(kind: str, g: int) -> np.ndarray:
             return np.asarray(jax.device_get(device_result[kind][g]))

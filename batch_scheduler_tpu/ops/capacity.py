@@ -1,0 +1,765 @@
+"""Continuous cluster capacity analytics, computed where they are free.
+
+The observability stack answers per-batch ("where did the nanoseconds
+go", utils.profiler) and per-gang ("why is my gang pending",
+core.explain) questions; this module answers the FLEET question an
+operator asks first: how full is the cluster, how fragmented is the
+remaining capacity, which tenant is consuming it, and can the pending
+work actually land. It is one jit'd kernel (``capacity_summary``) run
+against the committed batch inputs — the same device-resident buffers
+ops.device_state already keeps in HBM — after a published batch, emitting
+an **O(lanes) summary**:
+
+- **per-lane utilization/headroom spectra** — lane totals plus a
+  ``[R, _BINS]`` histogram of per-node headroom measured in units of the
+  pending work's mean member demand, bucketed with the SAME
+  ``min(cap, _BINS-1)`` clamp the assignment scan's ``_select_best_fit``
+  / ``_hist_select`` ranking uses, so the spectrum agrees with what the
+  scan can actually place;
+- **fragmentation index** — the largest gang (vectorized power-of-two
+  size sweep over the carried leftover) that could still place as one
+  all-or-nothing unit, per priority tier and globally, vs the need-
+  clipped total: lots of total headroom with a small largest-placeable
+  is exactly "fragmented";
+- **stranded capacity** — per-lane headroom sitting on nodes where NO
+  pending gang shape fits even one member (capacity no queued work can
+  consume);
+- **seat-tightness distribution** — the stamped plan's seats histogrammed
+  by the tightness bucket of their node at batch entry (how best-fit the
+  placement actually was);
+- **per-tenant dominant-resource shares** — namespace-derived
+  (utils.tenancy), cardinality-capped attribution of consumed lanes and
+  pending seats.
+
+Cost discipline: the kernel is one scoring-pass equivalent
+(``O(G·N·R)`` elementwise + scatters — the same class as the batch's own
+``group_capacity``), and :class:`CapacitySampler` budget-gates it: after
+a sample costing ``k`` seconds, the next is allowed no sooner than
+``k / BST_CAPACITY_BUDGET_FRAC`` later, so the amortized hook cost is
+``<= BST_CAPACITY_BUDGET_FRAC`` (default 2%) of wall-clock by
+construction — the audit-hook discipline, enforced by ``make
+bench-capacity``.
+
+Determinism: the summary is derived from the batch inputs + result with
+fixed arithmetic, keyed by lane/tier/tenant INDEX (names only decorate
+display surfaces), and the per-batch tenant mapping is computed from the
+batch's own names — so the offline ``capacity`` subcommand can replay a
+recorded audit ring through this same kernel and reproduce the live
+series bit-identically (the replay-gate discipline applied to analytics).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .oracle import (
+    _BIG,
+    _BINS,
+    GANG_MAX,
+    _exact_floordiv,
+    _member_capacity,
+    group_capacity,
+)
+
+__all__ = [
+    "capacity_summary",
+    "annotate_summary",
+    "format_capacity_verdict",
+    "CapacitySampler",
+    "capacity_enabled",
+    "capacity_budget_frac",
+    "set_active_sampler",
+    "active_sampler",
+    "capacity_debug_view",
+    "TIERS",
+]
+
+# Priority tiers the fragmentation sweep reports on: gang priorities clip
+# into [0, TIERS) — deterministic from the recorded priority column, so
+# live and replayed summaries agree (tier 0 = the no-policy default).
+TIERS = 4
+
+# Power-of-two gang-size ladder for the largest-placeable sweep; 2**18 is
+# GANG_MAX, the largest admissible gang (ops.oracle).
+_SIZE_LADDER = tuple(2 ** p for p in range(19))
+
+
+# ---------------------------------------------------------------------------
+# env knobs
+# ---------------------------------------------------------------------------
+
+
+def capacity_enabled() -> bool:
+    """Parse-guarded BST_CAPACITY read: default ON; 0/off/false disables
+    the sampler (the BST_DEVICE_STATE idiom)."""
+    raw = os.environ.get("BST_CAPACITY", "").strip().lower()
+    if raw in ("0", "off", "false", "no"):
+        return False
+    return True
+
+
+def capacity_budget_frac() -> float:
+    """Parse-guarded BST_CAPACITY_BUDGET_FRAC: the fraction of wall-clock
+    the analytics hook may consume amortized (default 0.02). Clamped to
+    [1e-4, 1.0]; 1.0 effectively samples every batch (gates/tests)."""
+    raw = os.environ.get("BST_CAPACITY_BUDGET_FRAC", "").strip()
+    if raw:
+        try:
+            return min(max(float(raw), 1e-4), 1.0)
+        except ValueError:
+            pass
+    return 0.02
+
+
+# ---------------------------------------------------------------------------
+# the jit'd analytics kernel
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("tenants",))
+def _capacity_kernel(
+    alloc, requested, group_req, remaining, fit_mask, group_valid,
+    placed, a_nodes, a_counts, scheduled, matched, tenant_id, tier,
+    tenants: int,
+):
+    """The whole observatory in one traced function. Inputs are the
+    padded batch args (ops.bucketing order), the batch result's plan
+    fields, the progress counts, and the per-batch tenant/tier columns;
+    every output is O(lanes)-small (the [R, _BINS] histogram is the
+    largest). Pure: no env reads, no clocks (the jit-purity contract)."""
+    f32 = jnp.float32
+    n, r = alloc.shape
+    placed_b = placed.astype(bool)
+    valid_b = group_valid.astype(bool)
+    left0 = alloc - requested
+    # the stamped plan applied to the entry leftover: zero-count slots
+    # carry arbitrary backfill node indexes, but their contribution is
+    # zero, so the clip + scatter-add is correct without masking them
+    counts = jnp.clip(a_counts, 0, GANG_MAX) * placed_b.astype(
+        jnp.int32
+    )[:, None]
+    nodes_idx = jnp.clip(a_nodes, 0, n - 1)
+    seats = jnp.sum(counts, axis=1)
+    contrib = counts[:, :, None] * group_req[:, None, :]
+    used_by_plan = jnp.zeros_like(alloc).at[nodes_idx.reshape(-1)].add(
+        contrib.reshape(-1, r)
+    )
+    left_after = left0 - used_by_plan
+
+    node_real = jnp.any(alloc > 0, axis=1)
+    real_i = node_real.astype(jnp.int32)
+    lf = jnp.clip(left_after, 0, _BIG).astype(f32) * real_i.astype(
+        f32
+    )[:, None]
+    lane_alloc = jnp.sum(
+        jnp.clip(alloc, 0, _BIG).astype(f32) * real_i.astype(f32)[:, None],
+        axis=0,
+    )
+    lane_free = jnp.sum(lf, axis=0)
+    lane_max_free = jnp.max(
+        jnp.clip(left_after, 0, _BIG) * real_i[:, None], axis=0
+    )
+
+    # pending work and its mean member demand (the headroom yardstick)
+    pend = valid_b & (~placed_b) & (remaining > 0)
+    pend_members = remaining * pend.astype(jnp.int32)
+    tot_pend = jnp.sum(pend_members)
+    ref_num = jnp.sum(
+        group_req.astype(f32) * pend_members.astype(f32)[:, None], axis=0
+    )
+    ref = jnp.where(
+        tot_pend > 0,
+        jnp.round(ref_num / jnp.maximum(tot_pend, 1).astype(f32)),
+        0.0,
+    ).astype(jnp.int32)
+
+    # per-lane headroom spectrum, bucketed exactly like the scan ranks
+    # nodes: min(capacity-in-members, _BINS-1); ref==0 lanes (no pending
+    # demand touches them) park every real node in the top bucket
+    per_lane_cap = jnp.where(
+        ref[None, :] > 0,
+        _exact_floordiv(
+            jnp.clip(left_after, 0, _BIG), jnp.clip(ref[None, :], 1, _BIG)
+        ),
+        _BIG,
+    )
+    key_lane = jnp.minimum(per_lane_cap, _BINS - 1)
+    lane_iota = jax.lax.broadcasted_iota(jnp.int32, (n, r), 1)
+    headroom_hist = jnp.zeros((r, _BINS), jnp.int32).at[
+        lane_iota.reshape(-1), key_lane.reshape(-1)
+    ].add(jnp.broadcast_to(real_i[:, None], (n, r)).reshape(-1))
+
+    # what the pending work can still consume of the carried leftover
+    cap_after = group_capacity(left_after, group_req, fit_mask)
+    capc = jnp.minimum(cap_after, remaining[:, None]) * pend.astype(
+        jnp.int32
+    )[:, None]
+    feasible_after = (jnp.sum(capc, axis=1) >= remaining) & pend
+    unplaceable = jnp.sum((pend & ~feasible_after).astype(jnp.int32))
+
+    consumable = jnp.any((cap_after > 0) & pend[:, None], axis=0)
+    has_head = jnp.any(left_after > 0, axis=1) & node_real
+    stranded = has_head & (~consumable) & (tot_pend > 0)
+    stranded_f = stranded.astype(f32)
+    stranded_lane = jnp.sum(lf * stranded_f[:, None], axis=0)
+    stranded_nodes = jnp.sum(stranded.astype(jnp.int32))
+
+    # seat tightness: the plan's seats by their node's entry bucket
+    cap0 = group_capacity(left0, group_req, fit_mask)
+    key0 = jnp.minimum(cap0, _BINS - 1)
+    seat_keys = jnp.take_along_axis(key0, nodes_idx, axis=1)
+    seat_hist = jnp.zeros((_BINS,), jnp.int32).at[
+        seat_keys.reshape(-1)
+    ].add(counts.reshape(-1))
+
+    # largest-placeable sweep (fragmentation), per tier + global: for a
+    # reference member demand, the biggest ladder size s whose
+    # need-clipped pooled capacity covers s — the all-or-nothing gang
+    # admission rule applied to hypothetical sizes
+    sizes = jnp.asarray(_SIZE_LADDER, jnp.int32)
+    tiers_col = jnp.clip(tier, 0, TIERS - 1)
+
+    def _largest(ref_row, active):
+        cap_ref = _member_capacity(
+            left_after, ref_row[None, :]
+        ) * real_i
+        cap_ref = jnp.clip(cap_ref, 0, GANG_MAX)
+        tot_s = jnp.sum(
+            jnp.minimum(cap_ref[None, :], sizes[:, None]).astype(f32),
+            axis=1,
+        )
+        largest = jnp.max(
+            sizes * (tot_s >= sizes.astype(f32)).astype(jnp.int32)
+        )
+        total_ref = jnp.sum(cap_ref.astype(f32))
+        act = active.astype(jnp.int32)
+        return largest * act, total_ref * active.astype(f32)
+
+    tier_largest = []
+    tier_pending = []
+    for t in range(TIERS):
+        tmask = pend & (tiers_col == t)
+        tm = remaining * tmask.astype(jnp.int32)
+        tt = jnp.sum(tm)
+        ref_t = jnp.where(
+            tt > 0,
+            jnp.round(
+                jnp.sum(group_req.astype(f32) * tm.astype(f32)[:, None],
+                        axis=0)
+                / jnp.maximum(tt, 1).astype(f32)
+            ),
+            0.0,
+        ).astype(jnp.int32)
+        lt, _ = _largest(ref_t, tt > 0)
+        tier_largest.append(lt)
+        tier_pending.append(tt)
+    frag_largest, frag_total = _largest(ref, tot_pend > 0)
+
+    # per-tenant attribution: members already on nodes (scheduled +
+    # matched) plus this plan's seats, times the member demand row
+    members_active = (
+        jnp.clip(scheduled, 0, GANG_MAX)
+        + jnp.clip(matched, 0, GANG_MAX)
+        + seats
+    )
+    demand = members_active.astype(f32)[:, None] * group_req.astype(f32)
+    tid = jnp.clip(tenant_id, 0, tenants - 1)
+    tenant_used = jnp.zeros((tenants, r), f32).at[tid].add(
+        demand * valid_b.astype(f32)[:, None]
+    )
+    tenant_pending = jnp.zeros((tenants,), jnp.int32).at[tid].add(
+        pend_members
+    )
+
+    return {
+        "lane_alloc": lane_alloc,
+        "lane_free": lane_free,
+        "lane_max_free": lane_max_free,
+        "ref_demand": ref,
+        "headroom_hist": headroom_hist,
+        "stranded_lane": stranded_lane,
+        "stranded_nodes": stranded_nodes,
+        "seat_hist": seat_hist,
+        "tier_largest": jnp.stack(tier_largest),
+        "tier_pending": jnp.stack(tier_pending),
+        "frag_largest": frag_largest,
+        "frag_total": frag_total,
+        "tenant_used": tenant_used,
+        "tenant_pending": tenant_pending,
+        "pending_gangs": jnp.sum(pend.astype(jnp.int32)),
+        "pending_seats": tot_pend,
+        "unplaceable_gangs": unplaceable,
+        "placed_gangs": jnp.sum(placed_b.astype(jnp.int32)),
+        "placed_seats": jnp.sum(seats),
+        "nodes_real": jnp.sum(real_i),
+    }
+
+
+def _f(x) -> float:
+    return round(float(x), 6)
+
+
+def capacity_summary(
+    batch_args: tuple,
+    result: dict,
+    *,
+    group_names: Optional[List[str]] = None,
+    scheduled=None,
+    matched=None,
+    policy_prio=None,
+) -> dict:
+    """One canonical capacity summary for a published batch.
+
+    ``batch_args`` is the padded 7-tuple (host numpy or device-resident
+    jax arrays — ops.bucketing order); ``result`` the batch's host plan
+    dict (or an AuditReader record's ``result_arrays``). The summary is
+    keyed by lane/tier/tenant INDEX and derived deterministically, so a
+    recorded batch replayed through this function reproduces the live
+    sample bit-identically on the same backend. ``policy_prio`` (the
+    packed priority column) feeds the tier sweep; absent = every gang
+    tier 0 — the same rule live and offline."""
+    from ..utils.tenancy import batch_tenants, tenant_cap
+
+    (alloc, requested, group_req, remaining, fit_mask, group_valid,
+     _order) = batch_args
+    g_bucket = int(np.asarray(remaining).shape[0])
+    names = list(group_names or [])
+    tenant_id, labels = batch_tenants(names, g_bucket)
+    tenants = tenant_cap() + 1  # static width: labels pad into "other"
+    zeros_g = np.zeros(g_bucket, dtype=np.int32)
+    sched = zeros_g if scheduled is None else np.asarray(
+        scheduled, dtype=np.int32
+    )
+    mat = zeros_g if matched is None else np.asarray(matched, dtype=np.int32)
+    tier = zeros_g if policy_prio is None else np.asarray(
+        policy_prio, dtype=np.int32
+    )
+    out = _capacity_kernel(
+        alloc, requested, group_req, remaining, fit_mask, group_valid,
+        np.asarray(result["placed"]).astype(np.int32),
+        np.asarray(result["assignment_nodes"]).astype(np.int32),
+        np.asarray(result["assignment_counts"]).astype(np.int32),
+        sched, mat, tenant_id, tier,
+        tenants=int(tenants),
+    )
+    out = {k: np.asarray(jax.device_get(v)) for k, v in out.items()}
+
+    lanes = []
+    r = out["lane_alloc"].shape[0]
+    for i in range(r):
+        alloc_i = _f(out["lane_alloc"][i])
+        free_i = _f(out["lane_free"][i])
+        used_i = _f(max(alloc_i - free_i, 0.0))
+        lanes.append({
+            "lane": i,
+            "alloc": alloc_i,
+            "free": free_i,
+            "utilization": _f(used_i / max(alloc_i, 1.0)),
+            "max_node_free": int(out["lane_max_free"][i]),
+            "ref_member_demand": int(out["ref_demand"][i]),
+            "stranded_free": _f(out["stranded_lane"][i]),
+            "headroom_hist": [int(c) for c in out["headroom_hist"][i]],
+        })
+
+    frag_total = _f(out["frag_total"])
+    frag_largest = int(out["frag_largest"])
+    frag_index = _f(
+        1.0 - frag_largest / frag_total if frag_total > 0 else 0.0
+    )
+    stranded_lane = out["stranded_lane"]
+    top_stranded = int(np.argmax(stranded_lane)) if r else 0
+
+    tenants_out = []
+    for t, label in enumerate(labels):
+        shares = {}
+        dominant, dom_lane = 0.0, 0
+        for i in range(r):
+            s = _f(
+                float(out["tenant_used"][t, i])
+                / max(float(out["lane_alloc"][i]), 1.0)
+            )
+            shares[str(i)] = s
+            if s > dominant:
+                dominant, dom_lane = s, i
+        pending_t = int(out["tenant_pending"][t])
+        if dominant <= 0.0 and pending_t == 0 and label == "other":
+            continue  # an empty overflow bucket is noise
+        tenants_out.append({
+            "tenant": label,
+            "dominant_share": _f(dominant),
+            "dominant_lane": dom_lane,
+            "shares": shares,
+            "pending_seats": pending_t,
+        })
+    top = max(
+        tenants_out, key=lambda d: d["dominant_share"], default=None
+    )
+
+    return {
+        "schema": "bst-capacity/v1",
+        "nodes": int(out["nodes_real"]),
+        "gangs": len(names) if names else g_bucket,
+        "lanes": lanes,
+        "fragmentation_index": frag_index,
+        "largest_placeable_gang": frag_largest,
+        "largest_placeable_by_tier": [
+            int(x) for x in out["tier_largest"]
+        ],
+        "pending_seats_by_tier": [int(x) for x in out["tier_pending"]],
+        "stranded": {
+            "nodes": int(out["stranded_nodes"]),
+            "top_lane": top_stranded,
+            "top_lane_free": _f(stranded_lane[top_stranded]) if r else 0.0,
+        },
+        "seat_tightness_hist": [int(c) for c in out["seat_hist"]],
+        "pending": {
+            "gangs": int(out["pending_gangs"]),
+            "seats": int(out["pending_seats"]),
+            "unplaceable_gangs": int(out["unplaceable_gangs"]),
+        },
+        "placed": {
+            "gangs": int(out["placed_gangs"]),
+            "seats": int(out["placed_seats"]),
+        },
+        "tenants": tenants_out,
+        "top_tenant": top["tenant"] if top else "",
+        "top_tenant_share": top["dominant_share"] if top else 0.0,
+    }
+
+
+def annotate_summary(
+    summary: dict, lane_names: Optional[List[str]] = None
+) -> dict:
+    """A display copy of a canonical summary with lane indices resolved
+    to schema names (``lane<i>`` when unknown). The CANONICAL summary
+    stays index-keyed — names never enter the bit-compared series."""
+    names = list(lane_names or [])
+
+    def lname(i: int) -> str:
+        return names[i] if 0 <= i < len(names) else f"lane{i}"
+
+    out = dict(summary)
+    out["lanes"] = [
+        {**lane, "name": lname(lane["lane"])} for lane in summary["lanes"]
+    ]
+    stranded = dict(summary["stranded"])
+    stranded["top_lane_name"] = lname(stranded["top_lane"])
+    out["stranded"] = stranded
+    return out
+
+
+def format_capacity_verdict(
+    summary: dict, lane_names: Optional[List[str]] = None
+) -> str:
+    """The one-line exit-verdict form (cmd sim prints it beside the
+    ``slo health:`` line)."""
+    view = annotate_summary(summary, lane_names)
+    util = {
+        lane["name"]: lane["utilization"] for lane in view["lanes"]
+        if lane["alloc"] > 0
+    }
+    busiest = max(util.items(), key=lambda kv: kv[1], default=("-", 0.0))
+    pend = summary["pending"]
+    parts = [
+        f"frag {summary['fragmentation_index']:.2f}",
+        f"largest placeable {summary['largest_placeable_gang']}",
+        f"busiest lane {busiest[0]} {busiest[1] * 100:.0f}%",
+    ]
+    if summary["stranded"]["nodes"]:
+        parts.append(
+            f"stranded {summary['stranded']['nodes']} nodes "
+            f"(top {view['stranded']['top_lane_name']})"
+        )
+    if summary["top_tenant"]:
+        parts.append(
+            f"top tenant {summary['top_tenant']} "
+            f"{summary['top_tenant_share'] * 100:.0f}%"
+        )
+    if pend["unplaceable_gangs"]:
+        parts.append(f"UNPLACEABLE {pend['unplaceable_gangs']} gangs")
+    return "capacity: " + ", ".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# the budget-gated sampler
+# ---------------------------------------------------------------------------
+
+
+class CapacitySampler:
+    """Per-scorer (or per-sidecar) capacity sampling with the amortized
+    cost bound built in: a sample costing ``k`` seconds schedules the
+    next no sooner than ``k / budget_frac`` later. Samples land in a
+    bounded downsampling ring (utils.timeseries), the Prometheus gauges,
+    and — when an audit log is attached — a ``capacity_sample`` event in
+    the audit ring keyed by the batch's audit ID (the offline replay's
+    comparison anchor)."""
+
+    def __init__(self, label: str = "scorer", registry=None):
+        from ..utils.metrics import DEFAULT_REGISTRY
+        from ..utils.timeseries import DownsamplingRing
+
+        self.label = label
+        self._reg = registry or DEFAULT_REGISTRY
+        self._lock = threading.Lock()
+        self._ring = DownsamplingRing()  # internally locked
+        self._next_allowed = 0.0  # guarded-by: _lock
+        self.samples = 0  # guarded-by: _lock
+        self.skipped = 0  # guarded-by: _lock
+        self.last_kernel_s = 0.0  # guarded-by: _lock
+        self._last: Optional[dict] = None  # guarded-by: _lock
+        self._lane_names: Optional[List[str]] = None  # guarded-by: _lock
+        self._counter = self._reg.counter(
+            "bst_capacity_samples_total",
+            "Capacity-observatory kernel runs by outcome (sampled / "
+            "budget-skipped / error)",
+        )
+        self._kernel_hist = self._reg.histogram(
+            "bst_capacity_kernel_seconds",
+            "Wall-clock of one capacity-analytics kernel run (the "
+            "budget-gated hook cost)",
+        )
+
+    def note_batch(
+        self,
+        batch_args: tuple,
+        result: dict,
+        *,
+        group_names: Optional[List[str]] = None,
+        lane_names: Optional[List[str]] = None,
+        scheduled=None,
+        matched=None,
+        policy_prio=None,
+        audit_log=None,
+        audit_id: Optional[str] = None,
+    ) -> Optional[dict]:
+        """Hot-path entry: run the kernel iff the budget allows, record
+        the sample everywhere, return the summary (None when skipped).
+        Never raises — analytics must not fail the decision path."""
+        now = time.monotonic()
+        with self._lock:
+            if now < self._next_allowed:
+                self.skipped += 1
+                skipped = True
+            else:
+                skipped = False
+                # reserve the slot INSIDE the gate check: the sidecar's
+                # connection threads share one sampler, and a
+                # check-then-act gate would let N concurrent publishers
+                # all pass an open gate and pay the kernel in parallel —
+                # N times the documented budget. The infinite sentinel
+                # cannot expire mid-run (a >60s cold compile would reopen
+                # a timed one); it is ALWAYS overwritten before anything
+                # else can fail — by the error path (+5s) or by the real
+                # spacing, both set before the ring/gauge exports run.
+                self._next_allowed = float("inf")
+        if skipped:
+            self._counter.inc(outcome="skipped")
+            return None
+        try:
+            t0 = time.perf_counter()
+            summary = capacity_summary(
+                batch_args, result, group_names=group_names,
+                scheduled=scheduled, matched=matched,
+                policy_prio=policy_prio,
+            )
+            kernel_s = time.perf_counter() - t0
+        except Exception:  # noqa: BLE001 — analytics never break serving
+            self._counter.inc(outcome="error")
+            with self._lock:
+                # an erroring kernel must not retry at line rate
+                self._next_allowed = time.monotonic() + 5.0
+            return None
+        frac = capacity_budget_frac()
+        with self._lock:
+            self.samples += 1
+            self.last_kernel_s = kernel_s
+            # frac >= 1.0 means "every batch" (gates/tests); below it,
+            # the spacing IS the amortized-cost bound
+            self._next_allowed = (
+                0.0 if frac >= 1.0
+                else time.monotonic() + kernel_s / frac
+            )
+            self._last = summary
+            if lane_names:
+                self._lane_names = list(lane_names)
+            ring = self._ring
+        # the ring copy carries a 0/1 violation indicator the burn-rate
+        # model consumes: downsampling AVERAGES it, so a merged entry's
+        # value is exactly the fraction of violating raw samples it
+        # folded (utils.health burn:capacity). A shallow copy — the
+        # canonical summary recorded to the audit ring stays untouched
+        # (the offline bit-compare contract).
+        ring.append(
+            time.time(),
+            dict(
+                summary,
+                capacity_violation=(
+                    1.0
+                    if summary["pending"]["unplaceable_gangs"] > 0
+                    else 0.0
+                ),
+            ),
+        )
+        self._counter.inc(outcome="sampled")
+        self._kernel_hist.observe(kernel_s)
+        self._export_gauges(summary)
+        if audit_log is not None:
+            try:
+                audit_log.record_event(
+                    "capacity_sample", audit_id=audit_id, summary=summary
+                )
+            except Exception:  # noqa: BLE001 — evidence best-effort
+                pass
+        return summary
+
+    def _export_gauges(self, summary: dict) -> None:
+        reg = self._reg
+        reg.gauge(
+            "bst_capacity_fragmentation_index",
+            "1 - largest-placeable-gang / need-clipped total capacity "
+            "(0 = one gang could take everything, ~1 = crumbs)",
+        ).set(summary["fragmentation_index"])
+        reg.gauge(
+            "bst_capacity_largest_placeable_gang",
+            "Largest power-of-two gang of the pending mean member demand "
+            "still placeable as one unit, by priority tier",
+        ).set(float(summary["largest_placeable_gang"]), tier="all")
+        for t, v in enumerate(summary["largest_placeable_by_tier"]):
+            if summary["pending_seats_by_tier"][t]:
+                reg.gauge(
+                    "bst_capacity_largest_placeable_gang", ""
+                ).set(float(v), tier=str(t))
+        util = reg.gauge(
+            "bst_capacity_lane_utilization",
+            "Per-lane cluster utilization (used / allocatable), lane-"
+            "indexed per the snapshot schema",
+        )
+        stranded = reg.gauge(
+            "bst_capacity_stranded_free",
+            "Per-lane headroom on nodes no pending gang shape can "
+            "consume (device units)",
+        )
+        with self._lock:
+            names = list(self._lane_names or [])
+        for lane in summary["lanes"]:
+            i = lane["lane"]
+            label = names[i] if i < len(names) else f"lane{i}"
+            util.set(lane["utilization"], lane=label)
+            stranded.set(lane["stranded_free"], lane=label)
+        reg.gauge(
+            "bst_capacity_stranded_nodes",
+            "Nodes holding headroom that no pending gang shape can "
+            "consume",
+        ).set(float(summary["stranded"]["nodes"]))
+        reg.gauge(
+            "bst_capacity_pending_unplaceable_gangs",
+            "Pending gangs the carried leftover cannot place even with "
+            "every reserved seat released (capacity-infeasible now)",
+        ).set(float(summary["pending"]["unplaceable_gangs"]))
+        share = reg.gauge(
+            "bst_capacity_tenant_share",
+            "Per-tenant dominant-resource share of allocatable capacity "
+            "(namespace-derived, cardinality-capped via "
+            "BST_TENANT_LABEL_MAX)",
+        )
+        from ..utils.tenancy import OTHER_TENANT, tenant_label
+
+        for t in summary["tenants"]:
+            # the summary's labels are capped PER BATCH; the gauge's
+            # label set must be capped PER PROCESS (the first-seen
+            # registry) or namespace churn grows /metrics series without
+            # bound over the process lifetime — the label-explosion
+            # outage the cap exists to prevent
+            label = (
+                t["tenant"]
+                if t["tenant"] == OTHER_TENANT
+                else tenant_label(t["tenant"])
+            )
+            share.set(t["dominant_share"], tenant=label)
+
+    # -- reporting -----------------------------------------------------------
+
+    def last(self) -> Optional[dict]:
+        with self._lock:
+            return self._last
+
+    def lane_names(self) -> Optional[List[str]]:
+        with self._lock:
+            return list(self._lane_names) if self._lane_names else None
+
+    def series(self, max_points: Optional[int] = None) -> List[dict]:
+        return self._ring.series(max_points)
+
+    def report(self, series_points: int = 512) -> dict:
+        with self._lock:
+            last = self._last
+            names = list(self._lane_names or [])
+            samples, skipped = self.samples, self.skipped
+            kernel_s = self.last_kernel_s
+        return {
+            "label": self.label,
+            "samples": samples,
+            "skipped": skipped,
+            "last_kernel_s": round(kernel_s, 6),
+            "budget_frac": capacity_budget_frac(),
+            "lane_names": names,
+            "last": annotate_summary(last, names) if last else None,
+            "ring": self._ring.stats(),
+            "series": self.series(max_points=series_points),
+        }
+
+
+# ---------------------------------------------------------------------------
+# the active-sampler registry (the set_active_pending pattern)
+# ---------------------------------------------------------------------------
+
+_active: list = [None]
+
+
+def set_active_sampler(sampler: Optional[CapacitySampler]) -> None:
+    """Each OracleScorer registers its sampler at construction so
+    /debug/capacity (and the sim harness) answer for the LIVE scorer —
+    a torn-down harness's ring must not answer a later one's query."""
+    _active[0] = sampler
+
+
+def active_sampler() -> Optional[CapacitySampler]:
+    return _active[0]
+
+
+def capacity_debug_view(params: Optional[dict] = None) -> tuple:
+    """The /debug/capacity payload: (payload, http status). Bare GETs are
+    self-describing 200s (the /debug/ index probe's contract)."""
+    sampler = _active[0]
+    if sampler is None:
+        return (
+            {
+                "enabled": capacity_enabled(),
+                "sampler": None,
+                "hint": "no capacity sampler registered (oracle mode "
+                        "with BST_CAPACITY on required)",
+            },
+            200,
+        )
+    params = params or {}
+    points = 512
+    raw = params.get("points")
+    if raw is not None:
+        # parse BEFORE building the report: the series copy is the
+        # expensive part and must be taken exactly once, at the
+        # requested trim
+        try:
+            points = max(1, int(raw))
+        except ValueError:
+            return {"error": f"malformed points={raw!r}"}, 400
+    report = sampler.report(series_points=points)
+    report["enabled"] = capacity_enabled()
+    return report, 200

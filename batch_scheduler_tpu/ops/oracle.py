@@ -2775,9 +2775,16 @@ def _fold_batch_metrics(telemetry: dict) -> None:
         if telemetry.get("scan_sharded")
         else "wavefront" if telemetry["wave_width"] > 1 else "serial"
     )
+    # dominant-tenant attribution (utils.tenancy): the scorer arms a
+    # thread-local with the batch's top tenant (namespace-derived,
+    # cardinality-capped) before dispatch; paths with no tenant identity
+    # (the sidecar sees packed arrays, never names) label "-"
+    from ..utils.tenancy import current_batch_tenant
+
     reg.counter(
-        "bst_scan_batches_total", "Oracle batches by assignment-scan path"
-    ).inc(path=path)
+        "bst_scan_batches_total",
+        "Oracle batches by assignment-scan path and dominant tenant",
+    ).inc(path=path, tenant=current_batch_tenant() or "-")
     if telemetry.get("scan_topk", 0) > 0:
         reg.gauge(
             "bst_scan_topk_k",

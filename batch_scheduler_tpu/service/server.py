@@ -43,6 +43,58 @@ from . import protocol as proto
 __all__ = ["DeviceExecutor", "OracleServer", "serve_background"]
 
 
+# ---------------------------------------------------------------------------
+# sidecar-side capacity observatory (ops.capacity)
+# ---------------------------------------------------------------------------
+#
+# One process-wide sampler shared by every connection: a TRACED schedule
+# batch (single-device only — mesh-placed args would reshard under the
+# analytics jit) gets a budget-gated capacity sample whose compact form
+# rides back to the client inside the TRACE_INFO telemetry dict, so a
+# traced client sees the SIDECAR's utilization/fragmentation beside its
+# own. The sidecar sees packed arrays, never names, so tenant attribution
+# here is all-"other" — per-tenant shares are the client scorer's job.
+# Gated to traced requests: an untraced serving path must never pay the
+# analytics kernel's first compile inside a deadline'd request.
+
+_server_capacity_lock = threading.Lock()
+_server_capacity = None  # guarded-by: _server_capacity_lock
+
+
+def _maybe_server_capacity(batch_args, progress_args, host) -> None:
+    global _server_capacity
+    from ..ops.capacity import CapacitySampler, capacity_enabled
+
+    if not capacity_enabled():
+        return
+    with _server_capacity_lock:
+        if _server_capacity is None:
+            _server_capacity = CapacitySampler(label="server")
+        sampler = _server_capacity
+    try:
+        summary = sampler.note_batch(
+            batch_args, host,
+            scheduled=progress_args[1], matched=progress_args[2],
+        )
+    except Exception:  # noqa: BLE001 — telemetry only
+        return
+    tel = host.get("telemetry")
+    if summary is None or not isinstance(tel, dict):
+        return
+    tel["capacity"] = {
+        "fragmentation_index": summary["fragmentation_index"],
+        "largest_placeable_gang": summary["largest_placeable_gang"],
+        "utilization": {
+            str(lane["lane"]): lane["utilization"]
+            for lane in summary["lanes"] if lane["alloc"] > 0
+        },
+        "stranded_nodes": summary["stranded"]["nodes"],
+        "pending_unplaceable_gangs": (
+            summary["pending"]["unplaceable_gangs"]
+        ),
+    }
+
+
 def _pad_request(req: proto.ScheduleRequest):
     """Bucket-pad an unpadded request via the SAME canonical padding as the
     in-process snapshot packer (ops.bucketing.pad_oracle_batch) so the wire
@@ -562,6 +614,12 @@ class _Handler(socketserver.BaseRequestHandler):
                                     )
                                 except Exception:  # noqa: BLE001 — warm-only
                                     pass
+                            if req_trace is not None and mesh is None:
+                                # sidecar capacity sample for the traced
+                                # client (budget-gated; rides TRACE_INFO)
+                                _maybe_server_capacity(
+                                    args, progress_args, host
+                                )
                             timings = {
                                 "ts0": ts0,
                                 "unpack_pad": t1 - t0,
@@ -589,7 +647,9 @@ class _Handler(socketserver.BaseRequestHandler):
                     elif msg_type == proto.MsgType.DELTA_SCHEDULE_REQ:
 
                         def run_delta(payload=payload):
-                            return self._run_delta_body(payload)
+                            return self._run_delta_body(
+                                payload, traced=req_trace is not None
+                            )
 
                         outcome = self._run(run_delta, budget_ms)
                         if outcome is _DEADLINE_HIT:
@@ -847,7 +907,7 @@ class _Handler(socketserver.BaseRequestHandler):
             proto.pack_schedule_response(resp),
         )
 
-    def _run_delta_body(self, payload: bytes):
+    def _run_delta_body(self, payload: bytes, traced: bool = False):
         """One DELTA_SCHEDULE_REQ: bring the connection's device-resident
         mirror (ops.device_state.DeviceStateHolder) up to the client's
         generation — scatter-applying churned rows, or installing a full
@@ -926,6 +986,10 @@ class _Handler(socketserver.BaseRequestHandler):
                     len(body.node_idx) + len(body.group_idx)
                 ) if kind == proto.DELTA_ROWS else 0,
             }
+        if traced and mesh is None:
+            # capacity over the MIRROR's resident buffers — the sidecar's
+            # own view of the cluster it is scoring (rides TRACE_INFO)
+            _maybe_server_capacity(device_args, progress_args, host)
         timings = {
             "ts0": ts0,
             "unpack_pad": t1 - t0,

@@ -259,6 +259,15 @@ class SimCluster:
             return {"error": "no observatory (oracle mode required)"}
         return obs.explain(group)
 
+    def capacity(self) -> Dict:
+        """The capacity observatory's report (ops.capacity) — the
+        harness-side view of /debug/capacity: last summary, downsampled
+        series, sampler counters."""
+        from ..ops.capacity import capacity_debug_view
+
+        payload, _status = capacity_debug_view()
+        return payload
+
     def whatif(self, counterfactual: Dict, rung: str = "steady") -> Dict:
         """Score one counterfactual against live cluster state on a
         forked device-state buffer (core.explain) — the harness-side view

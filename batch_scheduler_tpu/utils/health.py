@@ -32,6 +32,19 @@ a CI gate can tighten them mid-run); warn fires at 80% of the target;
 with zero observations in the window reports ``ok`` with
 ``observations: 0`` — absence of traffic is not a breach.
 
+**Multi-window burn rate** (the SRE error-budget alert, ``burn:<signal>``
+entries): a p95 target implicitly grants a 5% violation budget; the burn
+rate is (observed violation fraction) / 5%, evaluated over the FAST
+window (``BST_SLO_WINDOW_S``) and a SLOW window
+(``BST_SLO_BURN_WINDOW_S``, default 3600 s) simultaneously. Breach
+requires BOTH elevated (``BST_SLO_BURN_FAST`` ≥ 14.4 fast AND
+``BST_SLO_BURN_SLOW`` ≥ 6 slow) — "burning budget NOW"; a high slow burn
+with a recovered fast window is only a warn — "budget burned EARLIER" —
+so recovery clears the page without hiding the spent budget. The
+capacity observatory (ops.capacity) feeds a ``burn:capacity`` signal the
+same way: a sample with capacity-unplaceable pending gangs is a
+violation. Gauges: ``bst_slo_burn_rate{signal, window}``.
+
 The **identity audit** closes the bit-identity gap docs/pipelining.md
 documents as CI-only: every Kth non-speculative published batch is
 re-executed on the CPU fallback rung (serial scan — the rung that is
@@ -82,6 +95,93 @@ QUANTILE_SIGNALS = (
 
 WARN_FRACTION = 0.8
 _VERDICT_RANK = {"ok": 0, "warn": 1, "breach": 2}
+
+# Burn-rate alerting constants: a p95 target budgets 5% violations; the
+# default thresholds are the classic SRE multi-window pair (14.4x on the
+# fast window to page only on real fires, 6x on the slow window so a
+# budget mostly spent stays visible as a warn after recovery).
+BURN_ALLOWED_FRACTION = 0.05
+DEFAULT_BURN_WINDOW_S = 3600.0
+DEFAULT_BURN_FAST_THRESHOLD = 14.4
+DEFAULT_BURN_SLOW_THRESHOLD = 6.0
+
+
+def _burn_window_s() -> float:
+    raw = os.environ.get("BST_SLO_BURN_WINDOW_S", "").strip()
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return DEFAULT_BURN_WINDOW_S
+
+
+def _burn_fast_threshold() -> float:
+    raw = os.environ.get("BST_SLO_BURN_FAST", "").strip()
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return DEFAULT_BURN_FAST_THRESHOLD
+
+
+def _burn_slow_threshold() -> float:
+    raw = os.environ.get("BST_SLO_BURN_SLOW", "").strip()
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return DEFAULT_BURN_SLOW_THRESHOLD
+
+
+def _burn_verdict(burns: Dict[str, float], subject: str) -> tuple:
+    """(verdict, reason, fast_threshold, slow_threshold) for one signal's
+    fast/slow burn pair — THE multi-window decision rule, shared by the
+    histogram-backed signals and burn:capacity so the two can never
+    drift: breach only while burning NOW (both windows elevated),
+    slow-only = budget burned EARLIER (recovery clears the page)."""
+    fast_thr = _burn_fast_threshold()
+    slow_thr = _burn_slow_threshold()
+    burning_now = burns["fast"] >= fast_thr
+    burned_slow = burns["slow"] >= slow_thr
+    if burning_now and burned_slow:
+        verdict, reason = (
+            "breach",
+            f"{subject} NOW: burning {burns['fast']}x over the fast "
+            f"window, {burns['slow']}x over the slow window",
+        )
+    elif burned_slow:
+        verdict, reason = (
+            "warn",
+            f"{subject}: budget burned EARLIER — slow-window burn "
+            f"{burns['slow']}x but the fast window has recovered",
+        )
+    elif burning_now:
+        verdict, reason = (
+            "warn",
+            f"{subject}: fast-window burn {burns['fast']}x; slow window "
+            "not yet confirming",
+        )
+    else:
+        verdict, reason = "ok", ""
+    return verdict, reason, fast_thr, slow_thr
+
+
+def _violations(snap, buckets, target: float) -> tuple:
+    """(violations, total) of one histogram snapshot against a latency
+    target: observations strictly above the first bucket bound >= target
+    (the same conservative rounding Prometheus alerting math uses —
+    in-bucket positions are unknowable from cumulative counts)."""
+    counts, _, total = snap
+    idx = None
+    for i, b in enumerate(buckets):
+        if b >= target:
+            idx = i
+            break
+    good = counts[idx] if idx is not None else (counts[-1] if counts else 0)
+    return max(total - good, 0), total
 
 
 def worst(verdicts) -> str:
@@ -225,12 +325,23 @@ class HealthModel:
         self._snaps: Dict[str, deque] = {
             name: deque() for name, _, _, _ in QUANTILE_SIGNALS
         }
+        # burn-rate history: (ts, snapshot) per signal, retained for the
+        # SLOW window (the fast window reads a suffix of the same deque)
+        self._burn_snaps: Dict[str, deque] = {
+            name: deque() for name, _, _, _ in QUANTILE_SIGNALS
+        }
         self._last_verdict: Dict[str, str] = {}
         self._identity_mismatch: Optional[dict] = None
         self._breaches = self._reg.counter(
             "bst_slo_breach_total",
             "SLO signal transitions into breach, by signal "
             "(docs/observability.md health catalog)",
+        )
+        self._burn_gauge = self._reg.gauge(
+            "bst_slo_burn_rate",
+            "Error-budget burn rate per SLO signal and window "
+            "(violation fraction / 5% budget; breach needs fast AND "
+            "slow elevated — docs/observability.md)",
         )
 
     @property
@@ -257,8 +368,11 @@ class HealthModel:
         with self._lock:
             for name, metric, _, buckets in QUANTILE_SIGNALS:
                 hist = self._hist(metric, buckets)
+                snap = hist.snapshot()
                 self._snaps[name].clear()
-                self._snaps[name].append((now, hist.snapshot()))
+                self._snaps[name].append((now, snap))
+                self._burn_snaps[name].clear()
+                self._burn_snaps[name].append((now, snap))
             self._last_verdict.clear()
             self._identity_mismatch = None
 
@@ -280,9 +394,71 @@ class HealthModel:
             self._breaches.inc(signal=name)
         self._last_verdict[name] = verdict
 
+    def _burn_signal(
+        self, name: str, hist, current, now: float, fast_s: float,
+        slow_s: float, default: float,
+    ) -> dict:  # lock-held: _lock
+        """One signal's multi-window burn verdict from its snapshot
+        history. Maintains the slow-window deque as a side effect."""
+        dq = self._burn_snaps.setdefault(name, deque())
+        # bounded by CONSTRUCTION, not by evaluation rate: retain at most
+        # one snapshot per slow_s/1024 of wall-clock, so a high-rate
+        # /debug/health poller (a 10Hz dashboard) cannot grow the history
+        # past ~1k entries per signal — at a 3600s window a ~3.5s
+        # snapshot granularity loses nothing the verdict could see
+        if not dq or now - dq[-1][0] >= slow_s / 1024.0:
+            dq.append((now, current))
+        while len(dq) > 1 and now - dq[1][0] > slow_s:
+            dq.popleft()
+
+        def _at(window: float):
+            base = dq[0][1]
+            for ts, snap in dq:
+                if ts <= now - window:
+                    base = snap
+                else:
+                    break
+            return base
+
+        target = _target(name, default)
+        burns = {}
+        observations = 0
+        for window_name, window in (("fast", fast_s), ("slow", slow_s)):
+            bad, total = (
+                _violations(current, hist.buckets, target)[0]
+                - _violations(_at(window), hist.buckets, target)[0],
+                current[2] - _at(window)[2],
+            )
+            frac = bad / total if total > 0 else 0.0
+            burns[window_name] = round(frac / BURN_ALLOWED_FRACTION, 3)
+            if window_name == "fast":
+                observations = total
+            self._burn_gauge.set(
+                burns[window_name], signal=name, window=window_name
+            )
+        verdict, reason, fast_thr, slow_thr = _burn_verdict(
+            burns, f"{name} latency budget"
+        )
+        self._note_transition(f"burn:{name}", verdict)
+        return {
+            "kind": "burn",
+            "signal": name,
+            "target_p95_s": target,
+            "burn_fast": burns["fast"],
+            "burn_slow": burns["slow"],
+            "fast_window_s": fast_s,
+            "slow_window_s": slow_s,
+            "fast_threshold": fast_thr,
+            "slow_threshold": slow_thr,
+            "observations": observations,
+            "verdict": verdict,
+            "reason": reason,
+        }
+
     def evaluate(self) -> dict:
         now = time.time()
         window = self.window_s
+        slow_window = _burn_window_s()
         signals: Dict[str, dict] = {}
         with self._lock:
             for name, metric, default, buckets in QUANTILE_SIGNALS:
@@ -322,6 +498,12 @@ class HealthModel:
                     "verdict": verdict,
                 }
                 snaps.append((now, current))
+                # multi-window burn rate over the same histogram: is the
+                # p95 budget being spent NOW (fast) vs already spent
+                # (slow) — the page-vs-postmortem distinction
+                signals[f"burn:{name}"] = self._burn_signal(
+                    name, hist, current, now, window, slow_window, default
+                )
 
             # -- structural states ------------------------------------------
             degraded = self._reg.gauge("bst_oracle_degraded").value()
@@ -393,6 +575,78 @@ class HealthModel:
                 if verdict != "ok" else ""
             ),
         }
+
+        # -- capacity burn (ops.capacity observatory) ------------------------
+        # a capacity sample with pending gangs the carried leftover cannot
+        # place is a violation: burning placement budget. Lazy import —
+        # health must evaluate before the ops layer ever loads.
+        try:
+            from ..ops.capacity import active_sampler
+
+            sampler = active_sampler()
+        except Exception:  # noqa: BLE001 — health must always answer
+            sampler = None
+        if sampler is not None:
+            series = sampler.series()  # ONE ring copy for both windows
+            burns = {}
+            observations = 0
+            for window_name, w in (
+                ("fast", window), ("slow", slow_window),
+            ):
+                bad = total = 0.0
+                for entry in series:
+                    # a downsampled entry covers [ts, ts+span_s] and
+                    # folded `merged` raw samples: weight by the count
+                    # and admit by span OVERLAP, or the slow window is
+                    # systematically mis-weighted exactly when history
+                    # has downsampled (utils.timeseries)
+                    if entry["ts"] + entry.get("span_s", 0.0) < now - w:
+                        continue
+                    weight = entry.get("merged", 1) or 1
+                    total += weight
+                    data = entry.get("data") or {}
+                    # capacity_violation is a 0/1 indicator at append
+                    # time, so the ring's averaging makes a merged
+                    # entry's value the exact violating FRACTION of its
+                    # raw samples (ops.capacity); pre-indicator entries
+                    # fall back to the unplaceable count
+                    viol = data.get("capacity_violation")
+                    if viol is None:
+                        pend = data.get("pending") or {}
+                        viol = (
+                            1.0
+                            if (pend.get("unplaceable_gangs") or 0) > 0
+                            else 0.0
+                        )
+                    bad += weight * min(max(float(viol), 0.0), 1.0)
+                frac = bad / total if total else 0.0
+                burns[window_name] = round(
+                    frac / BURN_ALLOWED_FRACTION, 3
+                )
+                if window_name == "fast":
+                    observations = int(total)
+                self._burn_gauge.set(
+                    burns[window_name], signal="capacity",
+                    window=window_name,
+                )
+            verdict, reason, fast_thr, slow_thr = _burn_verdict(
+                burns, "capacity-unplaceable pending demand"
+            )
+            with self._lock:
+                self._note_transition("burn:capacity", verdict)
+            signals["burn:capacity"] = {
+                "kind": "burn",
+                "signal": "capacity",
+                "burn_fast": burns["fast"],
+                "burn_slow": burns["slow"],
+                "fast_window_s": window,
+                "slow_window_s": slow_window,
+                "fast_threshold": fast_thr,
+                "slow_threshold": slow_thr,
+                "observations": observations,
+                "verdict": verdict,
+                "reason": reason,
+            }
 
         return {
             "verdict": worst(s["verdict"] for s in signals.values()),

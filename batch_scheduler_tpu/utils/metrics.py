@@ -310,6 +310,11 @@ DEBUG_ENDPOINTS = {
                      "copy: ?drain=N | ?cordon=N | ?add_nodes=K | "
                      "?bump_gang=G&tier=T | ?remove_gang=G "
                      "(core.explain; docs/observability.md grammar)",
+    "/debug/capacity": "the capacity observatory (ops.capacity): last "
+                       "summary + the downsampled time series — per-lane "
+                       "utilization/headroom spectra, fragmentation, "
+                       "stranded capacity, seat tightness, tenant "
+                       "shares; ?points=K trims the series",
 }
 
 
@@ -452,6 +457,20 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             q = parse_qs(urlparse(self.path).query)
             params = {k: v[0] for k, v in q.items() if v}
             payload, status = whatif_debug_view(params)
+            body = json.dumps(payload, default=str).encode()
+            ctype = "application/json"
+        elif path == "/debug/capacity":
+            # the capacity observatory (ops.capacity): the live scorer's
+            # last O(lanes) summary + the bounded downsampled series —
+            # how full, how fragmented, who is consuming it
+            import json
+            from urllib.parse import parse_qs, urlparse
+
+            from ..ops.capacity import capacity_debug_view
+
+            q = parse_qs(urlparse(self.path).query)
+            params = {k: v[0] for k, v in q.items() if v}
+            payload, status = capacity_debug_view(params)
             body = json.dumps(payload, default=str).encode()
             ctype = "application/json"
         elif path in ("/debug", "/debug/"):

@@ -484,10 +484,12 @@ def perf_report(registry=None) -> dict:
     batches = reg.get("bst_scan_batches_total")
     values_fn = getattr(batches, "values", None)
     if callable(values_fn):
+        # accumulate per path: the counter also carries a tenant label
+        # (utils.tenancy), so one path may span several labeled series
         for key, v in values_fn().items():
             label = dict(key).get("path", "")
             if label:
-                scan_mix[label] = v
+                scan_mix[label] = scan_mix.get(label, 0.0) + v
     memory = sample_device_memory()
     # device-resident state holders (ops.device_state): generation,
     # scatter/keyframe counts per holder — [] when none live. Guarded:

@@ -371,6 +371,15 @@ class FlightRecorder:
             "verdict": verdict,
             "reason": reason,
         }
+        # cardinality-capped tenant attribution on every decision record
+        # (utils.tenancy; the ROADMAP multi-tenant prep): stamped here so
+        # no record site needs to know the mapping. Pseudo-gangs without
+        # a namespace ("_batch") carry no tenant.
+        from .tenancy import gang_namespace, tenant_label
+
+        ns = gang_namespace(gang)
+        if ns:
+            rec["tenant"] = tenant_label(ns)
         ctx = current_context()
         if ctx is not None:
             rec["trace_id"] = ctx[0]

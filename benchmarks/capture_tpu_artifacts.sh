@@ -218,6 +218,27 @@ else
     echo "whatif gate failed:"; tail -4 /tmp/whatif_gate.out; fail=1
 fi
 
+echo "== capacity-observatory gate on hardware (CAPACITY_${TAG}) =="
+# the bench-capacity gate on the real backend: the analytics kernel's
+# cost (and so the 2% budget-gated cadence) against ~10ms TPU batches —
+# the capture that decides how often the observatory can afford to
+# sample at the north-star shape — plus the same replay-identity,
+# share-conservation and burn-rate-flip checks as CI
+# (docs/observability.md "Capacity observatory & burn-rate alerts")
+if BST_CAPACITY_GATE_PLATFORM=default timeout 900 \
+        python benchmarks/capacity_gate.py "CAPACITY_${TAG}.json" \
+        > /tmp/capacity_gate.out 2>&1; then
+    echo "capacity gate captured: CAPACITY_${TAG}.json"
+    tail -1 /tmp/capacity_gate.out
+else
+    if [ -s "CAPACITY_${TAG}.json" ]; then
+        echo "capacity gate reported failure — evidence kept: CAPACITY_${TAG}.json"
+        tail -4 /tmp/capacity_gate.out
+    else
+        echo "capacity gate failed:"; tail -4 /tmp/capacity_gate.out; fail=1
+    fi
+fi
+
 echo "== lockcheck-enabled sim cycle (LOCKCHECK_${TAG}) =="
 # one short sim cycle with the runtime lock-discipline checker armed
 # (BST_LOCKCHECK=1, docs/static_analysis.md): TPU batch times shift every

@@ -16,6 +16,9 @@ slower" tripwire on every build, not a hardware benchmark (that's
 - ``refresh_device_delta_s``  one churned refresh through the
   device-resident path: delta pack + jit'd scatter-update
   (ops.device_state) — the hot path that replaced the full repack
+- ``capacity_kernel_s``       one capacity-observatory analytics kernel
+  run (ops.capacity) at the small bucket — the observatory held to the
+  same regression gate it feeds
 - ``metrics_render_s``        the /metrics exposition render at a
   realistic series count (observability must not become the overhead)
 
@@ -65,6 +68,7 @@ TOLERANCES = {
     "oracle_wavefront_batch_s": 1.6,
     "snapshot_pack_s": 1.6,
     "refresh_device_delta_s": 1.6,
+    "capacity_kernel_s": 1.6,
     "metrics_render_s": 1.6,
 }
 
@@ -179,6 +183,18 @@ def probe_set():
         delta_req[name] = {"cpu": 1000 + tick[0], "pods": 1}
         holder.sync(packer.pack(big_nodes, delta_req, big_groups))
 
+    # capacity-observatory analytics kernel (ops.capacity): the
+    # observatory is itself a hot-path hook, so it rides the same gate
+    from batch_scheduler_tpu.ops.capacity import capacity_summary
+
+    cap_host, _ = execute_batch_host(batch_args, progress_args)
+    cap_names = [g.full_name for g in groups]
+
+    def capacity():
+        capacity_summary(
+            batch_args, cap_host, group_names=cap_names,
+        )
+
     reg = Registry()
     for i in range(40):
         reg.counter(f"bst_probe_counter_{i}_total", "probe").inc(
@@ -196,6 +212,7 @@ def probe_set():
         ("oracle_wavefront_batch_s", wavefront, wavefront),
         ("snapshot_pack_s", pack, pack),
         ("refresh_device_delta_s", device_delta, device_delta),
+        ("capacity_kernel_s", capacity, capacity),
         ("metrics_render_s", render, render),
     ]
 

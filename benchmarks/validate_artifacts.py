@@ -90,13 +90,17 @@ def _nonbench_ok(doc) -> bool:
     """Artifact families that are NOT bench lines and so never carry the
     envelope, accepted under ANY filename: Chrome-trace exports
     (TRACE_<tag>.json), replay-CLI summaries and lockcheck notes written
-    by pre-envelope builds, and driver-written dryrun records."""
+    by pre-envelope builds, driver-written dryrun records, and the
+    `capacity --audit-dir` offline-replay summary (CAPACITY_<tag>
+    evidence written by an installed package without benchmarks/ — the
+    in-repo path wraps it in the envelope)."""
     if not isinstance(doc, dict):
         return False
     keys = set(doc)
     return (
         "traceEvents" in keys
         or {"audit_dir", "against", "replayed"} <= keys
+        or {"audit_dir", "compared", "divergent"} <= keys
         or {"tag", "lockcheck"} <= keys
         or {"ok", "rc"} <= keys
     )

@@ -25,6 +25,11 @@ os.environ.setdefault("BST_BUCKET_COST", "0")
 # cross-run attribution data it exists for. Tests that exercise the
 # ledger pass an explicit path.
 os.environ.setdefault("BST_COMPILE_LEDGER", "off")
+# The capacity observatory's analytics kernel (ops.capacity) compiles one
+# jit signature per batch shape — across a suite that builds hundreds of
+# tiny scorers that is pure compile load for samples nothing reads.
+# Tests that exercise the observatory re-enable via monkeypatch/env.
+os.environ.setdefault("BST_CAPACITY", "0")
 
 import jax  # noqa: E402
 

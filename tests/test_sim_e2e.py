@@ -451,3 +451,66 @@ def test_prescheduling_gang_with_lost_bind_responses_recovers(sim):
         cluster.scheduler.stats,
         cluster.group("lost").status,
     )
+
+
+def test_capacity_observatory_in_sim_verdict(sim, monkeypatch):
+    """The capacity observatory end to end over a real sim (satellite of
+    the capacity-observatory PR): the scorer's publish hook samples, the
+    harness view answers like /debug/capacity would, tenant shares are
+    attributed by namespace, and the exit-verdict line the CLI prints
+    formats from the same summary."""
+    monkeypatch.setenv("BST_CAPACITY", "1")
+    monkeypatch.setenv("BST_CAPACITY_BUDGET_FRAC", "1.0")
+    cluster = sim(scorer="oracle")
+    cluster.add_nodes(
+        [make_sim_node(f"c{i}", {"cpu": "8", "pods": "64"}) for i in range(4)]
+    )
+    for t in range(2):
+        cluster.create_group(
+            make_sim_group(f"capg{t}", 2, namespace=f"team-{t}",
+                           creation_ts=float(t))
+        )
+    cluster.start()
+    for t in range(2):
+        cluster.create_pods(
+            make_member_pods(f"capg{t}", 2, {"cpu": "1"},
+                             namespace=f"team-{t}")
+        )
+        assert cluster.wait_for(
+            lambda t=t: sum(
+                1
+                for p in cluster.member_pods(f"capg{t}", f"team-{t}")
+                if p.spec.node_name
+            ) >= 2,
+            timeout=30.0,
+        )
+
+    report = cluster.capacity()
+    assert report["samples"] >= 1, report
+    last = report["last"]
+    assert last is not None
+    assert last["placed"]["gangs"] >= 1
+    tenants = {t["tenant"] for t in last["tenants"]}
+    assert {"team-0", "team-1"} & tenants, tenants
+    # shares conserve per lane (the bench-capacity acceptance, in-suite)
+    sums = {}
+    for t in last["tenants"]:
+        for lane, share in t["shares"].items():
+            sums[lane] = sums.get(lane, 0.0) + share
+    assert all(v <= 1.000001 for v in sums.values()), sums
+
+    from batch_scheduler_tpu.ops.capacity import (
+        active_sampler,
+        format_capacity_verdict,
+    )
+
+    sampler = active_sampler()
+    line = format_capacity_verdict(sampler.last(), sampler.lane_names())
+    assert line.startswith("capacity: frag ")
+    assert "busiest lane cpu" in line
+    # the decision records carry the tenant stamp (utils.tenancy)
+    decisions = cluster.decisions("team-0/capg0")
+    recs = decisions.get("team-0/capg0") or []
+    assert recs and all(
+        r.get("tenant") == "team-0" for r in recs
+    ), recs[:2]
