@@ -5,9 +5,9 @@
 
 PY ?= python
 
-.PHONY: all build vet analyze stamp-coupling test test-cpu test-tier1 bench bench-scan bench-pipeline bench-delta bench-policy bench-whatif bench-capacity bench-sharding bench-xl bench-regress validate-artifacts native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
+.PHONY: all build vet analyze stamp-coupling test test-cpu test-tier1 bench bench-scan bench-pipeline bench-delta bench-policy bench-whatif bench-capacity bench-coalesce bench-sharding bench-xl bench-regress validate-artifacts native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
 
-all: vet analyze native test bench-regress bench-capacity validate-artifacts
+all: vet analyze native test bench-regress bench-capacity bench-coalesce validate-artifacts
 
 build: vet analyze native
 
@@ -128,6 +128,18 @@ bench-whatif:
 # observatory & burn-rate alerts")
 bench-capacity:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/capacity_gate.py
+
+# multi-tenant coalescer CI gate (CPU): 8 concurrent clients through one
+# coalescing sidecar vs the 8-dedicated-sidecars time-sliced equivalent —
+# per-tenant plan digests bit-identical on BOTH merge lowerings (span
+# re-dispatch + block-diagonal mega-batch), a starved small tenant's p95
+# queue wait bounded under a whale storm, and the aggregate-throughput
+# floor (host-fingerprint-aware: a 1-core host has nothing to overlap
+# with, so it demotes to a parity band and the measured speedup rides
+# the envelope for the COALESCE_<tag> hardware capture)
+# (docs/multitenancy.md)
+bench-coalesce:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/coalesce_gate.py
 
 # audit/replay/health CI gate (CPU): records a short sim into an audit
 # ring, replays every batch bit-identically (steady + cpu-ladder rungs),

@@ -63,6 +63,15 @@ COUPLED_GROUPS: Dict[str, List[str]] = {
         "batch_scheduler_tpu/ops/device_state.py::_scatter_impl",
         "batch_scheduler_tpu/ops/device_state.py::DeviceStateHolder.apply_rows",
     ],
+    # the max-progress selection computed on device and its host-side
+    # numpy twin: the coalescer demux (service.coalescer) re-derives each
+    # tenant's `best` from the tenant's own padded progress args, so the
+    # two formulas must change together or a coalesced tenant's response
+    # drifts from its dedicated-sidecar run
+    "find-max-group": [
+        "batch_scheduler_tpu/ops/oracle.py::find_max_group",
+        "batch_scheduler_tpu/ops/oracle.py::find_max_group_host",
+    ],
     # the explain kernel's entry-leftover capture replays the serial scan
     # body (base and policy-composite forms): its captured leftover IS
     # the explanation's evidence, so the step formula must change

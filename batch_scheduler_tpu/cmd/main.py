@@ -238,6 +238,19 @@ def build_parser() -> argparse.ArgumentParser:
              "Equivalent to BST_POLICY; weights ride the BST_POLICY_* "
              "knobs. Empty/off = the exact pre-policy scan paths",
     )
+    sim.add_argument(
+        "--multi-client", type=int, default=0, metavar="K",
+        help="multi-tenant coalescer mode (docs/multitenancy.md): instead "
+             "of the full framework sim, drive K concurrent scheduler "
+             "clients' deterministic oracle streams through ONE sidecar "
+             "(--oracle-addr, or an in-process coalescing sidecar when "
+             "omitted) and print aggregate throughput + per-tenant queue "
+             "waits; --nodes/--groups size each tenant's cluster",
+    )
+    sim.add_argument(
+        "--mc-batches", type=int, default=8, metavar="B",
+        help="batches per client in --multi-client mode",
+    )
     _add_metrics_flag(sim)
     _add_profile_flag(sim)
     _add_trace_flags(sim)
@@ -263,6 +276,16 @@ def build_parser() -> argparse.ArgumentParser:
              "bucket shapes around live traffic so bucket transitions hit "
              "warm executables (hit/miss counters in /metrics and "
              "TRACE_INFO telemetry — docs/pipelining.md)",
+    )
+    serve.add_argument(
+        "--coalesce",
+        action="store_true",
+        help="multi-tenant cross-client coalescer (docs/multitenancy.md): "
+             "merge compatible pending batches from different connections "
+             "in a DRF-fair admission order in front of the device "
+             "executor (single-device servers only; equivalent to "
+             "BST_COALESCE=1 — depth/fairness ride the BST_COALESCE_* "
+             "knobs)",
     )
     _add_metrics_flag(serve)
     _add_profile_flag(serve)
@@ -939,6 +962,8 @@ def cmd_serve(args) -> int:
     server = OracleServer(
         host=args.host, port=args.port, compile_warmer=args.compile_warmer,
         audit_log=_maybe_audit_log(args),
+        # flag is sugar over BST_COALESCE; None lets the env decide
+        coalesce=True if args.coalesce else None,
     )
     host, port = server.address
     print(f"oracle sidecar listening on {host}:{port}", flush=True)
@@ -952,6 +977,73 @@ def cmd_serve(args) -> int:
         from ..utils import profiler as profiler_mod
 
         profiler_mod.shutdown()
+    return 0
+
+
+def _cmd_sim_multi_client(args) -> int:
+    """sim --multi-client K: the coalescer acceptance harness as a CLI —
+    K concurrent scheduler clients' deterministic oracle streams through
+    one sidecar (docs/multitenancy.md "Multi-client sim")."""
+    from ..sim.harness import drive_multi_client
+
+    nodes = args.nodes or 256
+    gangs = max(args.groups, 1)
+    server = None
+    addr = args.oracle_addr
+    if not addr:
+        from ..service.server import serve_background
+
+        # in-process coalescing sidecar: --oracle-addr points the driver
+        # at an external `serve --coalesce` instead
+        server = serve_background(coalesce=True)
+        if server.coalescer is None:
+            print(
+                "note: in-process sidecar is mesh-backed; coalescing off "
+                "(start a single-device `serve --coalesce` and pass "
+                "--oracle-addr to exercise the merge queue)",
+                file=sys.stderr,
+            )
+        host, port = server.address
+        addr = f"{host}:{port}"
+    print(
+        f"multi-client sim: {args.multi_client} clients x "
+        f"{args.mc_batches} batches, per-tenant [{nodes} nodes, "
+        f"{gangs} gangs] via {addr}",
+        flush=True,
+    )
+    try:
+        result = drive_multi_client(
+            addr,
+            clients=args.multi_client,
+            batches=args.mc_batches,
+            nodes=nodes,
+            gangs=gangs,
+            deadline_ms=args.oracle_deadline_ms,
+        )
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+    wall = result.pop("_wall_s")
+    total = sum(len(v["digests"]) for v in result.values())
+    busy = sum(v["busy"] for v in result.values())
+    print(
+        f"aggregate: {total} batches in {wall:.2f}s = "
+        f"{total / max(wall, 1e-9):.1f} batches/s"
+        + (f", {busy} busy-dropped" if busy else "")
+    )
+    from ..sim.harness import wait_p95
+
+    for tenant in sorted(result):
+        waits = sorted(result[tenant]["waits"])
+        if not waits:
+            print(f"  {tenant}: no completed batches")
+            continue
+        p95 = wait_p95(waits)
+        print(
+            f"  {tenant}: {len(waits)} batches, wait p50 "
+            f"{waits[len(waits) // 2] * 1000:.1f}ms p95 {p95 * 1000:.1f}ms"
+        )
     return 0
 
 
@@ -988,6 +1080,9 @@ def cmd_sim(args) -> int:
     _resolve_backend_or_degrade()
     _enable_compilation_cache()
     _start_profiler(args)
+
+    if args.multi_client > 0:
+        return _cmd_sim_multi_client(args)
 
     scorer = cfg.plugin_config.scorer
     oracle_client = None

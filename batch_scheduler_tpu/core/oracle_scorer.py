@@ -794,6 +794,18 @@ class OracleScorer:
         snap._tenancy = (ns_counts, dominant)
         return snap._tenancy
 
+    def dominant_tenant(self, snap) -> str:
+        """The batch's dominant tenant LABEL (cardinality-capped through
+        the process registry, utils.tenancy) — the one identity this
+        batch carries everywhere: the local scan counter's tenant label,
+        and (RemoteScorer) the TENANT wire annotation the sidecar's
+        capacity/scan attribution and coalescer fairness key off. ""
+        when the snapshot has no namespaced gangs."""
+        from ..utils import tenancy
+
+        _counts, dominant = self._snapshot_tenancy(snap)
+        return tenancy.tenant_label(dominant) if dominant else ""
+
     def _capacity_sample(self, snap, host, audit_id) -> None:
         """Budget-gated capacity-observatory hook (ops.capacity): one
         analytics kernel over exactly the committed inputs this batch
@@ -933,10 +945,7 @@ class OracleScorer:
         # foreground batch on a reused thread
         from ..utils import tenancy
 
-        _ns_counts, dominant = self._snapshot_tenancy(snap)
-        tenancy.set_batch_tenant(
-            tenancy.tenant_label(dominant) if dominant else ""
-        )
+        tenancy.set_batch_tenant(self.dominant_tenant(snap))
         try:
             host, device_result = execute_batch_host(
                 batch_args, snap.progress_args(),

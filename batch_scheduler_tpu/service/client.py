@@ -26,6 +26,7 @@ from ..ops.snapshot import ClusterSnapshot
 from ..utils.errors import (
     CircuitOpenError,
     DeltaResyncRequired,
+    OracleBusyError,
     OracleDeadlineError,
     OracleTransportError,
     StaleBatchError,
@@ -85,6 +86,7 @@ class OracleClient:
         trace_ctx: Optional[Tuple[str, str]] = None,
         audit_id: Optional[str] = None,
         policy_fp: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> Tuple[int, bytes]:
         with self._lock:
             if deadline_ms is not None:
@@ -124,6 +126,17 @@ class OracleClient:
                         proto.MsgType.POLICY_INFO,
                         proto.pack_policy_info(policy_fp),
                     )
+                if tenant:
+                    # tenant identity (docs/multitenancy.md): the
+                    # sidecar's capacity/scan attribution and the
+                    # coalescer's DRF fairness key off this label; None
+                    # keeps the wire bytes identical to a pre-tenant
+                    # client
+                    proto.write_frame(
+                        self._sock,
+                        proto.MsgType.TENANT,
+                        proto.pack_tenant(tenant),
+                    )
                 proto.write_frame(self._sock, msg_type, payload)
                 try:
                     resp_type, resp = proto.read_frame(self._sock)
@@ -146,6 +159,11 @@ class OracleClient:
                     self._sock.settimeout(self._timeout)
         if resp_type == proto.MsgType.DEADLINE_ERROR:
             raise OracleDeadlineError(resp.decode(errors="replace"))
+        if resp_type == proto.MsgType.BUSY:
+            retry_ms, message = proto.unpack_busy(resp)
+            raise OracleBusyError(
+                message or "oracle coalescer saturated", retry_ms
+            )
         if resp_type == proto.MsgType.ERROR:
             raise in_band_error(resp.decode(errors="replace"))
         return resp_type, resp
@@ -192,6 +210,7 @@ class OracleClient:
         deadline_ms: Optional[int] = None,
         audit_id: Optional[str] = None,
         policy_fp: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> proto.ScheduleResponse:
         # propagate the live span context over the wire (the TRACE
         # annotation frame); None when tracing is off or no span is open,
@@ -208,6 +227,7 @@ class OracleClient:
             trace_ctx=trace_ctx,
             audit_id=audit_id,
             policy_fp=policy_fp,
+            tenant=tenant,
         )
         if resp_type != proto.MsgType.SCHEDULE_RESP:
             raise OracleTransportError(
@@ -227,6 +247,7 @@ class OracleClient:
         deadline_ms: Optional[int] = None,
         audit_id: Optional[str] = None,
         policy_fp: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> proto.ScheduleResponse:
         """One device-resident-state batch (docs/pipelining.md
         "Device-resident state"): ``body`` is a full ScheduleRequest when
@@ -251,6 +272,7 @@ class OracleClient:
             trace_ctx=trace_ctx,
             audit_id=audit_id,
             policy_fp=policy_fp,
+            tenant=tenant,
         )
         if resp_type == proto.MsgType.DELTA_RESYNC:
             raise DeltaResyncRequired(proto.unpack_delta_resync(resp))
@@ -322,10 +344,11 @@ class _ClientSlot:
         deadline_ms: Optional[int] = None,
         audit_id: Optional[str] = None,
         policy_fp: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> proto.ScheduleResponse:
         return self._parent.schedule(
             req, deadline_ms, audit_id=audit_id, policy_fp=policy_fp,
-            _slot=self._idx,
+            tenant=tenant, _slot=self._idx,
         )
 
     def delta_schedule(
@@ -337,10 +360,12 @@ class _ClientSlot:
         deadline_ms: Optional[int] = None,
         audit_id: Optional[str] = None,
         policy_fp: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> proto.ScheduleResponse:
         return self._parent.delta_schedule(
             kind, base_generation, new_generation, body, deadline_ms,
-            audit_id=audit_id, policy_fp=policy_fp, _slot=self._idx,
+            audit_id=audit_id, policy_fp=policy_fp, tenant=tenant,
+            _slot=self._idx,
         )
 
     def row(
@@ -432,6 +457,12 @@ class ResilientOracleClient:
         self._deadline_errors = reg.counter(
             "bst_oracle_deadline_errors_total",
             "Oracle requests answered with an in-band deadline error",
+        )
+        self._busy_answers = reg.counter(
+            "bst_oracle_busy_total",
+            "Oracle requests answered BUSY (coalescer admission queue "
+            "saturated) — retried after the server's retry-after hint, "
+            "never a breaker failure",
         )
         self._breaker_gauge = reg.gauge(
             "bst_oracle_breaker_state",
@@ -543,10 +574,12 @@ class ResilientOracleClient:
         with self._slot_locks[slot]:
             self._admit(slot)
             last: Optional[BaseException] = None
+            slept_busy_hint = False
             for attempt in range(self.retry_policy.max_attempts):
-                if attempt:
+                if attempt and not slept_busy_hint:
                     self._retries.inc(op=op, client=self._label)
                     time.sleep(self.retry_policy.backoff(attempt - 1))
+                slept_busy_hint = False
                 try:
                     result = fn(self._ensure(slot))
                 except (StaleBatchError, OracleDeadlineError) as e:
@@ -557,6 +590,26 @@ class ResilientOracleClient:
                         self._deadline_errors.inc(client=self._label)
                     self.breaker.record_success()
                     raise
+                except OracleBusyError as e:
+                    # the sidecar is alive and telling us exactly when to
+                    # come back: wait out its hint (capped) and burn one
+                    # retry attempt — overload resolves, so unlike a
+                    # deadline this IS retried; unlike a transport
+                    # failure it never advances the breaker or drops the
+                    # connection. Exhausted attempts surface the
+                    # BusyError itself (the scorer's fallback decides),
+                    # not a transport wrapper.
+                    self.breaker.record_success()
+                    self._busy_answers.inc(op=op, client=self._label)
+                    if attempt + 1 >= self.retry_policy.max_attempts:
+                        raise
+                    time.sleep(min(max(e.retry_after_ms, 1) / 1000.0, 5.0))
+                    # the hint IS the wait: skip the generic transport
+                    # backoff (and its retries counter — this was an
+                    # answered request, not a transport failure) so the
+                    # retry lands when the server said a slot frees up
+                    slept_busy_hint = True
+                    last = e
                 except _TRANSPORT_ERRORS as e:
                     self._failures.inc(op=op, client=self._label)
                     self._drop(slot)
@@ -591,6 +644,7 @@ class ResilientOracleClient:
         deadline_ms: Optional[int] = None,
         audit_id: Optional[str] = None,
         policy_fp: Optional[str] = None,
+        tenant: Optional[str] = None,
         _slot: int = 0,
     ) -> proto.ScheduleResponse:
         d = (
@@ -601,7 +655,8 @@ class ResilientOracleClient:
         return self._call(
             "schedule",
             lambda c: c.schedule(
-                req, deadline_ms=d, audit_id=audit_id, policy_fp=policy_fp
+                req, deadline_ms=d, audit_id=audit_id, policy_fp=policy_fp,
+                tenant=tenant,
             ),
             slot=_slot,
         )
@@ -615,6 +670,7 @@ class ResilientOracleClient:
         deadline_ms: Optional[int] = None,
         audit_id: Optional[str] = None,
         policy_fp: Optional[str] = None,
+        tenant: Optional[str] = None,
         _slot: int = 0,
     ) -> proto.ScheduleResponse:
         d = (
@@ -626,7 +682,7 @@ class ResilientOracleClient:
             "delta_schedule",
             lambda c: c.delta_schedule(
                 kind, base_generation, new_generation, body, deadline_ms=d,
-                audit_id=audit_id, policy_fp=policy_fp,
+                audit_id=audit_id, policy_fp=policy_fp, tenant=tenant,
             ),
             slot=_slot,
         )
@@ -738,6 +794,7 @@ class RemoteScorer(OracleScorer):
         client: OracleClient,
         background_client: OracleClient = None,
         fallback: str = "deny",
+        tenant: Optional[str] = None,
     ):
         # device_state=False: this process's device lives behind the
         # sidecar — the server keeps the resident mirror, fed by the wire
@@ -759,6 +816,13 @@ class RemoteScorer(OracleScorer):
             self._clients = [client]
         self._next = 0
         self.fallback = fallback
+        # wire tenant identity (docs/multitenancy.md): an explicit label
+        # (multi-client sims, fleet deployments with a configured tenant)
+        # wins; otherwise each batch announces its snapshot's dominant
+        # namespace (OracleScorer.dominant_tenant) — cardinality-capped,
+        # so the sidecar's label set stays bounded. None/"" keeps the
+        # wire bytes identical to a pre-tenant client.
+        self.tenant = tenant
         self.supports_background_refresh = len(self._clients) > 1
         # dispatch-ahead has the same single-connection hazard as
         # background refresh: the speculative wire round-trip would hold
@@ -786,6 +850,19 @@ class RemoteScorer(OracleScorer):
         self._wire_delta_ok = device_state_enabled() and all(
             hasattr(c, "delta_schedule") and hasattr(c, "would_attempt")
             for c in self._clients
+        )
+        # TENANT annotation gate: disproven ONCE against an old peer
+        # (in-band "unknown message type 16"), the process stops
+        # announcing tenants permanently — same mixed-fleet discipline
+        # as the wire-delta fallback, plans unaffected either way.
+        # Resilient lanes only (the wire-delta gating): recovering from
+        # an old peer's error answer requires dropping the lane (the real
+        # response is still in the stream behind it) and re-dialing — a
+        # plain OracleClient never reconnects, so on one the recovery
+        # would permanently kill the transport. A plain-client
+        # deployment just keeps its pre-tenant attribution.
+        self._wire_tenant_ok = all(
+            hasattr(c, "would_attempt") for c in self._clients
         )
         self._wire_delta_counter = DEFAULT_REGISTRY.counter(
             "bst_oracle_wire_delta_batches_total",
@@ -861,7 +938,8 @@ class RemoteScorer(OracleScorer):
             pass
         cursor.reset()
 
-    def _wire_schedule(self, client, cursor, snap, req, audit_id, policy_fp):
+    def _wire_schedule(self, client, cursor, snap, req, audit_id, policy_fp,
+                       tenant=None):
         """One remote batch, delta-encoded when this lane's mirror can
         take it: churned rows + generation (DELTA_ROWS), a full keyframe
         when the mirror needs (re)installing, or a plain full snapshot
@@ -871,7 +949,9 @@ class RemoteScorer(OracleScorer):
         delta = getattr(snap, "delta", None)
         if not self._wire_delta_ok or delta is None:
             self._wire_delta_counter.inc(kind="full")
-            return client.schedule(req, audit_id=audit_id, policy_fp=policy_fp)
+            return client.schedule(
+                req, audit_id=audit_id, policy_fp=policy_fp, tenant=tenant
+            )
         gen = delta.generation
         if cursor.synced and not cursor.need_keyframe:
             n, g = int(snap.alloc.shape[0]), int(snap.group_req.shape[0])
@@ -886,6 +966,7 @@ class RemoteScorer(OracleScorer):
                         proto.DELTA_ROWS, cursor.server_gen, gen,
                         self._build_delta(snap, cursor),
                         audit_id=audit_id, policy_fp=policy_fp,
+                        tenant=tenant,
                     )
                     cursor.mark_synced(gen)
                     self._wire_delta_counter.inc(kind="delta")
@@ -898,18 +979,25 @@ class RemoteScorer(OracleScorer):
                     self._wire_resyncs.inc()
                     self._drop_lane(client, cursor)
                 except RuntimeError as e:
-                    if "unknown message type" not in str(e):
+                    if "unknown message type" not in str(e) or (
+                        "message type 16" in str(e)
+                    ):
+                        # type 16 is the TENANT annotation, written
+                        # BEFORE the delta frame: _execute owns that
+                        # fallback (stop announcing tenants), not this
+                        # knob
                         raise
                     # old peer: no MsgType 14 — full snapshots, forever
                     self._wire_delta_ok = False
                     self._wire_delta_counter.inc(kind="full")
                     return client.schedule(
-                        req, audit_id=audit_id, policy_fp=policy_fp
+                        req, audit_id=audit_id, policy_fp=policy_fp,
+                        tenant=tenant,
                     )
         try:
             resp = client.delta_schedule(
                 proto.DELTA_KEYFRAME, 0, gen, req,
-                audit_id=audit_id, policy_fp=policy_fp,
+                audit_id=audit_id, policy_fp=policy_fp, tenant=tenant,
             )
             cursor.mark_synced(gen)
             self._wire_delta_counter.inc(kind="keyframe")
@@ -921,13 +1009,19 @@ class RemoteScorer(OracleScorer):
             self._wire_resyncs.inc()
             self._drop_lane(client, cursor)
             self._wire_delta_counter.inc(kind="full")
-            return client.schedule(req, audit_id=audit_id, policy_fp=policy_fp)
+            return client.schedule(
+                req, audit_id=audit_id, policy_fp=policy_fp, tenant=tenant
+            )
         except RuntimeError as e:
-            if "unknown message type" not in str(e):
-                raise
+            if "unknown message type" not in str(e) or (
+                "message type 16" in str(e)
+            ):
+                raise  # type 16 = TENANT annotation: _execute's fallback
             self._wire_delta_ok = False
             self._wire_delta_counter.inc(kind="full")
-            return client.schedule(req, audit_id=audit_id, policy_fp=policy_fp)
+            return client.schedule(
+                req, audit_id=audit_id, policy_fp=policy_fp, tenant=tenant
+            )
 
     def _execute(self, snap: ClusterSnapshot):
         # fit_mask may be the [1,N] broadcast fast path; the wire carries
@@ -970,16 +1064,43 @@ class RemoteScorer(OracleScorer):
         # the mismatch — never a silent divergence. None when no policy is
         # live, which keeps the wire bytes identical to a pre-policy client.
         policy_fp = getattr(self, "policy_fingerprint", None)
+        # tenant identity at the client edge (docs/multitenancy.md): an
+        # explicit configured label wins; else the snapshot's dominant
+        # namespace, through the cardinality-capped registry — the same
+        # label the local scan counter uses (OracleScorer._execute)
+        tenant = None
+        if self._wire_tenant_ok:
+            tenant = self.tenant or self.dominant_tenant(snap) or None
         try:
             with trace_mod.span("oracle.wire_round_trip", cat="oracle"):
-                resp = self._wire_schedule(
-                    client, cursor, snap, req, audit_id, policy_fp
-                )
-        except _TRANSPORT_ERRORS + (OracleDeadlineError,):
+                try:
+                    resp = self._wire_schedule(
+                        client, cursor, snap, req, audit_id, policy_fp,
+                        tenant=tenant,
+                    )
+                except RuntimeError as e:
+                    if not (
+                        tenant and "unknown message type 16" in str(e)
+                    ):
+                        raise
+                    # old peer: no TENANT frame. The stream still holds
+                    # the un-consumed real response behind the in-band
+                    # error, so drop the lane and resend plain — and
+                    # never announce again (DEADLINE ship-together rule,
+                    # degraded gracefully).
+                    self._wire_tenant_ok = False
+                    self._drop_lane(client, cursor)
+                    resp = self._wire_schedule(
+                        client, cursor, snap, req, audit_id, policy_fp
+                    )
+        except _TRANSPORT_ERRORS + (OracleDeadlineError, OracleBusyError) as e:
             # whether the server applied anything is unknown (a deadline
             # may abandon a half-applied delta): forget this lane's
-            # mirror state so the next batch on it keyframes
-            cursor.reset()
+            # mirror state so the next batch on it keyframes. A BUSY
+            # answer is the exception — admission was refused before any
+            # mirror mutation, so the cursor stays valid.
+            if not isinstance(e, OracleBusyError):
+                cursor.reset()
             # raw OSError/EOFError included, not just the resilient
             # client's wrapped OracleTransportError: a plain OracleClient
             # is a supported transport here, and its bare socket errors

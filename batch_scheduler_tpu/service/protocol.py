@@ -102,6 +102,11 @@ __all__ = [
     "unpack_trace_info",
     "pack_audit_id",
     "unpack_audit_id",
+    "pack_tenant",
+    "unpack_tenant",
+    "pack_busy",
+    "unpack_busy",
+    "TENANT_LABEL_MAX_BYTES",
     "is_stale_batch_message",
 ]
 
@@ -144,6 +149,28 @@ class MsgType:
     # and never change existing layouts).
     DELTA_SCHEDULE_REQ = 14
     DELTA_RESYNC = 15
+    # Tenant identity annotation (docs/multitenancy.md): a cardinality-
+    # capped tenant label (utils.tenancy — the client's dominant
+    # namespace) annotating the NEXT request on this connection, the
+    # AUDIT_ID/POLICY_INFO pattern: no reply, old peers never see it
+    # (clients send it only when they have a tenant identity), every
+    # existing request/response layout — and the native C++ client,
+    # which never announces tenants — stays bit-for-bit unchanged. The
+    # sidecar sees packed arrays, never names, so without this frame its
+    # capacity summary and scan counters attribute everything to
+    # "other"/"-"; with it, sidecar-side capacity/metrics attribute
+    # truthfully and the coalescer's DRF admission order has a tenant
+    # to be fair BETWEEN.
+    TENANT = 16
+    # Admission-control refusal (docs/multitenancy.md): the coalescer's
+    # bounded merge queue is saturated — the request was NOT executed and
+    # nothing server-side changed (a delta's mirror generation is
+    # untouched). Carries a retry-after hint in ms; the resilient client
+    # waits it out and retries (never a breaker failure — the sidecar is
+    # alive and telling the client exactly when to come back, never a
+    # silent hang). Sent only by a coalescing server, which only clients
+    # shipping this PR's frames talk to — the DEADLINE ship-together rule.
+    BUSY = 17
 
 
 ROW_KINDS = ("capacity", "scores")
@@ -437,6 +464,52 @@ def pack_policy_info(fingerprint: str) -> bytes:
 
 def unpack_policy_info(payload: bytes) -> str:
     return _POLICY.unpack(payload)[0].decode("ascii", errors="replace")
+
+
+# -- tenant annotation + busy admission-control reply ------------------------
+
+# Variable-length (tenant labels are namespaces, not fixed-width hex), but
+# bounded: a label is already cardinality-capped client-side
+# (utils.tenancy.tenant_label), and the byte cap here keeps a hostile
+# peer from using the annotation as a memory lever.
+TENANT_LABEL_MAX_BYTES = 64
+
+
+def pack_tenant(label: str) -> bytes:
+    raw = label.encode("utf-8")
+    if not raw:
+        raise ValueError("tenant label must be non-empty")
+    if len(raw) > TENANT_LABEL_MAX_BYTES:
+        # truncate, never raise: the label is attribution metadata — a
+        # long namespace must degrade to a clipped label, not crash the
+        # schedule path mid-stream (annotation frames already written).
+        # Re-encode through a lossy decode so a codepoint split at the
+        # byte cap drops cleanly instead of shipping a partial sequence.
+        raw = (
+            raw[:TENANT_LABEL_MAX_BYTES]
+            .decode("utf-8", errors="ignore")
+            .encode("utf-8")
+        )
+    return raw
+
+
+def unpack_tenant(payload: bytes) -> str:
+    return payload[:TENANT_LABEL_MAX_BYTES].decode("utf-8", errors="replace")
+
+
+# retry-after hint in ms, then a UTF-8 message for operators/logs
+_BUSY = struct.Struct("<I")
+
+
+def pack_busy(retry_after_ms: int, message: str = "") -> bytes:
+    if not 0 <= retry_after_ms <= 0xFFFFFFFF:
+        raise ValueError(f"retry_after_ms out of range: {retry_after_ms}")
+    return _BUSY.pack(retry_after_ms) + message.encode()
+
+
+def unpack_busy(payload: bytes) -> Tuple[int, str]:
+    (retry_after_ms,) = _BUSY.unpack_from(payload, 0)
+    return int(retry_after_ms), payload[_BUSY.size:].decode(errors="replace")
 
 
 # -- device-resident state deltas -------------------------------------------

@@ -39,6 +39,12 @@ from ..ops.oracle import collect_batch, dispatch_batch
 from ..utils.metrics import DEFAULT_REGISTRY, LONG_OP_BUCKETS
 from ..utils import trace as trace_mod
 from . import protocol as proto
+from .coalescer import (
+    CoalesceJob,
+    CoalesceSaturated,
+    OracleCoalescer,
+    coalesce_enabled,
+)
 
 __all__ = ["DeviceExecutor", "OracleServer", "serve_background"]
 
@@ -53,7 +59,10 @@ __all__ = ["DeviceExecutor", "OracleServer", "serve_background"]
 # rides back to the client inside the TRACE_INFO telemetry dict, so a
 # traced client sees the SIDECAR's utilization/fragmentation beside its
 # own. The sidecar sees packed arrays, never names, so tenant attribution
-# here is all-"other" — per-tenant shares are the client scorer's job.
+# was historically all-"other"; a connection that announced its tenant
+# (the TENANT wire annotation, docs/multitenancy.md) now attributes its
+# batches' capacity shares to that label — the shares also feed the
+# coalescer's DRF admission weights (_capacity_tenant_shares).
 # Gated to traced requests: an untraced serving path must never pay the
 # analytics kernel's first compile inside a deadline'd request.
 
@@ -61,7 +70,25 @@ _server_capacity_lock = threading.Lock()
 _server_capacity = None  # guarded-by: _server_capacity_lock
 
 
-def _maybe_server_capacity(batch_args, progress_args, host) -> None:
+def _capacity_tenant_shares() -> dict:
+    """{tenant: dominant share} from the sidecar sampler's last summary —
+    the capacity observatory's live feed into the coalescer's DRF
+    admission order (empty before the first sample / with capacity off)."""
+    with _server_capacity_lock:
+        sampler = _server_capacity
+    if sampler is None:
+        return {}
+    last = sampler.last()
+    if not last:
+        return {}
+    return {
+        t["tenant"]: float(t["dominant_share"])
+        for t in last.get("tenants", [])
+    }
+
+
+def _maybe_server_capacity(batch_args, progress_args, host, tenant=None,
+                           g=None) -> None:
     global _server_capacity
     from ..ops.capacity import CapacitySampler, capacity_enabled
 
@@ -72,9 +99,18 @@ def _maybe_server_capacity(batch_args, progress_args, host) -> None:
             _server_capacity = CapacitySampler(label="server")
         sampler = _server_capacity
     try:
+        kwargs = {}
+        if tenant and g:
+            # synthetic namespace-prefixed names: the kernel's per-batch
+            # tenant mapping (utils.tenancy.batch_tenants) derives from
+            # gang names the wire never carries — the announced label
+            # stands in for all of them, so the whole batch attributes
+            # to the connection's tenant instead of "other"
+            kwargs["group_names"] = [f"{tenant}/wire-{i}" for i in range(g)]
         summary = sampler.note_batch(
             batch_args, host,
             scheduled=progress_args[1], matched=progress_args[2],
+            **kwargs,
         )
     except Exception:  # noqa: BLE001 — telemetry only
         return
@@ -194,11 +230,11 @@ class _ExecJob:
     consistent no matter which side gave up."""
 
     __slots__ = ("kind", "args", "progress_args", "fn", "enqueued",
-                 "queue_wait", "run_seconds", "donate", "_done", "_result",
-                 "_error")
+                 "queue_wait", "run_seconds", "donate", "tenant", "_done",
+                 "_result", "_error")
 
     def __init__(self, kind, args=None, progress_args=None, fn=None,
-                 donate=None):
+                 donate=None, tenant=None):
         self.kind = kind
         self.args = args
         self.progress_args = progress_args
@@ -207,6 +243,11 @@ class _ExecJob:
         # batches); False is forced for batches dispatched FROM a
         # device-resident mirror, whose buffers donation would consume
         self.donate = donate
+        # tenant label (the TENANT wire annotation / coalescer span) for
+        # the collect-side scan-counter attribution — the sidecar sees
+        # packed arrays, never names, so the label is the only tenant
+        # identity this process ever has
+        self.tenant = tenant
         self.enqueued = time.perf_counter()
         self.queue_wait = 0.0
         self.run_seconds = 0.0
@@ -276,20 +317,25 @@ class DeviceExecutor:
         self._depth.set(float(self._q.qsize()))
         return job
 
-    def submit_batch(self, batch_args, progress_args, donate=None) -> _ExecJob:
+    def submit_batch(self, batch_args, progress_args, donate=None,
+                     tenant=None) -> _ExecJob:
         return self._submit(
             _ExecJob(
                 "batch", args=batch_args, progress_args=progress_args,
-                donate=donate,
+                donate=donate, tenant=tenant,
             )
         )
 
-    def run_batch(self, batch_args, progress_args, donate=None):
+    def run_batch(self, batch_args, progress_args, donate=None, tenant=None):
         """Blocking convenience: returns (host, batch, queue_wait_s,
         run_s). The caller's thread (a per-connection worker) may be
         abandoned on deadline — see class docstring. ``donate=False``
-        forces non-donating dispatch (device-resident mirror batches)."""
-        job = self.submit_batch(batch_args, progress_args, donate=donate)
+        forces non-donating dispatch (device-resident mirror batches);
+        ``tenant`` attributes the batch's scan counter
+        (bst_scan_batches_total) to the announced wire tenant."""
+        job = self.submit_batch(
+            batch_args, progress_args, donate=donate, tenant=tenant
+        )
         host, batch = job.wait()
         return host, batch, job.queue_wait, job.run_seconds
 
@@ -307,13 +353,25 @@ class DeviceExecutor:
     # -- the executor thread ------------------------------------------------
 
     def _collect_oldest(self, inflight: deque) -> None:
+        from ..utils import tenancy
+
         job, pending = inflight.popleft()
+        # arm the executor thread's dominant-tenant context for the
+        # collect-side metric fold (ops.oracle._fold_batch_metrics): the
+        # wire tenant the connection announced (TENANT annotation), or
+        # the coalescer span's tenant — cleared in the finally so the
+        # next job never inherits it
+        if job.tenant:
+            tenancy.set_batch_tenant(job.tenant)
         try:
             result = collect_batch(pending)
         except BaseException as e:  # noqa: BLE001 — delivered to the waiter
             job.run_seconds = time.perf_counter() - job.enqueued - job.queue_wait
             job.finish(error=e)
             return
+        finally:
+            if job.tenant:
+                tenancy.set_batch_tenant(None)
         job.run_seconds = time.perf_counter() - job.enqueued - job.queue_wait
         job.finish(result=result)
 
@@ -499,6 +557,11 @@ class _Handler(socketserver.BaseRequestHandler):
         audit_ctx: Optional[str] = None  # armed for the NEXT request
         policy_ctx: Optional[str] = None  # armed for the NEXT request
         self._worker: Optional[_ConnWorker] = None
+        # the connection's announced tenant (TENANT annotation): armed for
+        # the next request like every annotation, then kept STICKY — a
+        # scheduler's tenant identity doesn't change per batch, and the
+        # coalescer/capacity attribution wants it on every later request
+        self._tenant: Optional[str] = None
         # per-connection batch state (handler instances are per-connection;
         # requests serialize through _run, so these need no lock)
         self._last_batch: Optional[dict] = None
@@ -541,6 +604,9 @@ class _Handler(socketserver.BaseRequestHandler):
                     if msg_type == proto.MsgType.POLICY_INFO:
                         policy_ctx = proto.unpack_policy_info(payload)
                         continue  # annotation only; no reply
+                    if msg_type == proto.MsgType.TENANT:
+                        self._tenant = proto.unpack_tenant(payload)
+                        continue  # annotation only; no reply
                     budget_ms, deadline_ms = deadline_ms, None
                     req_trace, trace_ctx = trace_ctx, None
                     req_audit, audit_ctx = audit_ctx, None
@@ -566,6 +632,63 @@ class _Handler(socketserver.BaseRequestHandler):
                             args, progress_args, (n, g) = _pad_request(req)
                             mesh = self.server.scan_mesh
                             warmer = self.server.warmer
+                            coal = self.server.coalescer
+                            if coal is not None and mesh is None:
+                                # multi-tenant coalescing (service.
+                                # coalescer): the padded batch joins the
+                                # DRF merge queue instead of going to the
+                                # executor directly; the demuxed result
+                                # is bit-identical to this direct path
+                                t1 = time.perf_counter()
+                                job = CoalesceJob(
+                                    tenant=self._tenant or "",
+                                    n=n, g=g,
+                                    r=int(req.alloc.shape[1]),
+                                    padded_args=args,
+                                    progress_args=progress_args,
+                                    raw_fn=lambda req=req: (
+                                        req.alloc, req.requested,
+                                        req.group_req, req.remaining,
+                                        req.fit_mask, req.group_valid,
+                                        req.order, req.min_member,
+                                        req.scheduled, req.matched,
+                                        req.ineligible, req.creation_rank,
+                                    ),
+                                    want_audit=(
+                                        self.server.audit_log is not None
+                                    ),
+                                )
+                                res = coal.schedule(job)
+                                if warmer is not None:
+                                    try:
+                                        # the span lowering dispatches
+                                        # these padded args donating
+                                        # (executor default), so warm
+                                        # the same variant the fallback/
+                                        # span path serves with
+                                        warmer.note_batch(
+                                            args, progress_args,
+                                            res.host.get("telemetry")
+                                            or {},
+                                            donate=True,
+                                        )
+                                    except Exception:  # noqa: BLE001
+                                        pass
+                                if req_trace is not None:
+                                    _maybe_server_capacity(
+                                        args, progress_args, res.host,
+                                        tenant=self._tenant, g=g,
+                                    )
+                                timings = {
+                                    "ts0": ts0,
+                                    "unpack_pad": t1 - t0,
+                                    "lock_wait": res.queue_wait,
+                                    "device": res.run_seconds,
+                                }
+                                return (
+                                    res.host, res.rows, (n, g), timings,
+                                    res.audit_args,
+                                )
                             # host-side padded args, captured BEFORE mesh
                             # placement: the audit record must replay on
                             # any backend, so it keeps plain numpy
@@ -599,7 +722,8 @@ class _Handler(socketserver.BaseRequestHandler):
                             # connections.
                             host, batch, queue_wait, run_s = (
                                 self.server.executor.run_batch(
-                                    args, progress_args
+                                    args, progress_args,
+                                    tenant=self._tenant,
                                 )
                             )
                             if warmer is not None:
@@ -616,9 +740,11 @@ class _Handler(socketserver.BaseRequestHandler):
                                     pass
                             if req_trace is not None and mesh is None:
                                 # sidecar capacity sample for the traced
-                                # client (budget-gated; rides TRACE_INFO)
+                                # client (budget-gated; rides TRACE_INFO),
+                                # attributed to the announced wire tenant
                                 _maybe_server_capacity(
-                                    args, progress_args, host
+                                    args, progress_args, host,
+                                    tenant=self._tenant, g=g,
                                 )
                             timings = {
                                 "ts0": ts0,
@@ -635,7 +761,17 @@ class _Handler(socketserver.BaseRequestHandler):
                         # stale mirror would only pin device memory
                         self._mirror = None
                         self._mirror_counts = None
-                        outcome = self._run(run_schedule, budget_ms)
+                        try:
+                            outcome = self._run(run_schedule, budget_ms)
+                        except CoalesceSaturated as e:
+                            # admission control: bounded coalescer queue
+                            # full — an in-band BUSY with the retry-after
+                            # hint, never a dropped or hanging request
+                            proto.write_frame(
+                                self.request, proto.MsgType.BUSY,
+                                proto.pack_busy(e.retry_after_ms, str(e)),
+                            )
+                            continue
                         if outcome is _DEADLINE_HIT:
                             proto.write_frame(
                                 self.request,
@@ -651,7 +787,24 @@ class _Handler(socketserver.BaseRequestHandler):
                                 payload, traced=req_trace is not None
                             )
 
-                        outcome = self._run(run_delta, budget_ms)
+                        try:
+                            outcome = self._run(run_delta, budget_ms)
+                        except CoalesceSaturated as e:
+                            # _run_delta_body checks admission BEFORE
+                            # touching the mirror, so the common refusal
+                            # leaves the client's cursor valid for a
+                            # plain retry. The rare race (queue filled
+                            # between the check and the submit, mirror
+                            # already advanced) still converges: the
+                            # retried delta's base mismatches, the
+                            # server answers DELTA_RESYNC, and the
+                            # client keyframes — correct, one extra
+                            # round-trip.
+                            proto.write_frame(
+                                self.request, proto.MsgType.BUSY,
+                                proto.pack_busy(e.retry_after_ms, str(e)),
+                            )
+                            continue
                         if outcome is _DEADLINE_HIT:
                             # the abandoned job may still advance the
                             # mirror generation; the client resets its
@@ -699,6 +852,11 @@ class _Handler(socketserver.BaseRequestHandler):
                             # batch's collectives deadlocks the rendezvous
                             # (seen as a 2-minute stall in the dual-
                             # connection background-refresh test)
+                            if hasattr(batch, "gather"):
+                                # coalesced batch: the row view owns the
+                                # span slicing AND the executor hop
+                                return batch.gather(kind, gidx)
+
                             def gather():
                                 return np.asarray(
                                     jax.device_get(batch[kind][gidx])
@@ -924,6 +1082,12 @@ class _Handler(socketserver.BaseRequestHandler):
         )
         mesh = self.server.scan_mesh
         executor = self.server.executor
+        coal = self.server.coalescer if mesh is None else None
+        if coal is not None:
+            # refuse BEFORE the mirror apply below, so a BUSY answer
+            # leaves the client's generation cursor valid (see the
+            # BUSY handler's race note)
+            coal.check_admission()
         if self._mirror is None:
             from ..ops.device_state import DeviceStateHolder
 
@@ -973,9 +1137,41 @@ class _Handler(socketserver.BaseRequestHandler):
                     tuple(np.asarray(a) for a in device_args), progress_args
                 )
         t1 = time.perf_counter()
-        host, batch, queue_wait, run_s = executor.run_batch(
-            device_args, progress_args, donate=False
-        )
+        if coal is not None:
+            # the mirror is synced; the batch itself joins the DRF merge
+            # queue like a full request. donate=False is load-bearing —
+            # a donated span dispatch would consume the mirror.
+            n_real, g_real, r_real = n, g, self._mirror_counts[2]
+
+            def raw_fn(device_args=device_args,
+                       progress_args=progress_args, n=n_real, g=g_real):
+                al, rq, gr, rem, fm, gv, od = (
+                    np.asarray(a) for a in device_args
+                )
+                mm, sc, mt, inel, cr = (
+                    np.asarray(a) for a in progress_args
+                )
+                mask = fm[:1, :n] if fm.shape[0] == 1 else fm[:g, :n]
+                return (
+                    al[:n], rq[:n], gr[:g], rem[:g], mask, gv[:g],
+                    od[:g], mm[:g], sc[:g], mt[:g], inel[:g], cr[:g],
+                )
+
+            job = CoalesceJob(
+                tenant=self._tenant or "", n=n_real, g=g_real, r=r_real,
+                padded_args=device_args, progress_args=progress_args,
+                raw_fn=raw_fn, donate=False, want_audit=want_audit,
+            )
+            res = coal.schedule(job)
+            host, batch = res.host, res.rows
+            queue_wait, run_s = res.queue_wait, res.run_seconds
+            if want_audit and audit_args is None:
+                audit_args = res.audit_args
+        else:
+            host, batch, queue_wait, run_s = executor.run_batch(
+                device_args, progress_args, donate=False,
+                tenant=self._tenant,
+            )
         telemetry = host.get("telemetry")
         if isinstance(telemetry, dict):
             telemetry["device_state"] = {
@@ -988,8 +1184,12 @@ class _Handler(socketserver.BaseRequestHandler):
             }
         if traced and mesh is None:
             # capacity over the MIRROR's resident buffers — the sidecar's
-            # own view of the cluster it is scoring (rides TRACE_INFO)
-            _maybe_server_capacity(device_args, progress_args, host)
+            # own view of the cluster it is scoring (rides TRACE_INFO),
+            # attributed to the announced wire tenant
+            _maybe_server_capacity(
+                device_args, progress_args, host,
+                tenant=self._tenant, g=g,
+            )
         timings = {
             "ts0": ts0,
             "unpack_pad": t1 - t0,
@@ -1009,6 +1209,7 @@ class OracleServer(socketserver.ThreadingTCPServer):
         port: int = 0,
         compile_warmer: bool = False,
         audit_log=None,
+        coalesce: Optional[bool] = None,
     ):
         super().__init__((host, port), _Handler)
         # sidecar-side batch audit ring (utils.audit): every executed
@@ -1026,6 +1227,28 @@ class OracleServer(socketserver.ThreadingTCPServer):
         # the single-owner device pipeline (replaces the PR-1 server-wide
         # execute_lock; see DeviceExecutor)
         self.executor = DeviceExecutor(scan_mesh=self.scan_mesh)
+        # multi-tenant cross-client coalescer (service.coalescer,
+        # docs/multitenancy.md): DRF-fair merge queue in front of the
+        # executor. Single-device only — a mesh batch's shard placement
+        # happens per connection BEFORE the executor, and a merged
+        # mega-batch would reshard under it; the mesh deployment keeps
+        # the direct path (its executor already serializes launches).
+        want_coalesce = (
+            coalesce_enabled() if coalesce is None else bool(coalesce)
+        )
+        self.coalescer = None
+        if want_coalesce and self.scan_mesh is None:
+            self.coalescer = OracleCoalescer(
+                self.executor, weights_fn=_capacity_tenant_shares
+            )
+        elif want_coalesce:
+            import sys
+
+            print(
+                "coalescer skipped: mesh server (shard placement happens "
+                "per connection; merged batches would reshard)",
+                file=sys.stderr,
+            )
         self.warmer = None
         if compile_warmer:
             from ..ops.bucketing import maybe_compile_warmer
@@ -1044,6 +1267,11 @@ class OracleServer(socketserver.ThreadingTCPServer):
             # OracleScorer.drain_background (exit-abort fix)
             if self.warmer is not None:
                 self.warmer.stop(timeout=10.0)
+            # coalescer before executor: it is an executor PRODUCER, and
+            # a group dispatched after executor.stop would hang its
+            # waiters (the producer-before-join shutdown ordering)
+            if self.coalescer is not None:
+                self.coalescer.stop(timeout=10.0)
             self.executor.stop(timeout=10.0)
             if self.audit_log is not None:
                 self.audit_log.stop(timeout=10.0)
@@ -1070,12 +1298,13 @@ class OracleServer(socketserver.ThreadingTCPServer):
 
 def serve_background(
     host: str = "127.0.0.1", port: int = 0, compile_warmer: bool = False,
-    audit_log=None,
+    audit_log=None, coalesce: Optional[bool] = None,
 ) -> OracleServer:
     """Start an OracleServer on a daemon thread; returns it (``.address``
     has the bound port, ``.shutdown()`` stops it)."""
     server = OracleServer(
-        host, port, compile_warmer=compile_warmer, audit_log=audit_log
+        host, port, compile_warmer=compile_warmer, audit_log=audit_log,
+        coalesce=coalesce,
     )
     t = threading.Thread(
         target=server.serve_forever, name="oracle-server", daemon=True

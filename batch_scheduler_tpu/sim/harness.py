@@ -21,7 +21,146 @@ from ..plugin.factory import PluginConfig, new_plugin_runtime
 from ..utils.labels import POD_GROUP_LABEL
 from .kubelet import SimKubelet
 
-__all__ = ["SimCluster"]
+__all__ = ["SimCluster", "drive_multi_client", "wait_p95"]
+
+
+def wait_p95(xs):
+    """p95 by sorted index over a non-empty sample list — ONE copy of the
+    percentile convention shared by ``sim --multi-client``'s report and
+    the coalesce gate's enforced starvation bound, so the CLI can never
+    report a different p95 than the gate checks."""
+    xs = sorted(xs)
+    return xs[min(int(len(xs) * 0.95), len(xs) - 1)]
+
+
+def drive_multi_client(
+    addr: str,
+    clients: int = 8,
+    batches: int = 8,
+    nodes: int = 256,
+    gangs: int = 32,
+    concurrent: bool = True,
+    seed: int = 0,
+    deadline_ms: Optional[int] = None,
+    tenant_batches: Optional[Dict[str, int]] = None,
+):
+    """Drive K scheduler clients' oracle request streams through ONE
+    sidecar (docs/multitenancy.md "Multi-client sim") — the coalescer
+    acceptance harness, shared by ``sim --multi-client``, ``make
+    bench-coalesce`` and the tests.
+
+    Each client is a ResilientOracleClient with its own tenant label
+    (``tenant-<i>``) replaying the deterministic
+    ``sim.scenarios.tenant_oracle_stream`` for that tenant.
+    ``concurrent=True`` runs every client on its own thread (the
+    coalesced deployment); ``False`` runs them strictly one request at a
+    time in round-robin (the "K dedicated sidecars, time-sliced over one
+    device" equivalent — same total device work, no overlap). The same
+    (clients, batches, nodes, gangs, seed) always replays the same
+    streams, so per-tenant plan digests compare across deployments.
+
+    ``tenant_batches`` overrides the per-tenant batch count (whale
+    scenarios: {"tenant-0": 64} floods tenant 0 while the rest stay at
+    ``batches``).
+
+    Returns ``{tenant: {"digests": [...], "waits": [...], "busy": int}}``
+    plus a ``"_wall_s"`` entry with the run's wall-clock."""
+    import numpy as np
+
+    from ..service.client import ResilientOracleClient
+    from ..utils import audit as audit_mod
+    from ..utils.errors import OracleBusyError
+    from .scenarios import tenant_oracle_stream
+
+    host, _, port = addr.rpartition(":")
+    host = host or "127.0.0.1"
+
+    def digest(resp) -> str:
+        return audit_mod.plan_digest(
+            {
+                "gang_feasible": np.asarray(resp.gang_feasible),
+                "placed": np.asarray(resp.placed),
+                "progress": np.asarray(resp.progress),
+                "best": int(resp.best),
+                "best_exists": bool(resp.best_exists),
+                "assignment_nodes": np.asarray(resp.assignment_nodes),
+                "assignment_counts": np.asarray(resp.assignment_counts),
+            }
+        )
+
+    labels = [f"tenant-{i}" for i in range(clients)]
+    streams = {
+        labels[i]: tenant_oracle_stream(
+            i,
+            (tenant_batches or {}).get(labels[i], batches),
+            nodes=nodes,
+            gangs=gangs,
+            seed=seed,
+        )
+        for i in range(clients)
+    }
+    out: Dict[str, Dict] = {
+        t: {"digests": [], "waits": [], "busy": 0} for t in labels
+    }
+    conns = {
+        t: ResilientOracleClient(
+            host, int(port), deadline_ms=deadline_ms, name=t
+        )
+        for t in labels
+    }
+
+    def run_one(tenant: str, req) -> None:
+        t0 = time.perf_counter()
+        try:
+            resp = conns[tenant].schedule(req, tenant=tenant)
+        except OracleBusyError:
+            # retries exhausted while saturated: count it and move on —
+            # the driver measures the bound, it doesn't crash on it
+            out[tenant]["busy"] += 1
+            return
+        out[tenant]["waits"].append(time.perf_counter() - t0)
+        out[tenant]["digests"].append(digest(resp))
+
+    wall0 = time.perf_counter()
+    if concurrent:
+        import threading
+
+        def run_tenant(tenant: str) -> None:
+            for req in streams[tenant]:
+                run_one(tenant, req)
+
+        threads = [
+            threading.Thread(
+                target=run_tenant, args=(t,), name=f"mc-{t}", daemon=True
+            )
+            for t in labels
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+    else:
+        # the time-sliced dedicated equivalent: one request in flight
+        # EVER, round-robin across tenants (one device, K sidecars that
+        # each get the device serially)
+        cursors = {t: 0 for t in labels}
+        live = set(labels)
+        while live:
+            for t in list(labels):
+                if t not in live:
+                    continue
+                i = cursors[t]
+                if i >= len(streams[t]):
+                    live.discard(t)
+                    continue
+                run_one(t, streams[t][i])
+                cursors[t] = i + 1
+    wall = time.perf_counter() - wall0
+    for conn in conns.values():
+        conn.close()
+    result: Dict = dict(out)
+    result["_wall_s"] = wall
+    return result
 
 
 class SimCluster:

@@ -36,6 +36,7 @@ __all__ = [
     "readback_tail_scenarios",
     "spot_vs_guaranteed_scenario",
     "synthetic_cluster",
+    "tenant_oracle_stream",
     "XLClusterSpec",
     "xl_scan_operands",
     "xl_churn_burst",
@@ -372,3 +373,50 @@ def synthetic_cluster(
             name, spec.members_per_group, member_request, priority=prio
         )
     return nodes, groups, pods
+
+
+def tenant_oracle_stream(tenant: int, batches: int, nodes: int = 256,
+                         gangs: int = 32, lanes: int = 4, seed: int = 0):
+    """Deterministic per-tenant oracle request stream for the multi-client
+    coalescer sim (docs/multitenancy.md): ``batches`` ScheduleRequests
+    over one synthetic [nodes, lanes] cluster with light per-batch churn
+    (a few requested rows and gang remainders move each step). Pure
+    numpy — the coalescer acceptance compares plan digests between a
+    coalescing sidecar and dedicated sidecars, so the SAME stream must be
+    replayable against both; everything derives from (tenant, seed, batch
+    index), nothing from wall-clock."""
+    import numpy as np
+
+    from ..service.protocol import ScheduleRequest
+
+    rng = random.Random(seed * 1000003 + tenant)
+    np_rng = np.random.RandomState(seed * 9176 + tenant)
+    alloc = np_rng.randint(8, 96, size=(nodes, lanes)).astype("int32")
+    requested = np_rng.randint(0, 6, size=(nodes, lanes)).astype("int32")
+    group_req = np_rng.randint(1, 5, size=(gangs, lanes)).astype("int32")
+    remaining = np_rng.randint(1, 6, size=gangs).astype("int32")
+    out = []
+    for b in range(batches):
+        # churn: a handful of node rows and one gang's demand move
+        for _ in range(4):
+            row = rng.randrange(nodes)
+            requested[row] = np_rng.randint(0, 6, size=lanes)
+        g = rng.randrange(gangs)
+        remaining[g] = rng.randrange(1, 6)
+        out.append(
+            ScheduleRequest(
+                alloc=alloc.copy(),
+                requested=requested.copy(),
+                group_req=group_req.copy(),
+                remaining=remaining.copy(),
+                fit_mask=np.ones((1, nodes), dtype=bool),
+                group_valid=np.ones(gangs, dtype=bool),
+                order=np.arange(gangs, dtype="int32"),
+                min_member=remaining.copy(),
+                scheduled=np.zeros(gangs, dtype="int32"),
+                matched=np.zeros(gangs, dtype="int32"),
+                ineligible=np.zeros(gangs, dtype=bool),
+                creation_rank=np.arange(gangs, dtype="int32"),
+            )
+        )
+    return out

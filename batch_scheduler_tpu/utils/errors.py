@@ -67,3 +67,20 @@ class OracleDeadlineError(RuntimeError):
     finished (e.g. an unwarmed jit compile). The transport is ALIVE — this
     never trips the breaker and is never retried (a retry would blow the
     same budget again)."""
+
+
+class OracleBusyError(RuntimeError):
+    """The sidecar answered a BUSY frame: its coalescer admission queue is
+    saturated (bounded depth, docs/multitenancy.md) — the request was NOT
+    executed. Server-side state is normally untouched (the delta path
+    checks admission before applying its mirror, so the client's cursor
+    stays valid for a plain retry; the rare check/submit race converges
+    through the ordinary DELTA_RESYNC -> keyframe recovery). An
+    in-band answer over a live transport: never advances the breaker. The
+    resilient client sleeps out ``retry_after_ms`` and RETRIES (unlike a
+    deadline error, which is never retried) — overload resolves; a blown
+    budget does not."""
+
+    def __init__(self, message: str, retry_after_ms: int = 100):
+        super().__init__(message)
+        self.retry_after_ms = int(retry_after_ms)

@@ -185,6 +185,23 @@ else
     echo "delta gate failed:"; tail -4 /tmp/delta_gate.out; fail=1
 fi
 
+echo "== multi-tenant coalescer gate on hardware (COALESCE_${TAG}) =="
+# the bench-coalesce gate on the real backend: this is the capture that
+# answers the throughput acceptance properly — on TPU the device compute
+# runs off-CPU, so the coalescer's merge queue + window-2 executor have
+# real work to overlap with (the CPU CI box is 1-core and can only prove
+# identity/fairness at a parity floor; docs/multitenancy.md). Same
+# digest-bit-identity + DRF starvation-bound checks as CI, full 1.05x
+# floor enforced (>= 2 cores on every TPU host class).
+if BST_COALESCE_GATE_PLATFORM=default timeout 900 \
+        python benchmarks/coalesce_gate.py "COALESCE_${TAG}.json" \
+        > /tmp/coalesce_gate.out 2>&1; then
+    echo "coalesce gate captured: COALESCE_${TAG}.json"
+    tail -1 /tmp/coalesce_gate.out
+else
+    echo "coalesce gate failed:"; tail -4 /tmp/coalesce_gate.out; fail=1
+fi
+
 echo "== policy gate on hardware (zero-policy identity + preempt-pass cost) =="
 # the bench-policy gate on the real backend: zero-policy plans must stay
 # bit-identical to the pre-policy scan on the hardware rungs, the policy

@@ -1,0 +1,332 @@
+"""CI gate for the multi-tenant oracle coalescer (make bench-coalesce).
+
+Pins the acceptance claims of docs/multitenancy.md, all on CPU so it runs
+anywhere:
+
+1. **aggregate throughput** — 8 concurrent scheduler clients' streams
+   through ONE coalescing sidecar must beat the 8-dedicated-sidecars
+   time-sliced equivalent (the same streams, strictly one request in
+   flight ever — one device, K sidecars sharing it serially) on
+   aggregate batches/s by ``COALESCE_FLOOR``x. The floor is
+   host-fingerprint-aware (the bench-policy discipline): coalescing
+   wins by OVERLAPPING host work with device compute, and on a 1-core
+   host there is physically nothing to overlap with — the same core
+   runs the protocol, the pack, and the XLA "device" serially either
+   way, so the best possible outcome is parity. Below 2 cores the
+   floor demotes to a no-pathological-regression band
+   (``COALESCE_FLOOR_1CORE``) and the measured speedup rides the
+   envelope for the ``COALESCE_<tag>`` hardware capture, which answers
+   the acceptance on a real accelerator (device compute off-CPU — the
+   executor's window-2 pipeline has real work to overlap).
+   ``BST_COALESCE_GATE_FLOOR`` overrides either floor.
+2. **per-tenant bit-identity** — every tenant's plan digests from the
+   coalesced run equal its dedicated-sidecar run's, on BOTH merge
+   lowerings (span re-dispatch and the block-diagonal mega-batch).
+3. **starvation bound** — under a whale storm (6 connections flooding
+   one tenant label) a small tenant's p95 queue wait stays bounded: it
+   must not scale with the whale's backlog (DRF admission order), gated
+   both relative to the whale's p95 and against an idle-server baseline.
+
+Prints one JSON line (the bst-bench envelope; the ``COALESCE_<tag>``
+capture artifact); exits non-zero on any failure. Run from the repo
+root: ``make bench-coalesce``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# CPU by default (CI gate); the hardware capture sets
+# BST_COALESCE_GATE_PLATFORM=default to keep the probed backend
+try:
+    _platform = os.environ.get("BST_COALESCE_GATE_PLATFORM", "cpu")
+except Exception:  # noqa: BLE001 — env read only
+    _platform = "cpu"
+if _platform == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+os.environ.setdefault("BST_BUCKET_COST", "0")  # no teardown-racing compiles
+os.environ.setdefault("BST_COMPILE_LEDGER", "off")
+os.environ.setdefault("BST_CAPACITY", "0")
+
+import numpy as np  # noqa: E402
+
+COALESCE_FLOOR = 1.05  # coalesced aggregate throughput vs time-sliced
+COALESCE_FLOOR_1CORE = 0.6  # parity band: nothing to overlap with
+CLIENTS = 8
+BATCHES = 6
+NODES = 192
+GANGS = 24
+DRAWS = 3
+
+
+def _floor() -> float:
+    raw = os.environ.get("BST_COALESCE_GATE_FLOOR", "").strip()
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = os.cpu_count() or 1
+    return COALESCE_FLOOR if cores >= 2 else COALESCE_FLOOR_1CORE
+
+
+def _server(coalesce, mode=None):
+    from batch_scheduler_tpu.service.coalescer import OracleCoalescer
+    from batch_scheduler_tpu.service.server import (
+        _capacity_tenant_shares,
+        serve_background,
+    )
+
+    srv = serve_background(coalesce=coalesce)
+    srv.scan_mesh = None
+    srv.executor.scan_mesh = None
+    if coalesce and srv.coalescer is None:
+        srv.coalescer = OracleCoalescer(
+            srv.executor, weights_fn=_capacity_tenant_shares
+        )
+    if coalesce and mode is not None:
+        srv.coalescer.mode = mode
+    return srv
+
+
+def _close(srv):
+    srv.shutdown()
+    srv.server_close()
+
+
+def _addr(srv):
+    host, port = srv.address
+    return f"{host}:{port}"
+
+
+def _warm(addr, passes=2):
+    """Full passes of every tenant's stream so jit compiles (including
+    the merged mega shapes, whose buckets depend on merge widths) land
+    outside the measured draws — dedicated sidecars in steady state are
+    warm too, so this keeps the comparison about overlap, not compiles."""
+    from batch_scheduler_tpu.sim.harness import drive_multi_client
+
+    for _ in range(passes):
+        drive_multi_client(
+            addr, clients=CLIENTS, batches=2, nodes=NODES, gangs=GANGS
+        )
+
+
+def check_throughput_and_identity(detail):
+    from batch_scheduler_tpu.sim.harness import drive_multi_client
+
+    ok = True
+    ded_srv = _server(False)
+    _warm(_addr(ded_srv))
+    # the time-sliced dedicated equivalent: same streams, one request in
+    # flight EVER (concurrent=False), against a non-coalescing sidecar —
+    # the same device work with zero cross-client overlap
+    ded = None
+    ded_wall = float("inf")
+    for _ in range(DRAWS):
+        draw = drive_multi_client(
+            _addr(ded_srv), clients=CLIENTS, batches=BATCHES,
+            nodes=NODES, gangs=GANGS, concurrent=False,
+        )
+        w = draw.pop("_wall_s")
+        if w < ded_wall:
+            ded_wall = w
+        ded = draw
+    _close(ded_srv)
+    total = sum(len(v["digests"]) for v in ded.values())
+    detail["dedicated_wall_s"] = round(ded_wall, 4)
+    detail["batches_total"] = total
+    detail["draws"] = DRAWS
+
+    for mode in ("span", "mega"):
+        srv = _server(True, mode=mode)
+        _warm(_addr(srv))
+        res = None
+        wall = float("inf")
+        for _ in range(DRAWS):
+            draw = drive_multi_client(
+                _addr(srv), clients=CLIENTS, batches=BATCHES,
+                nodes=NODES, gangs=GANGS, concurrent=True,
+            )
+            w = draw.pop("_wall_s")
+            if w < wall:
+                wall = w
+            res = draw
+        stats = srv.coalescer.stats()
+        _close(srv)
+        got = sum(len(v["digests"]) for v in res.values())
+        speedup = ded_wall / max(wall, 1e-9)
+        detail[f"{mode}_wall_s"] = round(wall, 4)
+        detail[f"{mode}_speedup_vs_timesliced"] = round(speedup, 2)
+        detail[f"{mode}_groups_run"] = stats["groups_run"]
+        detail[f"{mode}_mega_groups"] = stats["mega_groups"]
+        mismatches = sum(
+            1
+            for t in ded
+            if res.get(t, {}).get("digests") != ded[t]["digests"]
+        )
+        detail[f"{mode}_digest_mismatched_tenants"] = mismatches
+        if got != total or mismatches:
+            detail[f"{mode}_fail"] = (
+                f"completed {got}/{total}, {mismatches} tenants' digests "
+                "diverged from their dedicated-sidecar run"
+            )
+            ok = False
+
+    # the acceptance floor applies to the better lowering (the gate
+    # measures both — 'measure which wins', docs/multitenancy.md)
+    best = max(
+        detail["span_speedup_vs_timesliced"],
+        detail["mega_speedup_vs_timesliced"],
+    )
+    floor = _floor()
+    detail["best_speedup_vs_timesliced"] = best
+    detail["winning_mode"] = (
+        "span"
+        if detail["span_speedup_vs_timesliced"]
+        >= detail["mega_speedup_vs_timesliced"]
+        else "mega"
+    )
+    detail["throughput_floor"] = floor
+    detail["host_cores"] = os.cpu_count()
+    if best < floor:
+        detail["throughput_fail"] = (
+            f"coalesced {best:.2f}x vs time-sliced (floor {floor}x at "
+            f"{os.cpu_count()} cores)"
+        )
+        ok = False
+    return ok
+
+
+def check_starvation_bound(detail):
+    """Whale storm: 6 connections flood the 'whale' label while a small
+    tenant trickles — DRF must keep the small tenant's p95 queue wait
+    bounded instead of queueing it behind the whale's backlog."""
+    from batch_scheduler_tpu.service.client import OracleClient
+    from batch_scheduler_tpu.sim.scenarios import tenant_oracle_stream
+
+    srv = _server(True)
+    host, port = srv.address
+    try:
+        # idle-server baseline: what one batch costs with no contention
+        base_client = OracleClient(host, port)
+        solo = []
+        stream = tenant_oracle_stream(50, 4, nodes=NODES, gangs=GANGS)
+        for req in stream[:1]:
+            base_client.schedule(req, tenant="warm")  # compile outside
+        for req in stream[1:]:
+            t0 = time.perf_counter()
+            base_client.schedule(req, tenant="warm")
+            solo.append(time.perf_counter() - t0)
+        base_client.close()
+        solo_s = sorted(solo)[len(solo) // 2]
+
+        whale_waits, small_waits = [], []
+
+        def whale(i):
+            c = OracleClient(host, port, timeout=300)
+            for req in tenant_oracle_stream(
+                60 + i, 8, nodes=NODES, gangs=GANGS
+            ):
+                t0 = time.perf_counter()
+                c.schedule(req, tenant="whale")
+                whale_waits.append(time.perf_counter() - t0)
+            c.close()
+
+        def small():
+            c = OracleClient(host, port, timeout=300)
+            for req in tenant_oracle_stream(99, 8, nodes=NODES, gangs=GANGS):
+                t0 = time.perf_counter()
+                c.schedule(req, tenant="small")
+                small_waits.append(time.perf_counter() - t0)
+                time.sleep(solo_s)  # a trickle, not a flood
+            c.close()
+
+        threads = [
+            threading.Thread(target=whale, args=(i,)) for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(solo_s * 2)  # let the whale backlog form first
+        st = threading.Thread(target=small)
+        st.start()
+        st.join()
+        for t in threads:
+            t.join()
+    finally:
+        _close(srv)
+
+    from batch_scheduler_tpu.sim.harness import wait_p95
+
+    small_p95, whale_p95 = wait_p95(small_waits), wait_p95(whale_waits)
+    bound = max(10 * solo_s, 1.0)
+    detail["solo_batch_s"] = round(solo_s, 4)
+    detail["small_p95_s"] = round(small_p95, 4)
+    detail["whale_p95_s"] = round(whale_p95, 4)
+    detail["starvation_bound_s"] = round(bound, 4)
+    # the absolute bound is the claim; the relative check (25% slack —
+    # with a shallow whale backlog the two p95s legitimately converge)
+    # guards the DRF ordering against regressing to FIFO-behind-the-whale
+    ok = small_p95 <= bound and small_p95 <= whale_p95 * 1.25
+    if not ok:
+        detail["starvation_fail"] = (
+            f"small tenant p95 {small_p95:.3f}s vs bound {bound:.3f}s "
+            f"(whale p95 {whale_p95:.3f}s)"
+        )
+    return ok
+
+
+def main() -> int:
+    detail = {}
+    checks = {
+        "throughput_identity": check_throughput_and_identity,
+        "starvation_bound": check_starvation_bound,
+    }
+    results = {}
+    for name, fn in checks.items():
+        try:
+            results[name] = bool(fn(detail))
+        except Exception as e:  # noqa: BLE001 — the JSON line must go out
+            import traceback
+
+            traceback.print_exc()
+            detail[f"{name}_error"] = repr(e)[:300]
+            results[name] = False
+    ok = all(results.values())
+    from benchmarks import artifact
+
+    doc = artifact.emit(
+        {
+            "metric": "coalesce_gate",
+            "value": detail.get("best_speedup_vs_timesliced", 0.0),
+            "unit": "x_vs_dedicated_timesliced",
+            "detail": {"ok": ok, "checks": results, **detail},
+        },
+        metrics={
+            k: v
+            for k, v in detail.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        },
+    )
+    if len(sys.argv) > 1 and not sys.argv[1].startswith("-"):
+        # capture mode (COALESCE_<tag>.json): persist the envelope
+        with open(sys.argv[1], "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True, default=str)
+            f.write("\n")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,11 @@ slower" tripwire on every build, not a hardware benchmark (that's
 - ``capacity_kernel_s``       one capacity-observatory analytics kernel
   run (ops.capacity) at the small bucket — the observatory held to the
   same regression gate it feeds
+- ``coalesce_merge_s``        the multi-tenant coalescer's host-side
+  merge hot path (service.coalescer): one 4-tenant block-diagonal
+  mega-batch build + per-tenant demux arithmetic (max-progress twin +
+  assignment-row repack) — the work every mega group pays on the
+  sidecar's worker thread
 - ``metrics_render_s``        the /metrics exposition render at a
   realistic series count (observability must not become the overhead)
 
@@ -69,6 +74,7 @@ TOLERANCES = {
     "snapshot_pack_s": 1.6,
     "refresh_device_delta_s": 1.6,
     "capacity_kernel_s": 1.6,
+    "coalesce_merge_s": 1.6,
     "metrics_render_s": 1.6,
 }
 
@@ -195,6 +201,52 @@ def probe_set():
             batch_args, cap_host, group_names=cap_names,
         )
 
+    # multi-tenant coalescer merge hot path (service.coalescer): the
+    # block-diagonal mega-batch build plus the per-tenant demux
+    # arithmetic (host max-progress twin + one assignment-row repack per
+    # tenant) — pure host numpy, no executor, same deterministic streams
+    # the coalesce gate replays
+    import numpy as np
+
+    from batch_scheduler_tpu.ops.oracle import (
+        batch_top_k,
+        find_max_group_host,
+        repack_assignment_span,
+    )
+    from batch_scheduler_tpu.service.coalescer import build_mega_batch
+    from batch_scheduler_tpu.sim.scenarios import tenant_oracle_stream
+
+    mc_reqs = [
+        tenant_oracle_stream(i, 1, nodes=128, gangs=32)[0]
+        for i in range(4)
+    ]
+    mc_raws = [
+        (r.alloc, r.requested, r.group_req, r.remaining, r.fit_mask,
+         r.group_valid, r.order, r.min_member, r.scheduled, r.matched,
+         r.ineligible, r.creation_rank)
+        for r in mc_reqs
+    ]
+
+    def coalesce_merge():
+        mega_args, _mega_progress, noffs, _goffs = build_mega_batch(
+            mc_raws
+        )
+        mega_k = batch_top_k(
+            int(mega_args[0].shape[0]),
+            int(np.asarray(mega_args[3]).max(initial=0)),
+        )
+        row = np.zeros(mega_k, dtype=np.int32)
+        for i, r in enumerate(mc_reqs):
+            n = int(r.alloc.shape[0])
+            k = batch_top_k(n, int(r.remaining.max(initial=0)))
+            find_max_group_host(
+                r.min_member, r.scheduled, r.matched, r.ineligible,
+                r.creation_rank,
+            )
+            # one repack per GANG, as the demux pays it
+            for _gi in range(int(r.group_req.shape[0])):
+                repack_assignment_span(row, row, noffs[i], n, k)
+
     reg = Registry()
     for i in range(40):
         reg.counter(f"bst_probe_counter_{i}_total", "probe").inc(
@@ -213,6 +265,7 @@ def probe_set():
         ("snapshot_pack_s", pack, pack),
         ("refresh_device_delta_s", device_delta, device_delta),
         ("capacity_kernel_s", capacity, capacity),
+        ("coalesce_merge_s", coalesce_merge, coalesce_merge),
         ("metrics_render_s", render, render),
     ]
 

@@ -408,6 +408,12 @@ def test_wire_delta_survives_dropped_and_duplicated_frames(server):
     )
     remote = RemoteScorer(client, fallback="deny")
     assert remote._wire_delta_ok  # resilient transport: delta path live
+    # pin the wire layout to one frame per request: with tenant
+    # announcements on, the TENANT annotation precedes the delta frame
+    # and the proxy's single-frame faults land on IT — which is
+    # harmlessly fire-and-forget (attribution only), so the drop this
+    # case is about would never reach the delta stream
+    remote._wire_tenant_ok = False
     cluster, cache, gang_names, nodes, reference = _delta_world()
 
     def refresh_and_compare():

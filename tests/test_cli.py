@@ -323,38 +323,78 @@ def test_cli_sim_requires_nodes_and_groups(capsys):
     assert main(["sim", "--timeout", "1"]) == 2
 
 
-def test_cli_sim_remote_scorer(capsys):
+def test_cli_sim_remote_scorer():
     """sim --oracle-addr scores through the sidecar service (the start.sh
-    deployment shape: scheduler process + oracle sidecar)."""
-    from batch_scheduler_tpu.service.server import serve_background
+    deployment shape: scheduler process + oracle sidecar).
 
-    server = serve_background()
-    host, port = server.address
+    Both halves run in SUBPROCESSES: in-process, this test settled
+    Pending whenever any single-device ``execute_batch_host`` test ran
+    first in the same interpreter (an ad-hoc-ordering interaction
+    through leaked process-global jit/gate state, pre-existing on seed
+    HEAD and documented in CHANGES PR 13) — fresh processes make the
+    deployment shape the test actually claims, with no inherited
+    device/global state on either side."""
+    import re
+    import subprocess
+    import sys
+    import time
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("BST_BUCKET_COST", "0")
+    env.setdefault("BST_COMPILE_LEDGER", "off")
+    env.setdefault("BST_CAPACITY", "0")
+    server = subprocess.Popen(
+        [sys.executable, "-m", "batch_scheduler_tpu", "serve", "--port", "0"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        cwd=REPO,
+        env=env,
+    )
     try:
-        rc = main(
+        addr = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = server.stdout.readline()
+            if not line:
+                break
+            m = re.search(r"listening on ([\d.]+:\d+)", line)
+            if m:
+                addr = m.group(1)
+                break
+        assert addr, "sidecar subprocess never reported its address"
+        sim = subprocess.run(
             [
-                "sim",
-                "-f",
-                os.path.join(REPO, "examples", "example1.yaml"),
-                "--nodes",
-                "4",
-                "--node-cpu",
-                "4",
-                "--oracle-addr",
-                f"{host}:{port}",
-                "--timeout",
-                "30",
-                "--settle",
-                "2",
-            ]
+                sys.executable, "-m", "batch_scheduler_tpu", "sim",
+                "-f", os.path.join(REPO, "examples", "example1.yaml"),
+                "--nodes", "4",
+                "--node-cpu", "4",
+                "--oracle-addr", addr,
+                "--timeout", "60",
+                "--settle", "2",
+            ],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            env=env,
+            timeout=300,
         )
+        assert sim.returncode == 0, sim.stdout + sim.stderr
+        row = next(
+            l.split()
+            for l in sim.stdout.splitlines()
+            if l.startswith("default/group1")
+        )
+        assert row[1] == "Running" and row[3] == "9", sim.stdout
     finally:
-        server.shutdown()
-        server.server_close()
-    assert rc == 0
-    out = capsys.readouterr().out
-    row = next(l.split() for l in out.splitlines() if l.startswith("default/group1"))
-    assert row[1] == "Running" and row[3] == "9"
+        server.terminate()
+        try:
+            server.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            server.kill()
+            server.wait(timeout=10)
+        server.stdout.close()
 
 
 def test_sim_cluster_enabled_points_passthrough():

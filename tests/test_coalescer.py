@@ -1,0 +1,739 @@
+"""Multi-tenant oracle coalescer (service.coalescer, docs/multitenancy.md):
+per-tenant bit-identity against dedicated sidecars (span + mega lowerings,
+steady and wire-delta lanes mixed), DRF admission order under a whale,
+saturation BUSY + client retry, chaos (mid-merge disconnect drops only that
+tenant's span), tenant wire attribution, and the BST_LOCKCHECK-armed
+submit storm over the new shared queue state."""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from batch_scheduler_tpu.service import protocol as proto
+from batch_scheduler_tpu.service.coalescer import (
+    CoalesceJob,
+    CoalesceSaturated,
+    OracleCoalescer,
+    coalesce_depth,
+    coalesce_enabled,
+    coalesce_mode,
+    coalesce_span_max,
+)
+from batch_scheduler_tpu.service.server import serve_background
+from batch_scheduler_tpu.utils import audit as audit_mod
+from batch_scheduler_tpu.utils.errors import OracleBusyError
+
+from helpers import FakeCluster, make_group, make_node, make_pod, status_for
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def single_device_server(coalesce=False, **kw):
+    """A sidecar pinned to one device (the coalescer's deployment shape —
+    the conftest mesh forces 8 virtual devices, so the test forces the
+    single-device path the way test_capacity does) with a live coalescer
+    when asked."""
+    srv = serve_background(**kw)
+    srv.scan_mesh = None
+    srv.executor.scan_mesh = None
+    if coalesce and srv.coalescer is None:
+        from batch_scheduler_tpu.service.server import _capacity_tenant_shares
+
+        srv.coalescer = OracleCoalescer(
+            srv.executor, weights_fn=_capacity_tenant_shares
+        )
+    return srv
+
+
+def close_server(srv):
+    srv.shutdown()
+    srv.server_close()
+
+
+def make_request(n=32, g=8, lanes=4, seed=0, per_group_mask=False):
+    r = np.random.RandomState(seed)
+    remaining = r.randint(1, 5, size=g).astype(np.int32)
+    if per_group_mask:
+        mask = r.rand(g, n) > 0.2
+        mask[:, 0] = True  # every gang keeps at least one feasible node
+    else:
+        mask = np.ones((1, n), dtype=bool)
+    return proto.ScheduleRequest(
+        alloc=r.randint(4, 64, size=(n, lanes)).astype(np.int32),
+        requested=r.randint(0, 4, size=(n, lanes)).astype(np.int32),
+        group_req=r.randint(1, 4, size=(g, lanes)).astype(np.int32),
+        remaining=remaining,
+        fit_mask=mask,
+        group_valid=np.ones(g, dtype=bool),
+        order=r.permutation(g).astype(np.int32),
+        min_member=remaining.copy(),
+        scheduled=np.zeros(g, dtype=np.int32),
+        matched=r.randint(0, 2, size=g).astype(np.int32),
+        ineligible=np.zeros(g, dtype=bool),
+        creation_rank=r.permutation(g).astype(np.int32),
+    )
+
+
+def response_digest(resp):
+    return audit_mod.plan_digest(
+        {
+            "gang_feasible": np.asarray(resp.gang_feasible),
+            "placed": np.asarray(resp.placed),
+            "progress": np.asarray(resp.progress),
+            "best": int(resp.best),
+            "best_exists": bool(resp.best_exists),
+            "assignment_nodes": np.asarray(resp.assignment_nodes),
+            "assignment_counts": np.asarray(resp.assignment_counts),
+        }
+    )
+
+
+class FakeExecJob:
+    def __init__(self, host, batch, delay):
+        self._host, self._batch, self._delay = host, batch, delay
+        self.queue_wait = 0.0
+        self.run_seconds = delay
+
+    def wait(self, timeout=None):
+        time.sleep(self._delay)
+        return self._host, self._batch
+
+
+class FakeExecutor:
+    """Duck-typed DeviceExecutor for queue-dynamics tests: fixed service
+    delay, records dispatch order."""
+
+    def __init__(self, delay=0.01):
+        self.delay = delay
+        self.dispatched = []
+        self._lock = threading.Lock()
+
+    def _host(self, g):
+        return {
+            "gang_feasible": np.ones(g, bool),
+            "placed": np.zeros(g, bool),
+            "progress": np.zeros(g, np.int32),
+            "best": 0,
+            "best_exists": False,
+            "assignment_nodes": np.zeros((g, 4), np.int32),
+            "assignment_counts": np.zeros((g, 4), np.int32),
+            "telemetry": {},
+        }
+
+    def submit_batch(self, batch_args, progress_args, donate=None,
+                     tenant=None):
+        with self._lock:
+            self.dispatched.append(tenant)
+        g = int(np.asarray(batch_args[2]).shape[0])
+        return FakeExecJob(self._host(g), {"capacity": None}, self.delay)
+
+    def run_batch(self, batch_args, progress_args, donate=None, tenant=None):
+        job = self.submit_batch(batch_args, progress_args, donate, tenant)
+        host, batch = job.wait()
+        return host, batch, 0.0, self.delay
+
+    def run(self, fn):
+        return fn()
+
+
+def make_job(tenant, n=8, g=4, seed=0):
+    from batch_scheduler_tpu.ops.bucketing import pad_oracle_batch
+
+    req = make_request(n=n, g=g, seed=seed)
+    args, progress = pad_oracle_batch(
+        alloc=req.alloc, requested=req.requested, group_req=req.group_req,
+        remaining=req.remaining, fit_mask=req.fit_mask,
+        group_valid=req.group_valid, order=req.order,
+        min_member=req.min_member, scheduled=req.scheduled,
+        matched=req.matched, ineligible=req.ineligible,
+        creation_rank=req.creation_rank,
+    )
+    return CoalesceJob(
+        tenant=tenant, n=n, g=g, r=int(req.alloc.shape[1]),
+        padded_args=args, progress_args=progress,
+        raw_fn=lambda req=req: (
+            req.alloc, req.requested, req.group_req, req.remaining,
+            req.fit_mask, req.group_valid, req.order, req.min_member,
+            req.scheduled, req.matched, req.ineligible, req.creation_rank,
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# host-twin formula checks (the coupled-formula spine)
+# ---------------------------------------------------------------------------
+
+
+def test_find_max_group_host_matches_device():
+    from batch_scheduler_tpu.ops.oracle import (
+        find_max_group,
+        find_max_group_host,
+    )
+
+    r = np.random.RandomState(7)
+    for trial in range(20):
+        g = int(r.randint(2, 40))
+        min_member = r.randint(1, 9, size=g).astype(np.int32)
+        scheduled = r.randint(0, 9, size=g).astype(np.int32)
+        matched = r.randint(0, 9, size=g).astype(np.int32)
+        ineligible = r.rand(g) < 0.3
+        creation_rank = r.permutation(g).astype(np.int32)
+        db, de, dp = find_max_group(
+            min_member, scheduled, matched, ineligible, creation_rank
+        )
+        hb, he, hp = find_max_group_host(
+            min_member, scheduled, matched, ineligible, creation_rank
+        )
+        assert (int(db), bool(de)) == (hb, he), trial
+        np.testing.assert_array_equal(np.asarray(dp), hp)
+
+
+def test_repack_assignment_span_reproduces_dedicated_topk():
+    """The demux's backfill rule must equal lax.top_k's tie-break on the
+    dedicated take vector — including the ascending zero-count tail."""
+    import jax
+
+    from batch_scheduler_tpu.ops.oracle import repack_assignment_span
+
+    r = np.random.RandomState(3)
+    for trial in range(10):
+        nb, offset, k = 16, 32, 8
+        local = np.zeros(nb, np.int32)
+        for _ in range(int(r.randint(0, 5))):
+            local[r.randint(nb)] = r.randint(1, 9)
+        ded_counts, ded_nodes = jax.lax.top_k(local, k)
+        # the mega row: the same takes embedded at `offset` in a wider
+        # space whose other blocks hold zeros
+        mega = np.zeros(96, np.int32)
+        mega[offset:offset + nb] = local
+        mega_counts, mega_nodes = jax.lax.top_k(mega, k)
+        nodes, counts = repack_assignment_span(
+            np.asarray(mega_nodes), np.asarray(mega_counts), offset, nb, k
+        )
+        np.testing.assert_array_equal(nodes, np.asarray(ded_nodes))
+        np.testing.assert_array_equal(counts, np.asarray(ded_counts))
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: coalescing sidecar vs dedicated sidecars
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["span", "mega"])
+def test_wire_bit_identity_vs_dedicated(mode):
+    """K tenants' streams through one coalescing sidecar produce the
+    exact responses their dedicated-sidecar runs produce — per-group
+    masks, permuted orders, and concurrent submission included."""
+    coal_srv = single_device_server(coalesce=True)
+    coal_srv.coalescer.mode = mode
+    ded_srv = single_device_server()
+    try:
+        ch, cp = coal_srv.address
+        dh, dp = ded_srv.address
+        from batch_scheduler_tpu.service.client import OracleClient
+
+        mismatches = []
+
+        def run_tenant(i):
+            c = OracleClient(ch, cp)
+            d = OracleClient(dh, dp)
+            try:
+                for b in range(3):
+                    req = make_request(
+                        n=24 + 8 * i, g=4 + i, seed=i * 100 + b,
+                        per_group_mask=(i % 2 == 0),
+                    )
+                    r_coal = c.schedule(req, tenant=f"t{i}")
+                    r_ded = d.schedule(req)
+                    if response_digest(r_coal) != response_digest(r_ded):
+                        mismatches.append((i, b))
+                    # row fetches demux back to the tenant's node space
+                    row_c = c.row("capacity", 0, r_coal.batch_seq)
+                    row_d = d.row("capacity", 0, r_ded.batch_seq)
+                    if not np.array_equal(row_c, row_d):
+                        mismatches.append((i, b, "row"))
+            finally:
+                c.close()
+                d.close()
+
+        threads = [
+            threading.Thread(target=run_tenant, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not mismatches, mismatches
+        stats = coal_srv.coalescer.stats()
+        assert stats["groups_run"] >= 1
+    finally:
+        close_server(coal_srv)
+        close_server(ded_srv)
+
+
+def test_mega_demux_identity_direct():
+    """Deterministic mega-group demux: every field of every tenant's
+    result equals its own dedicated execute_batch_host — mixed shapes,
+    mixed mask modes, forced into ONE block-diagonal mega-batch."""
+    from batch_scheduler_tpu.ops.oracle import execute_batch_host
+    from batch_scheduler_tpu.service.server import DeviceExecutor
+
+    executor = DeviceExecutor(scan_mesh=None)
+    coal = OracleCoalescer(executor, mode="mega", mega_cells=1 << 30)
+    try:
+        jobs = [
+            make_job("alpha", n=16, g=4, seed=1),
+            make_job("beta", n=40, g=7, seed=2),
+            make_job("gamma", n=8, g=3, seed=3),
+        ]
+        coal._run_mega(jobs)
+        for job in jobs:
+            res = job.wait(timeout=60)
+            ded_host, _ = execute_batch_host(
+                job.padded_args, job.progress_args
+            )
+            g = job.g
+            np.testing.assert_array_equal(
+                np.asarray(res.host["gang_feasible"]),
+                np.asarray(ded_host["gang_feasible"])[:g],
+            )
+            np.testing.assert_array_equal(
+                np.asarray(res.host["placed"]),
+                np.asarray(ded_host["placed"])[:g],
+            )
+            np.testing.assert_array_equal(
+                np.asarray(res.host["progress"]),
+                np.asarray(ded_host["progress"])[:g],
+            )
+            assert int(res.host["best"]) == int(ded_host["best"])
+            assert bool(res.host["best_exists"]) == bool(
+                ded_host["best_exists"]
+            )
+            np.testing.assert_array_equal(
+                np.asarray(res.host["assignment_nodes"]),
+                np.asarray(ded_host["assignment_nodes"])[:g],
+            )
+            np.testing.assert_array_equal(
+                np.asarray(res.host["assignment_counts"]),
+                np.asarray(ded_host["assignment_counts"])[:g],
+            )
+    finally:
+        coal.stop()
+        executor.stop()
+
+
+def test_wire_delta_and_full_lanes_mixed():
+    """A wire-delta RemoteScorer (device-resident mirror) and a
+    full-snapshot RemoteScorer coalesce through one sidecar and stay
+    bit-identical to the local scorer across churned refreshes — the
+    'coalesced batch may mix delta-synced and keyframe tenants' claim."""
+    from batch_scheduler_tpu.cache import PGStatusCache
+    from batch_scheduler_tpu.core.oracle_scorer import OracleScorer
+    from batch_scheduler_tpu.service.client import (
+        RemoteScorer,
+        ResilientOracleClient,
+    )
+    from batch_scheduler_tpu.utils.metrics import DEFAULT_REGISTRY
+
+    srv = single_device_server(coalesce=True)
+    host, port = srv.address
+    delta_remote = RemoteScorer(
+        ResilientOracleClient(host, port, timeout=60, window=2),
+        tenant="team-delta",
+    )
+    full_remote = RemoteScorer(
+        ResilientOracleClient(host, port, timeout=60, window=2),
+        tenant="team-full",
+    )
+    full_remote._wire_delta_ok = False  # pinned to full snapshots
+    local = OracleScorer(device_state=True)
+    try:
+        nodes = [
+            make_node(f"n{i}", {"cpu": "8", "memory": "32Gi", "pods": "110"})
+            for i in range(8)
+        ]
+        cluster = FakeCluster(nodes)
+        cache = PGStatusCache()
+        gang_names = []
+        for i in range(4):
+            name = f"gang{i}"
+            pg = make_group(name, 3, creation_ts=float(i))
+            members = [
+                make_pod(f"{name}-{m}", group=name, requests={"cpu": "1"})
+                for m in range(3)
+            ]
+            status_for(pg, cache, rep_pod=members[0])
+            gang_names.append(f"default/{name}")
+        counter = DEFAULT_REGISTRY.counter(
+            "bst_oracle_wire_delta_batches_total"
+        )
+        deltas_before = counter.value(kind="delta")
+        mismatches = []
+        for rnd in range(3):
+            for s in (delta_remote, full_remote, local):
+                s.mark_dirty()
+                s.ensure_fresh(cluster, cache, group=gang_names[0])
+            for gname in gang_names:
+                plans = [
+                    (
+                        s.placed(gname),
+                        s.gang_feasible(gname),
+                        tuple(sorted(s.assignment(gname).items())),
+                    )
+                    for s in (delta_remote, full_remote, local)
+                ]
+                if not plans[0] == plans[1] == plans[2]:
+                    mismatches.append((rnd, gname, plans))
+            cluster.bind(
+                make_pod(f"filler-{rnd}", requests={"cpu": "2"}),
+                nodes[rnd].metadata.name,
+            )
+        assert not mismatches, mismatches
+        assert counter.value(kind="delta") - deltas_before >= 1
+        assert srv.coalescer.stats()["groups_run"] >= 1
+    finally:
+        delta_remote.close()
+        full_remote.close()
+        close_server(srv)
+
+
+# ---------------------------------------------------------------------------
+# DRF fairness: a starved small tenant never waits behind the whale
+# ---------------------------------------------------------------------------
+
+
+def test_drf_whale_starvation_bound():
+    executor = FakeExecutor(delay=0.01)
+    coal = OracleCoalescer(
+        executor, depth=256, span_max=2, mode="span"
+    )
+    try:
+        # the whale floods 24 jobs; once they are queued, a small tenant
+        # submits ONE — DRF must dequeue it within the next couple of
+        # groups, not behind the whale's backlog
+        whale_jobs = [make_job("whale", seed=s) for s in range(24)]
+        small_job = make_job("small", seed=99)
+        threads = [
+            threading.Thread(target=coal.schedule, args=(j,))
+            for j in whale_jobs
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 5
+        while coal.stats()["pending"] < 12 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        small_thread = threading.Thread(
+            target=coal.schedule, args=(small_job,)
+        )
+        small_thread.start()
+        small_thread.join(timeout=30)
+        assert small_job._done.is_set()
+        for t in threads:
+            t.join(timeout=30)
+        order = executor.dispatched
+        pos = order.index("small")
+        whales_before_small = order[:pos].count("whale")
+        # the small tenant jumped the whale's backlog: at submission time
+        # >= 12 whale jobs were already queued, yet it dispatches with
+        # span_max * 2 of the head (one in-flight group + the group that
+        # admits it)
+        assert whales_before_small <= 6, order
+    finally:
+        coal.stop()
+
+
+def test_drf_uses_observatory_weights():
+    """A tenant the capacity observatory says already holds the cluster
+    (dominant share ~1) sorts behind a zero-share tenant even with no
+    serviced-work history."""
+    executor = FakeExecutor(delay=0.02)
+    coal = OracleCoalescer(
+        executor, depth=64, span_max=1, mode="span",
+        weights_fn=lambda: {"hog": 0.9, "lean": 0.0},
+    )
+    try:
+        # stall the worker with a filler so both contenders are queued
+        # when selection happens
+        filler = make_job("filler", seed=0)
+        t0 = threading.Thread(target=coal.schedule, args=(filler,))
+        t0.start()
+        time.sleep(0.005)
+        hog = make_job("hog", seed=1)
+        lean = make_job("lean", seed=2)
+        t1 = threading.Thread(target=coal.schedule, args=(hog,))
+        t1.start()
+        deadline = time.monotonic() + 2
+        while coal.stats()["pending"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.001)
+        t2 = threading.Thread(target=coal.schedule, args=(lean,))
+        t2.start()
+        for t in (t0, t1, t2):
+            t.join(timeout=30)
+        order = [t for t in executor.dispatched if t in ("hog", "lean")]
+        assert order == ["lean", "hog"], executor.dispatched
+    finally:
+        coal.stop()
+
+
+# ---------------------------------------------------------------------------
+# admission control: BUSY + retry, never a silent hang
+# ---------------------------------------------------------------------------
+
+
+def test_saturation_raises_busy():
+    executor = FakeExecutor(delay=0.2)
+    coal = OracleCoalescer(executor, depth=1, span_max=1, mode="span")
+    try:
+        jobs = [make_job("a", seed=0), make_job("a", seed=1)]
+        threads = [
+            threading.Thread(target=coal.schedule, args=(j,)) for j in jobs
+        ]
+        for t in threads:
+            t.start()
+        # with depth=1 and a slow worker, a third submit must be refused
+        deadline = time.monotonic() + 2
+        saturated = None
+        while time.monotonic() < deadline and saturated is None:
+            try:
+                coal.check_admission()
+                time.sleep(0.005)
+            except CoalesceSaturated as e:
+                saturated = e
+        assert saturated is not None
+        assert 25 <= saturated.retry_after_ms <= 5000
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        coal.stop()
+
+
+def test_busy_over_wire_and_resilient_retry():
+    """A saturated coalescer answers BUSY in-band; the raw client raises
+    OracleBusyError with the hint, the resilient client waits it out and
+    succeeds — and the breaker never opens."""
+    from batch_scheduler_tpu.service.client import (
+        OracleClient,
+        ResilientOracleClient,
+    )
+
+    srv = single_device_server(coalesce=True)
+    # replace with a tiny-depth coalescer whose executor stalls briefly,
+    # so concurrent submits saturate deterministically
+    srv.coalescer.stop()
+
+    class SlowExecutor:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def submit_batch(self, *a, **kw):
+            time.sleep(0.3)
+            return self._inner.submit_batch(*a, **kw)
+
+        def run_batch(self, *a, **kw):
+            time.sleep(0.3)
+            return self._inner.run_batch(*a, **kw)
+
+        def run(self, fn):
+            return self._inner.run(fn)
+
+    srv.coalescer = OracleCoalescer(
+        SlowExecutor(srv.executor), depth=1, span_max=1, mode="span"
+    )
+    host, port = srv.address
+    try:
+        req = make_request(seed=5)
+        busy_seen = []
+        done = []
+
+        def flood(i):
+            c = OracleClient(host, port)
+            try:
+                for b in range(2):
+                    try:
+                        c.schedule(req)
+                        done.append(i)
+                    except OracleBusyError as e:
+                        busy_seen.append(e.retry_after_ms)
+            finally:
+                c.close()
+
+        threads = [
+            threading.Thread(target=flood, args=(i,)) for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert busy_seen, "saturation never produced a BUSY answer"
+        assert all(25 <= ms <= 5000 for ms in busy_seen)
+        # the resilient client rides retry-after to a successful answer
+        rc = ResilientOracleClient(host, port, timeout=60)
+        resp = rc.schedule(req, tenant="retrier")
+        assert resp.gang_feasible.shape[0] == 8
+        assert rc.breaker.state == "closed"
+        rc.close()
+    finally:
+        close_server(srv)
+
+
+# ---------------------------------------------------------------------------
+# chaos: a mid-merge disconnect drops only that tenant's span
+# ---------------------------------------------------------------------------
+
+
+def test_disconnect_mid_merge_drops_only_that_span():
+    from batch_scheduler_tpu.service.client import OracleClient
+
+    srv = single_device_server(coalesce=True)
+    host, port = srv.address
+    try:
+        # tenant A ships a request and slams the connection shut before
+        # reading the response — its span's result has nowhere to go
+        dead = socket.create_connection((host, port), timeout=10)
+        req_a = make_request(seed=11)
+        proto.write_frame(
+            dead, proto.MsgType.TENANT, proto.pack_tenant("vanisher")
+        )
+        proto.write_frame(
+            dead, proto.MsgType.SCHEDULE_REQ,
+            proto.pack_schedule_request(req_a),
+        )
+        dead.close()
+        # tenant B's concurrent (possibly coalesced-with-A) batch must
+        # complete and stay bit-identical to a dedicated run
+        ded = single_device_server()
+        try:
+            c = OracleClient(host, port)
+            d = OracleClient(*ded.address)
+            req_b = make_request(seed=12)
+            r_coal = c.schedule(req_b, tenant="survivor")
+            r_ded = d.schedule(req_b)
+            assert response_digest(r_coal) == response_digest(r_ded)
+            # and the server keeps serving: another round works
+            r2 = c.schedule(make_request(seed=13), tenant="survivor")
+            assert r2.batch_seq == r_coal.batch_seq + 1
+            c.close()
+            d.close()
+        finally:
+            close_server(ded)
+    finally:
+        close_server(srv)
+
+
+# ---------------------------------------------------------------------------
+# tenant wire attribution
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_annotation_attributes_scan_counter():
+    from batch_scheduler_tpu.service.client import OracleClient
+    from batch_scheduler_tpu.utils.metrics import DEFAULT_REGISTRY
+
+    srv = single_device_server(coalesce=True)
+    host, port = srv.address
+    try:
+        counter = DEFAULT_REGISTRY.counter("bst_scan_batches_total")
+        before = counter.value(path="serial", tenant="acme")
+        c = OracleClient(host, port)
+        c.schedule(make_request(seed=21), tenant="acme")
+        c.close()
+        deadline = time.monotonic() + 5
+        while (
+            counter.value(path="serial", tenant="acme") <= before
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert counter.value(path="serial", tenant="acme") > before
+    finally:
+        close_server(srv)
+
+
+def test_tenant_frame_roundtrip_and_bounds():
+    assert proto.unpack_tenant(proto.pack_tenant("team-a")) == "team-a"
+    with pytest.raises(ValueError):
+        proto.pack_tenant("")
+    # overlong labels truncate (attribution metadata must never crash the
+    # schedule path), clipping a codepoint split at the byte cap cleanly
+    assert proto.pack_tenant("x" * 65) == b"x" * 64
+    # 3 + 2*40 bytes in, 64-byte cap: 30 whole é fit after "ns-", the
+    # codepoint split across the boundary drops (61st byte is half an é)
+    assert proto.unpack_tenant(proto.pack_tenant("ns-" + "é" * 40)) == (
+        "ns-" + "é" * 30
+    )
+    ms, msg = proto.unpack_busy(proto.pack_busy(1234, "queue full"))
+    assert (ms, msg) == (1234, "queue full")
+
+
+# ---------------------------------------------------------------------------
+# knobs: parse-guarded, typo'd values never crash
+# ---------------------------------------------------------------------------
+
+
+def test_knob_parse_guards(monkeypatch):
+    monkeypatch.setenv("BST_COALESCE", "bananas")
+    assert coalesce_enabled() is False
+    monkeypatch.setenv("BST_COALESCE", "1")
+    assert coalesce_enabled() is True
+    monkeypatch.setenv("BST_COALESCE_DEPTH", "not-an-int")
+    assert coalesce_depth() == 64
+    monkeypatch.setenv("BST_COALESCE_SPAN_MAX", "9999")
+    assert coalesce_span_max() == 64  # clamped
+    monkeypatch.setenv("BST_COALESCE_MODE", "warp")
+    assert coalesce_mode() == "auto"
+
+
+# ---------------------------------------------------------------------------
+# lock discipline: the submit storm under BST_LOCKCHECK
+# ---------------------------------------------------------------------------
+
+
+def test_lockcheck_armed_submit_storm(monkeypatch):
+    """8 threads hammer schedule()/check_admission()/stats() against a
+    live coalescer with BST_LOCKCHECK instrumentation installed — an
+    unguarded read of the queue state raises LockDisciplineError with
+    both stacks (docs/static_analysis.md)."""
+    import os
+
+    from batch_scheduler_tpu.analysis import lockcheck
+
+    prev = os.environ.get("BST_LOCKCHECK")
+    os.environ["BST_LOCKCHECK"] = "1"
+    lockcheck.install()
+    try:
+        executor = FakeExecutor(delay=0.002)
+        coal = OracleCoalescer(executor, depth=32, span_max=4, mode="span")
+        errors = []
+
+        def storm(i):
+            try:
+                for b in range(6):
+                    try:
+                        coal.schedule(make_job(f"t{i % 3}", seed=i * 10 + b))
+                    except CoalesceSaturated:
+                        time.sleep(0.005)
+                    coal.stats()
+            except BaseException as e:  # noqa: BLE001 — the assertion
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=storm, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        coal.stop()
+        assert not errors, errors
+    finally:
+        if prev is None:
+            os.environ.pop("BST_LOCKCHECK", None)
+        else:
+            os.environ["BST_LOCKCHECK"] = prev
