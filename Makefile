@@ -5,9 +5,9 @@
 
 PY ?= python
 
-.PHONY: all build vet analyze stamp-coupling test test-cpu test-tier1 bench bench-scan bench-pipeline bench-delta bench-policy bench-whatif bench-capacity bench-coalesce bench-sharding bench-xl bench-regress validate-artifacts native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
+.PHONY: all build vet analyze stamp-coupling test test-cpu test-tier1 bench bench-scan bench-pipeline bench-delta bench-policy bench-whatif bench-capacity bench-coalesce bench-failover bench-sharding bench-xl bench-regress validate-artifacts native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
 
-all: vet analyze native test bench-regress bench-capacity bench-coalesce validate-artifacts
+all: vet analyze native test bench-regress bench-capacity bench-coalesce bench-failover validate-artifacts
 
 build: vet analyze native
 
@@ -140,6 +140,18 @@ bench-capacity:
 # (docs/multitenancy.md)
 bench-coalesce:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/coalesce_gate.py
+
+# sidecar HA CI gate (CPU): crash-recovery drills — mid-storm graceful
+# drain (zero client-visible errors, clean flush report, DRAINING
+# promotions counted) and a ChaosProxy kill of the primary (clients trip
+# the breaker, promote to the warm standby, finish with plan digests
+# bit-identical to an uninterrupted control run: zero lost plans, zero
+# double-applied plans), time-to-recovery bounded, breaker/failover
+# metrics truthful, and warmth replication asserted (first post-failover
+# shape is a compile-warmer HIT on the standby)
+# (docs/resilience.md "High availability")
+bench-failover:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/failover_gate.py
 
 # audit/replay/health CI gate (CPU): records a short sim into an audit
 # ring, replays every batch bit-identically (steady + cpu-ladder rungs),

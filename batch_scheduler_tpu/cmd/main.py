@@ -21,6 +21,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -170,9 +171,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sim.add_argument("--scorer", choices=["oracle", "serial"], default=None,
                      help="override the scorer gate (--scorer=tpu north star)")
-    sim.add_argument("--oracle-addr", default=None, metavar="HOST:PORT",
+    sim.add_argument("--oracle-addr", default=None,
+                     metavar="HOST:PORT[,HOST:PORT...]",
                      help="score via a remote oracle sidecar (see `serve`) "
-                          "instead of the in-process oracle")
+                          "instead of the in-process oracle; a comma list "
+                          "names warm standbys after the primary — the "
+                          "client promotes on DRAINING (graceful drain) or "
+                          "breaker-open (crash), see docs/resilience.md "
+                          "\"High availability\"")
     sim.add_argument(
         "--oracle-fallback", choices=["deny", "local-cpu"], default="deny",
         help="behavior when the sidecar transport is down (breaker open / "
@@ -967,6 +973,33 @@ def cmd_serve(args) -> int:
     )
     host, port = server.address
     print(f"oracle sidecar listening on {host}:{port}", flush=True)
+
+    # SIGTERM = graceful drain (docs/resilience.md "High availability"):
+    # stop admitting, finish the in-flight window, flush warmer ->
+    # executor -> telemetry -> audit in producer-before-join order, keep
+    # answering DRAINING + failover hint meanwhile, THEN exit. Runs on a
+    # helper thread so the signal handler returns immediately (drain can
+    # legitimately take BST_DRAIN_TIMEOUT_S); shutdown() unblocks
+    # serve_forever once the flush is done.
+    import signal
+
+    def _drain_and_exit() -> None:
+        report = server.drain()
+        print(f"drain complete: {json.dumps(report, sort_keys=True)}",
+              flush=True)
+        server.shutdown()
+
+    def _on_sigterm(signum, frame):  # noqa: ARG001 — signal signature
+        print("SIGTERM: draining oracle sidecar", flush=True)
+        threading.Thread(
+            target=_drain_and_exit, name="drain-sigterm", daemon=True
+        ).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):
+        pass  # non-main thread / unsupported platform: abrupt kill remains
+
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -1100,15 +1133,16 @@ def cmd_sim(args) -> int:
     if args.oracle_addr:
         from ..service.client import RemoteScorer, ResilientOracleClient
 
-        host, _, port = args.oracle_addr.rpartition(":")
         # resilient transport: reconnect + retry + breaker + deadline —
         # connections are lazy, so a sidecar that is still coming up (or
         # briefly gone) no longer kills the whole run at construction.
+        # A comma list configures a warm-standby pool (the client parses
+        # the spec itself — parse_oracle_addresses).
         # Dispatch-ahead widens the in-flight window to 2 connection
         # slots so the speculative batch never contends with row reads
         # on the served batch (docs/pipelining.md).
         oracle_client = ResilientOracleClient(
-            host or "127.0.0.1", int(port),
+            args.oracle_addr,
             deadline_ms=args.oracle_deadline_ms, name="fg",
             window=2 if want_dispatch_ahead else 1,
         )
@@ -1117,7 +1151,7 @@ def cmd_sim(args) -> int:
         bg_client = None
         if want_bg_refresh:
             bg_client = ResilientOracleClient(
-                host or "127.0.0.1", int(port),
+                args.oracle_addr,
                 deadline_ms=args.oracle_deadline_ms, name="bg",
             )
         scorer = RemoteScorer(

@@ -267,6 +267,11 @@ class CompileWarmer:
         self._seen: set = set()    # shapes serving traffic already compiled; guarded-by: _state_lock
         self._failed: set = set()  # guarded-by: _state_lock
         self._last_key = None  # guarded-by: _state_lock
+        # recent observed-shape prototypes, key -> proto (insertion-ordered,
+        # bounded): the warmth-replication feed a warm STANDBY sidecar
+        # precompiles from (docs/resilience.md "High availability");
+        # guarded-by: _state_lock
+        self._protos: dict = {}
         # GIL-atomic one-way flag (single writer: stop()); deliberately
         # lock-free so the worker can observe it mid-compile
         self._stopped = False
@@ -342,7 +347,36 @@ class CompileWarmer:
                 wave,
                 donate,
             )
-            self._q.put(proto)
+            with self._state_lock:
+                self._protos[key] = proto
+                while len(self._protos) > 16:  # bounded replication feed
+                    self._protos.pop(next(iter(self._protos)))
+            self._q.put(proto + (False,))
+
+    def warmth_snapshot(self) -> list:
+        """The retained observed-shape prototypes, oldest first — feed
+        them to a standby's :meth:`replicate` so promotion lands on warm
+        executables instead of paying the cold compiles the primary
+        already absorbed."""
+        with self._state_lock:
+            return list(self._protos.values())
+
+    def replicate(self, protos) -> int:
+        """Queue another warmer's :meth:`warmth_snapshot` for
+        precompilation — INCLUDING each prototype's own shape, not just
+        its adjacents: the standby has served no traffic, so the
+        primary's steady shapes are exactly the cold compiles a
+        promotion would otherwise pay. Replicated shapes land in the
+        warm set, so the first post-failover batch at one counts as a
+        warmer hit. Returns the number of prototypes enqueued."""
+        n = 0
+        for proto in protos or []:
+            if self._stopped:
+                break
+            batch_args, progress_args, wave, donate = proto[:4]
+            self._q.put((batch_args, progress_args, wave, donate, True))
+            n += 1
+        return n
 
     def stop(self, timeout: float = 60.0) -> bool:
         """Drain the warmer before process teardown (same XLA-daemon-thread
@@ -395,12 +429,18 @@ class CompileWarmer:
             item = self._q.get()
             if item is None:
                 return
-            batch_args, progress_args, wave, donate = item
+            batch_args, progress_args, wave, donate, warm_self = item
             g_bucket = int(batch_args[2].shape[0])
             n_bucket = int(batch_args[0].shape[0])
             lanes = int(batch_args[0].shape[1])
             mask_rows = int(batch_args[4].shape[0])
-            for gb, nb in adjacent_bucket_shapes(g_bucket, n_bucket):
+            # replicated prototypes (warm_self) warm their OWN shape
+            # first, then the adjacents; locally observed shapes are
+            # already compiled by serving traffic and warm adjacents only
+            shapes = (
+                [(g_bucket, n_bucket)] if warm_self else []
+            ) + list(adjacent_bucket_shapes(g_bucket, n_bucket))
+            for gb, nb in shapes:
                 key = self._key(gb, nb, lanes, mask_rows, wave, donate)
                 with self._state_lock:
                     if (

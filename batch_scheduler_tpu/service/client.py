@@ -3,9 +3,13 @@
 ``OracleClient`` is the raw protocol client (one TCP connection, serialized
 round-trips, no recovery). ``ResilientOracleClient`` is the production
 transport: same surface, plus automatic reconnect, bounded retries with
-exponential backoff + full jitter (utils.retry.RetryPolicy), per-request
-deadline propagation, and a circuit breaker that fails fast during an
-outage and re-closes through a half-open ping probe (docs/resilience.md).
+exponential backoff + decorrelated jitter (utils.retry.RetryPolicy),
+per-request deadline propagation, a circuit breaker that fails fast during
+an outage and re-closes through a half-open ping probe, and — with a
+multi-address pool — warm-standby failover: promotion on a DRAINING answer
+(proactive, never a breaker failure) or on breaker-open (crash), with
+delta mirrors re-keyframing on the new primary through the ordinary
+DELTA_RESYNC machinery (docs/resilience.md "High availability").
 ``RemoteScorer`` plugs either into ScheduleOperation with the same
 interface as the in-process OracleScorer — the control plane is agnostic to
 whether the oracle lives in-process on the local chip or behind the sidecar
@@ -17,7 +21,9 @@ from __future__ import annotations
 import socket
 import threading
 import time
-from typing import Optional, Tuple
+import weakref
+from collections import deque
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -28,6 +34,7 @@ from ..utils.errors import (
     DeltaResyncRequired,
     OracleBusyError,
     OracleDeadlineError,
+    OracleDrainingError,
     OracleTransportError,
     StaleBatchError,
 )
@@ -36,7 +43,32 @@ from ..utils.retry import CircuitBreaker, RetryPolicy
 from ..utils import trace as trace_mod
 from . import protocol as proto
 
-__all__ = ["OracleClient", "ResilientOracleClient", "RemoteScorer"]
+__all__ = [
+    "OracleClient",
+    "ResilientOracleClient",
+    "RemoteScorer",
+    "parse_oracle_addresses",
+    "active_failover_report",
+]
+
+
+def parse_oracle_addresses(
+    spec: str, default_host: str = "127.0.0.1"
+) -> List[Tuple[str, int]]:
+    """``host:port[,host:port...]`` -> ``[(host, port), ...]`` — the
+    ``--oracle-addr`` list form. Each entry may omit the host
+    (``:9090`` / ``9090``), which defaults like the single-address CLI
+    parse always has. Raises ValueError on an empty or unparsable spec."""
+    addresses = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        addresses.append((host or default_host, int(port)))
+    if not addresses:
+        raise ValueError(f"no oracle addresses in {spec!r}")
+    return addresses
 
 
 def in_band_error(message: str) -> Exception:
@@ -163,6 +195,14 @@ class OracleClient:
             retry_ms, message = proto.unpack_busy(resp)
             raise OracleBusyError(
                 message or "oracle coalescer saturated", retry_ms
+            )
+        if resp_type == proto.MsgType.DRAINING:
+            retry_ms, hint = proto.unpack_draining(resp)
+            raise OracleDrainingError(
+                "oracle draining"
+                + (f" (failover hint: {hint})" if hint else ""),
+                retry_ms,
+                failover_hint=hint,
             )
         if resp_type == proto.MsgType.ERROR:
             raise in_band_error(resp.decode(errors="replace"))
@@ -320,6 +360,53 @@ _TRANSPORT_ERRORS = (OSError, EOFError, OracleTransportError)
 
 _BREAKER_STATE_VALUES = {"closed": 0, "open": 1, "half-open": 2}
 
+# live multi-address clients, for the /debug/health ``failover`` signal
+# (utils.health reads active_failover_report() through a lazy import, the
+# same pattern as ops.capacity.active_sampler)
+_POOLED_CLIENTS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def active_failover_report() -> dict:
+    """Pool state of every live multi-address ResilientOracleClient:
+    active address, standby freshness (seconds since a standby last
+    answered — None until one has), per-backend breaker states, and the
+    recent promotion history with reasons. Best-effort and lock-light;
+    health snapshots must never block a scheduling cycle."""
+    now = time.time()
+    mono = time.monotonic()
+    clients = []
+    for c in list(_POOLED_CLIENTS):
+        try:
+            with c._pool_lock:
+                active = c._active
+                promotions = list(c._promotions)
+            addrs = [f"{h}:{p}" for h, p in c._addresses]
+            last_ok = list(c._backend_last_ok)
+            standby_ages = [
+                mono - t
+                for i, t in enumerate(last_ok)
+                if i != active and t > 0.0
+            ]
+            clients.append({
+                "client": c._label,
+                "active": active,
+                "active_addr": addrs[active],
+                "addresses": addrs,
+                "standby_freshness_s": (
+                    round(min(standby_ages), 3) if standby_ages else None
+                ),
+                "promotions": [
+                    {"ago_s": round(now - ts, 3), "reason": r, "to": to}
+                    for ts, r, to in promotions
+                ],
+                "breakers": {
+                    addrs[i]: b.state for i, b in enumerate(c._breakers)
+                },
+            })
+        except Exception:  # noqa: BLE001 — a dying client must not
+            continue  # poison the health snapshot
+    return {"clients": clients}
+
 
 class _ClientSlot:
     """One in-flight lane of a windowed ResilientOracleClient: the same
@@ -411,17 +498,32 @@ class ResilientOracleClient:
     server-side connection) that executed it. The default window of 1 is
     exactly the old single-connection behavior.
 
+    ``host`` may be a comma-separated ADDRESS POOL (``"h1:p1,h2:p2"``,
+    the ``--oracle-addr`` list form; ``port`` is then ignored): the first
+    address is the primary, the rest warm standbys. Each backend gets its
+    OWN breaker (an outage of the primary must not poison the standby's
+    admission state); every slot always dials the pool's single ACTIVE
+    backend and lazily re-dials after a promotion. Promotion happens on a
+    DRAINING answer (proactive — the primary said it will not serve
+    again; never a breaker failure) or when the active backend's breaker
+    opens (crash). Server-side per-connection state (delta mirrors, batch
+    rows) dies with the old connections by design: the standby answers
+    DELTA_RESYNC / in-band stale, and the existing keyframe + stale-batch
+    discipline re-converges (docs/resilience.md "High availability").
+
     Observability (registry, default the process registry):
     bst_oracle_retries_total, bst_oracle_transport_failures_total,
-    bst_oracle_reconnects_total, bst_oracle_deadline_errors_total
-    (counters) and bst_oracle_breaker_state (gauge; 0=closed 1=open
-    2=half-open), all labelled by ``client`` (``name`` or host:port).
+    bst_oracle_reconnects_total, bst_oracle_deadline_errors_total,
+    bst_oracle_failover_total (counters), bst_oracle_breaker_state
+    (gauge; 0=closed 1=open 2=half-open; pooled backends are labelled
+    ``label@host:port``) and bst_oracle_active_backend (gauge; pool
+    index), labelled by ``client`` (``name`` or the address spec).
     """
 
     def __init__(
         self,
         host: str,
-        port: int,
+        port: Optional[int] = None,
         timeout: float = 120.0,
         connect_timeout: float = 5.0,
         retry_policy: Optional[RetryPolicy] = None,
@@ -431,7 +533,14 @@ class ResilientOracleClient:
         registry: Optional[Registry] = None,
         window: int = 1,
     ):
-        self._host, self._port = host, port
+        if port is None or "," in host or ":" in host:
+            # address-spec form ("h1:p1,h2:p2", ":9090", "9090"): the
+            # CLI's --oracle-addr string, port arg ignored
+            self._addresses = parse_oracle_addresses(host)
+        else:
+            self._addresses = [(host, int(port))]
+        self._active = 0
+        self._pool_lock = threading.Lock()
         self._timeout = timeout
         self._connect_timeout = connect_timeout
         self.retry_policy = retry_policy or RetryPolicy()
@@ -439,9 +548,20 @@ class ResilientOracleClient:
         self.window = max(1, int(window))
         self._slot_clients: list = [None] * self.window
         self._slot_connected: list = [False] * self.window
+        self._slot_addr = [0] * self.window
         self._slot_locks = [threading.RLock() for _ in range(self.window)]
         reg = registry or DEFAULT_REGISTRY
-        self._label = name or f"{host}:{port}"
+        addr_labels = [f"{h}:{p}" for h, p in self._addresses]
+        pooled = len(self._addresses) > 1
+        self._label = name or ",".join(addr_labels)
+        # single-address clients keep the historical one-gauge-per-client
+        # label; pooled backends each get label@host:port so the breaker
+        # gauge stays truthful per backend
+        self._backend_labels = (
+            [f"{self._label}@{a}" for a in addr_labels]
+            if pooled
+            else [self._label]
+        )
         self._retries = reg.counter(
             "bst_oracle_retries_total",
             "Oracle requests retried after a transport failure",
@@ -468,9 +588,51 @@ class ResilientOracleClient:
             "bst_oracle_breaker_state",
             "Oracle circuit breaker state (0=closed 1=open 2=half-open)",
         )
-        self.breaker = breaker or CircuitBreaker()
-        self.breaker.on_transition = self._record_breaker_state
-        self._record_breaker_state(self.breaker.state)
+        self._failovers = reg.counter(
+            "bst_oracle_failover_total",
+            "Pooled-client standby promotions by reason (drain = "
+            "proactive on a DRAINING answer; crash = the active "
+            "backend's breaker opened)",
+        )
+        self._active_gauge = reg.gauge(
+            "bst_oracle_active_backend",
+            "Index into the client's oracle address pool it is currently "
+            "serving from (0 = first configured address)",
+        )
+        first = breaker or CircuitBreaker()
+        self._breakers = [first]
+        for _ in self._addresses[1:]:
+            # standbys clone the caller's breaker CONFIG (threshold,
+            # cooldown, clock) but never its state: each backend earns
+            # its open/closed verdict from its own transport evidence
+            self._breakers.append(
+                CircuitBreaker(
+                    failure_threshold=first.failure_threshold,
+                    reset_timeout=first.reset_timeout,
+                    clock=first._clock,
+                )
+            )
+        for i, b in enumerate(self._breakers):
+            b.on_transition = (
+                lambda st, _i=i: self._record_breaker_state(st, _i)
+            )
+            self._record_breaker_state(b.state, i)
+        self._backend_last_ok = [0.0] * len(self._addresses)
+        self._promotions: deque = deque(maxlen=64)  # (wall_ts, reason, to)
+        self._active_gauge.set(0, client=self._label)
+        if pooled:
+            _POOLED_CLIENTS.add(self)
+
+    @property
+    def breaker(self) -> CircuitBreaker:
+        """The ACTIVE backend's breaker (the only one for a
+        single-address client — the historical attribute, unchanged)."""
+        return self._breakers[self._active]
+
+    @property
+    def active_address(self) -> Tuple[str, int]:
+        """(host, port) of the pool backend currently being served from."""
+        return self._addresses[self._active]
 
     @staticmethod
     def _check_deadline(deadline_ms: Optional[int]) -> Optional[int]:
@@ -485,9 +647,10 @@ class ResilientOracleClient:
             )
         return deadline_ms
 
-    def _record_breaker_state(self, state: str) -> None:
+    def _record_breaker_state(self, state: str, idx: int = 0) -> None:
         self._breaker_gauge.set(
-            _BREAKER_STATE_VALUES.get(state, -1), client=self._label
+            _BREAKER_STATE_VALUES.get(state, -1),
+            client=self._backend_labels[idx],
         )
 
     def would_attempt(self) -> bool:
@@ -522,13 +685,25 @@ class ResilientOracleClient:
             self._drop(idx)
 
     def _ensure(self, slot: int = 0) -> OracleClient:
+        active = self._active
+        if (
+            self._slot_clients[slot] is not None
+            and self._slot_addr[slot] != active
+        ):
+            # a promotion happened since this slot dialed: the old
+            # connection points at a draining/dead backend — re-dial
+            # lazily (each slot under its own lock, so promotion never
+            # needs to touch another slot's connection)
+            self._drop(slot)
         if self._slot_clients[slot] is None:
+            host, port = self._addresses[active]
             self._slot_clients[slot] = OracleClient(
-                self._host,
-                self._port,
+                host,
+                port,
                 timeout=self._timeout,
                 connect_timeout=self._connect_timeout,
             )
+            self._slot_addr[slot] = active
             if self._slot_connected[slot]:
                 self._reconnects.inc(client=self._label)
             self._slot_connected[slot] = True
@@ -539,8 +714,54 @@ class ResilientOracleClient:
             self._slot_clients[slot].close()
             self._slot_clients[slot] = None
 
+    def _note_ok(self) -> None:
+        """Active backend answered over a working transport: close/keep
+        its breaker closed and stamp its freshness (the /debug/health
+        ``failover`` signal's standby-staleness input)."""
+        self.breaker.record_success()
+        self._backend_last_ok[self._active] = time.monotonic()
+
+    def _promote(self, reason: str, require_healthy: bool = False) -> bool:
+        """Advance the pool to the next standby, preferring one whose
+        breaker would admit. Connections re-dial lazily per slot
+        (``_ensure`` compares ``_slot_addr`` to the active index), so
+        promotion never blocks on another slot's in-flight request;
+        server-side delta mirrors die with the old connections and the
+        standby forces a keyframe via the ordinary DELTA_RESYNC answer.
+        ``require_healthy`` (the admission-refused path) declines to
+        promote when every standby's breaker is also open — flapping
+        round-robin through a fleet-wide outage would only falsify the
+        failover counter. Returns False on a single-address pool."""
+        if len(self._addresses) < 2:
+            return False
+        with self._pool_lock:
+            old = self._active
+            order = [
+                (old + k) % len(self._addresses)
+                for k in range(1, len(self._addresses))
+            ]
+            nxt = next(
+                (i for i in order if self._breakers[i].would_attempt()),
+                None,
+            )
+            if nxt is None:
+                if require_healthy:
+                    return False
+                nxt = order[0]
+            self._active = nxt
+            self._promotions.append((time.time(), reason, nxt))
+        self._failovers.inc(reason=reason, client=self._label)
+        self._active_gauge.set(nxt, client=self._label)
+        return True
+
     def _admit(self, slot: int = 0) -> None:
         decision = self.breaker.admit()
+        if decision == "refuse" and self._promote(
+            "crash", require_healthy=True
+        ):
+            # the active backend is in cooldown but a standby would
+            # admit: serve from the standby instead of failing fast
+            decision = self.breaker.admit()
         if decision == "refuse":
             raise CircuitOpenError(
                 f"oracle circuit open ({self._label}); "
@@ -568,17 +789,24 @@ class ResilientOracleClient:
                 raise CircuitOpenError(
                     f"oracle half-open probe failed ({self._label})"
                 )
-            self.breaker.record_success()
+            self._note_ok()
 
     def _call(self, op: str, fn, slot: int = 0):
         with self._slot_locks[slot]:
             self._admit(slot)
             last: Optional[BaseException] = None
             slept_busy_hint = False
+            prev_delay: Optional[float] = None
             for attempt in range(self.retry_policy.max_attempts):
                 if attempt and not slept_busy_hint:
                     self._retries.inc(op=op, client=self._label)
-                    time.sleep(self.retry_policy.backoff(attempt - 1))
+                    # decorrelated jitter: each delay seeds the next
+                    # draw's range, so clients that crashed in sync
+                    # drift apart instead of stampeding the standby
+                    prev_delay = self.retry_policy.backoff(
+                        attempt - 1, prev=prev_delay
+                    )
+                    time.sleep(prev_delay)
                 slept_busy_hint = False
                 try:
                     result = fn(self._ensure(slot))
@@ -588,8 +816,25 @@ class ResilientOracleClient:
                     # the same budget), never advance the breaker
                     if isinstance(e, OracleDeadlineError):
                         self._deadline_errors.inc(client=self._label)
-                    self.breaker.record_success()
+                    self._note_ok()
                     raise
+                except OracleDrainingError as e:
+                    # graceful-shutdown answer over a live transport —
+                    # never a breaker failure. With a standby configured
+                    # this is the PROACTIVE failover signal: promote and
+                    # re-issue immediately (no backoff — the primary just
+                    # told us it will never serve this request).
+                    # Single-address clients wait out the hint like BUSY
+                    # and surface the DrainingError when attempts run out.
+                    self._note_ok()
+                    last = e
+                    if self._promote("drain"):
+                        slept_busy_hint = True  # promotion IS the wait
+                        continue
+                    if attempt + 1 >= self.retry_policy.max_attempts:
+                        raise
+                    time.sleep(min(max(e.retry_after_ms, 1) / 1000.0, 5.0))
+                    slept_busy_hint = True
                 except OracleBusyError as e:
                     # the sidecar is alive and telling us exactly when to
                     # come back: wait out its hint (capped) and burn one
@@ -599,7 +844,7 @@ class ResilientOracleClient:
                     # connection. Exhausted attempts surface the
                     # BusyError itself (the scorer's fallback decides),
                     # not a transport wrapper.
-                    self.breaker.record_success()
+                    self._note_ok()
                     self._busy_answers.inc(op=op, client=self._label)
                     if attempt + 1 >= self.retry_policy.max_attempts:
                         raise
@@ -616,14 +861,20 @@ class ResilientOracleClient:
                     self.breaker.record_failure()
                     last = e
                     if not self.breaker.would_attempt():
-                        break  # breaker opened mid-loop: stop burning attempts
+                        # breaker opened mid-loop. With a standby this is
+                        # the CRASH promotion trigger: point the
+                        # remaining attempts at it (backoff still
+                        # applies — the dial is real work). Without one,
+                        # stop burning attempts.
+                        if not self._promote("crash"):
+                            break
                 except RuntimeError:
                     # in-band server error (bad request, row out of
                     # range): the transport answered — surface as-is
-                    self.breaker.record_success()
+                    self._note_ok()
                     raise
                 else:
-                    self.breaker.record_success()
+                    self._note_ok()
                     return result
             raise OracleTransportError(
                 f"oracle {op} via {self._label} failed after "
@@ -1093,12 +1344,17 @@ class RemoteScorer(OracleScorer):
                     resp = self._wire_schedule(
                         client, cursor, snap, req, audit_id, policy_fp
                     )
-        except _TRANSPORT_ERRORS + (OracleDeadlineError, OracleBusyError) as e:
+        except _TRANSPORT_ERRORS + (
+            OracleDeadlineError, OracleBusyError, OracleDrainingError,
+        ) as e:
             # whether the server applied anything is unknown (a deadline
             # may abandon a half-applied delta): forget this lane's
             # mirror state so the next batch on it keyframes. A BUSY
             # answer is the exception — admission was refused before any
-            # mirror mutation, so the cursor stays valid.
+            # mirror mutation, so the cursor stays valid. A DRAINING
+            # answer surfacing here means a single-address client rode
+            # out the whole retry budget against a draining sidecar: the
+            # connection dies with the server, so the cursor resets too.
             if not isinstance(e, OracleBusyError):
                 cursor.reset()
             # raw OSError/EOFError included, not just the resilient

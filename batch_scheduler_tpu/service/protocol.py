@@ -106,6 +106,8 @@ __all__ = [
     "unpack_tenant",
     "pack_busy",
     "unpack_busy",
+    "pack_draining",
+    "unpack_draining",
     "TENANT_LABEL_MAX_BYTES",
     "is_stale_batch_message",
 ]
@@ -171,6 +173,18 @@ class MsgType:
     # silent hang). Sent only by a coalescing server, which only clients
     # shipping this PR's frames talk to — the DEADLINE ship-together rule.
     BUSY = 17
+    # Graceful-drain refusal (docs/resilience.md "High availability"): the
+    # server received SIGTERM (or /debug/drain) and is finishing its
+    # in-flight window before exit — the request was NOT executed and
+    # nothing server-side changed. Carries a retry-after hint in ms plus a
+    # failover hint string (the standby address list when the operator
+    # supplied one). A pooled client promotes its standby PROACTIVELY on
+    # this answer — the transport worked, so it never advances the circuit
+    # breaker (the BUSY discipline). Old servers answer MsgType 18 with an
+    # in-band ERROR and old clients never see it (a draining old server
+    # just closes) — the BUSY/AUDIT_ID compatibility pattern: existing
+    # layouts stay bit-for-bit unchanged.
+    DRAINING = 18
 
 
 ROW_KINDS = ("capacity", "scores")
@@ -508,6 +522,22 @@ def pack_busy(retry_after_ms: int, message: str = "") -> bytes:
 
 
 def unpack_busy(payload: bytes) -> Tuple[int, str]:
+    (retry_after_ms,) = _BUSY.unpack_from(payload, 0)
+    return int(retry_after_ms), payload[_BUSY.size:].decode(errors="replace")
+
+
+# DRAINING shares BUSY's layout: retry-after hint in ms, then a UTF-8
+# failover hint (the standby address list, comma-separated, when the
+# operator supplied one — empty otherwise).
+
+
+def pack_draining(retry_after_ms: int, failover_hint: str = "") -> bytes:
+    if not 0 <= retry_after_ms <= 0xFFFFFFFF:
+        raise ValueError(f"retry_after_ms out of range: {retry_after_ms}")
+    return _BUSY.pack(retry_after_ms) + failover_hint.encode()
+
+
+def unpack_draining(payload: bytes) -> Tuple[int, str]:
     (retry_after_ms,) = _BUSY.unpack_from(payload, 0)
     return int(retry_after_ms), payload[_BUSY.size:].decode(errors="replace")
 

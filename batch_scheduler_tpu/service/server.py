@@ -24,10 +24,12 @@ stop-and-wait.
 
 from __future__ import annotations
 
+import os
 import queue
 import socketserver
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Optional
 
@@ -46,7 +48,48 @@ from .coalescer import (
     coalesce_enabled,
 )
 
-__all__ = ["DeviceExecutor", "OracleServer", "serve_background"]
+__all__ = [
+    "DeviceExecutor",
+    "OracleServer",
+    "serve_background",
+    "active_servers",
+]
+
+# graceful drain (docs/resilience.md "High availability"): the
+# work-bearing message types the drain gate refuses. Annotations and PING
+# keep flowing — a draining sidecar is alive and says so; only execution
+# is refused.
+_DRAIN_GATED = (
+    proto.MsgType.SCHEDULE_REQ,
+    proto.MsgType.DELTA_SCHEDULE_REQ,
+    proto.MsgType.ROW_REQ,
+)
+# retry-after hint carried in every DRAINING answer: long enough that a
+# single-address client's hint-sleeps don't hammer the dying process,
+# short enough that it observes the exit within its retry budget
+_DRAIN_RETRY_AFTER_MS = 200
+
+# live servers in this process, for the /debug/drain endpoint
+# (utils.metrics reaches them through a lazy import — no import cycle)
+_LIVE_SERVERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def active_servers() -> list:
+    """Every live OracleServer in this process (weakly held)."""
+    return list(_LIVE_SERVERS)
+
+
+def _drain_timeout_s() -> float:
+    """BST_DRAIN_TIMEOUT_S: bound on how long ``drain()`` waits for the
+    in-flight request window to empty before flushing and reporting
+    (seconds, default 30; parse-guarded like every knob)."""
+    raw = os.environ.get("BST_DRAIN_TIMEOUT_S")
+    if raw is None:
+        return 30.0
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        return 30.0
 
 
 # ---------------------------------------------------------------------------
@@ -591,6 +634,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     return
                 except ValueError:
                     return  # not speaking our protocol: drop the connection
+                admitted = False
                 try:
                     if msg_type == proto.MsgType.DEADLINE:
                         deadline_ms = proto.unpack_deadline(payload)
@@ -613,6 +657,26 @@ class _Handler(socketserver.BaseRequestHandler):
                     req_policy, policy_ctx = policy_ctx, None
                     if req_policy is not None:
                         self._note_policy_skew(req_policy)
+                    if msg_type in _DRAIN_GATED:
+                        # graceful-drain admission gate (docs/resilience.md
+                        # "High availability"): once drain() flips the
+                        # flag, work-bearing requests get a DRAINING
+                        # answer + failover hint instead of execution —
+                        # while requests admitted BEFORE the flip finish
+                        # inside the in-flight window drain() waits out.
+                        # PING stays answered below: liveness is truthful
+                        # to the end, and a half-open probe that succeeds
+                        # only to see DRAINING next promotes proactively.
+                        if not self.server._admit_request():
+                            proto.write_frame(
+                                self.request, proto.MsgType.DRAINING,
+                                proto.pack_draining(
+                                    _DRAIN_RETRY_AFTER_MS,
+                                    self.server.failover_hint,
+                                ),
+                            )
+                            continue
+                        admitted = True
                     if msg_type == proto.MsgType.PING:
                         # answered inline, never through the worker:
                         # liveness must stay observable even while a
@@ -884,6 +948,12 @@ class _Handler(socketserver.BaseRequestHandler):
                         )
                     except OSError:
                         return
+                finally:
+                    # every admitted request retires exactly once (the
+                    # annotation/BUSY/DEADLINE `continue`s above still
+                    # pass through here) — drain() waits on this count
+                    if admitted:
+                        self.server._request_done()
         finally:
             if self._worker is not None:
                 self._worker.close()
@@ -1254,10 +1324,132 @@ class OracleServer(socketserver.ThreadingTCPServer):
             from ..ops.bucketing import maybe_compile_warmer
 
             self.warmer = maybe_compile_warmer(self.scan_mesh)
+        # graceful drain (docs/resilience.md "High availability"):
+        # _admit_request/_request_done bracket every work-bearing request
+        # so drain() can wait out the admitted in-flight window before
+        # flushing; failover_hint rides in every DRAINING answer so even
+        # clients configured with a single address learn where the
+        # standby lives (BST_FAILOVER_HINT, or drain(failover_hint=...))
+        self._draining = False
+        self._inflight_requests = 0
+        self._inflight_lock = threading.Lock()
+        self._drain_done = threading.Event()
+        self._drain_report: dict = {}
+        try:
+            self.failover_hint = os.environ.get("BST_FAILOVER_HINT", "") or ""
+        except Exception:  # noqa: BLE001 — hint is advisory
+            self.failover_hint = ""
+        self._draining_gauge = DEFAULT_REGISTRY.gauge(
+            "bst_server_draining",
+            "1 while the sidecar refuses new work with DRAINING answers "
+            "(SIGTERM / /debug/drain received), else 0",
+        )
+        self._draining_gauge.set(0, addr=f"{host}:{self.server_address[1]}")
+        self._gauge_addr = f"{host}:{self.server_address[1]}"
+        _LIVE_SERVERS.add(self)
 
     @property
     def address(self):
         return self.server_address
+
+    def draining(self) -> bool:
+        with self._inflight_lock:
+            return self._draining
+
+    def _admit_request(self) -> bool:
+        """Admission bracket for one work-bearing request (the handler's
+        drain gate). False once drain() flipped the flag — the handler
+        answers DRAINING instead of executing."""
+        with self._inflight_lock:
+            if self._draining:
+                return False
+            self._inflight_requests += 1
+            return True
+
+    def _request_done(self) -> None:
+        with self._inflight_lock:
+            self._inflight_requests -= 1
+
+    def drain(
+        self,
+        timeout: Optional[float] = None,
+        failover_hint: Optional[str] = None,
+    ) -> dict:
+        """Graceful drain: stop admitting, finish the in-flight window,
+        flush everything durable, report. Subsequent work requests get
+        DRAINING + the failover hint; PING and annotations still flow.
+
+        Flush order is the producer-before-join shutdown discipline:
+        warmer stop (its precompiles spawn telemetry threads), coalescer
+        stop (an executor producer), executor drain, telemetry-thread
+        join, and the audit flush LAST — every producer retired before
+        its consumer, so nothing lands after its ledger closed.
+
+        Idempotent: concurrent callers wait on the first drain and get
+        its report. ``timeout`` bounds the in-flight wait only
+        (default BST_DRAIN_TIMEOUT_S, 30s); flush steps keep their own
+        bounded budgets. Does NOT close the listener — the caller (the
+        SIGTERM path in cmd.main, or /debug/drain followed by an
+        operator stop) decides when the refusing-but-alive phase ends.
+        """
+        if timeout is None:
+            timeout = _drain_timeout_s()
+        if failover_hint is not None:
+            self.failover_hint = failover_hint
+        with self._inflight_lock:
+            first = not self._draining
+            self._draining = True
+        self._draining_gauge.set(1, addr=self._gauge_addr)
+        if not first:
+            self._drain_done.wait(max(1.0, float(timeout)) + 60.0)
+            return dict(self._drain_report)
+        t0 = time.monotonic()
+        deadline = t0 + max(0.0, float(timeout))
+        while True:
+            with self._inflight_lock:
+                inflight = self._inflight_requests
+            if inflight <= 0 or time.monotonic() >= deadline:
+                break
+            time.sleep(0.02)
+        if self.warmer is not None:
+            self.warmer.stop(timeout=10.0)
+        if self.coalescer is not None:
+            self.coalescer.stop(timeout=10.0)
+        self.executor.stop(timeout=10.0)
+        from ..ops.oracle import drain_telemetry_threads
+
+        telemetry_ok = bool(drain_telemetry_threads(timeout=30.0))
+        audit_ok = True
+        if self.audit_log is not None:
+            try:
+                self.audit_log.flush(timeout=30.0)
+            except Exception:  # noqa: BLE001 — report, don't abort exit
+                audit_ok = False
+        self._drain_report = {
+            "drained": inflight <= 0,
+            "inflight_at_flush": inflight,
+            "wait_s": round(time.monotonic() - t0, 3),
+            "telemetry_joined": telemetry_ok,
+            "audit_flushed": audit_ok,
+            "failover_hint": self.failover_hint,
+        }
+        self._drain_done.set()
+        return dict(self._drain_report)
+
+    def warmth_snapshot(self) -> list:
+        """The compile warmer's observed bucket-shape prototypes —
+        the primary side of warmth replication (standby HA)."""
+        if self.warmer is None:
+            return []
+        return self.warmer.warmth_snapshot()
+
+    def replicate_warmth(self, protos) -> int:
+        """Feed another sidecar's observed shapes into this server's
+        warmer so promotion pays no cold compile; returns how many
+        prototypes were enqueued (0 with no warmer)."""
+        if self.warmer is None or not protos:
+            return 0
+        return self.warmer.replicate(protos)
 
     def server_close(self) -> None:
         try:

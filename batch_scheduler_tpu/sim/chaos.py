@@ -30,6 +30,16 @@ request-direction faults aimed at the device-resident-state delta stream
                  the server sees the same delta again and must refuse it
                  on the generation check, never apply it twice
 
+Beyond per-frame faults, the proxy exposes ENDPOINT-level primitives for
+the HA crash drills (docs/resilience.md "High availability"): ``kill_
+endpoint()`` RSTs every live connection and refuses new ones (instance
+loss), ``partition_endpoint()`` black-holes both directions while
+connections stay up (network partition), ``hang_endpoint()`` delivers
+requests but swallows every response (accepting-but-dead), and
+``restore_endpoint()`` brings the endpoint back. The failover gate
+(benchmarks/failover_gate.py) drives a whole sidecar through these to
+prove the pooled client's standby promotion.
+
 Used by tests/test_chaos_oracle.py to prove ResilientOracleClient survives
 every class, and by the chaos-enabled fuzz e2e (tests/test_fuzz_e2e.py).
 """
@@ -83,6 +93,9 @@ class ChaosProxy:
         self.hang_s = 30.0
         self.injected: Dict[str, int] = {k: 0 for k in _ALL_KINDS}  # guarded-by: _lock
         self._socks: list = [self._listener]  # guarded-by: _lock
+        # endpoint-wide failure mode: None | "killed" | "partitioned" |
+        # "hung" (the HA crash-drill primitives); guarded-by: _lock
+        self._endpoint_mode: Optional[str] = None
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="chaos-accept", daemon=True
         )
@@ -128,6 +141,58 @@ class ChaosProxy:
     def clear_fault(self) -> None:
         self.set_fault(None)
 
+    # -- endpoint-level primitives (HA crash drills) -------------------------
+
+    def kill_endpoint(self) -> None:
+        """Crash the whole endpoint: every live connection dies with an RST
+        and new connections are refused the same way — the kill -9 /
+        instance-loss failure mode the failover gate drills. The listener
+        stays bound (the address doesn't vanish, the process behind it
+        did); ``restore_endpoint()`` brings it back, clients must redial."""
+        with self._lock:
+            self._endpoint_mode = "killed"
+            conns = [s for s in self._socks if s is not self._listener]
+            self._socks = [self._listener]
+        for s in conns:
+            self._rst_close(s)
+
+    def partition_endpoint(self) -> None:
+        """Network partition: connections stay up but no bytes cross in
+        either direction; new connections are accepted, then black-holed.
+        Clients see read timeouts, never a clean close."""
+        with self._lock:
+            self._endpoint_mode = "partitioned"
+
+    def hang_endpoint(self) -> None:
+        """Hung endpoint: requests still reach the server but every
+        response is swallowed — the accepting-but-dead mode the client's
+        bounded half-open probe exists for."""
+        with self._lock:
+            self._endpoint_mode = "hung"
+
+    def restore_endpoint(self) -> None:
+        """Clear the endpoint failure mode (connections killed or
+        black-holed meanwhile stay dead — clients redial)."""
+        with self._lock:
+            self._endpoint_mode = None
+
+    def endpoint_mode(self) -> Optional[str]:
+        with self._lock:
+            return self._endpoint_mode
+
+    @staticmethod
+    def _rst_close(s: socket.socket) -> None:
+        try:
+            s.setsockopt(
+                socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0)
+            )
+        except OSError:
+            pass
+        try:
+            s.close()
+        except OSError:
+            pass
+
     def injected_counts(self) -> Dict[str, int]:
         """Snapshot of per-kind injection counters. The BST_LOCKCHECK sweep
         caught the callers reading ``.injected`` bare from the test thread
@@ -158,6 +223,15 @@ class ChaosProxy:
                 continue
             except OSError:
                 return
+            with self._lock:
+                mode = self._endpoint_mode
+            if mode == "killed":
+                self._rst_close(client)  # dead process: dial answered by RST
+                continue
+            if mode == "partitioned":
+                with self._lock:
+                    self._socks.append(client)
+                continue  # accepted, never relayed: the black-hole
             try:
                 upstream = socket.create_connection(self._upstream, timeout=5.0)
             except OSError:
@@ -209,6 +283,11 @@ class ChaosProxy:
                     break
                 if not data:
                     break
+                mode = self.endpoint_mode()
+                if mode == "killed":
+                    break
+                if mode == "partitioned":
+                    continue  # swallow: the partition eats the bytes
                 dst.sendall(data)
         except OSError:
             pass
@@ -230,6 +309,11 @@ class ChaosProxy:
                     payload = self._read_exact(src, length)
                     if payload is None:
                         break
+                mode = self.endpoint_mode()
+                if mode == "killed":
+                    break
+                if mode == "partitioned":
+                    continue  # swallow: the partition eats the frame
                 fault = self._draw(C2S_FAULT_KINDS)
                 if fault == "drop_c2s":
                     continue  # the frame never arrives; the stream lives
@@ -254,6 +338,11 @@ class ChaosProxy:
                     payload = self._read_exact(src, length)
                     if payload is None:
                         break
+                mode = self.endpoint_mode()
+                if mode == "killed":
+                    break
+                if mode in ("partitioned", "hung"):
+                    continue  # response swallowed; keep draining upstream
                 fault = self._draw()
                 if fault is None:
                     dst.sendall(header + payload)

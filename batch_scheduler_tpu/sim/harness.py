@@ -43,6 +43,8 @@ def drive_multi_client(
     seed: int = 0,
     deadline_ms: Optional[int] = None,
     tenant_batches: Optional[Dict[str, int]] = None,
+    client_kwargs: Optional[Dict] = None,
+    on_batch: Optional[Callable[[str, int], None]] = None,
 ):
     """Drive K scheduler clients' oracle request streams through ONE
     sidecar (docs/multitenancy.md "Multi-client sim") — the coalescer
@@ -63,6 +65,17 @@ def drive_multi_client(
     scenarios: {"tenant-0": 64} floods tenant 0 while the rest stay at
     ``batches``).
 
+    ``addr`` may be a comma list (``"h1:p1,h2:p2"``) — each client then
+    gets the whole warm-standby pool and promotes on DRAINING /
+    breaker-open (docs/resilience.md "High availability"); the failover
+    gate drives a storm through exactly this. ``client_kwargs`` forwards
+    extra ResilientOracleClient options (the gate tightens
+    retry/breaker budgets so a crash promotes within one call; callable
+    values are invoked per client — pass a CircuitBreaker FACTORY, not a
+    shared instance);
+    ``on_batch(tenant, index)`` observes each completed request (the
+    gate's mid-storm kill trigger).
+
     Returns ``{tenant: {"digests": [...], "waits": [...], "busy": int}}``
     plus a ``"_wall_s"`` entry with the run's wall-clock."""
     import numpy as np
@@ -71,9 +84,6 @@ def drive_multi_client(
     from ..utils import audit as audit_mod
     from ..utils.errors import OracleBusyError
     from .scenarios import tenant_oracle_stream
-
-    host, _, port = addr.rpartition(":")
-    host = host or "127.0.0.1"
 
     def digest(resp) -> str:
         return audit_mod.plan_digest(
@@ -102,14 +112,23 @@ def drive_multi_client(
     out: Dict[str, Dict] = {
         t: {"digests": [], "waits": [], "busy": 0} for t in labels
     }
+    def _client_kwargs() -> Dict:
+        # callable values are invoked PER CLIENT — a CircuitBreaker is
+        # stateful, so the failover gate passes a factory rather than
+        # sharing one instance across every tenant's connection
+        return {
+            k: (v() if callable(v) else v)
+            for k, v in (client_kwargs or {}).items()
+        }
+
     conns = {
         t: ResilientOracleClient(
-            host, int(port), deadline_ms=deadline_ms, name=t
+            addr, deadline_ms=deadline_ms, name=t, **_client_kwargs()
         )
         for t in labels
     }
 
-    def run_one(tenant: str, req) -> None:
+    def run_one(tenant: str, req, index: int = 0) -> None:
         t0 = time.perf_counter()
         try:
             resp = conns[tenant].schedule(req, tenant=tenant)
@@ -120,14 +139,16 @@ def drive_multi_client(
             return
         out[tenant]["waits"].append(time.perf_counter() - t0)
         out[tenant]["digests"].append(digest(resp))
+        if on_batch is not None:
+            on_batch(tenant, index)
 
     wall0 = time.perf_counter()
     if concurrent:
         import threading
 
         def run_tenant(tenant: str) -> None:
-            for req in streams[tenant]:
-                run_one(tenant, req)
+            for i, req in enumerate(streams[tenant]):
+                run_one(tenant, req, i)
 
         threads = [
             threading.Thread(
@@ -153,7 +174,7 @@ def drive_multi_client(
                 if i >= len(streams[t]):
                     live.discard(t)
                     continue
-                run_one(t, streams[t][i])
+                run_one(t, streams[t][i], i)
                 cursors[t] = i + 1
     wall = time.perf_counter() - wall0
     for conn in conns.values():

@@ -84,3 +84,23 @@ class OracleBusyError(RuntimeError):
     def __init__(self, message: str, retry_after_ms: int = 100):
         super().__init__(message)
         self.retry_after_ms = int(retry_after_ms)
+
+
+class OracleDrainingError(RuntimeError):
+    """The sidecar answered a DRAINING frame: it received SIGTERM (or
+    ``/debug/drain``) and is finishing its in-flight window before exit
+    (docs/resilience.md "High availability") — the request was NOT
+    executed and nothing server-side changed. An in-band answer over a
+    live transport: NEVER advances the breaker. A pooled client treats it
+    as the proactive-failover signal — promote the standby and re-issue
+    there (delta cursors re-keyframe via the ordinary DELTA_RESYNC
+    machinery); a single-address client surfaces it after the retry
+    budget, like exhausted transport retries but with a truthful cause.
+    ``failover_hint`` carries the server's standby address list when the
+    operator supplied one."""
+
+    def __init__(self, message: str, retry_after_ms: int = 100,
+                 failover_hint: str = ""):
+        super().__init__(message)
+        self.retry_after_ms = int(retry_after_ms)
+        self.failover_hint = failover_hint

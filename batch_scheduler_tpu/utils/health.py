@@ -648,6 +648,45 @@ class HealthModel:
                 "reason": reason,
             }
 
+        # -- oracle failover (pooled ResilientOracleClient) ------------------
+        # which backend each pooled client is serving from, how fresh the
+        # standby is, and the promotions inside the rolling window. A
+        # recent promotion WARNS (the fleet is on its standby — restore
+        # redundancy), it does not breach: traffic is still being served,
+        # which is the whole point of the pool. Lazy import — health must
+        # evaluate before the service layer ever loads.
+        try:
+            from ..service.client import active_failover_report
+
+            failover = active_failover_report()
+        except Exception:  # noqa: BLE001 — health must always answer
+            failover = None
+        if failover is not None and failover.get("clients"):
+            recent = [
+                {**p, "client": c["client"]}
+                for c in failover["clients"]
+                for p in c.get("promotions", [])
+                if p.get("ago_s", window + 1) <= window
+            ]
+            verdict = "warn" if recent else "ok"
+            with self._lock:
+                self._note_transition("failover", verdict)
+            signals["failover"] = {
+                "kind": "state",
+                "verdict": verdict,
+                "promotions_in_window": len(recent),
+                "clients": failover["clients"],
+                "reason": (
+                    "standby promotion(s) in window: "
+                    + ", ".join(
+                        f"{p['client']} -> backend {p['to']} "
+                        f"({p['reason']}, {p['ago_s']:.0f}s ago)"
+                        for p in recent[:4]
+                    )
+                    if recent else ""
+                ),
+            }
+
         return {
             "verdict": worst(s["verdict"] for s in signals.values()),
             "ts": now,

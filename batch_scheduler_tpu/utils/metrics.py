@@ -315,6 +315,12 @@ DEBUG_ENDPOINTS = {
                        "utilization/headroom spectra, fragmentation, "
                        "stranded capacity, seat tightness, tenant "
                        "shares; ?points=K trims the series",
+    "/debug/drain": "?go=1 gracefully drains every in-process "
+                    "OracleServer (stop admitting, finish the in-flight "
+                    "window, flush ledgers; docs/resilience.md \"High "
+                    "availability\") and answers the drain reports — the "
+                    "HTTP face of SIGTERM, idempotent; bare GET reports "
+                    "drain state only",
 }
 
 
@@ -471,6 +477,36 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             q = parse_qs(urlparse(self.path).query)
             params = {k: v[0] for k, v in q.items() if v}
             payload, status = capacity_debug_view(params)
+            body = json.dumps(payload, default=str).encode()
+            ctype = "application/json"
+        elif path == "/debug/drain":
+            # graceful drain over HTTP (the act-via-query precedent is
+            # /debug/profile?seconds=N): ?go=1 drains every live
+            # in-process OracleServer and answers the reports — the HTTP
+            # face of SIGTERM; idempotent (a second call waits on the
+            # first drain and returns the same report). A bare GET only
+            # reports drain state, so probes walking the index never
+            # drain anything. The process is NOT exited here; the
+            # operator (or the SIGTERM path) owns process lifetime.
+            import json
+            from urllib.parse import parse_qs, urlparse
+
+            from ..service.server import active_servers
+
+            q = parse_qs(urlparse(self.path).query)
+            servers = active_servers()
+            if (q.get("go") or ["0"])[0] in ("1", "true", "yes"):
+                payload = {
+                    "ok": True,
+                    "servers": len(servers),
+                    "reports": [s.drain() for s in servers],
+                }
+            else:
+                payload = {
+                    "ok": True,
+                    "servers": len(servers),
+                    "draining": [s.draining() for s in servers],
+                }
             body = json.dumps(payload, default=str).encode()
             ctype = "application/json"
         elif path in ("/debug", "/debug/"):

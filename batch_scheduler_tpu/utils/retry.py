@@ -6,9 +6,14 @@ treat that link as a thing that fails. This module holds the two reusable
 policies the service client composes:
 
 - :class:`RetryPolicy` — bounded attempts with exponential backoff and
-  FULL jitter (delay drawn uniformly from [0, cap]): under a fleet-wide
-  sidecar restart, full jitter de-synchronises the retry herd where
-  equal-jitter would re-align it.
+  DECORRELATED jitter (first delay drawn uniformly from [0, cap]; each
+  later delay from [base, 3*prev], capped at max_delay): under a
+  fleet-wide sidecar crash every client starts its retry chain at the
+  same instant, and full jitter alone re-correlates the herd around the
+  shared exponential envelope — decorrelating each draw on the client's
+  OWN previous delay spreads the reconnect stampede the standby would
+  otherwise absorb as one thundering wave (the HA failover concern,
+  docs/resilience.md "High availability").
 - :class:`CircuitBreaker` — closed -> open after N consecutive failures,
   open -> half-open after a cooldown, half-open -> closed on a successful
   probe (or back to open on a failed one). While open, callers fail fast
@@ -35,11 +40,18 @@ __all__ = ["RetryPolicy", "CircuitBreaker"]
 
 @dataclass(frozen=True)
 class RetryPolicy:
-    """Bounded retries with exponential backoff + full jitter.
+    """Bounded retries with exponential backoff + decorrelated jitter.
 
     ``max_attempts`` counts the first try: 4 means one initial attempt and
     up to three retries. ``backoff(i)`` returns the sleep before retry
-    ``i`` (0-based): uniform in [0, min(max_delay, base * multiplier^i)].
+    ``i`` (0-based): with no ``prev`` (the chain's first draw, or a
+    stateless caller) uniform in [0, min(max_delay, base * multiplier^i)]
+    — full jitter; with ``prev`` (the previous delay in this retry chain)
+    the decorrelated draw uniform in [base, 3 * prev], capped at
+    ``max_delay``. Two clients whose chains start identically diverge on
+    their first draws and then *stay* diverged — each delay feeds the
+    next draw's range — where per-index full jitter would keep re-sampling
+    the same envelope in lockstep.
     """
 
     max_attempts: int = 4
@@ -47,9 +59,19 @@ class RetryPolicy:
     max_delay: float = 2.0
     multiplier: float = 2.0
 
-    def backoff(self, retry_index: int, rng: Optional[random.Random] = None) -> float:
+    def backoff(
+        self,
+        retry_index: int,
+        rng: Optional[random.Random] = None,
+        prev: Optional[float] = None,
+    ) -> float:
+        r = rng or random
+        if prev is not None:
+            lo = self.base_delay
+            hi = max(3.0 * prev, lo)
+            return min(self.max_delay, r.uniform(lo, hi))
         cap = min(self.max_delay, self.base_delay * self.multiplier ** max(retry_index, 0))
-        return (rng or random).uniform(0.0, cap)
+        return r.uniform(0.0, cap)
 
     def call(
         self,
@@ -61,7 +83,11 @@ class RetryPolicy:
     ):
         """Run ``fn()`` under this policy. ``no_retry`` wins over
         ``retry_on``; ``on_retry(retry_index, exc, delay)`` observes each
-        retry. The last failure is re-raised unwrapped."""
+        retry. The last failure is re-raised unwrapped. Each retry's
+        delay decorrelates on the previous one (``backoff(prev=...)``) —
+        the chain state lives here, per call, so the frozen policy stays
+        shareable across threads."""
+        prev = None
         for attempt in range(self.max_attempts):
             try:
                 return fn()
@@ -70,7 +96,8 @@ class RetryPolicy:
             except retry_on as e:
                 if attempt == self.max_attempts - 1:
                     raise
-                delay = self.backoff(attempt)
+                delay = self.backoff(attempt, prev=prev)
+                prev = delay
                 if on_retry is not None:
                     on_retry(attempt, e, delay)
                 sleep(delay)

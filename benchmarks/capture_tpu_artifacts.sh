@@ -202,6 +202,23 @@ else
     echo "coalesce gate failed:"; tail -4 /tmp/coalesce_gate.out; fail=1
 fi
 
+echo "== sidecar HA failover gate on hardware (FAILOVER_${TAG}) =="
+# the bench-failover crash drills on the real backend: graceful drain +
+# ChaosProxy kill of the primary with digest identity vs an
+# uninterrupted control, bounded time-to-recovery, truthful
+# breaker/failover metrics. On sharded-mesh hosts the compile warmer is
+# ineligible (single eligibility rule, ops/bucketing.py) so the warmth
+# assertion self-skips and rides the CPU CI gate
+# (docs/resilience.md "High availability").
+if BST_FAILOVER_GATE_PLATFORM=default timeout 900 \
+        python benchmarks/failover_gate.py "FAILOVER_${TAG}.json" \
+        > /tmp/failover_gate.out 2>&1; then
+    echo "failover gate captured: FAILOVER_${TAG}.json"
+    tail -1 /tmp/failover_gate.out
+else
+    echo "failover gate failed:"; tail -4 /tmp/failover_gate.out; fail=1
+fi
+
 echo "== policy gate on hardware (zero-policy identity + preempt-pass cost) =="
 # the bench-policy gate on the real backend: zero-policy plans must stay
 # bit-identical to the pre-policy scan on the hardware rungs, the policy

@@ -35,6 +35,31 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache for the suite itself — the serving
+# entry points' BST_COMPILATION_CACHE_DIR discipline (cmd/main.py
+# _enable_compilation_cache) applied to tests: the suite compiles
+# hundreds of oracle bucket shapes and the unrolled assignment scan is
+# expensive to BUILD, so re-runs on the same machine should pay XLA once.
+# Results are bit-identical (the cache stores the compiled module keyed
+# by HLO + flags); python-side compile accounting (jit cache-size deltas
+# feeding the "compiled" telemetry flag, warmer hit/miss, the compile
+# ledger) is unaffected — tracing still happens, only the XLA backend
+# build is served from disk. Cached under /tmp, NOT the user's
+# ~/.cache serving dir (the BST_COMPILE_LEDGER rule: tests must not
+# pollute cross-run serving caches). Same opt-out values as the serving
+# knob: BST_COMPILATION_CACHE_DIR=off/0/empty disables.
+_test_cache = os.environ.get(
+    "BST_COMPILATION_CACHE_DIR", "/tmp/bst-test-xla-cache"
+)
+if _test_cache.strip().lower() not in ("", "0", "off"):
+    try:
+        os.makedirs(_test_cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _test_cache)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # cache is an optimization only, never block tests
+        pass
+
 
 def pytest_configure(config):
     # the tier-1 gate runs `-m 'not slow'`: slow marks the compile-heavy

@@ -479,3 +479,229 @@ def test_wire_delta_survives_dropped_and_duplicated_frames(server):
 
     remote.close()
     chaos.stop()
+
+
+# -- warm-standby failover drills (docs/resilience.md "High availability") --
+
+
+def _pooled(spec, registry, attempts=6, timeout=2.0, reset_timeout=5.0,
+            name=None):
+    """The HA drills' tuned pool client: the breaker trips on the second
+    transport error, so a crash promotes within one schedule() call."""
+    return ResilientOracleClient(
+        spec,
+        timeout=timeout,
+        registry=registry,
+        name=name,
+        retry_policy=RetryPolicy(
+            max_attempts=attempts, base_delay=0.01, max_delay=0.05
+        ),
+        breaker=CircuitBreaker(
+            failure_threshold=2, reset_timeout=reset_timeout
+        ),
+    )
+
+
+def test_kill_mid_delta_stream_fails_over_and_resyncs(server):
+    """Primary killed mid-delta-stream: the pooled client must trip the
+    breaker, promote to the standby, land the retried delta request on a
+    sidecar with NO device mirror — which answers DELTA_RESYNC — resync
+    through a full keyframe, and keep every published plan bit-identical
+    to an independent full-repack scorer. The cursor survives failover
+    by re-keyframing, never by silently applying deltas to the wrong
+    mirror."""
+    standby = serve_background()
+    chaos = ChaosProxy(*server.address)
+    reg = Registry()
+    client = _pooled(
+        "%s:%s,%s:%s" % (chaos.address + standby.address), reg
+    )
+    remote = RemoteScorer(client, fallback="deny")
+    assert remote._wire_delta_ok
+    cluster, cache, gang_names, nodes, reference = _delta_world()
+
+    def refresh_and_compare():
+        for s in (remote, reference):
+            s.mark_dirty()
+            s.ensure_fresh(cluster, cache, group=gang_names[0])
+        for full_name in gang_names:
+            assert remote.placed(full_name) == reference.placed(full_name)
+            assert remote.gang_feasible(
+                full_name
+            ) == reference.gang_feasible(full_name)
+            assert remote.assignment(full_name) == reference.assignment(
+                full_name
+            )
+
+    resyncs = DEFAULT_REGISTRY.counter("bst_oracle_wire_delta_resyncs_total")
+    kinds = DEFAULT_REGISTRY.counter("bst_oracle_wire_delta_batches_total")
+    try:
+        # healthy baseline on the primary: keyframe, then a delta
+        refresh_and_compare()
+        cluster.bind(make_pod("pre-kill-filler", requests={"cpu": "2"}), "n0")
+        refresh_and_compare()
+        primary_addr = client.active_address
+
+        # the crash: every primary connection RSTs, new dials refused
+        chaos.kill_endpoint()
+        resyncs_before = resyncs.value()
+        keyframes_before = kinds.value(kind="keyframe")
+        cluster.bind(make_pod("kill-filler", requests={"cpu": "2"}), "n1")
+        refresh_and_compare()
+
+        # promoted, resynced through a keyframe, plans exact
+        assert client.active_address != primary_addr
+        assert client.active_address == standby.address
+        assert resyncs.value() >= resyncs_before + 1
+        assert kinds.value(kind="keyframe") >= keyframes_before + 1
+        failovers = reg.counter("bst_oracle_failover_total")
+        pool_label = "%s:%s,%s:%s" % (chaos.address + standby.address)
+        assert failovers.value(reason="crash", client=pool_label) >= 1
+
+        # steady state on the standby: churn rides deltas again
+        cluster.bind(make_pod("post-kill-filler", requests={"cpu": "2"}), "n2")
+        deltas_before = kinds.value(kind="delta")
+        refresh_and_compare()
+        assert kinds.value(kind="delta") == deltas_before + 1
+    finally:
+        remote.close()
+        chaos.stop()
+        standby.shutdown()
+        standby.server_close()
+
+
+def test_draining_during_coalesced_mega_batch():
+    """DRAINING lands while a coalesced mega-batch is in flight: the
+    admitted group must finish (drain waits out the in-flight window —
+    zero client-visible errors), and every tenant's NEXT batch promotes
+    to the standby. The coalescer is flushed as part of the drain's
+    producer-before-join order, so no merged group is lost half-applied."""
+    import threading
+
+    from batch_scheduler_tpu.service.client import active_failover_report
+    from batch_scheduler_tpu.service.coalescer import OracleCoalescer
+    from batch_scheduler_tpu.service.server import _capacity_tenant_shares
+
+    primary = serve_background(coalesce=True)
+    primary.scan_mesh = None
+    primary.executor.scan_mesh = None
+    if primary.coalescer is None:
+        primary.coalescer = OracleCoalescer(
+            primary.executor, weights_fn=_capacity_tenant_shares
+        )
+    primary.coalescer.mode = "mega"
+    standby = serve_background()
+    spec = "%s:%s,%s:%s" % (primary.address + standby.address)
+    reg = Registry()
+    tenants = [f"ha-t{i}" for i in range(4)]
+    clients = {
+        t: _pooled(spec, reg, timeout=30.0, name=t) for t in tenants
+    }
+    results = {t: [] for t in tenants}
+    errors = []
+    barrier = threading.Barrier(len(tenants))
+    drained = threading.Event()
+
+    def run(tenant):
+        try:
+            for i in range(3):
+                barrier.wait(timeout=30)
+                if tenant == tenants[0] and i == 1 and not drained.is_set():
+                    # fire the drain while every tenant's batch i=1 is
+                    # in flight (or queued in the coalescer)
+                    drained.set()
+                    threading.Thread(
+                        target=lambda: primary.drain(timeout=15.0),
+                        daemon=True,
+                    ).start()
+                resp = clients[tenant].schedule(_request(), tenant=tenant)
+                results[tenant].append(np.asarray(resp.placed).copy())
+        except Exception as e:  # noqa: BLE001 — collected, asserted empty
+            errors.append((tenant, repr(e)))
+
+    threads = [
+        threading.Thread(target=run, args=(t,), daemon=True)
+        for t in tenants
+    ]
+    try:
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=120)
+            assert not th.is_alive()
+        # zero client-visible errors and zero lost batches
+        assert errors == []
+        for t in tenants:
+            assert len(results[t]) == 3
+            for placed in results[t]:
+                assert placed.all()
+        # every tenant promoted off the draining primary
+        for t in tenants:
+            assert clients[t].active_address == standby.address, t
+        report = primary.drain()  # idempotent: returns the first report
+        assert report["drained"] is True
+        assert report["audit_flushed"] is True
+        rows = {
+            c["client"]: c
+            for c in active_failover_report()["clients"]
+        }
+        for t in tenants:
+            reasons = {p["reason"] for p in rows[t]["promotions"]}
+            assert "drain" in reasons, (t, rows[t])
+    finally:
+        for c in clients.values():
+            c.close()
+        primary.shutdown()
+        primary.server_close()
+        standby.shutdown()
+        standby.server_close()
+
+
+def test_failover_races_half_open_probe(server):
+    """Promotion interleaved with the breaker's half-open lifecycle: the
+    client crashes off the primary, then — when the standby dies after
+    the primary's cooldown has elapsed — promotes BACK onto the primary
+    through its half-open probe slot. The successful probe closes the
+    breaker; the request is served, not refused."""
+    standby = serve_background()
+    chaos_primary = ChaosProxy(*server.address)
+    chaos_standby = ChaosProxy(*standby.address)
+    reg = Registry()
+    client = _pooled(
+        "%s:%s,%s:%s" % (chaos_primary.address + chaos_standby.address),
+        reg,
+        reset_timeout=0.3,
+    )
+    primary_addr = tuple(chaos_primary.address)
+    standby_addr = tuple(chaos_standby.address)
+    try:
+        assert client.schedule(_request()).placed.all()
+        assert client.active_address == primary_addr
+
+        # crash the primary: trip, promote, serve from the standby
+        chaos_primary.kill_endpoint()
+        assert client.schedule(_request()).placed.all()
+        assert client.active_address == standby_addr
+        assert client._breakers[0].state == "open"
+
+        # primary heals; its cooldown elapses (half-open probe eligible)
+        chaos_primary.restore_endpoint()
+        time.sleep(0.35)
+
+        # the standby dies exactly when the primary's breaker is waiting
+        # on its half-open probe: promotion must route the request back
+        # through that probe slot and close the breaker on success
+        chaos_standby.kill_endpoint()
+        assert client.schedule(_request()).placed.all()
+        assert client.active_address == primary_addr
+        assert client._breakers[0].state == "closed"
+        assert client._breakers[1].state == "open"
+        # both hops are in the promotion history, both as crashes
+        hops = [(reason, to) for _ts, reason, to in client._promotions]
+        assert hops == [("crash", 1), ("crash", 0)]
+    finally:
+        client.close()
+        chaos_primary.stop()
+        chaos_standby.stop()
+        standby.shutdown()
+        standby.server_close()

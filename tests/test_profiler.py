@@ -313,3 +313,75 @@ def test_scorer_drain_joins_warmer_and_telemetry_threads():
         assert oracle.drain_background(timeout=30.0) is True
     finally:
         cluster.stop()
+
+
+def test_serve_sigterm_drains_and_exits_cleanly():
+    """The SIGTERM graceful-drain path (docs/resilience.md "High
+    availability"): a live sidecar that has served traffic must, on
+    SIGTERM, finish the in-flight window, flush warmer -> executor ->
+    telemetry -> audit in producer-before-join order, print the drain
+    report, and exit 0 with no interpreter-teardown abort — in a real
+    subprocess, because both the signal handler and the exit-abort only
+    exist there."""
+    import re
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        BST_BUCKET_COST="0",
+        BST_COMPILE_LEDGER="off",
+        BST_CAPACITY="0",
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-u", "-m", "batch_scheduler_tpu", "serve",
+            "--port", "0", "--compile-warmer",
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+    )
+    try:
+        # wait for the bound port announcement
+        line = ""
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if "listening on" in line:
+                break
+            assert proc.poll() is None, proc.stderr.read()[-2000:]
+        m = re.search(r"listening on ([\d.]+):(\d+)", line)
+        assert m, f"no listening line: {line!r}"
+        host, port = m.group(1), int(m.group(2))
+
+        # serve one real batch so drain has ledgers/threads to flush
+        from batch_scheduler_tpu.service.client import OracleClient
+        from batch_scheduler_tpu.sim.scenarios import tenant_oracle_stream
+
+        req = tenant_oracle_stream(0, 1, nodes=16, gangs=4)[0]
+        client = OracleClient(host, port, timeout=120.0)
+        resp = client.schedule(req, tenant="drainer")
+        assert resp.placed.shape[0] > 0
+        client.close()
+
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=120)
+    except BaseException:
+        proc.kill()
+        proc.communicate(timeout=30)
+        raise
+    assert proc.returncode == 0, (out[-2000:], err[-2000:])
+    assert "SIGTERM: draining oracle sidecar" in out
+    m = re.search(r"drain complete: (\{.*\})", out)
+    assert m, out[-2000:]
+    report = json.loads(m.group(1))
+    assert report["drained"] is True
+    assert report["telemetry_joined"] is True
+    assert report["audit_flushed"] is True
+    assert "terminate called" not in err
+    assert "Aborted" not in err

@@ -1,5 +1,6 @@
-"""Unit tests for utils.retry: full-jitter backoff bounds, the reusable
-RetryPolicy.call driver, and the CircuitBreaker state machine (the pieces
+"""Unit tests for utils.retry: backoff bounds (full jitter on the first
+draw, decorrelated jitter down the chain), the reusable RetryPolicy.call
+driver, and the CircuitBreaker state machine (the pieces
 ResilientOracleClient composes; docs/resilience.md)."""
 
 import random
@@ -20,6 +21,69 @@ def test_backoff_full_jitter_bounds():
     # the draw actually spreads (full jitter, not equal-jitter floor)
     draws = [policy.backoff(3, rng=rng) for _ in range(200)]
     assert min(draws) < 0.2 and max(draws) > 0.6
+
+
+def test_backoff_decorrelated_bounds():
+    policy = RetryPolicy(base_delay=0.1, max_delay=1.0, multiplier=2.0)
+    rng = random.Random(7)
+    prev = policy.backoff(0, rng=rng)
+    for i in range(1, 12):
+        d = policy.backoff(i, rng=rng, prev=prev)
+        lo = policy.base_delay
+        hi = min(policy.max_delay, max(3.0 * prev, lo))
+        assert lo <= d <= hi or d == policy.max_delay, (i, d, prev)
+        assert d <= policy.max_delay
+        prev = d
+    # a tiny prev never collapses the range below base_delay
+    d = policy.backoff(1, rng=rng, prev=1e-6)
+    assert policy.base_delay <= d <= policy.max_delay
+
+
+def test_decorrelated_chains_desynchronize():
+    """The HA stampede claim: two clients that start their retry chains
+    at the same instant diverge on the first draw and STAY diverged —
+    each delay feeds the next draw's range, so the chains' cumulative
+    wakeup times separate instead of re-correlating around the shared
+    exponential envelope."""
+    policy = RetryPolicy(base_delay=0.05, max_delay=30.0)
+
+    def chain(seed, n=8):
+        rng = random.Random(seed)
+        delays = []
+        prev = None
+        for i in range(n):
+            d = policy.backoff(i, rng=rng, prev=prev)
+            delays.append(d)
+            prev = d
+        return delays
+
+    a, b = chain(1), chain(2)
+    assert a != b
+    # cumulative wakeup instants separate measurably, not by epsilon
+    wake_a = sum(a)
+    wake_b = sum(b)
+    assert abs(wake_a - wake_b) > policy.base_delay
+    # determinism: the same seed replays the same chain
+    assert chain(1) == a
+
+
+def test_call_threads_prev_through_chain():
+    """RetryPolicy.call feeds each delay into the next draw (the
+    decorrelated recurrence), so every observed sleep after the first
+    lies in [base, min(max_delay, 3*prev)]."""
+    sleeps = []
+    policy = RetryPolicy(max_attempts=8, base_delay=0.01, max_delay=5.0)
+
+    def always():
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        policy.call(always, retry_on=(OSError,), sleep=sleeps.append)
+    assert len(sleeps) == policy.max_attempts - 1
+    for prev, d in zip(sleeps, sleeps[1:]):
+        assert policy.base_delay <= d <= min(
+            policy.max_delay, max(3.0 * prev, policy.base_delay)
+        ), (prev, d)
 
 
 def test_call_retries_then_succeeds():

@@ -428,3 +428,58 @@ def test_remote_scorer_dual_connection_background_refresh(server):
     assert scorer._bg_error is None
     scorer.drain_background()
     scorer.close()
+
+
+def test_draining_frame_roundtrip():
+    """DRAINING (MsgType 18) shares the BUSY payload layout plus a UTF-8
+    failover hint; the hint may be empty (no standby configured)."""
+    ms, hint = proto.unpack_draining(
+        proto.pack_draining(250, "10.0.0.2:9090")
+    )
+    assert ms == 250 and hint == "10.0.0.2:9090"
+    ms, hint = proto.unpack_draining(proto.pack_draining(100))
+    assert ms == 100 and hint == ""
+
+
+def test_parse_oracle_addresses():
+    from batch_scheduler_tpu.service.client import parse_oracle_addresses
+
+    assert parse_oracle_addresses("h1:9090,h2:9191") == [
+        ("h1", 9090), ("h2", 9191),
+    ]
+    # bare ports keep the historical --oracle-addr sugar
+    assert parse_oracle_addresses("9090") == [("127.0.0.1", 9090)]
+    assert parse_oracle_addresses(":9090, h2:91 ,") == [
+        ("127.0.0.1", 9090), ("h2", 91),
+    ]
+    with pytest.raises(ValueError):
+        parse_oracle_addresses("")
+    with pytest.raises(ValueError):
+        parse_oracle_addresses("h1:notaport")
+
+
+def test_drain_refuses_work_keeps_ping_and_reports_flush():
+    """A draining sidecar answers DRAINING (with the failover hint) to
+    work requests, keeps PING flowing (half-open probes must succeed so
+    clients can see the DRAINING answer), and reports a clean flush."""
+    srv = serve_background()
+    host, port = srv.address
+    client = OracleClient(host, port)
+    try:
+        assert client.schedule(_request()).placed.all()
+        report = srv.drain(timeout=5.0, failover_hint="standby:1234")
+        assert report["drained"] is True
+        assert report["telemetry_joined"] is True
+        assert report["audit_flushed"] is True
+        assert srv.draining() is True
+        assert client.ping()  # probes still flow
+        with pytest.raises(errs.OracleDrainingError) as ei:
+            client.schedule(_request())
+        assert ei.value.failover_hint == "standby:1234"
+        assert ei.value.retry_after_ms > 0
+        # idempotent: a second drain returns the same report
+        assert srv.drain(timeout=5.0)["drained"] is True
+    finally:
+        client.close()
+        srv.shutdown()
+        srv.server_close()
