@@ -639,6 +639,21 @@ class Observatory:
         out["gang"] = gang
         out["batch"] = oracle.batches_run
         out["degraded"] = bool(getattr(oracle, "degraded", False))
+        # refresh provenance (docs/pipelining.md "Snapshot-lite & event
+        # ingest"): which path built the serving batch's inputs — a full
+        # scan or an event fold — and at what pack generation. The
+        # breakdown above reads the snapshot's HOST arrays (device_args),
+        # which the device-derived fit/order columns equal byte-for-byte
+        # by construction, so recorded_agrees below is unaffected by the
+        # derivation path.
+        delta = getattr(snap, "delta", None)
+        if delta is not None:
+            out["refresh"] = {
+                "generation": int(delta.generation),
+                "kind": delta.kind,
+                "reason": delta.reason,
+                "source": getattr(delta, "source", "scan"),
+            }
         # the recorded-blame count: PreFilter's denial records carry the
         # capacity-row feasible-node count, which is the INDEPENDENT
         # count by construction (both read cap vs the batch-head leftover)

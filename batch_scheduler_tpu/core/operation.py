@@ -272,10 +272,29 @@ class ScheduleOperation:
     # scorer lifecycle
     # ------------------------------------------------------------------
 
-    def mark_dirty(self) -> None:
-        """Invalidate the oracle batch (cluster or gang state changed)."""
+    def mark_dirty(self, group: Optional[str] = None) -> None:
+        """Invalidate the oracle batch (cluster or gang state changed).
+
+        ``group`` attributes the invalidation to ONE gang's demand row so
+        the scorer's event-fold refresh stays O(churn) (ops.events): the
+        named row is re-read at the next pack instead of the whole
+        cluster. ``None`` is a blind mark — the next refresh falls back
+        to the full scan, which is always correct. Callers must pass a
+        group ONLY when the gang row is the sole oracle-visible state
+        they changed outside the evented cluster mutators."""
         if self.oracle is not None:
-            self.oracle.mark_dirty()
+            self.oracle.mark_dirty(group)
+
+    def _gang_event(self, full_name: str) -> None:
+        """Note a gang-row change WITHOUT invalidating the batch — the
+        plan-covered permit/bind paths pre-account their capacity
+        (credit_expected_change), so the batch stays servable; but the
+        next refresh, whenever something else triggers it, must re-read
+        this gang's progress row rather than fold it as unchanged."""
+        if self.oracle is not None:
+            note = getattr(self.oracle, "note_group_event", None)
+            if note is not None:
+                note(full_name)
 
     def _oracle_fresh(self, group: Optional[str] = None) -> OracleScorer:
         self.oracle.ensure_fresh(self.cluster, self.status_cache, group)
@@ -558,6 +577,9 @@ class ScheduleOperation:
         # through the gang's plan (the bulk form of on_assume's credit)
         if self.oracle is not None:
             self.oracle.credit_expected_change(len(members))
+        # the gang's progress row (phase, released flag) moved outside the
+        # evented cluster mutators — note it for the next event fold
+        self._gang_event(full_name)
         self.pending_tracker.note_placed(full_name)
         return True
 
@@ -638,8 +660,15 @@ class ScheduleOperation:
                 self.pg_client.podgroups(ns).patch_many(patches)
             except Exception:
                 pass  # controller reconciliation recovers the phase
+        # every touched gang's progress row moved (binds_committed /
+        # scheduled / phase / dropped plan) — name them all so the next
+        # event fold re-reads exactly these rows, then invalidate once
+        # per flush (not per gang) when any gang completed
+        touched = [full_name for full_name, bound in items if bound > 0]
+        for full_name in touched:
+            self._gang_event(full_name)
         if completed_any:
-            self.mark_dirty()
+            self.mark_dirty(group=touched[0] if touched else None)
 
     def on_assume(
         self, pod: Pod, node_name: str, from_plan: bool = False
@@ -653,21 +682,28 @@ class ScheduleOperation:
         the slot bookkeeping may not match), and placements against a
         superseded batch's plan — dirties the batch, since its per-node
         rows now diverge from reality (ADVICE r2)."""
-        if self.scorer_kind == "oracle" and self.oracle is not None and from_plan:
-            pg_name, ok = pod_group_name(pod)
-            if ok:
-                pgs = self.status_cache.get(
-                    f"{pod.metadata.namespace}/{pg_name}"
-                )
-                if (
-                    pgs is not None
-                    and pgs.placement_plan is not None
-                    and node_name in pgs.placement_plan
-                    and pgs.plan_batch_seq == self.oracle.batches_run
-                ):
-                    self.oracle.credit_expected_change(1)
-                    return
-        self.mark_dirty()
+        pg_name, ok = pod_group_name(pod)
+        full_name = f"{pod.metadata.namespace}/{pg_name}" if ok else None
+        if (
+            self.scorer_kind == "oracle"
+            and self.oracle is not None
+            and from_plan
+            and ok
+        ):
+            pgs = self.status_cache.get(full_name)
+            if (
+                pgs is not None
+                and pgs.placement_plan is not None
+                and node_name in pgs.placement_plan
+                and pgs.plan_batch_seq == self.oracle.batches_run
+            ):
+                self.oracle.credit_expected_change(1)
+                return
+        # the node-row change itself is already evented by the cluster
+        # mutator (ClusterState.assume); a known gang name keeps the
+        # conservative invalidation attributed so the next refresh can
+        # still fold instead of scanning. Non-gang pods stay blind.
+        self.mark_dirty(group=full_name)
 
     # ------------------------------------------------------------------
     # Filter (reference core.go:170-191,514-564)
@@ -824,7 +860,9 @@ class ScheduleOperation:
                 )
             except Exception:  # noqa: BLE001 — controller reconciles
                 pass
-        self.mark_dirty()
+        # the member deletions rode the evented cluster mutators; the
+        # gang-row reset above is the only out-of-band change — name it
+        self.mark_dirty(group=full_name)
 
     def forget_denied(self, full_name: str) -> None:
         """Drop a gang's deny-cache entry (a successful preemption freed
@@ -990,7 +1028,11 @@ class ScheduleOperation:
             # assignment already placed every remaining member, so a member
             # matching only *reduces* future demand (conservative to serve
             # from the existing batch).
-            self.mark_dirty()
+            self.mark_dirty(group=full_name)
+        else:
+            # plan-covered: no invalidation, but the matched count moved —
+            # the next fold must re-read this gang's progress row
+            self._gang_event(full_name)
 
         matched = len(pgs.matched_pod_nodes.items())
         if matched >= pg.spec.min_member - pg.status.scheduled:
@@ -1061,7 +1103,11 @@ class ScheduleOperation:
             or self.scorer_kind != "oracle"
             or pgs.placement_plan is None
         ):
-            self.mark_dirty()
+            self.mark_dirty(group=full_name)
+        else:
+            # plan-covered, quorum not yet met: binds_committed/scheduled
+            # advanced — name the row for the next event fold
+            self._gang_event(full_name)
 
     # ------------------------------------------------------------------
     # Queue ordering (reference core.go:368-411)
@@ -1192,10 +1238,10 @@ class ScheduleOperation:
                 or pod.spec.tolerations
                 or pgs.pod_group.spec.min_resources is None
             ):
-                self.mark_dirty()
+                self.mark_dirty(group=pgs.pod_group.full_name())
         if pgs.pod_group.spec.min_resources is None:
             pgs.pod_group.spec.min_resources = pod.resource_require()
-            self.mark_dirty()
+            self.mark_dirty(group=pgs.pod_group.full_name())
         occupied = pgs.pod_group.status.occupied_by
         if not occupied:
             if refs:

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional
 
 import jax
@@ -469,6 +470,29 @@ class OracleScorer:
         self.policy_engine = policy_engine
         self._packer = DeltaSnapshotPacker(policy_engine=policy_engine)
         self._schema = None
+        # Event-sourced refresh (ops.events, docs/pipelining.md
+        # "Snapshot-lite & event ingest"): informer/bind/permit mutations
+        # append entity NAMES to a bounded host event log (wired lazily
+        # to the cluster's subscribe_events on first pack), and an
+        # eligible refresh folds just the named entities into the
+        # packer's persistent buffers (pack_fold) instead of re-reading
+        # every node/group — steady-state refresh cost O(churn). The
+        # wiring state below moves only under _refresh_lock (packs
+        # serialize); the LOG REFERENCE itself is written once under that
+        # lock and read WITHOUT it by producers (mark_dirty /
+        # note_group_event run on scheduling threads and must never block
+        # behind a refresh in flight) — benign: the EventLog is
+        # internally locked, and a producer racing the wiring at worst
+        # misses the log, which the version-bump accounting catches as a
+        # skew (scan fallback), never a stale fold.
+        self._event_log = None  # racy-read by design (see above)
+        self._event_cluster_ref = None  # guarded-by: _refresh_lock
+        self._event_cache_ref = None  # guarded-by: _refresh_lock
+        # completeness baselines recorded at every pack: the cluster
+        # version and status-cache mutation counter the NEXT fold must
+        # reconcile against (None -> the fold cannot prove coverage)
+        self._fold_version = None  # guarded-by: _refresh_lock
+        self._fold_mut_base = None  # guarded-by: _refresh_lock
         # Device-resident cluster state (ops.device_state, docs/
         # pipelining.md "Device-resident state"): the packed [N,R]/[G,R]
         # buffers stay committed on device across batches and each pack's
@@ -562,11 +586,33 @@ class OracleScorer:
         else:
             self._identity = None
 
-    def mark_dirty(self) -> None:
+    def mark_dirty(self, group: Optional[str] = None) -> None:
         # GIL-level increment; a lost update between two racing markers
         # still leaves the generation ahead of _clean_gen, which is all
         # _stale needs
         self._dirty_gen += 1
+        # event attribution (ops.events): a caller naming the gang whose
+        # demand row changed keeps the next refresh fold-eligible; an
+        # unattributed mark is a BLIND mark — the next refresh falls back
+        # to the full scan, which is always correct. The unlocked read is
+        # benign: the log reference only ever moves under _refresh_lock
+        # and a mark racing the swap lands as a blind scan at worst.
+        log = self._event_log
+        if log is not None:
+            if group:
+                log.note_group(group)
+            else:
+                log.note_blind()
+
+    def note_group_event(self, full_name: str) -> None:
+        """Record that a gang's demand row (matched/scheduled progress)
+        changed WITHOUT dirtying the batch — the plan-covered mutations
+        the gang-granular credit path already accounts for. The pending
+        event makes the next refresh (whenever something else triggers
+        it) fold this gang's fresh state instead of scanning."""
+        log = self._event_log
+        if log is not None:
+            log.note_group(full_name)
 
     def credit_expected_change(self, n: int = 1) -> None:
         """Record n cluster-version bumps as pre-accounted by the current
@@ -586,26 +632,148 @@ class OracleScorer:
         with trace_mod.span("oracle.refresh", cat="oracle"):
             self._refresh_traced(cluster, status_cache)
 
-    def _pack_current(self, cluster, status_cache: PGStatusCache):
-        """Read cluster state and build one snapshot via the delta packer.
-        Returns (snap, dirty_gen, version_base, pack_seconds).
+    def _pack_current(self, cluster, status_cache: PGStatusCache):  # lock-held: _refresh_lock
+        """Read cluster state and build one snapshot — the O(churn) event
+        fold when the pending events prove complete coverage, else the
+        full read + delta pack. Returns (snap, dirty_gen, version_base,
+        pack_seconds).
 
         Credits, the dirty generation, and the version base are all taken
         BEFORE reading state: any change landing mid-pack leaves version()
         ahead of the base (or the generation ahead of the one recorded at
-        completion) and re-batches conservatively."""
+        completion) and re-batches conservatively. The mutation-counter
+        baseline follows the same rule — a membership change landing
+        mid-read skews the next fold's comparison and forces a scan."""
         t0 = time.perf_counter()
         dirty_gen = self._dirty_gen
         version_fn = getattr(cluster, "version", None)
         version_base = version_fn() if callable(version_fn) else None
-        nodes, node_req, demands = read_cluster_inputs(
-            cluster, status_cache
-        )
-        with trace_mod.span("oracle.snapshot_pack", cat="oracle"):
-            snap = self._packer.pack(nodes, node_req, demands)
+        self._ensure_event_wiring(cluster, status_cache)
+        log = self._event_log
+        mut_base = status_cache.mutations() if log is not None else None
+        snap = None
+        if log is not None:
+            snap = self._try_fold(
+                cluster, status_cache, version_base, mut_base
+            )
+        if snap is None:
+            nodes, node_req, demands = read_cluster_inputs(
+                cluster, status_cache
+            )
+            with trace_mod.span("oracle.snapshot_pack", cat="oracle"):
+                snap = self._packer.pack(nodes, node_req, demands)
+        if log is not None:
+            self._fold_version = version_base
+            self._fold_mut_base = mut_base
         self._schema = self._packer.schema
         self._note_pack(snap)
         return snap, dirty_gen, version_base, time.perf_counter() - t0
+
+    def _ensure_event_wiring(self, cluster, status_cache) -> None:  # lock-held: _refresh_lock
+        """Lazily subscribe one EventLog to THIS (cluster, status_cache)
+        pair. A provider without subscribe_events/version (FakeCluster,
+        plain test providers) gets no log — every refresh scans, exactly
+        the pre-event behaviour. Re-wiring on a provider change resets
+        the completeness baselines: a fold must never reconcile version
+        arithmetic across two different clusters."""
+        if (
+            self._event_log is not None
+            and self._event_cluster_ref is not None
+            and self._event_cluster_ref() is cluster
+            and self._event_cache_ref is not None
+            and self._event_cache_ref() is status_cache
+        ):
+            return
+        self._event_log = None
+        self._event_cluster_ref = None
+        self._event_cache_ref = None
+        self._fold_version = None
+        self._fold_mut_base = None
+        from ..ops.events import event_fold_enabled
+
+        if not event_fold_enabled():
+            return
+        subscribe = getattr(cluster, "subscribe_events", None)
+        version_fn = getattr(cluster, "version", None)
+        if not callable(subscribe) or not callable(version_fn):
+            return
+        if not callable(getattr(status_cache, "mutations", None)):
+            return
+        from ..ops.events import EventLog
+
+        log = EventLog(label="scorer")
+        subscribe(log.note_bump)  # weakly held: dies with this scorer
+        self._event_log = log
+        self._event_cluster_ref = weakref.ref(cluster)
+        self._event_cache_ref = weakref.ref(status_cache)
+
+    def _try_fold(  # lock-held: _refresh_lock
+        self, cluster, status_cache, version_base, mut_base
+    ):
+        """Attempt the O(churn) event-fold pack. The eligibility chain
+        proves — never assumes — that the drained events cover EVERY
+        oracle-visible change since the last pack:
+
+        1. the batch is complete (no blind mark, no structural node
+           mutation, no cap overflow);
+        2. every cluster version bump since the last pack's base has a
+           matching logged event (``version delta == drained bumps`` —
+           a mutation that bypassed the hooks breaks the equality);
+        3. the status cache's set/delete counter is unchanged (the gang
+           SET cannot have churned without it);
+        4. every named entity resolves against the packer's lite state
+           (pack_fold re-checks and bails to None otherwise).
+
+        Any failure returns None and the caller runs the full scan —
+        correctness never depends on hook coverage. Outcomes are counted
+        (bst_event_folds_total) so a fleet that silently stopped folding
+        is visible."""
+        from ..ops.events import event_fold_enabled
+
+        batch = self._event_log.drain()
+        snap = None
+        if not event_fold_enabled():
+            outcome = "disabled"
+        elif self._fold_version is None or version_base is None:
+            outcome = "no-base"
+        elif not batch.complete:
+            outcome = (
+                "blind" if batch.blind
+                else "structural" if batch.structural
+                else "overflow"
+            )
+        elif version_base - self._fold_version != batch.bumps:
+            outcome = "version-skew"
+        elif mut_base is None or self._fold_mut_base != mut_base:
+            outcome = "group-churn"
+        else:
+            node_updates = []
+            group_updates = []
+            unresolved = False
+            for name in sorted(batch.node_names):
+                node_updates.append((name, cluster.node_requested(name)))
+            for full_name in sorted(batch.group_names):
+                pgs = status_cache.get(full_name)
+                if pgs is None:
+                    unresolved = True
+                    break
+                group_updates.append(demand_from_status(full_name, pgs))
+            if unresolved:
+                outcome = "unknown-name"
+            else:
+                with trace_mod.span("oracle.event_fold", cat="oracle"):
+                    snap = self._packer.pack_fold(
+                        node_updates, group_updates
+                    )
+                outcome = "folded" if snap is not None else "packer-bail"
+        from ..utils.metrics import DEFAULT_REGISTRY
+
+        DEFAULT_REGISTRY.counter(
+            "bst_event_folds_total",
+            "Event-fold refresh attempts by outcome (folded = O(churn) "
+            "pack served; every other outcome fell back to the full scan)",
+        ).inc(outcome=outcome)
+        return snap
 
     def _note_pack(self, snap) -> None:  # lock-held: _refresh_lock
         """Per-pack hook, under the refresh lock: bring the device-resident
@@ -867,6 +1035,23 @@ class OracleScorer:
                 for ns, count in ns_counts.items():
                     label = tenancy.tenant_label(ns)
                     tenants[label] = tenants.get(label, 0) + count
+                extra = {"tenants": tenants}
+                # the event log itself rides the audit stream (the
+                # keyframe+delta audit discipline applied to refreshes):
+                # which rows this pack rewrote and which path produced it
+                # — replay and the identity auditor keep bit-comparing
+                # the recorded batch_args regardless of the path
+                delta = getattr(snap, "delta", None)
+                if delta is not None:
+                    extra["refresh"] = {
+                        "generation": int(delta.generation),
+                        "kind": delta.kind,
+                        "reason": delta.reason,
+                        "source": delta.source,
+                        "node_rows": [int(i) for i in delta.node_rows],
+                        "group_rows": [int(i) for i in delta.group_rows],
+                        "meta_rows": [int(i) for i in delta.meta_rows],
+                    }
                 self.audit_log.record_batch(
                     batch_args=snap.device_args(),
                     progress_args=snap.progress_args(),
@@ -880,7 +1065,7 @@ class OracleScorer:
                     degraded=bool(self.degraded),
                     telemetry=telemetry or {},
                     policy=policy_payload,
-                    extra={"tenants": tenants},
+                    extra=extra,
                 )
             if (
                 self._identity is not None
@@ -1228,6 +1413,12 @@ class OracleScorer:
             out["delta_packs"] = packer.delta_packs
             out["full_repacks"] = packer.full_repacks
             out["rows_rewritten_last"] = packer.last_rows_rewritten
+        if packer.lite_packs or packer.fold_packs:
+            out["lite_packs"] = packer.lite_packs
+            out["fold_packs"] = packer.fold_packs
+            out["order_resorts"] = packer.order_resorts
+        if self._event_log is not None:
+            out["event_log"] = self._event_log.stats()
         if self.dispatch_ahead or self.spec_served or self.spec_discarded:
             out["spec_served"] = self.spec_served
             out["spec_discarded"] = self.spec_discarded
@@ -1236,6 +1427,7 @@ class OracleScorer:
             out["device_state_generation"] = ds["generation"]
             out["device_rows_scattered"] = ds["rows_scattered"]
             out["device_keyframes"] = ds["keyframes"]
+            out["device_derived_batches"] = ds["derived_batches"]
         if self._warmer is not None:
             out.update(self._warmer.stats())
         if self.audit_log is not None:

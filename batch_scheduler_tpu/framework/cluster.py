@@ -13,6 +13,7 @@ oracle snapshot pack straight from here.
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Dict, List, Optional
 
 from ..api.types import Node, Pod, PodPhase
@@ -36,6 +37,39 @@ class ClusterState:
         # bumped on every capacity-relevant change; the oracle scorer uses it
         # to invalidate its batch without explicit mark_dirty plumbing
         self._version = 0  # guarded-by: _lock
+        # event subscribers (ops.events.EventLog.note_bump, weakly held):
+        # the emission invariant is ONE _emit per _version += 1, each
+        # naming the nodes whose requested view changed under that bump —
+        # subscribers prove fold completeness by matching bump counts
+        # against version deltas (docs/pipelining.md "Event ingest")
+        self._event_subs: list = []  # guarded-by: _lock
+
+    def subscribe_events(self, fn) -> None:
+        """Register a bound method called as ``fn(kind, names)`` once per
+        version bump, under the cluster lock (callees must not call back
+        into this state). ``kind`` is ``"node-object"`` for node add /
+        update / remove (structural — lane schema may move) and
+        ``"node-requested"`` for capacity accounting; ``names`` lists the
+        affected node names. Held via weakref: a collected subscriber is
+        pruned, never leaked."""
+        with self._lock:
+            self._event_subs.append(weakref.WeakMethod(fn))
+
+    def _emit(self, kind: str, names=()) -> None:  # lock-held: _lock
+        if not self._event_subs:
+            return
+        dead = []
+        for ref in self._event_subs:
+            fn = ref()
+            if fn is None:
+                dead.append(ref)
+                continue
+            try:
+                fn(kind, names)
+            except Exception:  # noqa: BLE001 — a broken subscriber must
+                pass  # never poison informer handling; fold just degrades
+        for ref in dead:
+            self._event_subs.remove(ref)
 
     def version(self) -> int:
         with self._lock:
@@ -48,6 +82,7 @@ class ClusterState:
             self._nodes[node.metadata.name] = node
             self._requested.setdefault(node.metadata.name, {})
             self._version += 1
+            self._emit("node-object", (node.metadata.name,))
 
     def update_node(self, node: Node) -> None:
         self.add_node(node)
@@ -57,6 +92,7 @@ class ClusterState:
             self._nodes.pop(name, None)
             self._requested.pop(name, None)
             self._version += 1
+            self._emit("node-object", (name,))
 
     # -- pod lifecycle -----------------------------------------------------
 
@@ -79,6 +115,11 @@ class ClusterState:
             self._pod_nodes[uid] = node_name
             self._pod_objs[uid] = pod
             self._version += 1
+            touched = (
+                (node_name,) if prev in (None, node_name)
+                else (node_name, prev)
+            )
+            self._emit("node-requested", touched)
 
     def assume_many(self, pairs) -> None:
         """Batch form of :meth:`assume` — one lock pass for a whole gang's
@@ -96,6 +137,11 @@ class ClusterState:
                 self._assumed[uid] = node_name
                 self._pod_nodes[uid] = node_name
                 self._pod_objs[uid] = pod
+                touched = (
+                    (node_name,) if prev in (None, node_name)
+                    else (node_name, prev)
+                )
+                self._emit("node-requested", touched)
             self._version += len(pairs)
 
     def forget(self, pod_uid: str) -> None:
@@ -108,6 +154,7 @@ class ClusterState:
             self._pod_objs.pop(pod_uid, None)
             self._requested.get(node, {}).pop(pod_uid, None)
             self._version += 1
+            self._emit("node-requested", (node,))
 
     def finish_binding(self, pod_uid: str) -> None:
         with self._lock:
@@ -138,6 +185,7 @@ class ClusterState:
                 self._pod_objs.pop(uid, None)
                 if charged is not None or known is not None:
                     self._version += 1
+                    self._emit("node-requested", (node,))
                 return
             req = self._require(pod)
             unchanged = (
@@ -153,6 +201,10 @@ class ClusterState:
             self._assumed.pop(uid, None)
             if not unchanged:
                 self._version += 1
+                touched = (
+                    (node,) if prev in (None, node) else (node, prev)
+                )
+                self._emit("node-requested", touched)
 
     def observe_pod_raw(self, d: dict) -> None:
         """Raw-dict fast path for pod watch events (the informer's ``raw``
@@ -177,6 +229,7 @@ class ClusterState:
                 self._pod_objs.pop(uid, None)
                 if charged is not None or known is not None:
                     self._version += 1
+                    self._emit("node-requested", (node,))
                 return
             if self._pod_nodes.get(uid) == node:
                 self._assumed.pop(uid, None)  # bind commit observed
@@ -201,6 +254,7 @@ class ClusterState:
             if node is not None:
                 self._requested.get(node, {}).pop(uid, None)
                 self._version += 1
+                self._emit("node-requested", (node,))
 
     # -- ClusterStateProvider ---------------------------------------------
 

@@ -42,11 +42,13 @@ from functools import lru_cache
 from typing import Dict, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 __all__ = [
     "DeviceStateHolder",
     "device_state_enabled",
+    "device_derive_enabled",
     "device_state_report",
 ]
 
@@ -77,6 +79,35 @@ def device_state_enabled() -> bool:
         print(
             f"ignoring unrecognised {_ENV}={raw!r}; device-resident "
             "state stays enabled",
+            file=sys.stderr,
+        )
+    return True
+
+
+_DERIVE_ENV = "BST_DEVICE_DERIVE"
+_derive_warned = [False]
+
+
+def device_derive_enabled() -> bool:
+    """Parse-guarded BST_DEVICE_DERIVE read: default ON; ``0``/``off``/
+    ``false`` keeps the fit-mask/queue-order columns host-uploaded per
+    batch instead of device-derived from the resident meta columns
+    (docs/pipelining.md "Snapshot-lite & event ingest"). Unrecognised
+    values warn once and keep the default."""
+    import os
+
+    raw = os.environ.get(_DERIVE_ENV, "").strip().lower()
+    if raw in ("", "1", "on", "true", "yes"):
+        return True
+    if raw in ("0", "off", "false", "no"):
+        return False
+    if not _derive_warned[0]:
+        _derive_warned[0] = True
+        import sys
+
+        print(
+            f"ignoring unrecognised {_DERIVE_ENV}={raw!r}; device-derived "
+            "columns stay enabled",
             file=sys.stderr,
         )
     return True
@@ -139,6 +170,35 @@ def _pad_update(idx: np.ndarray, rows: np.ndarray) -> Tuple[np.ndarray, np.ndarr
     return idx, rows
 
 
+def _derive_impl(inv_prio, ts_hi, ts_lo, name_rank, node_valid):
+    """THE device-side column derivation (docs/pipelining.md
+    "Snapshot-lite & event ingest"): reproduce, from the resident
+    queue-order meta columns, exactly what the host precomputes —
+
+    - ``order``: the queue permutation. Host sorts by ``(-priority,
+      creation_ts, full_name)``; the meta columns encode that as int32
+      lexsort keys (``inv_prio = ~priority``; ``(ts_hi, ts_lo)`` the
+      order-preserving split of the float64 timestamp, ops.snapshot
+      ._ts_sort_keys; ``name_rank`` the host's name order). jnp.lexsort
+      takes the PRIMARY key last. Pad sentinels (INT32_MAX / row index)
+      sort strictly after every real row, so the full-[Gb] static sort
+      matches pad_oracle_batch's padded order column bit-for-bit.
+    - ``fit``: the uniform-fit broadcast row IS the padded node-valid
+      row (ops.snapshot._fit_mask fast path — the lite capture only
+      stamps meta_cols when that fast path held).
+
+    Byte-identity against the host columns is gated by
+    tests/test_snapshot_lite.py and ``make bench-delta``."""
+    order = jnp.lexsort((name_rank, ts_lo, ts_hi, inv_prio)).astype(jnp.int32)
+    fit = node_valid[None, :]
+    return fit, order
+
+
+@lru_cache(maxsize=None)
+def _derive_fn():
+    return jax.jit(_derive_impl)
+
+
 # ---------------------------------------------------------------------------
 # holder registry (the /debug/perf device-state section)
 # ---------------------------------------------------------------------------
@@ -189,6 +249,17 @@ class DeviceStateHolder:
         self.rows_scattered = 0  # guarded-by: _lock
         self.keyframes: Dict[str, int] = {}  # guarded-by: _lock
         self.deltas_applied = 0  # guarded-by: _lock
+        # device-derived column state (single-device only, BST_DEVICE_DERIVE):
+        # resident queue-order meta columns (inv_prio, ts_hi, ts_lo,
+        # name_rank), the padded node-valid row they derive fit from, the
+        # (fit, order) derivation cache, and the generation the meta
+        # mirrors — None / -1 whenever the sync'd snapshot carries no
+        # meta_cols (derive then leaves the host columns untouched)
+        self._meta = None  # guarded-by: _lock
+        self._meta_nv = None  # guarded-by: _lock
+        self._derived = None  # guarded-by: _lock
+        self._meta_gen = -1  # guarded-by: _lock
+        self.derived_batches = 0  # guarded-by: _lock
         with _holders_lock:
             _holders.add(self)
 
@@ -247,6 +318,8 @@ class DeviceStateHolder:
         with self._lock:
             self._alloc = self._requested = self._group_req = None
             self._policy_hash = self._policy_dom = None
+            self._meta = self._meta_nv = self._derived = None
+            self._meta_gen = -1
             self.generation = 0
 
     def keyframe(self, batch_args: tuple, generation: int, reason: str) -> tuple:
@@ -354,7 +427,14 @@ class DeviceStateHolder:
         product) and return device-ready batch args. Scatter-applies the
         pack's churned rows when the delta record is contiguous with the
         resident generation; otherwise resyncs from a keyframe with the
-        reason counted (bst_device_keyframe_resyncs_total)."""
+        reason counted (bst_device_keyframe_resyncs_total). When the
+        snapshot carries queue-order meta columns (the snapshot-lite
+        capture), the fit-mask and order columns are swapped for
+        device-DERIVED ones (_maybe_derive) — the host columns stay
+        authoritative for audit/explain and byte-equal by construction."""
+        return self._maybe_derive(snap, self._sync_base(snap))
+
+    def _sync_base(self, snap) -> tuple:
         batch_args = snap.device_args()
         delta = getattr(snap, "delta", None)
         if delta is None:
@@ -391,6 +471,69 @@ class DeviceStateHolder:
         if out is None:  # raced invalidation: resync, never stale rows
             return self.keyframe(batch_args, delta.generation, "generation")
         return out
+
+    def _maybe_derive(self, snap, out: tuple) -> tuple:
+        """Swap ``out``'s fit-mask (index 4) and order (index 6) for
+        device-derived arrays when the snapshot carries meta columns.
+
+        Residency rule: the meta columns mirror generation ``_meta_gen``;
+        a contiguous ``"delta"`` pack with matching padded shapes scatters
+        only ``delta.meta_rows`` (empty → the cached derivation is reused
+        outright — the zero-churn steady state runs no device work here);
+        anything else re-uploads the meta wholesale. Snapshots without
+        meta_cols (lite ineligible: policy on, selectors/taints, direct
+        construction), mesh layouts, and BST_DEVICE_DERIVE=0 drop the
+        meta state and return the host columns untouched — every bail is
+        the exact pre-derive path."""
+        meta = getattr(snap, "meta_cols", None)
+        if meta is None or self.mesh is not None or not device_derive_enabled():
+            with self._lock:
+                self._meta = self._meta_nv = self._derived = None
+                self._meta_gen = -1
+            return out
+        delta = getattr(snap, "delta", None)
+        gen = 0 if delta is None else int(delta.generation)
+        with self._lock:
+            contiguous = (
+                delta is not None
+                and delta.kind == "delta"
+                and self._meta is not None
+                and self._meta_gen == gen - 1
+                and tuple(self._meta[0].shape) == np.asarray(meta[0]).shape
+                and tuple(self._meta_nv.shape)
+                == np.asarray(snap.node_valid).shape
+            )
+            if not contiguous:
+                self._meta = tuple(
+                    jax.device_put(np.ascontiguousarray(c)) for c in meta
+                )
+                self._meta_nv = jax.device_put(
+                    np.ascontiguousarray(snap.node_valid)
+                )
+                self._derived = None
+            elif len(delta.meta_rows):
+                idx = delta.meta_rows
+                # node_valid never scatters: it is immutable while the
+                # lite capture is valid (any node change keyframes)
+                self._meta = tuple(
+                    self._scatter(buf, idx, np.asarray(host)[idx])
+                    for buf, host in zip(self._meta, meta)
+                )
+                self._derived = None
+            if self._derived is None:
+                self._derived = _derive_fn()(*self._meta, self._meta_nv)
+            self._meta_gen = gen
+            fit, order = self._derived
+            self.derived_batches += 1
+            from ..utils.metrics import DEFAULT_REGISTRY
+
+            DEFAULT_REGISTRY.counter(
+                "bst_refresh_derived_batches_total",
+                "Batches whose fit-mask/queue-order columns were derived "
+                "on device from resident meta columns instead of host "
+                "precompute + upload",
+            ).inc()
+            return out[:4] + (fit, out[5], order)
 
     def sync_policy_cols(self, snap) -> Optional[tuple]:
         """Device-resident node policy columns (single-device only — the
@@ -537,6 +680,8 @@ class DeviceStateHolder:
                 "deltas_applied": self.deltas_applied,
                 "rows_scattered": self.rows_scattered,
                 "keyframes": dict(self.keyframes),
+                "derived_batches": self.derived_batches,
+                "meta_resident": self._meta is not None,
             }
             if self._requested is not None:
                 out["n_bucket"] = int(self._requested.shape[0])

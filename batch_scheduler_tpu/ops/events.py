@@ -1,0 +1,261 @@
+"""Bounded host event log: the O(churn) refresh feed (stage 3 of
+"Kill the snapshot", docs/pipelining.md "Snapshot-lite & event ingest").
+
+Informer/bind/permit mutations append the NAMES of the entities whose
+oracle-visible state changed (a node's requested view, a gang's demand
+row) instead of the mutation payloads. The scorer drains the log once
+per refresh and re-reads just the named entities from the live cluster
+state, so an event that raced the drain window re-folds harmlessly on
+the next pack — the fold is idempotent by construction, which is what
+lets producers emit outside any scorer lock.
+
+The log is name-coalesced: N mutations to one node are one entry. What
+it must track exactly is the NUMBER of cluster version bumps it saw
+(``note_bump`` per ``ClusterState._version += 1``) so the scorer can
+prove completeness — if ``version_now - version_at_last_pack`` does not
+equal the drained bump count, some mutation bypassed the hooks and the
+fold falls back to the full O(N+G) scan (always correct, never stale).
+
+Capacity is bounded by ``BST_EVENT_LOG_CAP``; hitting the cap sets the
+overflow flag and the next drain reports incomplete (scan fallback),
+exactly like a blind mark from an uninstrumented mutation path.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import FrozenSet
+
+__all__ = ["EventLog", "EventBatch", "event_log_cap", "event_fold_enabled"]
+
+
+_FOLD_ENV = "BST_EVENT_FOLD"
+_fold_warned = [False]
+
+
+def event_fold_enabled() -> bool:
+    """Parse-guarded BST_EVENT_FOLD read: default ON; ``0``/``off``/
+    ``false`` disables the O(churn) event-fold refresh path (every
+    refresh then runs the full O(N+G) cluster read — the snapshot-lite
+    scan path, kept as the bench comparison baseline). Unrecognised
+    values warn once and keep the default (the BST_SCAN_WAVE idiom)."""
+    import os
+
+    raw = os.environ.get(_FOLD_ENV, "").strip().lower()
+    if raw in ("", "1", "on", "true", "yes"):
+        return True
+    if raw in ("0", "off", "false", "no"):
+        return False
+    if not _fold_warned[0]:
+        _fold_warned[0] = True
+        import sys
+
+        print(
+            f"ignoring unrecognised {_FOLD_ENV}={raw!r}; event fold "
+            "stays enabled",
+            file=sys.stderr,
+        )
+    return True
+
+
+_CAP_ENV = "BST_EVENT_LOG_CAP"
+_CAP_DEFAULT = 4096
+_cap_warned = [False]
+
+
+def event_log_cap() -> int:
+    """Parse-guarded BST_EVENT_LOG_CAP read (default 4096): the bound on
+    distinct names the log coalesces before declaring overflow. A typo'd
+    knob warns once and keeps the default (the BST_SCAN_WAVE idiom)."""
+    import os
+
+    raw = os.environ.get(_CAP_ENV, "").strip()
+    if not raw:
+        return _CAP_DEFAULT
+    try:
+        return max(int(raw), 1)
+    except ValueError:
+        if not _cap_warned[0]:
+            _cap_warned[0] = True
+            import sys
+
+            print(
+                f"ignoring malformed {_CAP_ENV}={raw!r}; event log cap "
+                f"stays {_CAP_DEFAULT}",
+                file=sys.stderr,
+            )
+        return _CAP_DEFAULT
+
+
+@dataclass(frozen=True)
+class EventBatch:
+    """One drain's worth of pending events.
+
+    ``complete`` is the fold-eligibility verdict from the log's own side:
+    no blind marks, no overflow, no structural (node-object) mutation
+    since the last drain. The scorer layers its own checks on top
+    (version-bump accounting, status-cache mutation counter, resolvable
+    names) before trusting a targeted fold."""
+
+    node_names: FrozenSet[str] = frozenset()
+    group_names: FrozenSet[str] = frozenset()
+    bumps: int = 0
+    blind: bool = False
+    structural: bool = False
+    overflow: bool = False
+
+    @property
+    def complete(self) -> bool:
+        return not (self.blind or self.structural or self.overflow)
+
+    @property
+    def empty(self) -> bool:
+        return not (self.node_names or self.group_names or self.bumps
+                    or self.blind or self.structural or self.overflow)
+
+
+class EventLog:
+    """Thread-safe bounded, name-coalescing event accumulator.
+
+    Producers (ClusterState mutators via ``subscribe_events``, the
+    operation layer's gang hints, blind ``mark_dirty`` fallbacks) only
+    ever append; the single consumer (the scorer's refresh path, under
+    its refresh lock) drains. Producers may call under the cluster lock:
+    the log takes only its own ``_lock`` and the metrics registry's —
+    neither ever takes the cluster lock back, so there is no ordering
+    cycle (lock discipline instrumented via BST_LOCKCHECK, the
+    guarded-by annotations below).
+    """
+
+    def __init__(self, cap: int = 0, label: str = "scorer"):
+        self.label = label
+        self.cap = int(cap) if cap else event_log_cap()
+        self._lock = threading.Lock()
+        self._node_names: set = set()  # guarded-by: _lock
+        self._group_names: set = set()  # guarded-by: _lock
+        self._bumps = 0  # guarded-by: _lock
+        self._blind = False  # guarded-by: _lock
+        self._structural = False  # guarded-by: _lock
+        self._overflow = False  # guarded-by: _lock
+        self.appended = 0  # guarded-by: _lock
+        self.dropped = 0  # guarded-by: _lock
+        self.drains = 0  # guarded-by: _lock
+
+    # -- internals (lock-held) ---------------------------------------------
+
+    def _depth(self) -> int:  # lock-held: _lock
+        return len(self._node_names) + len(self._group_names)
+
+    def _count(self, kind: str, n: int = 1) -> None:  # lock-held: _lock
+        self.appended += n
+        from ..utils.metrics import DEFAULT_REGISTRY
+
+        DEFAULT_REGISTRY.counter(
+            "bst_event_appended_total",
+            "Mutation events appended to the host event log, by kind",
+        ).inc(n, kind=kind)
+        DEFAULT_REGISTRY.gauge(
+            "bst_event_log_depth",
+            "Distinct entity names pending in the host event log",
+        ).set(float(self._depth()), log=self.label)
+
+    def _add(self, names, target: set, kind: str) -> None:  # lock-held: _lock
+        for name in names:
+            if name in target:
+                continue
+            if self._depth() >= self.cap:
+                self._overflow = True
+                self.dropped += 1
+                from ..utils.metrics import DEFAULT_REGISTRY
+
+                DEFAULT_REGISTRY.counter(
+                    "bst_event_dropped_total",
+                    "Events dropped at the event-log cap (the next "
+                    "refresh falls back to a full scan)",
+                ).inc()
+                continue
+            target.add(name)
+        self._count(kind)
+
+    # -- producer API -------------------------------------------------------
+
+    def note_bump(self, kind: str, names=()) -> None:
+        """One cluster version bump: ``names`` are the nodes whose
+        requested view changed under it (may be empty — e.g. a no-op
+        release). ``kind == "node-object"`` marks a structural mutation
+        (add/update/remove of the node OBJECT): the packer's lane schema
+        may have moved, so the batch reports incomplete and the next
+        refresh scans."""
+        with self._lock:
+            self._bumps += 1
+            if kind == "node-object":
+                self._structural = True
+            self._add(names, self._node_names, kind)
+
+    def note_group(self, full_name: str) -> None:
+        """A gang's demand row changed (permit/bind/register progress)."""
+        with self._lock:
+            self._add((full_name,), self._group_names, "group")
+
+    def note_blind(self) -> None:
+        """A mutation with no event attribution (legacy ``mark_dirty``
+        callers): the next drain reports incomplete and the refresh falls
+        back to the full scan — correctness never depends on coverage."""
+        with self._lock:
+            self._blind = True
+            from ..utils.metrics import DEFAULT_REGISTRY
+
+            DEFAULT_REGISTRY.counter(
+                "bst_event_blind_marks_total",
+                "Unattributed dirty marks (event fold falls back to a "
+                "full scan for that refresh)",
+            ).inc()
+
+    # -- consumer API -------------------------------------------------------
+
+    def depth(self) -> int:
+        with self._lock:
+            return self._depth()
+
+    def drain(self) -> EventBatch:
+        """Snapshot-and-reset the pending events. The single consumer is
+        the scorer's refresh path (serialized by its refresh lock)."""
+        with self._lock:
+            batch = EventBatch(
+                node_names=frozenset(self._node_names),
+                group_names=frozenset(self._group_names),
+                bumps=self._bumps,
+                blind=self._blind,
+                structural=self._structural,
+                overflow=self._overflow,
+            )
+            self._node_names.clear()
+            self._group_names.clear()
+            self._bumps = 0
+            self._blind = False
+            self._structural = False
+            self._overflow = False
+            self.drains += 1
+            from ..utils.metrics import DEFAULT_REGISTRY
+
+            DEFAULT_REGISTRY.gauge(
+                "bst_event_log_depth",
+                "Distinct entity names pending in the host event log",
+            ).set(0.0, log=self.label)
+            return batch
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "label": self.label,
+                "cap": self.cap,
+                "depth": self._depth(),
+                "bumps_pending": self._bumps,
+                "appended": self.appended,
+                "dropped": self.dropped,
+                "drains": self.drains,
+                "blind_pending": self._blind,
+                "structural_pending": self._structural,
+                "overflow_pending": self._overflow,
+            }

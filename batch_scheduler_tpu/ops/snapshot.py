@@ -31,10 +31,65 @@ __all__ = [
     "DeltaSnapshotPacker",
     "SnapshotDelta",
     "node_requested_from_pods",
+    "snapshot_lite_enabled",
 ]
 
 
 _EMPTY_IDX = np.zeros(0, dtype=np.int32)
+
+_LITE_ENV = "BST_SNAPSHOT_LITE"
+_lite_warned = [False]
+
+
+def snapshot_lite_enabled() -> bool:
+    """Parse-guarded BST_SNAPSHOT_LITE read: default ON; ``0``/``off``/
+    ``false`` disables the persistent-buffer fast path (every pack then
+    runs the full ClusterSnapshot construction — the PR 11 behaviour,
+    kept as the bench comparison baseline). Unrecognised values warn once
+    and keep the default (the BST_SCAN_WAVE idiom)."""
+    import os
+
+    raw = os.environ.get(_LITE_ENV, "").strip().lower()
+    if raw in ("", "1", "on", "true", "yes"):
+        return True
+    if raw in ("0", "off", "false", "no"):
+        return False
+    if not _lite_warned[0]:
+        _lite_warned[0] = True
+        import sys
+
+        print(
+            f"ignoring unrecognised {_LITE_ENV}={raw!r}; snapshot-lite "
+            "stays enabled",
+            file=sys.stderr,
+        )
+    return True
+
+
+_I32_MAX = np.iinfo(np.int32).max
+
+
+def _ts_sort_keys(ts: np.ndarray):
+    """Order-preserving (hi, lo) int32 key pair for float64 creation
+    timestamps: total-ordered exactly like Python ``<`` on finite doubles
+    (with ``-0.0`` collapsed onto ``0.0``, which host tuple compare also
+    treats as equal). The IEEE754 bits are mapped to a monotone uint64
+    (sign-flip for positives, full complement for negatives), split into
+    32-bit halves, and each half biased into int32 — so a device lexsort
+    over ``(ts_hi, ts_lo)`` reproduces the host's float ascending order
+    bit-for-bit."""
+    ts = np.asarray(ts, dtype=np.float64)
+    ts = np.where(ts == 0.0, 0.0, ts)  # -0.0 and 0.0 must key equal
+    u = ts.view(np.uint64)
+    mask = np.where(
+        (u >> np.uint64(63)).astype(bool),
+        np.uint64(0xFFFFFFFFFFFFFFFF),
+        np.uint64(0x8000000000000000),
+    )
+    k = u ^ mask
+    hi = (k >> np.uint64(32)).astype(np.uint32) ^ np.uint32(0x80000000)
+    lo = (k & np.uint64(0xFFFFFFFF)).astype(np.uint32) ^ np.uint32(0x80000000)
+    return hi.view(np.int32), lo.view(np.int32)
 
 
 @dataclass
@@ -64,6 +119,16 @@ class SnapshotDelta:
     ``generation`` increments once per pack; consumers verify contiguity
     (``generation == applied + 1``) before scattering, and resync from a
     keyframe on any gap — never silently score stale rows.
+
+    ``source`` records which refresh path produced the pack (additive —
+    consumers key on ``kind`` only): ``"scan"`` for a full O(N+G) read of
+    the cluster state (the legacy and snapshot-lite scan paths), or
+    ``"events"`` for an O(churn) event fold (``pack_fold``).
+    ``meta_rows`` lists group rows whose QUEUE-ORDER meta (priority /
+    creation_ts sort keys) churned — the device-derive path scatters
+    those and re-derives the order permutation on device
+    (ops.device_state, docs/pipelining.md "Snapshot-lite & event
+    ingest").
     """
 
     generation: int
@@ -74,6 +139,8 @@ class SnapshotDelta:
     node_rows: np.ndarray = field(default_factory=lambda: _EMPTY_IDX)
     group_rows: np.ndarray = field(default_factory=lambda: _EMPTY_IDX)
     policy_node_rows: np.ndarray = field(default_factory=lambda: _EMPTY_IDX)
+    source: str = "scan"  # "scan" | "events"
+    meta_rows: np.ndarray = field(default_factory=lambda: _EMPTY_IDX)
 
 
 @dataclass
@@ -139,6 +206,24 @@ def _member_request_row(g: GroupDemand) -> Dict[str, int]:
     req = dict(g.member_request)
     req["pods"] = max(req.get("pods", 0), 1)
     return req
+
+
+def _demand_fp(g: GroupDemand) -> tuple:
+    """Content fingerprint of every oracle-visible demand field the lite
+    packer diffs. Callers may mutate a GroupDemand IN PLACE between packs,
+    so change detection must compare captured content, never a stored
+    object reference (the legacy ``_group_rows`` memo was content-keyed
+    for the same reason). ``remaining`` is derived from indices 1..3."""
+    return (
+        tuple(sorted(g.member_request.items())),
+        g.min_member,
+        g.scheduled,
+        g.matched,
+        g.priority,
+        g.creation_ts,
+        bool(g.released),
+        bool(g.has_pod),
+    )
 
 
 class ClusterSnapshot:
@@ -282,6 +367,11 @@ class ClusterSnapshot:
         # churned-row record stamped by DeltaSnapshotPacker.pack (None on
         # directly-constructed snapshots: no previous pack to delta from)
         self.delta: Optional["SnapshotDelta"] = None
+        # queue-order sort-key columns (inv_prio, ts_hi, ts_lo, name_rank),
+        # padded [Gb] int32 — stamped by the packer's snapshot-lite capture
+        # so ops.device_state can derive fit/order on device; None on
+        # directly-constructed snapshots (host columns stay authoritative)
+        self.meta_cols: Optional[tuple] = None
         if policy_engine is not None and policy_engine.enabled:
             from ..policy.terms import (
                 DOMAIN_BUCKETS,
@@ -412,6 +502,61 @@ class ClusterSnapshot:
         )
 
 
+@dataclass
+class _LiteState:
+    """The packer's persistent PADDED working set (snapshot-lite,
+    docs/pipelining.md "Snapshot-lite & event ingest"): everything a
+    ClusterSnapshot carries, kept alive across packs so a delta-applicable
+    refresh touches only churned rows — no per-refresh pad copies, no
+    fit-mask scan, no queue-order sort.
+
+    Mutability contract (what `_lite_snapshot` must copy vs may share):
+
+    - ``pad_requested`` / ``pad_group_req`` and the five tail arrays are
+      mutated IN PLACE per pack → copied into every emitted snapshot
+      (utils.audit holds snapshot arrays by reference);
+    - ``order`` / ``creation_rank`` / ``meta`` are REPLACED wholesale on
+      queue-meta churn (never mutated) → shared with snapshots;
+    - ``pad_alloc`` / ``fit_row`` / ``node_valid`` / ``group_valid`` are
+      immutable while the lite state is valid (any alloc / taint /
+      unschedulable / selector / membership change invalidates it) →
+      shared.
+
+    Validity requires: node list and gang set positionally stable, the
+    uniform-fit fast path (no selectors, no taints — ``fit_row`` IS the
+    padded node_valid row), policy engine off, and every churned value
+    inside the pad_oracle_batch bounds (a violation falls back to the
+    full path so the canonical OverflowError raises there)."""
+
+    n: int
+    g: int
+    nb: int
+    gb: int
+    node_names: tuple
+    group_names: tuple
+    node_index: dict
+    group_index: dict
+    node_names_list: list
+    group_names_list: list
+    demands: list
+    fps: list  # per-row _demand_fp — content diffs survive in-place mutation
+    gang_bound: int
+    pad_alloc: np.ndarray  # [Nb,R] shared (alloc churn keyframes)
+    pad_requested: np.ndarray  # [Nb,R] mutated in place
+    pad_group_req: np.ndarray  # [Gb,R] mutated in place
+    remaining: np.ndarray  # [Gb] mutated in place
+    min_member: np.ndarray  # [Gb] mutated in place
+    scheduled: np.ndarray  # [Gb] mutated in place
+    matched: np.ndarray  # [Gb] mutated in place
+    ineligible: np.ndarray  # [Gb] mutated in place
+    fit_row: np.ndarray  # [1,Nb] shared (uniform-fit invariant)
+    node_valid: np.ndarray  # [Nb] shared
+    group_valid: np.ndarray  # [Gb] shared
+    order: np.ndarray  # [Gb] replaced wholesale on meta churn
+    creation_rank: np.ndarray  # [Gb] replaced wholesale on meta churn
+    meta: tuple  # (inv_prio, ts_hi, ts_lo, name_rank) [Gb] i32, replaced
+
+
 class DeltaSnapshotPacker:
     """Persistent packed host buffers: rewrite only churned rows per refresh.
 
@@ -455,6 +600,12 @@ class DeltaSnapshotPacker:
         self.full_repacks = 0
         self.delta_packs = 0
         self.last_rows_rewritten = 0
+        # snapshot-lite working set (None until a capture-eligible full
+        # construction; see _LiteState) + per-path counters
+        self._lite: Optional[_LiteState] = None
+        self.lite_packs = 0  # lite scan-path packs
+        self.fold_packs = 0  # event-fold packs (pack_fold)
+        self.order_resorts = 0  # queue-meta churn resorts
         # Churned-row delta emission (SnapshotDelta): one record per pack,
         # consumed by the device-resident state layer (ops.device_state)
         # and the wire delta path (service.client RemoteScorer). The
@@ -565,6 +716,378 @@ class DeltaSnapshotPacker:
             out[gi] = row
         return out
 
+    # -- snapshot-lite (docs/pipelining.md "Snapshot-lite & event ingest") --
+
+    class _LiteBail(Exception):
+        """A churned group broke a lite invariant (selector appeared,
+        value out of the pad_oracle_batch bounds): fall back to the full
+        construction path — which rebuilds the fit mask, or raises the
+        canonical OverflowError — never a silent clamp. Raised ONLY from
+        the two-phase planner's validate pass, so a bail leaves the lite
+        buffers untouched."""
+
+    def _capture_lite(self, snap: ClusterSnapshot, nodes, groups) -> None:
+        """Adopt a freshly built full ClusterSnapshot as the persistent
+        lite working set (and stamp its queue-order meta columns for the
+        device-derive path). Eligibility: knob on, policy off, and the
+        uniform-fit fast path — no selectors, no taints — so the padded
+        fit row IS node_valid and churned groups cannot change it."""
+        self._lite = None
+        if not snapshot_lite_enabled():
+            return
+        engine = self.policy_engine
+        if engine is not None and getattr(engine, "enabled", False):
+            return
+        if snap.fit_mask.shape[0] != 1:
+            return
+        if any(g.node_selector for g in groups) or any(
+            n.spec.taints for n in nodes
+        ):
+            return
+        for g in groups:
+            # the device sort key is int32: a priority outside its domain
+            # cannot round-trip through ~p (host sort uses Python ints)
+            if not (-(2**31) <= g.priority < 2**31):
+                return
+        n, g_count = snap.num_nodes, snap.num_groups
+        nb, gb = snap.alloc.shape[0], snap.group_req.shape[0]
+        from .oracle import GANG_MAX
+
+        # padded queue-order meta: pad sentinels sort strictly AFTER every
+        # real row (pad ts_hi = INT32_MAX exceeds any finite double's
+        # biased hi half) and name_rank = the row index keeps the pad tail
+        # in arange(g, gb) order — a full-[Gb] static lexsort reproduces
+        # pad_oracle_batch's order column exactly, no dynamic g argument
+        prio = np.array([d.priority for d in groups], dtype=np.int64)
+        ts_hi_r, ts_lo_r = _ts_sort_keys(
+            np.array([d.creation_ts for d in groups], dtype=np.float64)
+        )
+        rank = np.empty(g_count, dtype=np.int32)
+        rank[
+            sorted(range(g_count), key=lambda i: groups[i].full_name)
+        ] = np.arange(g_count, dtype=np.int32)
+        inv_prio = np.full(gb, _I32_MAX, dtype=np.int32)
+        inv_prio[:g_count] = ~prio.astype(np.int32)
+        ts_hi = np.full(gb, _I32_MAX, dtype=np.int32)
+        ts_hi[:g_count] = ts_hi_r
+        ts_lo = np.full(gb, _I32_MAX, dtype=np.int32)
+        ts_lo[:g_count] = ts_lo_r
+        name_rank = np.arange(gb, dtype=np.int32)
+        name_rank[:g_count] = rank
+
+        lite = _LiteState(
+            n=n,
+            g=g_count,
+            nb=nb,
+            gb=gb,
+            node_names=tuple(snap.node_names),
+            group_names=tuple(snap.group_names),
+            node_index=snap._node_index,
+            group_index=snap._group_index,
+            node_names_list=snap.node_names,
+            group_names_list=snap.group_names,
+            demands=list(groups),
+            fps=[_demand_fp(d) for d in groups],
+            gang_bound=min(GANG_MAX, (2**31 - 1) // nb),
+            pad_alloc=snap.alloc,
+            pad_requested=np.array(snap.requested),
+            pad_group_req=np.array(snap.group_req),
+            remaining=np.array(snap.remaining),
+            min_member=np.array(snap.min_member),
+            scheduled=np.array(snap.scheduled),
+            matched=np.array(snap.matched),
+            ineligible=np.array(snap.ineligible),
+            fit_row=snap.fit_mask,
+            node_valid=snap.node_valid,
+            group_valid=snap.group_valid,
+            order=snap.order,
+            creation_rank=snap.creation_rank,
+            meta=(inv_prio, ts_hi, ts_lo, name_rank),
+        )
+        self._lite = lite
+        # rebind the packer's working arrays as VIEWS into the padded
+        # buffers: _delta_rows keeps its exact body (coupled formula) and
+        # its writes land directly in padded space — padding appends, so
+        # unpadded indices are valid there
+        self._requested = lite.pad_requested[:n]
+        self._group_prev = lite.pad_group_req[:g_count]
+        snap.meta_cols = lite.meta
+
+    def _plan_group_change(self, gi: int, old_fp: tuple, g: GroupDemand):
+        """Validate-only half of a lite group update: returns None when
+        nothing oracle-visible changed, else the planned write. Diffs the
+        fresh demand against the CAPTURED fingerprint, not the stored
+        object — callers may have mutated the same GroupDemand in place,
+        which would make an attribute compare vacuous. Raises _SchemaMiss
+        (covers miss → keyframe like _group_rows) or _LiteBail
+        (invariant/bound break → full path). MUST NOT mutate lite state —
+        a bail after partial writes would tear the positional diff the
+        delta consumers scatter from."""
+        fp = _demand_fp(g)
+        row = None
+        if fp[0] != old_fp[0]:
+            row = self._group_row_memo.get(fp[0])
+            if row is None:
+                d = _member_request_row(g)
+                if not self.schema.covers([d]):
+                    raise self._SchemaMiss
+                row = self.schema.pack(d)
+                self._group_row_memo[fp[0]] = row
+        tail = None
+        if fp[1:4] != old_fp[1:4]:
+            from .oracle import GANG_MAX
+
+            # mirror pad_oracle_batch's progress bounds: a violating value
+            # must surface as ITS OverflowError via the full path
+            if (
+                max(abs(g.min_member), abs(g.scheduled), abs(g.matched))
+                > GANG_MAX
+                or g.remaining > self._lite.gang_bound
+            ):
+                raise self._LiteBail
+            tail = (g.min_member, g.scheduled, g.matched, g.remaining)
+        inel = bool(g.released or not g.has_pod)
+        inel_changed = fp[6:8] != old_fp[6:8]
+        meta_changed = fp[4:6] != old_fp[4:6]
+        if meta_changed and not (-(2**31) <= g.priority < 2**31):
+            raise self._LiteBail
+        if g.node_selector:
+            raise self._LiteBail  # uniform-fit invariant broke
+        if row is None and tail is None and not inel_changed and not meta_changed:
+            return None
+        return (gi, g, fp, row, tail, inel_changed, inel, meta_changed)
+
+    def _apply_group_changes(self, changes) -> tuple:
+        """Apply planned group updates to the lite buffers; resort the
+        queue order when any sort key churned. Returns (group_rows,
+        meta_rows) index lists for the delta record."""
+        lite = self._lite
+        group_rows: list = []
+        meta_rows: list = []
+        for gi, g, fp, row, tail, inel_changed, inel, meta_changed in changes:
+            if row is not None:
+                lite.pad_group_req[gi] = row
+                group_rows.append(gi)
+            if tail is not None:
+                mm, sc, ma, rem = tail
+                lite.min_member[gi] = mm
+                lite.scheduled[gi] = sc
+                lite.matched[gi] = ma
+                lite.remaining[gi] = rem
+            if inel_changed:
+                lite.ineligible[gi] = inel
+            if meta_changed:
+                meta_rows.append(gi)
+            lite.demands[gi] = g
+            lite.fps[gi] = fp
+        if meta_rows:
+            self._lite_resort()
+        return group_rows, meta_rows
+
+    def _lite_resort(self) -> None:
+        """A queue sort key (priority / creation_ts) churned: rebuild the
+        order permutation, creation ranks and meta columns WHOLESALE
+        (replaced, never mutated — emitted snapshots share the old
+        arrays). The O(G log G) host sort stays authoritative for audit
+        and explain; the device derives the same permutation from the
+        meta columns (byte-equal by construction, ops.device_state)."""
+        lite = self._lite
+        g, gb = lite.g, lite.gb
+        demands = lite.demands
+        order_host = sorted(
+            range(g),
+            key=lambda i: (
+                -demands[i].priority,
+                demands[i].creation_ts,
+                demands[i].full_name,
+            ),
+        )
+        ranks = np.empty(g, dtype=np.int32)
+        ranks[order_host] = np.arange(g, dtype=np.int32)
+        order = np.concatenate(
+            [
+                np.asarray(order_host, dtype=np.int32),
+                np.arange(g, gb, dtype=np.int32),
+            ]
+        )
+        creation_rank = np.full(gb, gb - 1, dtype=np.int32)
+        creation_rank[:g] = ranks
+        prio = np.array([d.priority for d in demands], dtype=np.int64)
+        ts_hi_r, ts_lo_r = _ts_sort_keys(
+            np.array([d.creation_ts for d in demands], dtype=np.float64)
+        )
+        inv_prio = np.full(gb, _I32_MAX, dtype=np.int32)
+        inv_prio[:g] = ~prio.astype(np.int32)
+        ts_hi = np.full(gb, _I32_MAX, dtype=np.int32)
+        ts_hi[:g] = ts_hi_r
+        ts_lo = np.full(gb, _I32_MAX, dtype=np.int32)
+        ts_lo[:g] = ts_lo_r
+        lite.order = order
+        lite.creation_rank = creation_rank
+        lite.meta = (inv_prio, ts_hi, ts_lo, lite.meta[3])
+        self.order_resorts += 1
+        from ..utils.metrics import DEFAULT_REGISTRY
+
+        DEFAULT_REGISTRY.counter(
+            "bst_refresh_order_resorts_total",
+            "Queue-order resorts forced by priority/creation-ts churn on "
+            "the snapshot-lite path",
+        ).inc()
+
+    def _lite_snapshot(self, delta: SnapshotDelta) -> ClusterSnapshot:
+        """Materialise a ClusterSnapshot from the lite working set without
+        running __init__: in-place-mutated buffers are copied (audit holds
+        snapshot arrays by reference), wholesale-replaced and immutable
+        ones are shared (core.explain.baseline_inputs_key hashes VALUES,
+        so sharing is observationally safe)."""
+        lite = self._lite
+        snap = ClusterSnapshot.__new__(ClusterSnapshot)
+        snap.node_names = lite.node_names_list
+        snap.group_names = lite.group_names_list
+        snap.groups = list(lite.demands)
+        snap._node_index = lite.node_index
+        snap._group_index = lite.group_index
+        snap.schema = self.schema
+        snap.num_nodes = lite.n
+        snap.num_groups = lite.g
+        snap.alloc = lite.pad_alloc
+        snap.requested = lite.pad_requested.copy()
+        snap.group_req = lite.pad_group_req.copy()
+        snap.remaining = lite.remaining.copy()
+        snap.fit_mask = lite.fit_row
+        snap.group_valid = lite.group_valid
+        snap.order = lite.order
+        snap.min_member = lite.min_member.copy()
+        snap.scheduled = lite.scheduled.copy()
+        snap.matched = lite.matched.copy()
+        snap.ineligible = lite.ineligible.copy()
+        snap.creation_rank = lite.creation_rank
+        snap.node_valid = lite.node_valid
+        snap.policy_engine = None
+        snap.policy_cols = None
+        snap.meta_cols = lite.meta
+        snap.delta = delta
+        return snap
+
+    def _lite_emit(
+        self, node_rows, group_rows, meta_rows, source: str, path: str
+    ) -> ClusterSnapshot:
+        self.generation += 1
+        delta = SnapshotDelta(
+            self.generation,
+            "delta",
+            node_rows=np.asarray(node_rows, dtype=np.int32),
+            group_rows=np.asarray(group_rows, dtype=np.int32),
+            source=source,
+            meta_rows=np.asarray(meta_rows, dtype=np.int32),
+        )
+        self.last_delta = delta
+        self.delta_packs += 1
+        self.last_rows_rewritten = len(node_rows)
+        from ..utils.metrics import DEFAULT_REGISTRY
+
+        DEFAULT_REGISTRY.counter(
+            "bst_pack_rows_rewritten",
+            "Node lane rows rewritten by the delta snapshot packer "
+            "(2N on a full repack)",
+        ).inc(self.last_rows_rewritten)
+        DEFAULT_REGISTRY.counter(
+            "bst_refresh_lite_packs_total",
+            "Snapshot-lite packs that skipped the full ClusterSnapshot "
+            "construction, by refresh path (scan | fold)",
+        ).inc(path=path)
+        return self._lite_snapshot(delta)
+
+    def _lite_delta_pack(self, groups, node_idx) -> Optional[ClusterSnapshot]:
+        """Lite scan pack: the node side is already rewritten in place
+        (_delta_rows writes through the padded-buffer view); one O(G)
+        compare over the demand list plans the group side. Returns the
+        emitted snapshot, or None to fall back to the full construction
+        path — the planner is two-phase, so a bail leaves the buffers
+        exactly as the previous pack published them. Raises _SchemaMiss
+        exactly like _group_rows (caller keyframes "node-churn")."""
+        if self.policy_engine is not None and getattr(
+            self.policy_engine, "enabled", False
+        ):
+            return None
+        lite = self._lite
+        try:
+            changes = []
+            for gi, (old_fp, g) in enumerate(zip(lite.fps, groups)):
+                c = self._plan_group_change(gi, old_fp, g)
+                if c is not None:
+                    changes.append(c)
+        except self._LiteBail:
+            return None
+        group_rows, meta_rows = self._apply_group_changes(changes)
+        self.lite_packs += 1
+        return self._lite_emit(node_idx, group_rows, meta_rows, "scan", "scan")
+
+    def pack_fold(
+        self, node_updates, group_updates
+    ) -> Optional[ClusterSnapshot]:
+        """O(churn) event-fold pack (stage 3 of "Kill the snapshot"):
+        rewrite ONLY the named entities — nothing else is read, which is
+        the whole point. Caller contract (core.oracle_scorer._try_fold):
+        the node list, gang set and every unnamed entity's state are
+        UNCHANGED since the last pack — proven by the event log's
+        version-bump accounting and the status cache's mutation counter,
+        never assumed. Returns None when the fold does not apply (no lite
+        state, unknown name, schema covers miss, bound violation): the
+        caller falls back to the full scan ``pack()``, which is always
+        correct.
+
+        ``node_updates``: iterable of ``(node_name, requested_dict)``
+        (fresh ``cluster.node_requested`` reads);
+        ``group_updates``: iterable of fresh ``GroupDemand`` reads for
+        the named gangs. The fold is idempotent — updates carry current
+        state, not event payloads, so a name folded twice converges."""
+        lite = self._lite
+        if lite is None or self.schema is None or not snapshot_lite_enabled():
+            return None
+        if self.policy_engine is not None and getattr(
+            self.policy_engine, "enabled", False
+        ):
+            return None
+        schema = self.schema
+        node_plan: list = []
+        try:
+            for name, d in node_updates:
+                i = lite.node_index.get(name)
+                if i is None:
+                    return None
+                if d == self._req_dicts[i]:
+                    continue
+                key = tuple(sorted(d.items()))
+                row = self._req_row_memo.get(key)
+                if row is None:
+                    if not schema.covers([d]):
+                        return None
+                    row = schema.pack(d)
+                    self._req_row_memo[key] = row
+                node_plan.append((i, row, dict(d)))
+            changes = []
+            for g in group_updates:
+                gi = lite.group_index.get(g.full_name)
+                if gi is None:
+                    return None
+                c = self._plan_group_change(gi, lite.fps[gi], g)
+                if c is not None:
+                    changes.append(c)
+        except (self._SchemaMiss, self._LiteBail):
+            return None
+        node_rows: list = []
+        for i, row, d in node_plan:
+            # writes through the same padded buffer _delta_rows targets
+            # (self._requested is its [:n] view) — the delta-row-scatter
+            # coupling sees identical values either way
+            lite.pad_requested[i] = row
+            self._req_dicts[i] = d
+            node_rows.append(i)
+        group_rows, meta_rows = self._apply_group_changes(changes)
+        self.fold_packs += 1
+        return self._lite_emit(node_rows, group_rows, meta_rows, "events", "fold")
+
     def _policy_node_rows(self, nodes) -> Optional[tuple]:
         """Persistent node policy columns: rewrite only rows whose LABELS
         changed (spread key included — it lives in the labels). Returns
@@ -642,6 +1165,18 @@ class DeltaSnapshotPacker:
         if had_prev and names == self._node_names:
             try:
                 node_idx = self._delta_rows(nodes, req_dicts)
+                # snapshot-lite fast path: positionally-stable node list
+                # AND gang set, uniform fit, policy off — emit straight
+                # from the persistent padded working set (no pad copies,
+                # no fit scan, no sort; docs/pipelining.md)
+                if (
+                    self._lite is not None
+                    and snapshot_lite_enabled()
+                    and group_names == self._lite.group_names
+                ):
+                    snap = self._lite_delta_pack(groups, node_idx)
+                    if snap is not None:
+                        return snap
                 group_req = self._group_rows(groups)
                 self.delta_packs += 1
                 self.last_rows_rewritten = len(node_idx)
@@ -711,4 +1246,7 @@ class DeltaSnapshotPacker:
             node_policy_lanes=node_policy,
         )
         snap.delta = delta
+        # every full construction re-captures (or drops) the lite working
+        # set — keyframes and legacy deltas both leave it coherent
+        self._capture_lite(snap, nodes, groups)
         return snap

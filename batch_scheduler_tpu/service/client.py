@@ -985,7 +985,13 @@ class _DeltaCursor:
     def note(self, delta) -> None:
         """Fold one pack's SnapshotDelta in. A keyframe-kind record (full
         repack / node-list / group-set change) invalidates positional row
-        indices — this lane must resync from a keyframe."""
+        indices — this lane must resync from a keyframe. Event-fold packs
+        (``delta.source == "events"``, ops.snapshot.pack_fold) carry kind
+        "delta" with the same unpadded-space row indices as scan deltas —
+        positional stability is a precondition of the fold itself — so
+        they accumulate here unchanged, and the DELTA_ROWS frame's
+        wholesale order/fit columns keep the server's mirror exact even
+        when the host resorted the queue between syncs."""
         if delta is None or delta.kind != "delta":
             self.need_keyframe = True
             self.pending_nodes.clear()

@@ -30,6 +30,16 @@ Cost discipline:
   diff is computed here rather than trusted from the packer), and any
   shape/name change forces a fresh keyframe.
 
+Event-sourced refreshes (docs/pipelining.md "Snapshot-lite & event
+ingest") ride this format unchanged: the scorer stamps each record's
+``refresh`` field with the pack's provenance — generation, pack kind,
+keyframe reason, source (``scan`` vs ``events``) and the churned row
+indices — so the stream records the event log's effect batch by batch,
+while the row deltas below are still DIFFED here against the previously
+recorded arrays (never trusted from the packer). Replay therefore
+bit-compares identically whether a batch's inputs came from a full scan,
+a delta-applied refresh, or an event fold.
+
 Ring discipline: records append to ``audit-<seq>.jsonl`` segment files;
 when a segment exceeds ``segment_bytes`` a new one starts, and oldest
 segments are deleted once the directory exceeds ``cap_bytes``. The reader

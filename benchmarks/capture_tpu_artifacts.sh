@@ -185,6 +185,23 @@ else
     echo "delta gate failed:"; tail -4 /tmp/delta_gate.out; fail=1
 fi
 
+echo "== event-sourced refresh gate on hardware (EVENT_${TAG}) =="
+# the stage-3 "Kill the snapshot" capture: steady-state event-fold
+# refresh vs the PR 11 scatter-delta baseline priced against the real
+# host->HBM path, plus the churn sweep (1%/5%/20% of 5120 rows, fold vs
+# scan) and the four-path digest identity (docs/pipelining.md
+# "Snapshot-lite & event ingest"). CI runs the same checks inside
+# bench-delta; this artifact prices them on hardware.
+if BST_DELTA_GATE_PLATFORM=default \
+        BST_DELTA_GATE_CHECKS=steady_state,churn_sweep timeout 900 \
+        python benchmarks/delta_gate.py "EVENT_${TAG}.json" \
+        > /tmp/event_gate.out 2>&1; then
+    echo "event-refresh gate captured: EVENT_${TAG}.json"
+    tail -1 /tmp/event_gate.out
+else
+    echo "event-refresh gate failed:"; tail -4 /tmp/event_gate.out; fail=1
+fi
+
 echo "== multi-tenant coalescer gate on hardware (COALESCE_${TAG}) =="
 # the bench-coalesce gate on the real backend: this is the capture that
 # answers the throughput acceptance properly — on TPU the device compute

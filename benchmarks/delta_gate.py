@@ -21,9 +21,23 @@ runs anywhere (docs/pipelining.md "Device-resident state"):
    full-snapshot RemoteScorer and to the local scorer, with the delta
    encoding actually exercised (bst_oracle_wire_delta_batches_total).
 
+Stage 3 ("Kill the snapshot") adds two checks on the same shapes:
+
+5. **steady-state refresh** — the O(churn) event-fold pack + scatter
+   must beat the PR 11 scatter-delta refresh (``BST_SNAPSHOT_LITE=0``)
+   by ``EVENT_REFRESH_FLOOR``x.
+6. **churn sweep** — fold 1% / 5% / 20% of the rows: wall-clock scales
+   with churn (not N), fold beats the O(N) scan at low churn, buffers
+   stay bit-identical to a from-scratch pack, and plan digests agree
+   across all four refresh paths (event-fold / delta-applied /
+   keyframe-resync / full-repack).
+
 Prints one JSON line with ``"ok"`` + per-check details (the bst-bench
 envelope; the ``DELTA_<tag>`` capture artifact); exits non-zero on any
 failure. Run from the repo root: ``make bench-delta``.
+``BST_DELTA_GATE_CHECKS=steady_state,churn_sweep`` restricts the run to
+a named subset — how the hardware capture emits the ``EVENT_<tag>``
+artifact without re-paying the full matrix.
 """
 
 from __future__ import annotations
@@ -51,10 +65,12 @@ os.environ.setdefault("BST_BUCKET_COST", "0")  # no teardown-racing compiles
 import numpy as np  # noqa: E402
 
 DELTA_REFRESH_FLOOR = 2.5  # measured ~3.7x on the 1-core CI box
+EVENT_REFRESH_FLOOR = 2.0  # event-fold vs the PR 11 scatter-delta refresh
 REFRESH_NODES = 5120
 REFRESH_GROUPS = 2048
 REFRESH_MEMBERS = 5  # 2048 gangs x 5 members = 10240 pods
 CHURN_ROWS = 16
+SWEEP_CHURNS = (51, 256, 1024)  # 1% / 5% / 20% of REFRESH_NODES
 IDENTITY_NODES = 256
 IDENTITY_GROUPS = 64
 
@@ -155,6 +171,240 @@ def check_refresh_speedup(detail):
             f"{full_s:.4f}s = {speedup:.1f}x (floor {DELTA_REFRESH_FLOOR}x)"
         )
     return ok
+
+
+def check_steady_state(detail):
+    """Stage-3 claim ("Kill the snapshot"): the steady-state refresh —
+    event-fold pack + device scatter — must beat the PR 11 scatter-delta
+    refresh (full cluster scan + ClusterSnapshot construction + scatter,
+    ``BST_SNAPSHOT_LITE=0``) by ``EVENT_REFRESH_FLOOR``x at the
+    north-star shape, under the same ``CHURN_ROWS``-row churn."""
+    from batch_scheduler_tpu.ops.device_state import DeviceStateHolder
+    from batch_scheduler_tpu.ops.snapshot import DeltaSnapshotPacker
+
+    nodes, groups, node_req = build_inputs(REFRESH_NODES, REFRESH_GROUPS)
+
+    def churn(i):
+        names = []
+        for k in range(CHURN_ROWS):
+            name = f"n{(i * CHURN_ROWS + k) % REFRESH_NODES:05d}"
+            node_req[name] = {"cpu": 1500 + i, "pods": 1 + (i + k) % 4}
+            names.append(name)
+        return names
+
+    # PR 11 baseline: delta-row scan + full ClusterSnapshot + scatter
+    os.environ["BST_SNAPSHOT_LITE"] = "0"
+    try:
+        packer = DeltaSnapshotPacker()
+        holder = DeviceStateHolder(label="gate-legacy")
+        holder.sync(packer.pack(nodes, node_req, groups))
+        churn(500)
+        holder.sync(packer.pack(nodes, node_req, groups))  # warm the jit
+        legacy_draws = []
+        for i in range(4):
+            churn(510 + i)
+            t0 = time.perf_counter()
+            args = holder.sync(packer.pack(nodes, node_req, groups))
+            args[1].block_until_ready()
+            legacy_draws.append(time.perf_counter() - t0)
+    finally:
+        os.environ.pop("BST_SNAPSHOT_LITE", None)
+
+    # event-fold steady state: O(churn) pack_fold + scatter
+    packer = DeltaSnapshotPacker()
+    holder = DeviceStateHolder(label="gate-fold")
+    holder.sync(packer.pack(nodes, node_req, groups))  # keyframe arms lite
+    names = churn(600)
+    snap = packer.pack_fold([(nm, node_req[nm]) for nm in names], [])
+    assert snap is not None and snap.delta.source == "events"
+    holder.sync(snap)  # warm
+    fold_draws = []
+    for i in range(4):
+        names = churn(610 + i)
+        t0 = time.perf_counter()
+        snap = packer.pack_fold([(nm, node_req[nm]) for nm in names], [])
+        args = holder.sync(snap)
+        args[1].block_until_ready()
+        fold_draws.append(time.perf_counter() - t0)
+    assert packer.fold_packs >= 5
+
+    legacy_s = sorted(legacy_draws)[len(legacy_draws) // 2]
+    fold_s = sorted(fold_draws)[len(fold_draws) // 2]
+    speedup = legacy_s / max(fold_s, 1e-9)
+    detail["refresh_legacy_scan_s"] = round(legacy_s, 5)
+    detail["refresh_steady_state_s"] = round(fold_s, 5)
+    detail["steady_state_speedup"] = round(speedup, 1)
+    ok = speedup >= EVENT_REFRESH_FLOOR
+    if not ok:
+        detail["steady_state_fail"] = (
+            f"event-fold refresh {fold_s:.4f}s vs PR 11 scatter-delta "
+            f"{legacy_s:.4f}s = {speedup:.1f}x (floor {EVENT_REFRESH_FLOOR}x)"
+        )
+    return ok
+
+
+def check_churn_sweep(detail):
+    """Refresh wall-clock must scale with CHURN, not N: at 5120 nodes,
+    fold 1% / 5% / 20% of the rows and compare against the snapshot-lite
+    scan pack (O(N) dict compares + O(G) demand diff) under the same
+    churn. Ends with a buffer-identity check against a from-scratch
+    ClusterSnapshot — fold drift would break the bit-compare contract
+    before any digest does. Digest identity across all four refresh
+    paths (event-fold / delta-applied / keyframe-resync / full-repack)
+    is pinned at the small shape where the host oracle is cheap."""
+    from batch_scheduler_tpu.ops.device_state import DeviceStateHolder
+    from batch_scheduler_tpu.ops.snapshot import (
+        ClusterSnapshot,
+        DeltaSnapshotPacker,
+    )
+
+    nodes, groups, node_req = build_inputs(REFRESH_NODES, REFRESH_GROUPS)
+    g_count = len(groups)
+
+    def churn(base, rows):
+        names = []
+        for k in range(rows):
+            name = f"n{(base + k) % REFRESH_NODES:05d}"
+            node_req[name] = {"cpu": 1200 + base + k % 9, "pods": 1 + k % 4}
+            names.append(name)
+        for k in range(max(rows * g_count // REFRESH_NODES, 1)):
+            gi = (base + k) % g_count
+            groups[gi].member_request = {
+                "cpu": 4000 + base + k,
+                "memory": 8 * 1024**3,
+            }
+        return names
+
+    fold_packer = DeltaSnapshotPacker()
+    fold_holder = DeviceStateHolder(label="sweep-fold")
+    fold_holder.sync(fold_packer.pack(nodes, node_req, groups))
+    scan_packer = DeltaSnapshotPacker()
+    scan_holder = DeviceStateHolder(label="sweep-scan")
+    scan_holder.sync(scan_packer.pack(nodes, node_req, groups))
+    # warm both jits outside the clock
+    snap = fold_packer.pack_fold(
+        [(nm, node_req[nm]) for nm in churn(0, 8)],
+        [groups[0]],
+    )
+    assert snap is not None
+    fold_holder.sync(snap)
+    scan_holder.sync(scan_packer.pack(nodes, node_req, groups))
+
+    base = 1000
+    sweep = {}
+    for rows in SWEEP_CHURNS:
+        fold_ts, scan_ts = [], []
+        for rep in range(3):
+            names = churn(base, rows)
+            gis = sorted({(base + k) % g_count for k in range(
+                max(rows * g_count // REFRESH_NODES, 1)
+            )})
+            t0 = time.perf_counter()
+            snap = fold_packer.pack_fold(
+                [(nm, node_req[nm]) for nm in names],
+                [groups[gi] for gi in gis],
+            )
+            assert snap is not None and snap.delta.source == "events"
+            args = fold_holder.sync(snap)
+            args[1].block_until_ready()
+            fold_ts.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            args = scan_holder.sync(
+                scan_packer.pack(nodes, node_req, groups)
+            )
+            args[1].block_until_ready()
+            scan_ts.append(time.perf_counter() - t0)
+            base += rows
+        pct = round(100.0 * rows / REFRESH_NODES)
+        fold_s, scan_s = sorted(fold_ts)[1], sorted(scan_ts)[1]
+        sweep[pct] = (fold_s, scan_s)
+        detail[f"churn_{pct}pct_fold_s"] = round(fold_s, 5)
+        detail[f"churn_{pct}pct_scan_s"] = round(scan_s, 5)
+
+    # fold buffers must equal a from-scratch pack bit-for-bit
+    fresh = ClusterSnapshot(nodes, node_req, groups)
+    arrays_equal = all(
+        np.array_equal(getattr(snap, f), getattr(fresh, f))
+        for f in (
+            "requested",
+            "group_req",
+            "remaining",
+            "min_member",
+            "scheduled",
+            "matched",
+            "ineligible",
+            "order",
+            "creation_rank",
+            "fit_mask",
+        )
+    )
+    detail["churn_sweep_arrays_identical"] = arrays_equal
+
+    # four-path digest identity at the cheap shape
+    four_ok = _four_path_digest(detail)
+
+    lo_fold, lo_scan = sweep[1]
+    hi_fold, _ = sweep[20]
+    low_beats_scan = lo_scan / max(lo_fold, 1e-9)
+    detail["churn_1pct_fold_vs_scan"] = round(low_beats_scan, 1)
+    # loose monotonicity: a fold that secretly scanned all N rows would
+    # make 1% and 20% indistinguishable AND erase the scan advantage
+    monotone = lo_fold <= hi_fold * 1.5
+    ok = arrays_equal and four_ok and low_beats_scan >= 1.3 and monotone
+    if not ok:
+        detail["churn_sweep_fail"] = (
+            f"arrays={arrays_equal} four_path={four_ok} "
+            f"1pct_fold_vs_scan={low_beats_scan:.1f}x (floor 1.3) "
+            f"monotone={monotone} ({lo_fold:.4f}s @1% vs {hi_fold:.4f}s @20%)"
+        )
+    return ok
+
+
+def _four_path_digest(detail) -> bool:
+    """Plan digests bit-identical across event-fold / delta-applied
+    (lite scan) / keyframe-resync / full-repack, over churned rounds."""
+    from batch_scheduler_tpu.ops.device_state import DeviceStateHolder
+    from batch_scheduler_tpu.ops.snapshot import (
+        ClusterSnapshot,
+        DeltaSnapshotPacker,
+    )
+
+    nodes, groups, node_req = build_inputs(IDENTITY_NODES, IDENTITY_GROUPS)
+    fold_packer = DeltaSnapshotPacker()
+    fold_holder = DeviceStateHolder(label="four-fold")
+    fold_holder.sync(fold_packer.pack(nodes, node_req, groups))
+    scan_packer = DeltaSnapshotPacker()
+    scan_holder = DeviceStateHolder(label="four-scan")
+    resync_holder = DeviceStateHolder(label="four-resync")
+    scan_holder.sync(scan_packer.pack(nodes, node_req, groups))
+
+    rounds = []
+    for i in range(3):
+        names = [f"n{(2 * i + k) % IDENTITY_NODES:05d}" for k in range(2)]
+        for nm in names:
+            node_req[nm] = {"cpu": 700 + i, "pods": 2}
+        gi = i % len(groups)
+        groups[gi].member_request = {"cpu": 3500 + i}
+        fold_snap = fold_packer.pack_fold(
+            [(nm, node_req[nm]) for nm in names], [groups[gi]]
+        )
+        if fold_snap is None or fold_snap.delta.source != "events":
+            detail["four_path_fail"] = f"round {i}: fold did not apply"
+            return False
+        d_fold = _digest(fold_holder.sync(fold_snap), fold_snap.progress_args())
+        scan_snap = scan_packer.pack(nodes, node_req, groups)
+        d_scan = _digest(scan_holder.sync(scan_snap), scan_snap.progress_args())
+        resync_holder.reset()
+        d_key = _digest(resync_holder.sync(scan_snap), scan_snap.progress_args())
+        full_snap = ClusterSnapshot(nodes, node_req, groups)
+        d_full = _digest(full_snap.device_args(), full_snap.progress_args())
+        rounds.append((d_fold, d_scan, d_key, d_full))
+    identical = all(a == b == c == d for a, b, c, d in rounds)
+    detail["four_path_rounds"] = len(rounds)
+    detail["four_path_identical"] = identical
+    if not identical:
+        detail["four_path_fail"] = f"digests diverged: {rounds}"
+    return identical
 
 
 def _digest(batch_args, progress_args):
@@ -313,9 +563,27 @@ def main() -> int:
     detail = {}
     checks = {
         "refresh_speedup": check_refresh_speedup,
+        "steady_state": check_steady_state,
+        "churn_sweep": check_churn_sweep,
         "identity_resync": check_identity_and_resync,
         "wire_identity": check_wire_identity,
     }
+    only = {
+        s.strip()
+        for s in os.environ.get("BST_DELTA_GATE_CHECKS", "").split(",")
+        if s.strip()
+    }
+    if only:
+        unknown = only - set(checks)
+        if unknown:
+            print(
+                f"ignoring unknown BST_DELTA_GATE_CHECKS {sorted(unknown)}",
+                file=sys.stderr,
+            )
+        checks = {k: v for k, v in checks.items() if k in only}
+        if not checks:
+            print("BST_DELTA_GATE_CHECKS selected nothing", file=sys.stderr)
+            return 2
     results = {}
     for name, fn in checks.items():
         try:
@@ -332,7 +600,9 @@ def main() -> int:
     doc = artifact.emit(
         {
             "metric": "delta_gate",
-            "value": detail.get("refresh_speedup", 0.0),
+            "value": detail.get(
+                "refresh_speedup", detail.get("steady_state_speedup", 0.0)
+            ),
             "unit": "x_vs_full_repack_refresh",
             "detail": {"ok": ok, "checks": results, **detail},
         },

@@ -16,6 +16,10 @@ slower" tripwire on every build, not a hardware benchmark (that's
 - ``refresh_device_delta_s``  one churned refresh through the
   device-resident path: delta pack + jit'd scatter-update
   (ops.device_state) — the hot path that replaced the full repack
+- ``refresh_steady_state_s``  one churned refresh through the
+  event-fold path: O(churn) ``pack_fold`` + scatter (ops.events /
+  snapshot-lite) — the stage-3 hot path that replaced the full
+  cluster scan behind the delta pack
 - ``capacity_kernel_s``       one capacity-observatory analytics kernel
   run (ops.capacity) at the small bucket — the observatory held to the
   same regression gate it feeds
@@ -73,6 +77,7 @@ TOLERANCES = {
     "oracle_wavefront_batch_s": 1.6,
     "snapshot_pack_s": 1.6,
     "refresh_device_delta_s": 1.6,
+    "refresh_steady_state_s": 2.0,  # sub-ms probe: wider for timer noise
     "capacity_kernel_s": 1.6,
     "coalesce_merge_s": 1.6,
     "metrics_render_s": 1.6,
@@ -189,6 +194,25 @@ def probe_set():
         delta_req[name] = {"cpu": 1000 + tick[0], "pods": 1}
         holder.sync(packer.pack(big_nodes, delta_req, big_groups))
 
+    # event-fold steady-state refresh (snapshot-lite + ops.events): one
+    # O(churn) pack_fold + scatter — the stage-3 hot path that replaced
+    # the full cluster scan behind the delta pack, guarded from day one
+    fold_packer = DeltaSnapshotPacker()
+    fold_holder = DeviceStateHolder(label="perf-probe-fold")
+    fold_req = {
+        nd.metadata.name: {"cpu": 1000, "pods": 1} for nd in big_nodes
+    }
+    fold_holder.sync(fold_packer.pack(big_nodes, fold_req, big_groups))
+    ftick = [0]
+
+    def fold_refresh():
+        ftick[0] += 1
+        name = big_nodes[ftick[0] % len(big_nodes)].metadata.name
+        fold_req[name] = {"cpu": 1000 + ftick[0], "pods": 1}
+        snap = fold_packer.pack_fold([(name, fold_req[name])], [])
+        assert snap is not None  # fold must apply: node list is stable
+        fold_holder.sync(snap)
+
     # capacity-observatory analytics kernel (ops.capacity): the
     # observatory is itself a hot-path hook, so it rides the same gate
     from batch_scheduler_tpu.ops.capacity import capacity_summary
@@ -264,6 +288,7 @@ def probe_set():
         ("oracle_wavefront_batch_s", wavefront, wavefront),
         ("snapshot_pack_s", pack, pack),
         ("refresh_device_delta_s", device_delta, device_delta),
+        ("refresh_steady_state_s", fold_refresh, fold_refresh),
         ("capacity_kernel_s", capacity, capacity),
         ("coalesce_merge_s", coalesce_merge, coalesce_merge),
         ("metrics_render_s", render, render),

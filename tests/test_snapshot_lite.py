@@ -1,0 +1,518 @@
+"""Snapshot-lite, the event-sourced refresh, and device-derived columns
+(ops.snapshot / ops.events / ops.device_state, docs/pipelining.md
+"Snapshot-lite & event ingest"): the persistent-pack keyframe-reason
+matrix, content-based churn detection (in-place GroupDemand mutation),
+queue-order resorts, the EventLog producer/consumer contract, the
+ClusterState emission invariant, pack_fold equivalence + idempotence,
+and the scorer's fold-or-scan refresh with audit provenance — every
+path held to bit-identity against the from-scratch construction."""
+
+import numpy as np
+import pytest
+
+from batch_scheduler_tpu.framework.cluster import ClusterState
+from batch_scheduler_tpu.ops.device_state import (
+    DeviceStateHolder,
+    device_derive_enabled,
+)
+from batch_scheduler_tpu.ops.events import (
+    EventLog,
+    event_fold_enabled,
+    event_log_cap,
+)
+from batch_scheduler_tpu.ops.snapshot import (
+    ClusterSnapshot,
+    DeltaSnapshotPacker,
+    GroupDemand,
+    snapshot_lite_enabled,
+)
+
+from helpers import make_group, make_node, make_pod, status_for
+
+_FIELDS = (
+    "alloc", "requested", "group_req", "remaining", "min_member",
+    "scheduled", "matched", "ineligible", "order", "creation_rank",
+    "fit_mask", "group_valid", "node_valid",
+)
+
+
+def _world(n=8, g=4):
+    nodes = [
+        make_node(f"n{i:02d}", {"cpu": "16", "memory": "64Gi", "pods": "110"})
+        for i in range(n)
+    ]
+    groups = [
+        GroupDemand(
+            full_name=f"default/gang-{i}",
+            min_member=3,
+            member_request={"cpu": 2000, "memory": 4 * 1024**3},
+            creation_ts=float(i),
+        )
+        for i in range(g)
+    ]
+    node_req = {
+        nd.metadata.name: {"cpu": 1000 * (i % 3), "pods": i % 4}
+        for i, nd in enumerate(nodes)
+    }
+    return nodes, groups, node_req
+
+
+def _assert_matches_full(snap, nodes, node_req, groups):
+    """Every packed array bit-identical to a from-scratch construction."""
+    fresh = ClusterSnapshot(nodes, node_req, groups)
+    for f in _FIELDS:
+        assert np.array_equal(
+            np.asarray(getattr(snap, f)), np.asarray(getattr(fresh, f))
+        ), f
+
+
+# -- snapshot-lite pack paths ------------------------------------------------
+
+
+def test_lite_zero_churn_pack_is_noop_delta():
+    nodes, groups, node_req = _world()
+    packer = DeltaSnapshotPacker()
+    snap = packer.pack(nodes, node_req, groups)
+    assert snap.delta.kind == "keyframe"
+    assert packer._lite is not None  # keyframe armed the lite state
+
+    snap2 = packer.pack(nodes, node_req, groups)  # nothing changed
+    assert snap2.delta.kind == "delta"
+    assert snap2.delta.source == "scan"
+    assert snap2.delta.node_rows.tolist() == []
+    assert snap2.delta.group_rows.tolist() == []
+    assert snap2.delta.meta_rows.tolist() == []
+    assert packer.lite_packs == 1
+    _assert_matches_full(snap2, nodes, node_req, groups)
+
+
+def test_lite_keyframe_reason_matrix():
+    """Every documented resync reason still fires under snapshot-lite,
+    and each keyframe re-arms (or drops) the lite state coherently."""
+    nodes, groups, node_req = _world()
+    packer = DeltaSnapshotPacker()
+    packer.pack(nodes, node_req, groups)
+
+    # group-set shrink: positional gang indices break
+    shrunk = groups[:-1]
+    snap = packer.pack(nodes, node_req, shrunk)
+    assert (snap.delta.kind, snap.delta.reason) == ("keyframe", "group-set")
+    assert packer._lite is not None
+    _assert_matches_full(snap, nodes, node_req, shrunk)
+
+    # node-list reorder: positional node indices break
+    reordered = list(reversed(nodes))
+    snap = packer.pack(reordered, node_req, shrunk)
+    assert (snap.delta.kind, snap.delta.reason) == ("keyframe", "node-list")
+    assert packer._lite is not None
+    _assert_matches_full(snap, reordered, node_req, shrunk)
+
+    # schema change on a churned node row: covers miss -> full resync
+    node_req["n00"] = {"nvidia.com/gpu": 2}
+    snap = packer.pack(reordered, node_req, shrunk)
+    assert (snap.delta.kind, snap.delta.reason) == ("keyframe", "node-churn")
+    _assert_matches_full(snap, reordered, node_req, shrunk)
+
+    # schema change on a churned DEMAND row takes the same exit
+    shrunk[0].member_request = {"example.com/widget": 1}
+    snap = packer.pack(reordered, node_req, shrunk)
+    assert (snap.delta.kind, snap.delta.reason) == ("keyframe", "node-churn")
+    _assert_matches_full(snap, reordered, node_req, shrunk)
+
+
+def test_lite_detects_in_place_group_mutation():
+    """Regression: callers mutate GroupDemand objects IN PLACE between
+    packs (the snapshot holds references, not copies) — churn detection
+    must diff captured content, or the packed row goes silently stale."""
+    nodes, groups, node_req = _world()
+    packer = DeltaSnapshotPacker()
+    packer.pack(nodes, node_req, groups)
+
+    groups[1].member_request = {"cpu": 3000}
+    groups[2].scheduled = 2
+    snap = packer.pack(nodes, node_req, groups)
+    assert snap.delta.kind == "delta" and snap.delta.source == "scan"
+    assert snap.delta.group_rows.tolist() == [1]
+    _assert_matches_full(snap, nodes, node_req, groups)
+
+    # the fingerprint advanced with the mutation: the next pack is a no-op
+    snap2 = packer.pack(nodes, node_req, groups)
+    assert snap2.delta.group_rows.tolist() == []
+    _assert_matches_full(snap2, nodes, node_req, groups)
+
+
+def test_lite_meta_churn_resorts_queue_order():
+    nodes, groups, node_req = _world()
+    packer = DeltaSnapshotPacker()
+    packer.pack(nodes, node_req, groups)
+    resorts_before = packer.order_resorts
+
+    groups[3].priority = 50  # jumps the queue
+    groups[0].creation_ts = 99.5  # falls to the back of its band
+    snap = packer.pack(nodes, node_req, groups)
+    assert snap.delta.kind == "delta"
+    assert sorted(snap.delta.meta_rows.tolist()) == [0, 3]
+    assert packer.order_resorts == resorts_before + 1
+    _assert_matches_full(snap, nodes, node_req, groups)
+
+    # meta-quiet churn must NOT pay the resort
+    groups[2].matched = 1
+    packer.pack(nodes, node_req, groups)
+    assert packer.order_resorts == resorts_before + 1
+
+
+def test_lite_selector_appearance_drops_lite_and_stays_exact():
+    """A selector breaks the uniform-fit invariant: the pack falls back
+    to the full construction (rebuilding the per-group fit mask) and the
+    lite state is dropped until the world is uniform again."""
+    nodes, groups, node_req = _world()
+    packer = DeltaSnapshotPacker()
+    packer.pack(nodes, node_req, groups)
+    assert packer._lite is not None
+
+    groups[0].node_selector = {"zone": "a"}
+    nodes[0].metadata.labels = {"zone": "a"}
+    snap = packer.pack(nodes, node_req, groups)
+    assert packer._lite is None  # uniform-fit eligibility gone
+    assert snap.fit_mask.shape[0] > 1  # per-group fit rows are back
+    _assert_matches_full(snap, nodes, node_req, groups)
+
+
+def test_lite_randomized_equivalence_sweep():
+    """Mixed churn — node rows, demand rows (in-place), progress tails,
+    sort keys — across rounds: every lite pack bit-identical to the
+    from-scratch construction."""
+    rng = np.random.RandomState(7)
+    nodes, groups, node_req = _world(n=12, g=6)
+    packer = DeltaSnapshotPacker()
+    packer.pack(nodes, node_req, groups)
+    lite_rounds = 0
+    for rnd in range(10):
+        for _ in range(rng.randint(0, 3)):
+            i = rng.randint(len(nodes))
+            node_req[f"n{i:02d}"] = {
+                "cpu": int(rng.randint(0, 8000)),
+                "pods": int(rng.randint(0, 8)),
+            }
+        gi = rng.randint(len(groups))
+        mode = rng.randint(4)
+        if mode == 0:
+            groups[gi].member_request = {"cpu": int(rng.randint(1, 5000))}
+        elif mode == 1:
+            groups[gi].scheduled = int(rng.randint(0, 3))
+            groups[gi].matched = int(rng.randint(0, 2))
+        elif mode == 2:
+            groups[gi].priority = int(rng.randint(-5, 10))
+        else:
+            groups[gi].released = bool(rng.randint(2))
+        snap = packer.pack(nodes, node_req, groups)
+        _assert_matches_full(snap, nodes, node_req, groups)
+        if snap.delta.kind == "delta":
+            lite_rounds += 1
+    assert lite_rounds == 10  # positionally-stable churn never keyframes
+
+
+# -- pack_fold (the O(churn) event path) ------------------------------------
+
+
+def test_pack_fold_matches_full_construction_and_is_idempotent():
+    nodes, groups, node_req = _world()
+    packer = DeltaSnapshotPacker()
+    packer.pack(nodes, node_req, groups)
+
+    node_req["n05"] = {"cpu": 4321, "pods": 2}
+    update = GroupDemand(
+        full_name="default/gang-2",
+        min_member=3,
+        scheduled=1,
+        member_request={"cpu": 2500},
+        creation_ts=2.0,
+    )
+    groups2 = list(groups)
+    groups2[2] = update
+    snap = packer.pack_fold([("n05", node_req["n05"])], [update])
+    assert snap is not None
+    assert snap.delta.kind == "delta" and snap.delta.source == "events"
+    assert snap.delta.node_rows.tolist() == [5]
+    assert snap.delta.group_rows.tolist() == [2]
+    _assert_matches_full(snap, nodes, node_req, groups2)
+
+    # idempotent: updates carry current state, so a re-fold converges
+    snap2 = packer.pack_fold([("n05", node_req["n05"])], [update])
+    assert snap2 is not None
+    assert snap2.delta.node_rows.tolist() == []
+    assert snap2.delta.group_rows.tolist() == []
+    _assert_matches_full(snap2, nodes, node_req, groups2)
+
+
+def test_pack_fold_bails_to_none_never_guesses():
+    nodes, groups, node_req = _world()
+    packer = DeltaSnapshotPacker()
+
+    # no lite state yet: nothing to fold onto
+    assert packer.pack_fold([("n00", {"cpu": 1})], []) is None
+    packer.pack(nodes, node_req, groups)
+
+    # unknown names cannot be folded positionally
+    assert packer.pack_fold([("ghost", {"cpu": 1})], []) is None
+    stranger = GroupDemand(
+        full_name="default/stranger", min_member=1,
+        member_request={"cpu": 1}, creation_ts=0.0,
+    )
+    assert packer.pack_fold([], [stranger]) is None
+
+    # a row the cached schema cannot pack exactly forces the scan path
+    assert packer.pack_fold([("n01", {"odd.io/lane": 3})], []) is None
+
+    # every bail above was two-phase: the buffers are still exactly the
+    # previous pack, so a follow-up scan pack emits a clean no-op delta
+    snap = packer.pack(nodes, node_req, groups)
+    assert snap.delta.kind == "delta"
+    assert snap.delta.node_rows.tolist() == []
+    _assert_matches_full(snap, nodes, node_req, groups)
+
+
+def test_pack_fold_disabled_with_lite_off(monkeypatch):
+    nodes, groups, node_req = _world()
+    packer = DeltaSnapshotPacker()
+    packer.pack(nodes, node_req, groups)
+    monkeypatch.setenv("BST_SNAPSHOT_LITE", "0")
+    assert packer.pack_fold([("n00", {"cpu": 7})], []) is None
+
+
+# -- EventLog ----------------------------------------------------------------
+
+
+def test_event_log_coalesces_names_and_counts_bumps():
+    log = EventLog(cap=64, label="t")
+    for _ in range(3):
+        log.note_bump("node-requested", ("n1",))
+    log.note_bump("node-requested", ("n2",))
+    log.note_group("default/g1")
+    log.note_group("default/g1")
+    assert log.depth() == 3  # n1, n2, default/g1 — coalesced
+
+    batch = log.drain()
+    assert batch.complete and not batch.empty
+    assert batch.node_names == frozenset({"n1", "n2"})
+    assert batch.group_names == frozenset({"default/g1"})
+    assert batch.bumps == 4
+    assert log.depth() == 0
+    assert log.drain().empty  # drain resets everything
+
+
+def test_event_log_blind_and_structural_break_completeness():
+    log = EventLog(cap=64, label="t")
+    log.note_blind()
+    batch = log.drain()
+    assert batch.blind and not batch.complete
+
+    log.note_bump("node-object", ("n1",))
+    batch = log.drain()
+    assert batch.structural and not batch.complete
+    assert batch.node_names == frozenset({"n1"})
+    assert log.drain().complete  # flags cleared by the drain
+
+
+def test_event_log_cap_overflow_degrades_to_scan():
+    log = EventLog(cap=2, label="t")
+    for i in range(4):
+        log.note_bump("node-requested", (f"n{i}",))
+    batch = log.drain()
+    assert batch.overflow and not batch.complete
+    assert len(batch.node_names) == 2  # bounded: the rest were dropped
+    assert batch.bumps == 4  # bump accounting is NEVER dropped
+    assert log.stats()["dropped"] >= 2
+    assert log.drain().complete
+
+
+def test_cluster_state_emission_invariant():
+    """Every ClusterState version bump reaches subscribers as exactly one
+    event — the equality the scorer's fold-completeness proof rests on."""
+    cluster = ClusterState()
+    log = EventLog(cap=256, label="t")
+    cluster.subscribe_events(log.note_bump)
+
+    base = cluster.version()
+    n1 = make_node("e1", {"cpu": "8", "memory": "32Gi", "pods": "64"})
+    n2 = make_node("e2", {"cpu": "8", "memory": "32Gi", "pods": "64"})
+    cluster.add_node(n1)
+    cluster.add_node(n2)
+    p1 = make_pod("ep-1", requests={"cpu": "1"})
+    p2 = make_pod("ep-2", requests={"cpu": "1"})
+    cluster.assume(p1, "e1")
+    cluster.assume_many([(p2, "e2")])
+    cluster.forget(p1.metadata.uid)
+    batch = log.drain()
+    assert batch.bumps == cluster.version() - base
+    assert batch.structural  # node adds moved the lane schema
+    assert {"e1", "e2"} <= set(batch.node_names)
+
+    # steady state: accounting-only churn keeps the batch fold-eligible
+    base = cluster.version()
+    cluster.assume(make_pod("ep-3", requests={"cpu": "2"}), "e1")
+    batch = log.drain()
+    assert batch.complete
+    assert batch.bumps == cluster.version() - base
+    assert batch.node_names == frozenset({"e1"})
+
+
+# -- knobs -------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "env,fn",
+    [
+        ("BST_SNAPSHOT_LITE", snapshot_lite_enabled),
+        ("BST_EVENT_FOLD", event_fold_enabled),
+        ("BST_DEVICE_DERIVE", device_derive_enabled),
+    ],
+)
+def test_bool_knobs_parse_guard(monkeypatch, env, fn):
+    monkeypatch.delenv(env, raising=False)
+    assert fn() is True
+    monkeypatch.setenv(env, "0")
+    assert fn() is False
+    monkeypatch.setenv(env, "off")
+    assert fn() is False
+    monkeypatch.setenv(env, "bananas")  # degrades to default, never raises
+    assert fn() is True
+
+
+def test_event_log_cap_knob_parse_guard(monkeypatch):
+    monkeypatch.delenv("BST_EVENT_LOG_CAP", raising=False)
+    assert event_log_cap() == 4096
+    monkeypatch.setenv("BST_EVENT_LOG_CAP", "128")
+    assert event_log_cap() == 128
+    monkeypatch.setenv("BST_EVENT_LOG_CAP", "0")
+    assert event_log_cap() == 1  # clamped: a zero cap would never fold
+    monkeypatch.setenv("BST_EVENT_LOG_CAP", "lots")
+    assert event_log_cap() == 4096
+
+
+# -- device-derived columns --------------------------------------------------
+
+
+def test_device_derive_off_matches_derived_columns(monkeypatch):
+    """The device-derived fit/order columns must be byte-identical to the
+    host-computed ones the derive-off path uploads — churn after churn."""
+    nodes, groups, node_req = _world()
+    packer = DeltaSnapshotPacker()
+    on_holder = DeviceStateHolder(label="derive-on")
+    monkeypatch.setenv("BST_DEVICE_DERIVE", "0")
+    off_holder = DeviceStateHolder(label="derive-off")
+    monkeypatch.delenv("BST_DEVICE_DERIVE", raising=False)
+
+    for rnd in range(3):
+        node_req[f"n{rnd:02d}"] = {"cpu": 100 + rnd, "pods": 1}
+        groups[rnd % len(groups)].priority = rnd  # forces order churn
+        snap = packer.pack(nodes, node_req, groups)
+        host_args = snap.device_args()
+        on_args = on_holder.sync(snap)
+        monkeypatch.setenv("BST_DEVICE_DERIVE", "0")
+        off_args = off_holder.sync(snap)
+        monkeypatch.delenv("BST_DEVICE_DERIVE", raising=False)
+        for idx in (4, 6):  # fit_mask, order — the derived columns
+            assert np.array_equal(
+                np.asarray(on_args[idx]), np.asarray(host_args[idx])
+            ), f"round {rnd} derived arg {idx} != host"
+            assert np.array_equal(
+                np.asarray(off_args[idx]), np.asarray(host_args[idx])
+            ), f"round {rnd} uploaded arg {idx} != host"
+
+
+# -- scorer integration: fold-or-scan refresh + audit provenance -------------
+
+
+def _scorer_world():
+    cluster = ClusterState()
+    for i in range(10):
+        cluster.add_node(
+            make_node(f"s{i:02d}", {"cpu": "64", "memory": "256Gi",
+                                    "pods": "110"})
+        )
+    from batch_scheduler_tpu.cache import PGStatusCache
+
+    cache = PGStatusCache()
+    for gi in range(6):
+        pg = make_group(
+            f"g{gi:02d}", 3, min_resources={"cpu": "2", "memory": "4Gi"},
+            creation_ts=100.0 + gi,
+        )
+        status_for(pg, cache)
+    return cluster, cache
+
+
+def test_scorer_event_fold_refresh_end_to_end(tmp_path, monkeypatch):
+    from batch_scheduler_tpu.core.oracle_scorer import OracleScorer
+    from batch_scheduler_tpu.utils.audit import AuditLog, AuditReader
+    from batch_scheduler_tpu.utils import audit as audit_mod
+
+    cluster, cache = _scorer_world()
+    log = AuditLog(str(tmp_path))
+    scorer = OracleScorer(audit_log=log)
+    scorer.ensure_fresh(cluster, cache)
+    assert scorer.snapshot.delta.kind == "keyframe"
+
+    # evented churn: the refresh must FOLD, not scan
+    cluster.assume(
+        make_pod("fx-0", group="g00", requests={"cpu": "2"}), "s03"
+    )
+    scorer.mark_dirty("default/g00")
+    scorer.ensure_fresh(cluster, cache)
+    snap = scorer.snapshot
+    assert snap.delta.kind == "delta" and snap.delta.source == "events"
+    assert snap.delta.node_rows.tolist() == [3]
+    stats = scorer.stats()
+    assert stats["fold_packs"] >= 1
+    assert stats["event_log"]["drains"] >= 2
+
+    # a blind mark forces the scan fallback on the next refresh
+    cluster.assume(make_pod("fx-1", requests={"cpu": "4"}), "s05")
+    scorer.mark_dirty()
+    scorer.ensure_fresh(cluster, cache)
+    assert scorer.snapshot.delta.source == "scan"
+
+    # bit-compare contract: the folded scorer against a from-scratch
+    # scorer with every stage-3 knob off (PR 11 behaviour)
+    d_fold = audit_mod.plan_digest(scorer._state.result)
+    monkeypatch.setenv("BST_SNAPSHOT_LITE", "0")
+    monkeypatch.setenv("BST_EVENT_FOLD", "0")
+    monkeypatch.setenv("BST_DEVICE_DERIVE", "0")
+    legacy = OracleScorer()
+    legacy.ensure_fresh(cluster, cache)
+    assert audit_mod.plan_digest(legacy._state.result) == d_fold
+
+    # audit provenance: replayable records name the refresh path
+    assert log.flush()
+    batches, skipped = AuditReader(str(tmp_path)).batches()
+    assert not skipped and len(batches) >= 3
+    refreshes = [rec.get("refresh") for rec in batches]
+    assert refreshes[0] and refreshes[0]["kind"] == "keyframe"
+    sources = {r["source"] for r in refreshes if r}
+    assert "events" in sources and "scan" in sources
+    for rec in batches:
+        assert rec["refresh"]["generation"] >= 1
+    log.stop()
+
+
+def test_scorer_fold_falls_back_on_unhooked_mutation():
+    """A version bump with no matching event (simulating a mutation that
+    bypassed the hooks) must break the completeness equality and scan."""
+    from batch_scheduler_tpu.core.oracle_scorer import OracleScorer
+
+    cluster, cache = _scorer_world()
+    scorer = OracleScorer()
+    scorer.ensure_fresh(cluster, cache)
+    # fold once so the version baseline is armed
+    cluster.assume(make_pod("vx-0", requests={"cpu": "1"}), "s01")
+    scorer.mark_dirty("default/g01")
+    scorer.ensure_fresh(cluster, cache)
+    assert scorer.snapshot.delta.source == "events"
+
+    # skew: bump the version behind the log's back
+    with cluster._lock:
+        cluster._version += 1
+    scorer.mark_dirty("default/g01")
+    scorer.ensure_fresh(cluster, cache)
+    assert scorer.snapshot.delta.source == "scan"  # never a stale fold
