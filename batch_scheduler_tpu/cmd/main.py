@@ -120,7 +120,8 @@ def _maybe_audit_log(args):
         args.audit_dir, cap_bytes=max(args.audit_cap_mb, 1) * 1024 * 1024
     )
     print(
-        f"audit ring: {args.audit_dir} (cap {args.audit_cap_mb} MB)",
+        f"audit ring: {args.audit_dir} (cap {args.audit_cap_mb} MB, "
+        f"format {log.fmt})",
         flush=True,
     )
     return log
@@ -642,9 +643,10 @@ def cmd_replay(args) -> int:
                 " (WARNING: requested rung fell back to serial)"
                 if rep.get("rung_fell_back") else ""
             )
+            refolded = " (re-folded)" if rep.get("refolded") else ""
             print(
                 f"batch seq={rep['seq']} audit_id={rep['audit_id']} "
-                f"[{args.against}] identical{fell_back}",
+                f"[{args.against}] identical{refolded}{fell_back}",
                 flush=True,
             )
             continue
@@ -667,6 +669,11 @@ def cmd_replay(args) -> int:
         "divergent": divergent,
         "skipped_degraded": skipped_degraded,
         "unreconstructable": len(skipped),
+        # v2 event_batch records reconstructed by re-folding the
+        # recorded event stream (docs/observability.md "Audit format v2")
+        "refolded": sum(
+            1 for r in selected if r.get("record_kind") == "event_batch"
+        ),
         "reports": [
             r for r in reports
             if not r.get("skipped") and not r["identical"]
@@ -851,8 +858,34 @@ def cmd_capacity(args) -> int:
     for rec in batches:
         names = rec.get("names") or {}
         policy = rec.get("policy_args")
+        result = rec["result_arrays"]
+        if rec.get("record_kind") == "event_batch":
+            # v2 event records keep only the compact plan vectors; the
+            # assignment arrays the analytics kernel reads are recovered
+            # by re-executing the re-folded inputs, gated on the recorded
+            # plan digest (the same identity contract `replay` enforces)
+            from ..core.oracle_scorer import replay_batch
+            from ..utils.audit import plan_digest
+
+            host, _ = replay_batch(
+                rec["batch_args"], rec["progress_args"], against="steady",
+                policy=policy,
+            )
+            if plan_digest(host) != rec.get("plan_digest"):
+                divergent += 1
+                series.append({
+                    "seq": rec.get("seq"),
+                    "audit_id": rec.get("audit_id"),
+                    "identical": False,
+                    "error": "re-executed plan diverges from the recorded "
+                             "digest — assignment arrays unrecoverable",
+                })
+                continue
+            result = dict(result)
+            for k in ("assignment_nodes", "assignment_counts"):
+                result.setdefault(k, host[k])
         summary = capacity_summary(
-            rec["batch_args"], rec["result_arrays"],
+            rec["batch_args"], result,
             group_names=names.get("groups") or [],
             scheduled=rec["progress_args"][1],
             matched=rec["progress_args"][2],

@@ -173,6 +173,7 @@ def replay_audit_record(record: dict, against: str = "steady") -> dict:
             "skipped": "degraded conservative-fallback batch — no device "
                        "plan to re-execute",
         }
+    refolded = record.get("record_kind") == "event_batch"
     host, _ = replay_batch(
         record["batch_args"], record["progress_args"], against=against,
         policy=record.get("policy_args"),
@@ -203,12 +204,23 @@ def replay_audit_record(record: dict, against: str = "steady") -> dict:
         out["rung_fell_back"] = True
     if against == "topk" and exec_telemetry.get("scan_topk", 0) <= 0:
         out["rung_fell_back"] = True
+    if refolded:
+        out["refolded"] = True
     if not identical:
         names = record.get("names") or {}
         telemetry = exec_telemetry
         shape = record.get("shape") or {}
+        recorded_result = record["result_arrays"]
+        if refolded:
+            # event_batch records carry a compact result (assignment
+            # arrays omitted — the digest still covers them): substitute
+            # the replayed assignments so the field-by-field compare runs
+            # over the fields the record actually kept
+            recorded_result = dict(recorded_result)
+            for k in ("assignment_nodes", "assignment_counts"):
+                recorded_result.setdefault(k, host[k])
         blame = audit_mod.divergence_report(
-            record["result_arrays"],
+            recorded_result,
             host,
             node_names=names.get("nodes"),
             group_names=names.get("groups"),
@@ -222,6 +234,31 @@ def replay_audit_record(record: dict, against: str = "steady") -> dict:
                 },
             },
         )
+        if refolded:
+            refold = record.get("refold") or {}
+            if blame is None:
+                blame = {
+                    "field": "<assignment>",
+                    "reason": "digest mismatch confined to the assignment "
+                              "arrays, which event_batch records omit — "
+                              "re-execute against an array keyframe to "
+                              "localize the slot",
+                }
+            # name the fold outcome and — when the re-folded input stream
+            # itself diverged — the first differing event batch, so blame
+            # points at the event, not just the downstream array field
+            blame["fold"] = {
+                "outcome": (
+                    "refolded" if refold.get("input_digest_ok", True)
+                    else "input-divergence"
+                ),
+                "refresh": record.get("refresh"),
+            }
+            if refold.get("first_divergent_event") is not None:
+                blame["field"] = "<event-stream>"
+                blame["first_divergent_event"] = (
+                    refold["first_divergent_event"]
+                )
         out["blame"] = blame or {
             "field": "<record>",
             "reason": "digest mismatch but every plan field matches — "
@@ -766,6 +803,24 @@ class OracleScorer:
                         node_updates, group_updates
                     )
                 outcome = "folded" if snap is not None else "packer-bail"
+                if snap is not None:
+                    from ..ops.snapshot import _demand_fp
+
+                    # audit v2 (utils.audit): the exact drained,
+                    # name-coalesced batch this pack consumed, stashed so
+                    # the publish path can record an event_batch record
+                    # the replayer re-folds. Node dicts are copied —
+                    # cluster.node_requested returns live accounting
+                    snap.event_fold = {
+                        "bumps": int(batch.bumps),
+                        "nodes": [
+                            (name, dict(d)) for name, d in node_updates
+                        ],
+                        "groups": [
+                            (g.full_name, _demand_fp(g))
+                            for g in group_updates
+                        ],
+                    }
         from ..utils.metrics import DEFAULT_REGISTRY
 
         DEFAULT_REGISTRY.counter(
@@ -1052,6 +1107,10 @@ class OracleScorer:
                         "group_rows": [int(i) for i in delta.group_rows],
                         "meta_rows": [int(i) for i in delta.meta_rows],
                     }
+                # audit v2 payloads (no-ops under the array format): the
+                # drained event batch a fold pack consumed, and the
+                # snapshot-lite re-fold base a keyframe must carry
+                lite_fps = getattr(snap, "lite_fps", None)
                 self.audit_log.record_batch(
                     batch_args=snap.device_args(),
                     progress_args=snap.progress_args(),
@@ -1066,6 +1125,11 @@ class OracleScorer:
                     telemetry=telemetry or {},
                     policy=policy_payload,
                     extra=extra,
+                    event_fold=getattr(snap, "event_fold", None),
+                    refold=(
+                        (snap.schema, lite_fps)
+                        if lite_fps is not None else None
+                    ),
                 )
             if (
                 self._identity is not None

@@ -812,6 +812,12 @@ class DeltaSnapshotPacker:
         self._requested = lite.pad_requested[:n]
         self._group_prev = lite.pad_group_req[:g_count]
         snap.meta_cols = lite.meta
+        # audit v2 re-fold base (utils.audit): the per-gang demand
+        # fingerprints a keyframe record must carry so the replayer can
+        # prime this exact lite state and re-run recorded event folds.
+        # A shallow list copy — fp tuples are immutable, and later
+        # in-place `fps[gi] = ...` updates must not leak into the record
+        snap.lite_fps = list(lite.fps)
 
     def _plan_group_change(self, gi: int, old_fp: tuple, g: GroupDemand):
         """Validate-only half of a lite group update: returns None when
@@ -967,6 +973,8 @@ class DeltaSnapshotPacker:
         snap.policy_cols = None
         snap.meta_cols = lite.meta
         snap.delta = delta
+        # audit v2 re-fold base — see _capture_lite
+        snap.lite_fps = list(lite.fps)
         return snap
 
     def _lite_emit(

@@ -47,6 +47,23 @@ segments are deleted once the directory exceeds ``cap_bytes``. The reader
 delta records (reported as unreconstructable, never a crash) until the
 next keyframe.
 
+Audit format v2 (``BST_AUDIT_FORMAT=v2``): the event-sourced refresh
+(PR 17) made the steady-state pack an O(churn) fold of drained event
+batches, and v2 records THAT stream instead of array deltas. Batches
+whose snapshot came from a ``pack_fold`` are written as ``event_batch``
+records — the drained, name-coalesced (names, bumps) event batch, a
+compact result (assignment arrays omitted; the digest still covers
+them), and an ``input_digest`` over the exact padded inputs — while
+every non-fold refresh and every ``BST_AUDIT_KEYFRAME_EVERY``-th record
+stays a full array keyframe that additionally carries the snapshot-lite
+re-fold base (lane schema + per-gang demand fingerprints). The reader
+reconstructs event records by priming a real DeltaSnapshotPacker from
+the nearest keyframe and re-running ``pack_fold`` on the recorded
+batches — the same machinery the scorer used — then bit-checks each
+step against the recorded ``input_digest``. Old readers skip the new
+kind; array-format records are untouched. See docs/observability.md
+("Audit format v2").
+
 See docs/observability.md ("Audit log & replay") for the record schema
 and retention knobs.
 """
@@ -59,8 +76,10 @@ import hashlib
 import json
 import os
 import queue
+import sys
 import threading
 import time
+import weakref
 from typing import Dict, Iterator, List, Optional
 
 import numpy as np
@@ -73,9 +92,14 @@ __all__ = [
     "canonical_plan",
     "config_fingerprint",
     "divergence_report",
+    "audit_format",
+    "audit_keyframe_every",
+    "input_digest",
+    "ring_stats",
     "PLAN_FIELDS",
     "BATCH_ARG_NAMES",
     "PROGRESS_ARG_NAMES",
+    "EVENT_RESULT_FIELDS",
 ]
 
 # the plan fields the digest covers, in canonical order — everything a
@@ -119,6 +143,162 @@ _DELTA_ARRAYS = (
 
 _BOOL_ARRAYS = ("fit_mask", "group_valid", "ineligible", "placed",
                 "gang_feasible")
+
+# the plan fields an event_batch record carries inline. The [G,K]
+# assignment arrays dominate record size (≈340 KB base64 at the
+# north-star G=2048/K=16 shape — more than every event payload combined)
+# and are already covered by the recorded plan_digest, so v2 omits them:
+# replay recomputes the plan from re-folded inputs and the digest
+# bit-checks assignments too.
+EVENT_RESULT_FIELDS = ("placed", "gang_feasible", "progress", "best",
+                       "best_exists")
+
+_FORMAT_ENV = "BST_AUDIT_FORMAT"
+_format_warned = [False]
+
+
+def audit_format() -> str:
+    """Parse-guarded ``BST_AUDIT_FORMAT`` read: ``array`` (default) keeps
+    the PR 5 keyframe+row-delta ARRAY records; ``v2`` records the event
+    stream itself between periodic array keyframes (docs/observability.md
+    "Audit format v2"). Unrecognized values warn once to stderr and keep
+    the default — a typo must degrade the ring format, never crash the
+    scheduler."""
+    raw = os.environ.get(_FORMAT_ENV, "").strip().lower()
+    if raw in ("", "array", "v1"):
+        return "array"
+    if raw == "v2":
+        return "v2"
+    if not _format_warned[0]:
+        _format_warned[0] = True
+        print(
+            f"ignoring unrecognized {_FORMAT_ENV}={raw!r} "
+            "(expected 'array' or 'v2'); audit format stays 'array'",
+            file=sys.stderr,
+        )
+    return "array"
+
+
+_KEYFRAME_ENV = "BST_AUDIT_KEYFRAME_EVERY"
+_KEYFRAME_DEFAULT = 16
+_keyframe_warned = [False]
+
+
+def audit_keyframe_every() -> int:
+    """Parse-guarded ``BST_AUDIT_KEYFRAME_EVERY`` read: the audit chain
+    length — every Nth batch record is a full array keyframe (delta or
+    event records ride between). Non-integer values warn once and keep
+    the default; values below 1 clamp to 1 (every record full)."""
+    raw = os.environ.get(_KEYFRAME_ENV, "").strip()
+    if not raw:
+        return _KEYFRAME_DEFAULT
+    try:
+        return max(int(raw), 1)
+    except ValueError:
+        if not _keyframe_warned[0]:
+            _keyframe_warned[0] = True
+            print(
+                f"ignoring non-integer {_KEYFRAME_ENV}={raw!r}; "
+                f"keyframe cadence stays {_KEYFRAME_DEFAULT}",
+                file=sys.stderr,
+            )
+        return _KEYFRAME_DEFAULT
+
+
+def input_digest(arrays: Dict[str, np.ndarray]) -> str:
+    """sha256 over the canonical batch+progress input arrays in argument
+    order — the v2 bit-identity token for INPUTS (plan_digest covers
+    outputs): recorded on every v2 batch record, recomputed after each
+    reader-side re-fold, so a divergent event stream is localized to the
+    exact first differing event batch rather than discovered as an
+    unexplained plan mismatch downstream."""
+    h = hashlib.sha256()
+    for name in BATCH_ARG_NAMES + PROGRESS_ARG_NAMES:
+        a = np.asarray(arrays[name])
+        if a.dtype == bool:
+            a = np.ascontiguousarray(a, dtype=np.uint8)
+        else:
+            a = np.ascontiguousarray(a, dtype="<i4")
+        h.update(name.encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def _fp_payload(fp) -> list:
+    """JSON form of an ops.snapshot._demand_fp tuple — the per-gang
+    demand state a v2 record carries (group updates in event records,
+    the full roster in keyframe re-fold bases). The tuple round-trips
+    exactly: sorted member-request items stay sorted through a dict,
+    and JSON preserves float creation_ts bit-for-bit."""
+    return [
+        [[str(k), int(v)] for k, v in fp[0]],
+        int(fp[1]), int(fp[2]), int(fp[3]), int(fp[4]), float(fp[5]),
+        bool(fp[6]), bool(fp[7]),
+    ]
+
+
+def _fp_from_payload(p) -> tuple:
+    return (
+        tuple((str(k), int(v)) for k, v in p[0]),
+        int(p[1]), int(p[2]), int(p[3]), int(p[4]), float(p[5]),
+        bool(p[6]), bool(p[7]),
+    )
+
+
+def _demand_from_fp(full_name: str, fp: tuple, demand_cls):
+    """A GroupDemand whose _demand_fp reproduces ``fp`` exactly — the
+    reader-side reconstruction of a recorded gang, complete for every
+    field the fold path reads (selector/toleration-bearing gangs bail
+    the live fold, so they never reach an event record)."""
+    return demand_cls(
+        full_name=full_name,
+        min_member=fp[1],
+        scheduled=fp[2],
+        matched=fp[3],
+        priority=fp[4],
+        creation_ts=fp[5],
+        member_request=dict(fp[0]),
+        released=fp[6],
+        has_pod=fp[7],
+    )
+
+
+# every live AuditLog, for the /debug/perf compression readout
+# (utils.profiler.perf_report) — weak so a dropped log disappears from
+# the report instead of leaking
+_ACTIVE_LOGS: "weakref.WeakSet[AuditLog]" = weakref.WeakSet()
+
+
+def ring_stats() -> List[dict]:
+    """Per-ring telemetry for every live AuditLog: on-disk ring size,
+    record/byte counts by kind, and the bytes-per-record compression
+    readout surfaced at ``/debug/perf`` (docs/observability.md "Audit
+    format v2")."""
+    out = []
+    for log in sorted(_ACTIVE_LOGS, key=lambda l: l.directory):
+        written = log.records_written
+        by_kind = {}
+        for kind, count in sorted(log.kind_counts.items()):
+            kbytes = log.kind_bytes.get(kind, 0)
+            by_kind[kind] = {
+                "records": count,
+                "bytes": kbytes,
+                "bytes_per_record": round(kbytes / count, 1) if count else 0.0,
+            }
+        out.append({
+            "dir": log.directory,
+            "format": log.fmt,
+            "ring_bytes": log.ring_bytes,
+            "records_written": written,
+            "records_dropped": log.records_dropped,
+            "bytes_written": log.bytes_written,
+            "bytes_per_record": (
+                round(log.bytes_written / written, 1) if written else 0.0
+            ),
+            "by_kind": by_kind,
+        })
+    return out
 
 
 def new_audit_id() -> str:
@@ -317,7 +497,9 @@ class AuditLog:
     size), ``segment_bytes`` (rotation granularity — also the keyframe
     blast radius: a deleted segment loses at most its own records plus the
     delta tail that depended on its last keyframe), ``keyframe_every``
-    (delta chain length; 1 = every record full).
+    (delta/event chain length; 1 = every record full; defaults from
+    ``BST_AUDIT_KEYFRAME_EVERY``), ``fmt`` (``array`` or ``v2``; defaults
+    from ``BST_AUDIT_FORMAT``).
     """
 
     def __init__(
@@ -325,14 +507,23 @@ class AuditLog:
         directory: str,
         cap_bytes: int = 256 * 1024 * 1024,
         segment_bytes: int = 8 * 1024 * 1024,
-        keyframe_every: int = 16,
+        keyframe_every: Optional[int] = None,
         queue_max: int = 64,
+        fmt: Optional[str] = None,
     ):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self.cap_bytes = max(int(cap_bytes), 1)
         self.segment_bytes = max(int(segment_bytes), 4096)
-        self.keyframe_every = max(int(keyframe_every), 1)
+        self.keyframe_every = (
+            audit_keyframe_every() if keyframe_every is None
+            else max(int(keyframe_every), 1)
+        )
+        if fmt is None:
+            fmt = audit_format()
+        if fmt not in ("array", "v2"):
+            raise ValueError(f"unknown audit format {fmt!r}")
+        self.fmt = fmt
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_max)
         # resume the seq counter past an existing ring: a restarted
         # process appending to the same directory must not mint duplicate
@@ -348,13 +539,33 @@ class AuditLog:
         self.records_dropped = 0
         self.write_errors = 0
         self.bytes_written = 0
+        self.ring_bytes = self._scan_ring_bytes()
+        self.kind_counts: Dict[str, int] = {}
+        self.kind_bytes: Dict[str, int] = {}
+        # publish-order counter (hot path) vs last id serialized (writer
+        # thread): a queue-full drop consumes an id, so the writer sees a
+        # gap and knows the on-disk chain is missing a fold step — the
+        # next v2 record must re-keyframe rather than ride as an event
+        self._pub = 0
+        self._last_pub = 0
+        # True while the on-disk v2 chain is rooted at a keyframe that
+        # carries a re-fold base; a keyframe without one (non-lite pack)
+        # forces the next fold record to keyframe too
+        self._refold_chain = False
         self._config = None  # computed lazily on the writer thread
         from .metrics import DEFAULT_REGISTRY
 
         self._written_counter = DEFAULT_REGISTRY.counter(
             "bst_audit_records_total",
-            "Audit records by outcome (written / dropped on a full queue)",
+            "Audit records by record kind and outcome "
+            "(written / dropped on a full queue)",
         )
+        self._ring_gauge = DEFAULT_REGISTRY.gauge(
+            "bst_audit_ring_bytes",
+            "On-disk audit ring size in bytes, labeled by ring directory",
+        )
+        self._ring_gauge.set(float(self.ring_bytes), ring=self.directory)
+        _ACTIVE_LOGS.add(self)
         self._thread = threading.Thread(
             target=self._loop, name="audit-writer", daemon=True
         )
@@ -378,12 +589,22 @@ class AuditLog:
         telemetry: Optional[dict] = None,
         extra: Optional[dict] = None,
         policy=None,
+        event_fold: Optional[dict] = None,
+        refold=None,
     ) -> str:
         """Enqueue one batch record; returns its audit ID. Array arguments
         are held BY REFERENCE — callers pass published (immutable)
         snapshot/result arrays only. ``policy`` is the batch's
         ``(policy_cols, terms, weights)`` payload when it ran the policy
-        rung — recorded so replay re-executes the exact composite."""
+        rung — recorded so replay re-executes the exact composite.
+
+        v2 payloads (both ignored under the array format): ``event_fold``
+        is the drained event batch this snapshot was folded from
+        (``{"bumps", "nodes": [(name, req_dict)...], "groups":
+        [(full_name, demand_fp)...]}``, stashed by the scorer's
+        ``_try_fold``); ``refold`` is the snapshot-lite re-fold base
+        ``(schema, demand_fps)`` a keyframe must carry for later event
+        records to reconstruct from."""
         aid = audit_id or new_audit_id()
         item = {
             "kind": "batch",
@@ -407,6 +628,11 @@ class AuditLog:
             }
         if extra:
             item.update(extra)
+        if self.fmt == "v2":
+            item["_event_fold"] = event_fold
+            item["_refold"] = refold
+            self._pub += 1
+            item["_pub"] = self._pub
         self._enqueue(item)
         return aid
 
@@ -421,7 +647,9 @@ class AuditLog:
             self._q.put_nowait(item)
         except queue.Full:
             self.records_dropped += 1
-            self._written_counter.inc(outcome="dropped")
+            self._written_counter.inc(
+                outcome="dropped", kind=item.get("kind", "batch")
+            )
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -458,6 +686,8 @@ class AuditLog:
             "audit_dropped": self.records_dropped,
             "audit_write_errors": self.write_errors,
             "audit_bytes": self.bytes_written,
+            "audit_ring_bytes": self.ring_bytes,
+            "audit_format": self.fmt,
             "audit_dir": self.directory,
         }
 
@@ -496,6 +726,15 @@ class AuditLog:
                 return best
         return 0
 
+    def _scan_ring_bytes(self) -> int:
+        total = 0
+        for path in glob.glob(os.path.join(self.directory, "audit-*.jsonl")):
+            try:
+                total += os.path.getsize(path)
+            except OSError:
+                pass
+        return total
+
     def _loop(self) -> None:
         while True:
             item = self._q.get()
@@ -508,7 +747,14 @@ class AuditLog:
                 line = self._serialize(item)
                 self._append(line)
                 self.records_written += 1
-                self._written_counter.inc(outcome="written")
+                # kind AFTER serialization: a v2 batch item resolves to
+                # "batch" (keyframe) or "event_batch" there
+                kind = item.get("kind", "batch")
+                self.kind_counts[kind] = self.kind_counts.get(kind, 0) + 1
+                self.kind_bytes[kind] = (
+                    self.kind_bytes.get(kind, 0) + len(line) + 1
+                )
+                self._written_counter.inc(outcome="written", kind=kind)
             except Exception:  # noqa: BLE001 — auditing must never crash serving
                 self.write_errors += 1
                 # _serialize may have advanced _prev before the append
@@ -524,6 +770,9 @@ class AuditLog:
         arrays: Dict[str, np.ndarray] = item.pop("_arrays")
         result: Dict[str, np.ndarray] = item.pop("_result")
         names = item.pop("_names")
+        ev = item.pop("_event_fold", None)
+        refold = item.pop("_refold", None)
+        pub = item.pop("_pub", 0)
         self._seq += 1
         item["seq"] = self._seq
         item["shape"] = {
@@ -533,10 +782,18 @@ class AuditLog:
             "mask_rows": int(np.asarray(arrays["fit_mask"]).shape[0]),
         }
         snap = {k: np.asarray(v) for k, v in arrays.items()}
+        names_t = (tuple(names[0]), tuple(names[1]))
+        if self.fmt == "v2":
+            line = self._serialize_v2(
+                item, snap, names, names_t, result, ev, refold, pub
+            )
+            self._prev = snap
+            self._prev_names = names_t
+            return line
         keyframe = (
             self._prev is None
             or self._since_keyframe >= self.keyframe_every - 1
-            or self._prev_names != (tuple(names[0]), tuple(names[1]))
+            or self._prev_names != names_t
             # a policy flip mid-run changes the array SET: force a
             # keyframe so the reader's rolling state never carries stale
             # policy columns across the boundary
@@ -577,11 +834,75 @@ class AuditLog:
             }
             self._since_keyframe += 1
         self._prev = snap
-        self._prev_names = (tuple(names[0]), tuple(names[1]))
+        self._prev_names = names_t
         item["config"] = self._config  # set at this (or an earlier) keyframe
         item["result"] = {
             k: _enc(v) for k, v in canonical_plan(result).items()
         }
+        return json.dumps(item, default=str, sort_keys=True)
+
+    def _serialize_v2(
+        self, item, snap, names, names_t, result, ev, refold, pub
+    ) -> str:
+        """v2 record: an ``event_batch`` (the drained event batch this
+        snapshot was folded from, a compact result, and the input digest)
+        when the fold chain is intact, else a full array keyframe that
+        also carries the snapshot-lite re-fold base. Every record carries
+        ``input_digest`` so the reader can bit-check each re-fold step."""
+        # a queue-full drop consumed a publish id without reaching disk:
+        # contiguity broken means the recorded event stream is missing a
+        # fold step, so the next record must re-anchor with full arrays
+        contiguous = pub == self._last_pub + 1
+        self._last_pub = pub
+        use_event = (
+            ev is not None
+            and contiguous
+            and self._refold_chain
+            and self._prev is not None
+            and self._since_keyframe < self.keyframe_every - 1
+            and self._prev_names == names_t
+            and set(self._prev) == set(snap)
+            and all(self._prev[k].shape == snap[k].shape for k in snap)
+        )
+        item["input_digest"] = input_digest(snap)
+        plan = canonical_plan(result)
+        if use_event:
+            item["kind"] = "event_batch"
+            item["keyframe"] = False
+            item["events"] = {
+                "bumps": int(ev.get("bumps", 0)),
+                "nodes": [
+                    [str(nm), {str(k): int(v) for k, v in d.items()}]
+                    for nm, d in ev.get("nodes", ())
+                ],
+                "groups": [
+                    [str(nm), _fp_payload(fp)]
+                    for nm, fp in ev.get("groups", ())
+                ],
+            }
+            item["result"] = {
+                k: _enc(v) for k, v in plan.items()
+                if k in EVENT_RESULT_FIELDS
+            }
+            self._since_keyframe += 1
+        else:
+            self._config = config_fingerprint()
+            item["keyframe"] = True
+            item["names"] = {"nodes": names[0], "groups": names[1]}
+            item["arrays"] = {k: _enc(v) for k, v in snap.items()}
+            item["result"] = {k: _enc(v) for k, v in plan.items()}
+            if refold is not None:
+                schema, fps = refold
+                item["lite"] = {
+                    "schema": {
+                        "names": list(schema.names),
+                        "shifts": list(schema.shifts),
+                    },
+                    "fps": [_fp_payload(fp) for fp in fps],
+                }
+            self._since_keyframe = 0
+            self._refold_chain = refold is not None
+        item["config"] = self._config
         return json.dumps(item, default=str, sort_keys=True)
 
     def _append(self, line: str) -> None:
@@ -600,6 +921,8 @@ class AuditLog:
             f.write(data)
         self._segment_size += len(data)
         self.bytes_written += len(data)
+        self.ring_bytes += len(data)
+        self._ring_gauge.set(float(self.ring_bytes), ring=self.directory)
         # cap enforcement on ROTATION only: the cap can only newly be
         # exceeded as segments grow, and per-append glob+stat of every
         # segment (~33 metadata syscalls/record at the default sizing)
@@ -627,6 +950,11 @@ class AuditLog:
                 total -= size
             except OSError:
                 pass
+        # the glob+stat pass is authoritative: resync the incremental
+        # ring-size counter (and its gauge) here rather than trusting
+        # per-append arithmetic across deletions
+        self.ring_bytes = total
+        self._ring_gauge.set(float(total), ring=self.directory)
 
 
 # ---------------------------------------------------------------------------
@@ -640,7 +968,20 @@ class AuditReader:
     state). Delta records whose keyframe rotated out of the ring are
     yielded as ``{"kind": "unreconstructable", ...}`` — the ring losing
     its head is expected behavior, not corruption — and reconstruction
-    resumes at the next keyframe."""
+    resumes at the next keyframe.
+
+    v2 ``event_batch`` records are reconstructed by RE-FOLDING: each
+    keyframe primes a live DeltaSnapshotPacker from its recorded re-fold
+    base (lane schema + demand fingerprints + padded arrays), and every
+    event record then runs the recorded (names, bumps) batch through the
+    same ``pack_fold`` the scorer used, yielding the exact padded
+    ``[N,R]``/``[G,R]`` inputs (``record_kind: "event_batch"`` on the
+    reconstructed record). Each step is bit-checked against the recorded
+    ``input_digest``; the first mismatch is remembered and attached to
+    every later record of the chain as ``refold.first_divergent_event``.
+    An event record with no live base (rotated-away keyframe, fold bail,
+    snapshot-lite disabled) is unreconstructable with the fold outcome
+    named — never a crash."""
 
     def __init__(self, directory: str):
         self.directory = directory
@@ -651,6 +992,7 @@ class AuditReader:
     def records(self) -> Iterator[dict]:
         state: Optional[Dict[str, np.ndarray]] = None
         names: Optional[dict] = None
+        fold: Optional[dict] = None
         for path in self.segments():
             try:
                 with open(path) as f:
@@ -669,15 +1011,21 @@ class AuditReader:
                     yield {"kind": "unreconstructable",
                            "reason": "undecodable line", "segment": path}
                     state = None
+                    fold = None
                     continue
                 if rec.get("kind") == "event":
                     yield rec
+                    continue
+                if rec.get("kind") == "event_batch":
+                    out, skip, fold = self._refold_event(rec, fold, names)
+                    yield out if out is not None else skip
                     continue
                 if rec.get("kind") != "batch":
                     continue
                 if rec.get("keyframe"):
                     state = {k: _dec(v) for k, v in rec["arrays"].items()}
                     names = rec.get("names") or {"nodes": [], "groups": []}
+                    fold = self._prime_refold(rec, state)
                 else:
                     if state is None:
                         yield {
@@ -714,6 +1062,221 @@ class AuditReader:
                 }
                 out["names"] = names or {"nodes": [], "groups": []}
                 yield out
+
+    # -- v2 re-fold ----------------------------------------------------------
+
+    def _prime_refold(self, rec: dict, state: Dict[str, np.ndarray]):
+        """Re-fold state from a keyframe: a live DeltaSnapshotPacker whose
+        snapshot-lite buffers hold exactly the recorded arrays, primed
+        from the keyframe's ``lite`` payload (lane schema + per-gang
+        demand fingerprints). Returns a dict — ``{"ok": True, "packer",
+        ...}`` or ``{"ok": False, "outcome", "reason"}`` explaining why
+        event records under this keyframe cannot re-fold. Never raises:
+        reader robustness is the PR 5 recovery discipline."""
+        lite_payload = rec.get("lite")
+        if not lite_payload:
+            return {
+                "ok": False,
+                "outcome": "no-base",
+                "reason": "keyframe carries no re-fold base (the pack was "
+                          "not snapshot-lite); event records under it "
+                          "cannot re-fold",
+            }
+        try:
+            from ..ops.lanes import CORE_LANES, LaneSchema
+            from ..ops.oracle import GANG_MAX
+            from ..ops.snapshot import (
+                DeltaSnapshotPacker,
+                GroupDemand,
+                _I32_MAX,
+                _LiteState,
+                _ts_sort_keys,
+                snapshot_lite_enabled,
+            )
+        except Exception as exc:  # noqa: BLE001
+            return {"ok": False, "outcome": "import-error",
+                    "reason": f"re-fold machinery unavailable: {exc!r}"}
+        if not snapshot_lite_enabled():
+            return {
+                "ok": False,
+                "outcome": "disabled",
+                "reason": "snapshot-lite disabled in the replay "
+                          "environment (BST_SNAPSHOT_LITE) — event "
+                          "records cannot re-fold",
+            }
+        try:
+            sch = lite_payload["schema"]
+            schema = LaneSchema(
+                extended=tuple(sch["names"][len(CORE_LANES):]),
+                shifts=dict(zip(sch["names"], sch["shifts"])),
+            )
+            if list(schema.names) != [str(n) for n in sch["names"]]:
+                return {"ok": False, "outcome": "schema-mismatch",
+                        "reason": "recorded lane schema does not extend "
+                                  "the core lanes"}
+            rec_names = rec.get("names") or {}
+            node_names = [str(n) for n in rec_names.get("nodes") or []]
+            group_names = [str(n) for n in rec_names.get("groups") or []]
+            fps = [_fp_from_payload(p) for p in lite_payload["fps"]]
+            if len(fps) != len(group_names):
+                return {"ok": False, "outcome": "schema-mismatch",
+                        "reason": "re-fold base group count does not "
+                                  "match the recorded group names"}
+            if np.asarray(state["fit_mask"]).shape[0] != 1:
+                return {"ok": False, "outcome": "no-base",
+                        "reason": "keyframe fit mask is per-gang (not a "
+                                  "snapshot-lite pack); event records "
+                                  "under it cannot re-fold"}
+            demands = [
+                _demand_from_fp(nm, fp, GroupDemand)
+                for nm, fp in zip(group_names, fps)
+            ]
+            n, g = len(node_names), len(group_names)
+            nb = int(state["alloc"].shape[0])
+            gb = int(state["group_req"].shape[0])
+            # meta columns exactly as ops.snapshot._capture_lite builds
+            # them — the device-derived queue order must re-sort from
+            # identical keys or a re-folded reorder would diverge
+            prio = np.array([d.priority for d in demands], dtype=np.int64)
+            ts_hi_r, ts_lo_r = _ts_sort_keys(
+                np.array([d.creation_ts for d in demands], dtype=np.float64)
+            )
+            rank = np.empty(g, dtype=np.int32)
+            rank[sorted(range(g), key=lambda i: demands[i].full_name)] = (
+                np.arange(g, dtype=np.int32)
+            )
+            inv_prio = np.full(gb, _I32_MAX, dtype=np.int32)
+            inv_prio[:g] = ~prio.astype(np.int32)
+            ts_hi = np.full(gb, _I32_MAX, dtype=np.int32)
+            ts_hi[:g] = ts_hi_r
+            ts_lo = np.full(gb, _I32_MAX, dtype=np.int32)
+            ts_lo[:g] = ts_lo_r
+            name_rank = np.arange(gb, dtype=np.int32)
+            name_rank[:g] = rank
+            lite = _LiteState(
+                n=n, g=g, nb=nb, gb=gb,
+                node_names=tuple(node_names),
+                group_names=tuple(group_names),
+                node_index={nm: i for i, nm in enumerate(node_names)},
+                group_index={nm: i for i, nm in enumerate(group_names)},
+                node_names_list=node_names,
+                group_names_list=group_names,
+                demands=demands,
+                fps=fps,
+                gang_bound=min(GANG_MAX, (2 ** 31 - 1) // max(nb, 1)),
+                pad_alloc=state["alloc"],
+                pad_requested=state["requested"].copy(),
+                pad_group_req=state["group_req"].copy(),
+                remaining=state["remaining"].copy(),
+                min_member=state["min_member"].copy(),
+                scheduled=state["scheduled"].copy(),
+                matched=state["matched"].copy(),
+                ineligible=state["ineligible"].copy(),
+                fit_row=state["fit_mask"],
+                node_valid=np.asarray(state["fit_mask"])[0],
+                group_valid=state["group_valid"],
+                order=state["order"],
+                creation_rank=state["creation_rank"],
+                meta=(inv_prio, ts_hi, ts_lo, name_rank),
+            )
+            packer = DeltaSnapshotPacker()
+            packer.schema = schema
+            packer._node_names = tuple(node_names)
+            # None sentinels: the first event touching a node always
+            # re-packs its row, and re-packing under the recorded schema
+            # is bit-identical to the row already in the keyframe
+            packer._req_dicts = [None] * n
+            packer._group_names = tuple(group_names)
+            packer._lite = lite
+            packer._requested = lite.pad_requested[:n]
+            packer._group_prev = lite.pad_group_req[:g]
+        except Exception as exc:  # noqa: BLE001 — never crash the reader
+            return {"ok": False, "outcome": "prime-error",
+                    "reason": f"re-fold base priming failed: {exc!r}"}
+        return {"ok": True, "packer": packer, "divergent": None,
+                "demand_cls": GroupDemand}
+
+    def _refold_event(self, rec: dict, fold, names):
+        """(reconstructed record, skip record, fold state) for one
+        ``event_batch`` record: exactly one of the first two is not None."""
+
+        def unrec(reason: str, outcome: str):
+            return None, {
+                "kind": "unreconstructable",
+                "seq": rec.get("seq"),
+                "audit_id": rec.get("audit_id"),
+                "reason": reason,
+                "fold_outcome": outcome,
+            }, fold
+
+        if fold is None:
+            return unrec(
+                "event-batch record before any keyframe "
+                "(ring rotated past its keyframe)",
+                "no-base",
+            )
+        if not fold.get("ok"):
+            return unrec(fold.get("reason", "re-fold base unavailable"),
+                         fold.get("outcome", "no-base"))
+        packer = fold["packer"]
+        demand_cls = fold["demand_cls"]
+        try:
+            ev = rec.get("events") or {}
+            node_updates = [
+                (str(nm), {str(k): int(v) for k, v in d.items()})
+                for nm, d in ev.get("nodes", ())
+            ]
+            group_updates = [
+                _demand_from_fp(str(nm), _fp_from_payload(p), demand_cls)
+                for nm, p in ev.get("groups", ())
+            ]
+            snap = packer.pack_fold(node_updates, group_updates)
+        except Exception as exc:  # noqa: BLE001 — never crash the reader
+            fold = {"ok": False, "outcome": "refold-error",
+                    "reason": f"re-folding a recorded event batch raised "
+                              f"{exc!r}; chain broken until the next "
+                              f"keyframe"}
+            _, skip, _ = unrec(fold["reason"], fold["outcome"])
+            return None, skip, fold
+        if snap is None:
+            # the live fold would have bailed to a scan here; a recorded
+            # event record claiming otherwise means the ring and the
+            # replay environment disagree (e.g. tampering, or a
+            # structurally different snapshot module)
+            fold = {"ok": False, "outcome": "packer-bail",
+                    "reason": "recorded event batch did not re-fold (the "
+                              "packer bailed); chain broken until the "
+                              "next keyframe"}
+            _, skip, _ = unrec(fold["reason"], fold["outcome"])
+            return None, skip, fold
+        batch_args = snap.device_args()
+        progress_args = snap.progress_args()
+        arrays = dict(zip(BATCH_ARG_NAMES, batch_args)) | dict(
+            zip(PROGRESS_ARG_NAMES, progress_args)
+        )
+        digest = input_digest(arrays)
+        digest_ok = digest == rec.get("input_digest")
+        if not digest_ok and fold["divergent"] is None:
+            fold["divergent"] = {
+                "seq": rec.get("seq"),
+                "audit_id": rec.get("audit_id"),
+                "recorded_input_digest": rec.get("input_digest"),
+                "refolded_input_digest": digest,
+            }
+        out = dict(rec)
+        out["kind"] = "batch"
+        out["record_kind"] = "event_batch"
+        out["batch_args"] = batch_args
+        out["progress_args"] = progress_args
+        out["result_arrays"] = {
+            k: _dec(v) for k, v in rec.get("result", {}).items()
+        }
+        out["names"] = names or {"nodes": [], "groups": []}
+        out["refold"] = {
+            "input_digest_ok": digest_ok,
+            "first_divergent_event": fold["divergent"],
+        }
+        return out, None, fold
 
     def batches(self) -> tuple:
         """(reconstructed batch records, skipped records) — the list form

@@ -500,11 +500,21 @@ def perf_report(registry=None) -> dict:
         device_state = device_state_report()
     except Exception:  # noqa: BLE001 — reporting never fatal
         device_state = []
+    # audit-ring compression readout (utils.audit.ring_stats): on-disk
+    # ring size and bytes-per-record by record kind — the v2 vs array
+    # density claim, observable live. [] when no AuditLog is configured.
+    try:
+        from .audit import ring_stats
+
+        audit_rings = ring_stats()
+    except Exception:  # noqa: BLE001 — reporting never fatal
+        audit_rings = []
     return {
         "phases": phases,
         "scan_rung_mix": scan_mix,
         "device_memory": memory,  # None on CPU: no memory_stats
         "device_state": device_state,
+        "audit": audit_rings,
         "compile_ledger": COMPILE_LEDGER.report(),
         "profiler": profile_state(),
     }

@@ -170,6 +170,35 @@ else
     echo "audit-recorded sim failed:"; tail -3 /tmp/audit_sim.out; fail=1
 fi
 
+echo "== audit format v2 on hardware (event-batch ring + re-fold replay) =="
+# the same recorded-sim/replay claim under BST_AUDIT_FORMAT=v2: event
+# records between keyframes are re-folded back into exact padded inputs
+# by the reader, then replayed on the CPU rung — cross-backend identity
+# proven THROUGH the event re-fold, not just on stored arrays
+# (docs/observability.md "Audit format v2")
+AUDIT_V2_DIR="/tmp/bst-audit-v2-${TAG}"
+rm -rf "$AUDIT_V2_DIR"
+if BST_AUDIT_FORMAT=v2 timeout 900 \
+        python -m batch_scheduler_tpu sim --scenario synthetic \
+        --nodes 16 --groups 8 --members 4 --audit-dir "$AUDIT_V2_DIR" \
+        --identity-audit-every 2 --timeout 120 \
+        > /tmp/audit_v2_sim.out 2>&1; then
+    timeout 900 python -m batch_scheduler_tpu replay "$AUDIT_V2_DIR" \
+        --against cpu-ladder --json "AUDIT_V2_${TAG}.json" \
+        > /tmp/audit_v2_replay.out 2>&1
+    replay_rc=$?
+    if [ "$replay_rc" -eq 0 ]; then
+        echo "v2 audit replay captured (re-folded, bit-identical): AUDIT_V2_${TAG}.json"
+    elif [ -f "AUDIT_V2_${TAG}.json" ]; then
+        echo "v2 audit replay DIVERGED — blame report kept: AUDIT_V2_${TAG}.json"
+        tail -2 /tmp/audit_v2_replay.out
+    else
+        echo "v2 audit replay failed:"; tail -3 /tmp/audit_v2_replay.out; fail=1
+    fi
+else
+    echo "v2 audit-recorded sim failed:"; tail -3 /tmp/audit_v2_sim.out; fail=1
+fi
+
 echo "== device-resident state gate on hardware (DELTA_${TAG}) =="
 # the bench-delta gate on the real backend: on TPU the full-repack
 # baseline pays the real host->HBM upload per refresh, so this is the

@@ -28,6 +28,18 @@ Proves the black-box flight data subsystem end-to-end on CPU
    phase alone re-execs as a subprocess with the virtual-mesh forcing
    (``--phase-sharded``); phases 1-4 keep the single-device environment
    they were written against.
+6. **Audit format v2 (re-fold identity)**: a recorded churny fold chain
+   in ``BST_AUDIT_FORMAT=v2`` — event-batch records between periodic
+   keyframes — reconstructs its exact padded inputs by re-running the
+   recorded event batches through the snapshot-lite fold machinery, and
+   every record replays bit-identically on BOTH the steady and
+   cpu-ladder rungs. A tampered event batch produces a structured blame
+   naming the first divergent event, never a crash.
+7. **Audit format v2 (ring density)**: at the 5% churn point of the
+   delta_gate sweep (5120 nodes x 2048 gangs, 256 churned rows per
+   refresh) the v2 ring holds >= 3x the history of the array format
+   under the same cap, and every event record in the dense ring still
+   re-folds to its recorded input digest at that shape.
 
 Run from the repo root: ``JAX_PLATFORMS=cpu python benchmarks/replay_gate.py``
 — one JSON summary line; exit 1 on any failed acceptance.
@@ -157,6 +169,250 @@ def phase_record_replay(audit_dir: str) -> dict:
         "replayed_identical": identical,
         "identity_audits": stats.get("identity_audits", 0),
         "blame_fields": sorted(blame),
+    }
+
+
+def _tamper_first_event(audit_dir: str) -> int:
+    """Flip one demand field (min_member) inside the FIRST event_batch
+    record ON DISK — the tamper class v2 must blame by event, since the
+    corrupted event feeds every later re-fold in its keyframe chain.
+    Returns the tampered record's seq."""
+    import glob as _glob
+
+    for path in sorted(_glob.glob(os.path.join(audit_dir, "audit-*.jsonl"))):
+        with open(path) as f:
+            lines = f.readlines()
+        for i, line in enumerate(lines):
+            rec = json.loads(line)
+            if rec.get("kind") != "event_batch":
+                continue
+            rec["events"]["groups"][0][1][1] -= 1  # min_member
+            lines[i] = json.dumps(rec) + "\n"
+            with open(path, "w") as f:
+                f.writelines(lines)
+            return rec["seq"]
+    raise AssertionError("no event_batch record to tamper")
+
+
+def phase_v2_refold(audit_dir: str) -> dict:
+    """Audit format v2: a churny fold chain — the same event-fold
+    machinery the scorer publishes through, driven deterministically —
+    recorded as keyframes + event batches re-folds bit-identically from
+    its keyframes and replays on two rungs; an on-disk tamper of one
+    event batch yields a structured blame naming that event."""
+    from batch_scheduler_tpu.core.oracle_scorer import replay_audit_record
+    from batch_scheduler_tpu.ops.oracle import execute_batch_host
+    from batch_scheduler_tpu.ops.snapshot import (
+        DeltaSnapshotPacker,
+        GroupDemand,
+        _demand_fp,
+    )
+    from batch_scheduler_tpu.sim.scenarios import make_sim_node
+    from batch_scheduler_tpu.utils import audit as audit_mod
+    from batch_scheduler_tpu.utils.audit import AuditLog, AuditReader
+
+    nodes = [
+        make_sim_node(f"v{i}", {"cpu": "8", "memory": "32Gi", "pods": "64"})
+        for i in range(8)
+    ]
+    groups = [
+        GroupDemand(f"default/fold-{j}", 3, member_request={"cpu": 1000},
+                    creation_ts=float(j))
+        for j in range(6)
+    ]
+    node_req = {n.metadata.name: {} for n in nodes}
+    packer = DeltaSnapshotPacker()
+    log = AuditLog(audit_dir, fmt="v2", keyframe_every=6)
+
+    def publish(snap, ev):
+        host, _ = execute_batch_host(snap.device_args(), snap.progress_args())
+        lite_fps = getattr(snap, "lite_fps", None)
+        log.record_batch(
+            batch_args=snap.device_args(), progress_args=snap.progress_args(),
+            result=host, plan_digest=audit_mod.plan_digest(host),
+            node_names=snap.node_names, group_names=snap.group_names,
+            event_fold=ev,
+            refold=(snap.schema, lite_fps) if lite_fps is not None else None,
+        )
+
+    publish(packer.pack(nodes, node_req, groups), None)
+    for i in range(12):
+        nm = f"v{i % 8}"
+        node_req[nm] = {"cpu": 800 * (i + 1), "pods": 1 + i % 4}
+        g = groups[i % 6]
+        g.scheduled = min(i, 3)
+        if i == 5:
+            g.priority = 7  # meta churn: the re-sort path must re-fold too
+        snap = packer.pack_fold([(nm, dict(node_req[nm]))], [g])
+        if not check(snap is not None, "v2 chain stays on the event path",
+                     step=i):
+            log.stop()
+            return {}
+        publish(snap, {"bumps": i + 1, "nodes": [(nm, dict(node_req[nm]))],
+                       "groups": [(g.full_name, _demand_fp(g))]})
+
+    check(log.flush(), "v2 audit flush")
+    batches, skipped = AuditReader(audit_dir).batches()
+    check(not skipped, "v2 ring fully reconstructable",
+          skipped=[s.get("seq") for s in skipped])
+    events = [b for b in batches if b.get("record_kind") == "event_batch"]
+    check(len(batches) == 13 and len(events) >= 8,
+          "v2 ring is event-dominated",
+          records=len(batches), event_records=len(events))
+    check(
+        all(b["refold"]["input_digest_ok"]
+            and b["refold"]["first_divergent_event"] is None
+            for b in events),
+        "event re-fold reproduces every recorded input digest",
+    )
+    replayed = 0
+    for rung in ("steady", "cpu-ladder"):
+        for rec in batches:
+            rep = replay_audit_record(rec, against=rung)
+            if check(rep["identical"], "v2 re-fold replay bit-identical",
+                     rung=rung, seq=rec.get("seq"), report=rep.get("blame")):
+                replayed += 1
+    log.stop()
+
+    tampered_seq = _tamper_first_event(audit_dir)
+    batches2, skipped2 = AuditReader(audit_dir).batches()
+    check(not skipped2, "tampered ring still reads end to end",
+          skipped=len(skipped2))
+    tampered = next(b for b in batches2 if b.get("seq") == tampered_seq)
+    rep = replay_audit_record(tampered, against="steady")
+    blame = rep.get("blame") or {}
+    check(
+        not rep["identical"]
+        and blame.get("field") == "<event-stream>"
+        and (blame.get("fold") or {}).get("outcome") == "input-divergence"
+        and (blame.get("first_divergent_event") or {}).get("seq")
+        == tampered_seq,
+        "tampered event batch blamed by event", blame=blame,
+    )
+    return {
+        "v2_records": len(batches),
+        "v2_event_records": len(events),
+        "v2_replayed_identical": replayed,
+        "v2_tamper_blame_field": blame.get("field"),
+    }
+
+
+def phase_v2_ring_size(base_dir: str) -> dict:
+    """Ring density at the 5% churn point of the delta_gate sweep: the
+    same fold history recorded through both formats, byte-compared. The
+    >= 3x floor is what makes v2 worth its reader complexity — and the
+    dense ring must still re-fold every event record to its recorded
+    input digest at the north-star shape."""
+    from benchmarks.delta_gate import (
+        REFRESH_NODES,
+        build_inputs,
+    )
+    from batch_scheduler_tpu.ops.snapshot import (
+        DeltaSnapshotPacker,
+        _demand_fp,
+    )
+    from batch_scheduler_tpu.utils import audit as audit_mod
+    from batch_scheduler_tpu.utils.audit import AuditLog, AuditReader
+
+    nodes, groups, node_req = build_inputs(REFRESH_NODES, 2048)
+    g_count = len(groups)
+    rows = REFRESH_NODES // 20  # 256 rows: the sweep's 5% churn point
+
+    def churn(base):  # the delta_gate sweep's exact churn recipe
+        names = []
+        for k in range(rows):
+            name = f"n{(base + k) % REFRESH_NODES:05d}"
+            node_req[name] = {"cpu": 1200 + base + k % 9, "pods": 1 + k % 4}
+            names.append(name)
+        gis = sorted({
+            (base + k) % g_count
+            for k in range(max(rows * g_count // REFRESH_NODES, 1))
+        })
+        for gi in gis:
+            groups[gi].member_request = {
+                "cpu": 4000 + base + gi, "memory": 8 * 1024**3,
+            }
+        return names, gis
+
+    # a deterministic synthetic plan: this phase measures bytes, never
+    # replays — both rings get the identical result payload
+    G = g_count
+    result = {
+        "placed": np.zeros(G, np.int32),
+        "gang_feasible": np.ones(G, np.bool_),
+        "progress": np.arange(G, dtype=np.int32),
+        "best": np.zeros((), np.int32),
+        "best_exists": np.ones((), np.bool_),
+        "assignment_nodes": np.zeros((G, 16), np.int32),
+        "assignment_counts": np.zeros((G, 16), np.int32),
+    }
+    digest = audit_mod.plan_digest(result)
+    packer = DeltaSnapshotPacker()
+    logs = {
+        "array": AuditLog(os.path.join(base_dir, "array"), fmt="array"),
+        "v2": AuditLog(os.path.join(base_dir, "v2"), fmt="v2"),
+    }
+
+    def publish(snap, ev):
+        lite_fps = getattr(snap, "lite_fps", None)
+        for log in logs.values():
+            log.record_batch(
+                batch_args=snap.device_args(),
+                progress_args=snap.progress_args(),
+                result=result, plan_digest=digest,
+                node_names=snap.node_names, group_names=snap.group_names,
+                event_fold=ev,
+                refold=(snap.schema, lite_fps)
+                if lite_fps is not None else None,
+            )
+
+    publish(packer.pack(nodes, node_req, groups), None)
+    steps = 32  # two v2 keyframe periods at the default cadence
+    base = 1000
+    for i in range(steps):
+        names, gis = churn(base)
+        snap = packer.pack_fold(
+            [(nm, dict(node_req[nm])) for nm in names],
+            [groups[gi] for gi in gis],
+        )
+        if not check(snap is not None, "5%-churn refresh folds", step=i):
+            break
+        publish(snap, {
+            "bumps": i + 1,
+            "nodes": [(nm, dict(node_req[nm])) for nm in names],
+            "groups": [(groups[gi].full_name, _demand_fp(groups[gi]))
+                       for gi in gis],
+        })
+        base += rows
+        if i % 8 == 7:  # untimed: keep the bounded queues drained
+            for log in logs.values():
+                log.flush(60.0)
+    for log in logs.values():
+        check(log.flush(60.0) and log.records_dropped == 0,
+              "ring-size history recorded", fmt=log.fmt,
+              dropped=log.records_dropped)
+
+    ratio = logs["array"].bytes_written / max(logs["v2"].bytes_written, 1)
+    check(ratio >= 3.0, "v2 ring holds >= 3x history at 5% churn",
+          array_bytes=logs["array"].bytes_written,
+          v2_bytes=logs["v2"].bytes_written, ratio=round(ratio, 2))
+
+    batches, skipped = AuditReader(logs["v2"].directory).batches()
+    events = [b for b in batches if b.get("record_kind") == "event_batch"]
+    check(not skipped and len(batches) == steps + 1,
+          "dense v2 ring reads end to end",
+          records=len(batches), skipped=len(skipped))
+    check(len(events) >= steps - 4 and all(
+        b["refold"]["input_digest_ok"] for b in events),
+        "dense v2 ring re-folds at the north-star shape",
+        event_records=len(events))
+    for log in logs.values():
+        log.stop()
+    return {
+        "v2_ring_ratio": round(ratio, 2),
+        "v2_ring_bytes": logs["v2"].bytes_written,
+        "array_ring_bytes": logs["array"].bytes_written,
+        "v2_scale_event_records": len(events),
     }
 
 
@@ -429,6 +685,8 @@ def main() -> int:
     try:
         summary = {"ok": True}
         summary.update(phase_record_replay(os.path.join(base, "ring")))
+        summary.update(phase_v2_refold(os.path.join(base, "v2-ring")))
+        summary.update(phase_v2_ring_size(os.path.join(base, "v2-size")))
         summary.update(phase_health_flip())
         summary.update(phase_overhead(os.path.join(base, "overhead-ring")))
         summary.update(phase_sharded_cross_rung(os.path.join(base, "sharded")))

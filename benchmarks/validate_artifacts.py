@@ -100,6 +100,9 @@ def _nonbench_ok(doc) -> bool:
     return (
         "traceEvents" in keys
         or {"audit_dir", "against", "replayed"} <= keys
+        # the audit-format-v2 replay summary (AUDIT_V2_<tag>): same CLI,
+        # plus the count of event_batch records reconstructed by re-fold
+        or {"audit_dir", "against", "refolded"} <= keys
         or {"audit_dir", "compared", "divergent"} <= keys
         or {"tag", "lockcheck"} <= keys
         or {"ok", "rc"} <= keys

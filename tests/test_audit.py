@@ -160,6 +160,216 @@ def test_keyframe_recovery_after_truncation(tmp_path):
             assert np.array_equal(np.asarray(got), np.asarray(want))
 
 
+# ---------------------------------------------------------------------------
+# audit format v2: event-stream records + reader-side re-fold
+# ---------------------------------------------------------------------------
+
+
+def _fold_ring(tmp_path, steps=6, keyframe_every=4, **log_kw):
+    """A v2 ring recorded from a real fold sequence: one full pack, then
+    ``steps`` pack_fold refreshes (node churn + group tail churn, one
+    priority bump to exercise the queue-order resort on re-fold). Returns
+    (log, snaps, hosts) with the log NOT yet stopped."""
+    from batch_scheduler_tpu.ops.snapshot import DeltaSnapshotPacker, _demand_fp
+
+    nodes = [
+        make_sim_node(f"n{j}", {"cpu": "8", "memory": "32Gi", "pods": "64"})
+        for j in range(5)
+    ]
+    groups = [
+        GroupDemand(f"default/g{j}", 3, member_request={"cpu": 1000},
+                    creation_ts=float(j))
+        for j in range(4)
+    ]
+    node_req = {n.metadata.name: {} for n in nodes}
+    packer = DeltaSnapshotPacker()
+    log = AuditLog(str(tmp_path), fmt="v2", keyframe_every=keyframe_every,
+                   **log_kw)
+
+    def record(snap, ev):
+        host = _executed(snap)
+        lite_fps = getattr(snap, "lite_fps", None)
+        _record(
+            log, snap, host, event_fold=ev,
+            refold=(snap.schema, lite_fps) if lite_fps is not None else None,
+        )
+        return host
+
+    snaps, hosts = [], []
+    snap = packer.pack(nodes, node_req, groups)
+    hosts.append(record(snap, None))
+    snaps.append(snap)
+    for i in range(steps):
+        nm = f"n{i % 5}"
+        node_req[nm] = {"cpu": 1000 * (i + 1), "pods": i + 1}
+        gi = i % 4
+        g = groups[gi]
+        g.scheduled = min(i, 2)
+        if i == 3:
+            g.priority = 5  # sort-key churn: the re-fold must resort too
+        fsnap = packer.pack_fold([(nm, dict(node_req[nm]))], [g])
+        assert fsnap is not None, f"fold step {i} unexpectedly bailed"
+        ev = {"bumps": i + 1, "nodes": [(nm, dict(node_req[nm]))],
+              "groups": [(g.full_name, _demand_fp(g))]}
+        hosts.append(record(fsnap, ev))
+        snaps.append(fsnap)
+    return log, snaps, hosts
+
+
+def test_v2_event_records_refold_bit_identical(tmp_path):
+    """A churny fold sequence recorded in v2 reconstructs event_batch
+    records by RE-FOLDING the recorded event stream — bit-identical
+    inputs (input_digest checked per step) and bit-identical replay on
+    the steady and cpu-ladder rungs."""
+    log, snaps, hosts = _fold_ring(tmp_path)
+    assert log.flush()
+    batches, skipped = AuditReader(str(tmp_path)).batches()
+    assert len(batches) == 7 and not skipped
+    kinds = [rec.get("record_kind", "array") for rec in batches]
+    assert kinds.count("event_batch") >= 4, kinds
+    for rec, snap, host in zip(batches, snaps, hosts):
+        for got, want in zip(
+            rec["batch_args"] + rec["progress_args"],
+            snap.device_args() + snap.progress_args(),
+        ):
+            assert np.array_equal(np.asarray(got), np.asarray(want))
+        assert rec["plan_digest"] == audit_mod.plan_digest(host)
+        if rec.get("record_kind") == "event_batch":
+            assert rec["refold"]["input_digest_ok"]
+            assert rec["refold"]["first_divergent_event"] is None
+            # compact result: the digest still covers assignments, the
+            # record body does not carry them
+            assert "assignment_nodes" not in rec["result_arrays"]
+    for rec in batches:
+        for rung in ("steady", "cpu-ladder"):
+            rep = replay_audit_record(rec, against=rung)
+            assert rep["identical"], (rung, rep)
+            if rec.get("record_kind") == "event_batch":
+                assert rep.get("refolded")
+    log.stop()
+
+
+def test_v2_tampered_event_batch_blames_event(tmp_path):
+    """A tampered event batch yields structured blame NAMING THE EVENT:
+    the re-folded input digest diverges at the tampered record, replay
+    diverges, and blame reports field=<event-stream> with the first
+    divergent event's seq — on the tampered record and every later
+    record of the same chain."""
+    log, _snaps, _hosts = _fold_ring(tmp_path, keyframe_every=100)
+    assert log.flush()
+    log.stop()
+    (segment,) = glob.glob(os.path.join(str(tmp_path), "audit-*.jsonl"))
+    with open(segment) as f:
+        lines = [json.loads(ln) for ln in f.read().splitlines() if ln]
+    tampered_seq = None
+    for rec in lines:
+        if rec.get("kind") == "event_batch" and rec["events"]["groups"]:
+            rec["events"]["groups"][0][1][1] -= 1  # min_member 3 -> 2
+            tampered_seq = rec["seq"]
+            break
+    assert tampered_seq is not None
+    with open(segment, "w") as f:
+        f.writelines(json.dumps(rec, sort_keys=True) + "\n" for rec in lines)
+    batches, skipped = AuditReader(str(tmp_path)).batches()
+    assert not skipped  # tampering is a divergence, not a crash
+    divergent = [
+        rec for rec in batches
+        if (rec.get("refold") or {}).get("first_divergent_event")
+    ]
+    assert divergent and divergent[0]["seq"] == tampered_seq
+    for rec in divergent:
+        assert rec["refold"]["first_divergent_event"]["seq"] == tampered_seq
+    rep = replay_audit_record(divergent[0], against="steady")
+    assert rep["identical"] is False
+    blame = rep["blame"]
+    assert blame["field"] == "<event-stream>"
+    assert blame["first_divergent_event"]["seq"] == tampered_seq
+    assert blame["fold"]["outcome"] == "input-divergence"
+
+
+def test_v2_rotated_keyframe_reports_unreconstructable(tmp_path):
+    """An event_batch record whose base keyframe rotated away reports
+    unreconstructable with the fold-outcome reason — never a crash — and
+    re-folding resumes bit-exactly at the next keyframe (the PR 5
+    recovery discipline, v2 edition of the truncation case above)."""
+    log, snaps, _hosts = _fold_ring(tmp_path, keyframe_every=3,
+                                    segment_bytes=10**9)
+    assert log.flush()
+    log.stop()
+    # seqs: 1=K, 2=E, 3=E, 4=K, 5=E, 6=E, 7=K; drop the keyframe and the
+    # first event — the ring now STARTS with a dangling event record
+    (segment,) = glob.glob(os.path.join(str(tmp_path), "audit-*.jsonl"))
+    with open(segment) as f:
+        lines = f.readlines()
+    with open(segment, "w") as f:
+        f.writelines(lines[2:])
+    batches, skipped = AuditReader(str(tmp_path)).batches()
+    assert len(skipped) == 1
+    assert skipped[0]["seq"] == 3
+    assert skipped[0]["fold_outcome"] == "no-base"
+    assert "keyframe" in skipped[0]["reason"]
+    assert [rec["seq"] for rec in batches] == [4, 5, 6, 7]
+    for rec, snap in zip(batches, snaps[3:]):
+        for got, want in zip(rec["batch_args"], snap.device_args()):
+            assert np.array_equal(np.asarray(got), np.asarray(want))
+        if rec.get("record_kind") == "event_batch":
+            assert rec["refold"]["input_digest_ok"]
+
+
+def test_v2_knobs_parse_guarded(monkeypatch, capsys):
+    """BST_AUDIT_FORMAT / BST_AUDIT_KEYFRAME_EVERY are parse-guarded: a
+    typo degrades to the default with a warn-once, never a crash."""
+    monkeypatch.setattr(audit_mod, "_format_warned", [False])
+    monkeypatch.setattr(audit_mod, "_keyframe_warned", [False])
+    monkeypatch.setenv("BST_AUDIT_FORMAT", "v3-nope")
+    monkeypatch.setenv("BST_AUDIT_KEYFRAME_EVERY", "sixteen")
+    assert audit_mod.audit_format() == "array"
+    assert audit_mod.audit_format() == "array"  # warns once, not twice
+    assert audit_mod.audit_keyframe_every() == 16
+    err = capsys.readouterr().err
+    assert err.count("BST_AUDIT_FORMAT") == 1
+    assert "BST_AUDIT_KEYFRAME_EVERY" in err
+    monkeypatch.setenv("BST_AUDIT_FORMAT", "v2")
+    monkeypatch.setenv("BST_AUDIT_KEYFRAME_EVERY", "7")
+    assert audit_mod.audit_format() == "v2"
+    assert audit_mod.audit_keyframe_every() == 7
+    monkeypatch.setenv("BST_AUDIT_KEYFRAME_EVERY", "0")
+    assert audit_mod.audit_keyframe_every() == 1  # clamped, not rejected
+    monkeypatch.delenv("BST_AUDIT_FORMAT")
+    monkeypatch.delenv("BST_AUDIT_KEYFRAME_EVERY")
+    assert audit_mod.audit_format() == "array"
+    assert audit_mod.audit_keyframe_every() == 16
+
+
+def test_v2_ring_telemetry(tmp_path):
+    """bst_audit_ring_bytes / bst_audit_records_total{kind} plus the
+    bytes-per-record compression readout in /debug/perf."""
+    log, _snaps, _hosts = _fold_ring(tmp_path)
+    assert log.flush()
+    segments = glob.glob(os.path.join(str(tmp_path), "audit-*.jsonl"))
+    assert log.ring_bytes == sum(os.path.getsize(p) for p in segments) > 0
+    gauge = DEFAULT_REGISTRY.get("bst_audit_ring_bytes")
+    assert gauge is not None
+    assert gauge.value(ring=str(tmp_path)) == float(log.ring_bytes)
+    counter = DEFAULT_REGISTRY.get("bst_audit_records_total")
+    kinds = {dict(k).get("kind") for k in counter.values()}
+    assert "event_batch" in kinds and "batch" in kinds
+    rings = audit_mod.ring_stats()
+    mine = [r for r in rings if r["dir"] == str(tmp_path)]
+    assert mine and mine[0]["format"] == "v2"
+    by_kind = mine[0]["by_kind"]
+    assert by_kind["event_batch"]["records"] >= 4
+    # the compression claim, observable: event records are denser than
+    # array keyframes even at this toy shape
+    assert (by_kind["event_batch"]["bytes_per_record"]
+            < by_kind["batch"]["bytes_per_record"])
+    from batch_scheduler_tpu.utils.profiler import perf_report
+
+    report = perf_report()
+    assert any(r["dir"] == str(tmp_path) for r in report["audit"])
+    log.stop()
+
+
 def test_writer_failure_forces_keyframe(tmp_path):
     """A failed segment append drops the delta chain: the failed record
     never reached disk, so the next record must be a keyframe — diffing
