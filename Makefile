@@ -5,9 +5,9 @@
 
 PY ?= python
 
-.PHONY: all build vet analyze stamp-coupling test test-cpu test-tier1 bench bench-scan bench-pipeline bench-delta bench-policy bench-whatif bench-capacity bench-coalesce bench-failover bench-sharding bench-xl bench-regress validate-artifacts native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
+.PHONY: all build vet analyze stamp-coupling test test-cpu test-tier1 bench bench-scan bench-pipeline bench-delta bench-policy bench-whatif bench-capacity bench-slo bench-coalesce bench-failover bench-sharding bench-xl bench-regress validate-artifacts native ladder dryrun clean version tpu-artifacts http-e2e serial-e2e trace-demo replay-gate
 
-all: vet analyze native test bench-regress bench-capacity bench-coalesce bench-failover validate-artifacts
+all: vet analyze native test bench-regress bench-capacity bench-slo bench-coalesce bench-failover validate-artifacts
 
 build: vet analyze native
 
@@ -128,6 +128,17 @@ bench-whatif:
 # observatory & burn-rate alerts")
 bench-capacity:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/capacity_gate.py
+
+# gang-lifecycle / placement-SLO CI gate (CPU): the ledger hot path
+# costing <=1% of the 5120-node steady batch under a worst-case deny
+# storm (coalescing holding every gang to a bounded ring), the live
+# /debug/gangs snapshot byte-identical to the offline audit-ring re-fold
+# (the `timeline --audit-dir` path), and a real deny storm flipping
+# burn:ttp to breach against a tightened BST_SLO_TTP_P99_S — recovery
+# sliding the fast window clear (docs/observability.md "Gang lifecycle
+# & placement SLOs")
+bench-slo:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/slo_gate.py
 
 # multi-tenant coalescer CI gate (CPU): 8 concurrent clients through one
 # coalescing sidecar vs the 8-dedicated-sidecars time-sliced equivalent —

@@ -101,6 +101,14 @@ def _add_audit_flags(p: argparse.ArgumentParser, identity: bool = False) -> None
         help="total size cap of the audit ring; oldest segments are "
              "deleted first (default: 256)",
     )
+    p.add_argument(
+        "--lifecycle-dir", default=None, metavar="DIR",
+        help="stream gang lifecycle events (arrival/admission/deny "
+             "streaks/eviction/permit/bind — utils.lifecycle) as bounded "
+             "JSONL into DIR/events.jsonl, size-rotated to events.jsonl.1 "
+             "(cap: BST_LIFECYCLE_EXPORT_MAX_MB); the offline half of "
+             "/debug/events (docs/observability.md 'Gang lifecycle')",
+    )
     if identity:
         p.add_argument(
             "--identity-audit-every", type=int, default=0, metavar="K",
@@ -125,6 +133,26 @@ def _maybe_audit_log(args):
         flush=True,
     )
     return log
+
+
+def _maybe_lifecycle(args, audit_log=None) -> None:
+    """Wire the gang lifecycle ledger's sinks: mirror occurrences into
+    the audit ring (the `timeline --audit-dir` / slo_gate evidence
+    chain) and, with --lifecycle-dir, the bounded JSONL export. MUST run
+    AFTER the cluster/operation is constructed — ScheduleOperation
+    resets DEFAULT_LEDGER at construction (per-run isolation), which
+    detaches sinks."""
+    from ..utils.lifecycle import DEFAULT_LEDGER
+
+    if audit_log is not None:
+        DEFAULT_LEDGER.attach_audit(audit_log)
+    if getattr(args, "lifecycle_dir", None):
+        DEFAULT_LEDGER.set_export_dir(args.lifecycle_dir)
+        print(
+            f"lifecycle export: "
+            f"{os.path.join(args.lifecycle_dir, 'events.jsonl')}",
+            flush=True,
+        )
 
 
 def _maybe_configure_trace(args) -> bool:
@@ -433,6 +461,43 @@ def build_parser() -> argparse.ArgumentParser:
         "--json", default=None, metavar="PATH",
         help="also write the summary JSON (offline mode: the replayed "
              "series + comparison verdicts) here",
+    )
+
+    tl = sub.add_parser(
+        "timeline",
+        help="a gang's reconstructed lifecycle story — arrival, "
+             "admission, deny streaks, preemption eviction/respawn, "
+             "permit, bind — with the phase-decomposed time-to-placement "
+             "(queue/scheduling/sidecar/bind waits), live from a "
+             "scheduler's /debug/gangs or offline by re-folding a "
+             "recorded audit ring's gang_lifecycle events "
+             "(docs/observability.md 'Gang lifecycle')",
+    )
+    tl.add_argument(
+        "gang", nargs="?", default=None,
+        help="the gang's full name (namespace/name); omit to list every "
+             "recorded gang (scope with --tenant/--limit)",
+    )
+    tl_src = tl.add_mutually_exclusive_group(required=True)
+    tl_src.add_argument(
+        "--addr", metavar="HOST:PORT",
+        help="a live scheduler's --metrics-port endpoint "
+             "(queries /debug/gangs)",
+    )
+    tl_src.add_argument(
+        "--audit-dir", metavar="DIR",
+        help="reconstruct offline from a recorded audit ring: re-fold "
+             "its gang_lifecycle event records through the live ledger's "
+             "coalesce rule (byte-identical timelines — the slo_gate "
+             "contract)",
+    )
+    tl.add_argument("--tenant", default=None, metavar="T",
+                    help="scope to one tenant's gangs")
+    tl.add_argument("--limit", type=int, default=None, metavar="K",
+                    help="only the K most recently active gangs")
+    tl.add_argument(
+        "--json", default=None, metavar="PATH",
+        help="also write the timelines JSON here",
     )
 
     chk = sub.add_parser("check-config", help="validate a scheduler config JSON")
@@ -937,6 +1002,74 @@ def cmd_capacity(args) -> int:
     return 1 if divergent else 0
 
 
+def cmd_timeline(args) -> int:
+    """A gang's lifecycle timeline. Live mode proxies /debug/gangs on a
+    running scheduler; offline mode re-folds the audit ring's
+    ``gang_lifecycle`` event records through the ledger's own coalesce
+    rule (GangLifecycleLedger.fold) — byte-identical to what the live
+    process served (the slo_gate contract). Exit 0 on a structured
+    answer, 2 when nothing matches."""
+    if args.addr:
+        params: Dict[str, str] = {}
+        if args.gang:
+            params["gang"] = args.gang
+        if args.tenant:
+            params["tenant"] = args.tenant
+        if args.limit is not None:
+            params["limit"] = str(args.limit)
+        payload, status = _debug_get(args.addr, "/debug/gangs", params)
+        print(json.dumps(payload, indent=2, default=str))
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(payload, f, indent=2, default=str)
+        answered = (
+            status == 200
+            and "error" not in payload
+            and payload.get("count", 0) > 0
+        )
+        return 0 if answered else 2
+
+    # offline: pure record re-fold — no backend, no device, no drain
+    from ..utils.audit import AuditReader
+    from ..utils.lifecycle import GangLifecycleLedger
+
+    records = [
+        rec
+        for rec in AuditReader(args.audit_dir).records()
+        if rec.get("kind") == "event"
+        and rec.get("event") == "gang_lifecycle"
+    ]
+    folded = GangLifecycleLedger.fold(records)
+    items = [
+        (g, rec)
+        for g, rec in folded.items()
+        if (args.gang is None or g == args.gang)
+        and (args.tenant is None or rec.get("tenant") == args.tenant)
+    ]
+    if args.limit is not None and args.limit >= 0:
+        items = items[-args.limit:] if args.limit else []
+    gangs = {g: GangLifecycleLedger.timeline_view(rec) for g, rec in items}
+    out = {
+        "audit_dir": args.audit_dir,
+        "records": len(records),
+        "gangs": gangs,
+        "count": len(gangs),
+    }
+    print(json.dumps(out, indent=2, default=str))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=2, default=str)
+    if not gangs:
+        print(
+            f"error: no gang_lifecycle records in {args.audit_dir}"
+            + (f" match gang={args.gang!r}" if args.gang else "")
+            + (f" tenant={args.tenant!r}" if args.tenant else ""),
+            file=sys.stderr,
+        )
+        return 2
+    return 0
+
+
 def cmd_whatif(args) -> int:
     """Score one counterfactual against live cluster state (the
     /debug/whatif endpoint's CLI face). Exit 0 on a diff, 2 on error."""
@@ -1004,6 +1137,9 @@ def cmd_serve(args) -> int:
         # flag is sugar over BST_COALESCE; None lets the env decide
         coalesce=True if args.coalesce else None,
     )
+    # sidecar-side lifecycle export: nothing flows unless a scheduler
+    # runs in-process, but the flag contract is uniform across sim/serve
+    _maybe_lifecycle(args)
     host, port = server.address
     print(f"oracle sidecar listening on {host}:{port}", flush=True)
 
@@ -1230,6 +1366,8 @@ def cmd_sim(args) -> int:
         identity_audit_every=args.identity_audit_every,
         policy=policy_cfg,
     )
+    # after SimCluster: the operation's construction reset the ledger
+    _maybe_lifecycle(args, audit_log)
 
     nodes: List[Node] = []
     groups: List[PodGroup] = []
@@ -1445,6 +1583,24 @@ def cmd_sim(args) -> int:
                 f"python -m batch_scheduler_tpu explain "
                 f"{pend.get('oldest_gang')} --addr <metrics-port>"
             )
+        # per-tenant placement verdict: p99 time-to-placement from the
+        # gang lifecycle ledger (live forms: /debug/gangs timelines and
+        # the /debug/health burn:ttp signal)
+        from ..utils.lifecycle import DEFAULT_LEDGER
+
+        life = DEFAULT_LEDGER.report()
+        if life.get("tenants"):
+            parts = ", ".join(
+                f"{t} p99 {d['p99_ttp_s']:.2f}s/{d['count']}"
+                for t, d in sorted(life["tenants"].items())
+            )
+            print(f"placement ttp (tenant p99/gangs): {parts}")
+            ttp_burn = health["signals"].get("burn:ttp") or {}
+            if ttp_burn.get("verdict") not in (None, "ok"):
+                print(
+                    f"ttp burn: {ttp_burn['verdict']} "
+                    f"({ttp_burn['reason']})"
+                )
         if tracing:
             from ..utils.trace import DEFAULT_FLIGHT_RECORDER
 
@@ -1475,6 +1631,7 @@ COMMANDS = {
     "explain": cmd_explain,
     "whatif": cmd_whatif,
     "capacity": cmd_capacity,
+    "timeline": cmd_timeline,
 }
 
 

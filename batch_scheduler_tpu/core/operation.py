@@ -245,6 +245,13 @@ class ScheduleOperation:
 
         self.pending_tracker = PendingGangTracker()
         set_active_pending(self.pending_tracker)
+        # same isolation rule for the gang lifecycle ledger
+        # (utils.lifecycle): a fresh operation starts a fresh story —
+        # stale timelines from a torn-down harness must not feed this
+        # run's TTP histograms, /debug/gangs, or the event stream
+        from ..utils.lifecycle import DEFAULT_LEDGER
+
+        DEFAULT_LEDGER.reset()
         # the explain/what-if observatory (core.explain): process-wide so
         # /debug/explain + /debug/whatif and the CLI harness views reach
         # the live operation without extra wiring. A non-oracle operation
@@ -581,6 +588,9 @@ class ScheduleOperation:
         # evented cluster mutators — note it for the next event fold
         self._gang_event(full_name)
         self.pending_tracker.note_placed(full_name)
+        from ..utils.lifecycle import DEFAULT_LEDGER
+
+        DEFAULT_LEDGER.note_permit(full_name)
         return True
 
     def post_bind_gang(self, full_name: str, bound: int) -> None:
@@ -860,6 +870,11 @@ class ScheduleOperation:
                 )
             except Exception:  # noqa: BLE001 — controller reconciles
                 pass
+        # the eviction does NOT reset the gang's pending clock: the
+        # original first-seen is re-armed so pending age (and TTP, via
+        # the lifecycle ledger's preserved arrival anchor) include the
+        # preemption churn the gang is about to re-queue through
+        self.pending_tracker.note_evicted(full_name)
         # the member deletions rode the evented cluster mutators; the
         # gang-row reset above is the only out-of-band change — name it
         self.mark_dirty(group=full_name)
@@ -1038,6 +1053,9 @@ class ScheduleOperation:
         if matched >= pg.spec.min_member - pg.status.scheduled:
             pgs.scheduled = True
             self.pending_tracker.note_placed(full_name)
+            from ..utils.lifecycle import DEFAULT_LEDGER
+
+            DEFAULT_LEDGER.note_permit(full_name)
             return PermitOutcome(True, pg_name, None)
         return PermitOutcome(False, pg_name, errs.WaitingError())
 
@@ -1159,6 +1177,9 @@ class ScheduleOperation:
         # a deleted gang is no longer pending; its age never resolves
         # into the placement histogram (utils.health)
         self.pending_tracker.forget(full_name)
+        from ..utils.lifecycle import DEFAULT_LEDGER
+
+        DEFAULT_LEDGER.note_delete(full_name)
 
     def sort_key(self, info) -> tuple:
         """Total-order queue key equivalent to :meth:`compare` (reference

@@ -988,6 +988,17 @@ class OracleScorer:
             from ..utils import audit as audit_mod
 
             aid = audit_mod.new_audit_id()
+        # lifecycle batch context (utils.lifecycle): every gang event the
+        # scheduler notes until the NEXT publish stamps this audit id —
+        # joining the gang's timeline to the audit/flight evidence chain —
+        # and attributes the sidecar coalescer's queue wait (TRACE_INFO
+        # lock_wait_seconds; absent when the client ran untraced) once
+        # per (gang, batch)
+        from ..utils.lifecycle import DEFAULT_LEDGER
+
+        DEFAULT_LEDGER.note_batch_context(
+            aid, telemetry if isinstance(telemetry, dict) else None
+        )
         if self.audit_log is not None or self._identity is not None:
             self._audit_publish(snap, host, aid, speculative, telemetry)
         if self._capacity is not None:

@@ -26,6 +26,7 @@ from ..utils.errors import (
     SchedulingError,
 )
 from ..utils.labels import pod_group_name
+from ..utils.lifecycle import DEFAULT_LEDGER
 from ..utils.metrics import DEFAULT_REGISTRY
 from ..utils import trace as trace_mod
 from ..utils.trace import DEFAULT_FLIGHT_RECORDER
@@ -194,7 +195,16 @@ class Scheduler:
     def enqueue(self, pod: Pod) -> None:
         if pod.spec.node_name or pod.status.phase != PodPhase.PENDING:
             return
-        self.queue.push(PodInfo(pod=pod, timestamp=self._clock()))
+        info = PodInfo(pod=pod, timestamp=self._clock())
+        self.queue.push(info)
+        key = _gang_key(info)
+        if key is not None:
+            # lifecycle TTP anchor: the informer saw this gang member
+            # (member arrivals coalesce; a post-eviction arrival is the
+            # respawn and keeps the original anchor)
+            DEFAULT_LEDGER.note_arrival(
+                key, tier=int(pod.spec.priority or 0), pods=1
+            )
 
     def enqueue_raw(self, d: dict) -> None:
         """Raw-dict enqueue (the informer's ``raw`` handler form): the
@@ -204,7 +214,15 @@ class Scheduler:
             return
         if ((d.get("status") or {}).get("phase") or "Pending") != "Pending":
             return
-        self.queue.push(PodInfo(raw=d, timestamp=self._clock()))
+        info = PodInfo(raw=d, timestamp=self._clock())
+        self.queue.push(info)
+        key = _gang_key(info)
+        if key is not None:
+            try:
+                tier = int((d.get("spec") or {}).get("priority") or 0)
+            except (TypeError, ValueError):
+                tier = 0
+            DEFAULT_LEDGER.note_arrival(key, tier=tier, pods=1)
 
     # -- main cycle --------------------------------------------------------
 
@@ -464,6 +482,9 @@ class Scheduler:
             self.stats["binds"] += bound
             self.stats["scheduled"] += bound
             self._binds_total.inc(bound)
+            # lifecycle terminal event: observes bst_gang_ttp_seconds
+            # (arrival->THIS bind) + the phase decomposition
+            DEFAULT_LEDGER.note_bind(gang, members=bound)
         if not items:
             return
         self.cluster.finish_binding_many(finished)
@@ -610,6 +631,12 @@ class Scheduler:
             self.cluster.forget(info.uid)
             if self.plugin is not None:
                 self.plugin.mark_dirty()
+
+        if info.gang:
+            # lifecycle: the gang entered a scheduling cycle (coalesced —
+            # steady retries bump one streak; first_ts keeps the
+            # queue-wait anchor)
+            DEFAULT_LEDGER.note_admitted(_gang_key(info))
 
         if self.plugin is not None:
             try:
@@ -833,6 +860,7 @@ class Scheduler:
                     )
                 except NotFoundError:
                     self.cluster.forget(uid)
+            DEFAULT_LEDGER.note_evicted(victim_gang, preemptor=preemptor)
             if note_evicted is not None:
                 note_evicted(victim_gang)
             if self.requeue_evicted:
@@ -1017,6 +1045,10 @@ class Scheduler:
             coalesce=True,
             **rec,
         )
+        if info.gang:
+            # lifecycle: the same blame, coalesced into the gang's
+            # timeline (audit-id/trace-id stamped by the ledger)
+            DEFAULT_LEDGER.note_deny(_gang_key(info), reason)
         self.queue.push_backoff(info)
 
     # -- binding cycle -----------------------------------------------------
@@ -1085,6 +1117,14 @@ class Scheduler:
         self.stats["binds"] += 1
         self.stats["scheduled"] += 1
         self._binds_total.inc()
+        group, in_gang = pod_group_name(pod)
+        if in_gang:
+            # per-pod binding cycle (permit-quorum gangs): member binds
+            # coalesce into one bind streak; the ledger observes TTP on
+            # the streak's FIRST member only
+            DEFAULT_LEDGER.note_bind(
+                f"{pod.metadata.namespace}/{group}", members=1
+            )
         if self.plugin is not None:
             pod.spec.node_name = node_name
             # post_bind owns batch invalidation (per gang completion, not

@@ -669,6 +669,10 @@ class OracleCoalescer:
             tel = dict(host.get("telemetry") or {})
             tel["coalesce"] = {
                 "mode": "span", "width": len(group), "tenant": job.tenant,
+                # explicit per-request admission-queue wait: the gang
+                # lifecycle ledger's sidecar_wait phase attribution
+                # (rides TRACE_INFO back to the client's timeline)
+                "queue_wait_seconds": round(max(wait_s, 0.0), 6),
             }
             host["telemetry"] = tel
             job.finish(
@@ -720,10 +724,14 @@ class OracleCoalescer:
                     a_nodes[gs + gi], a_counts[gs + gi], ns, span_nb, k
                 )
             best, exists, _prog = find_max_group_host(*job.progress_args)
+            wait_s = time.perf_counter() - job.enqueued - run_s
             tel = dict(mega_tel)
             tel["coalesce"] = {
                 "mode": "mega", "width": len(group), "tenant": job.tenant,
                 "node_offset": ns, "gang_offset": gs,
+                # per-request admission-queue wait (lifecycle sidecar_wait
+                # attribution, the span path's contract)
+                "queue_wait_seconds": round(max(wait_s, 0.0), 6),
             }
             host_t = {
                 "gang_feasible": feas[gs:gs + g],
@@ -735,7 +743,6 @@ class OracleCoalescer:
                 "assignment_counts": t_counts,
                 "telemetry": tel,
             }
-            wait_s = time.perf_counter() - job.enqueued - run_s
             self._wait.observe(max(wait_s, 0.0), tenant=job.tenant)
             job.finish(
                 result=CoalesceResult(

@@ -45,6 +45,15 @@ capacity observatory (ops.capacity) feeds a ``burn:capacity`` signal the
 same way: a sample with capacity-unplaceable pending gangs is a
 violation. Gauges: ``bst_slo_burn_rate{signal, window}``.
 
+**Placement TTP burn** (``burn:ttp``): the gang lifecycle ledger
+(utils.lifecycle) observes arrival→bind time-to-placement into
+``bst_gang_ttp_seconds{tenant,tier}``; each (tenant, tier) series is
+judged against a per-TIER p99 target — ``BST_SLO_TTP_P99_S`` (default
+120 s) overridden per tier by ``BST_SLO_TTP_P99_T<tier>_S`` — and the
+violation fractions fold into one fast/slow burn pair through the same
+``_burn_verdict`` rule. This is the ROADMAP's streaming-admission gating
+SLO: p99 time-to-placement, enforced per tier.
+
 The **identity audit** closes the bit-identity gap docs/pipelining.md
 documents as CI-only: every Kth non-speculative published batch is
 re-executed on the CPU fallback rung (serial scan — the rung that is
@@ -61,7 +70,7 @@ from __future__ import annotations
 import os
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Dict, Optional
 
 from .metrics import DEFAULT_REGISTRY, LONG_OP_BUCKETS, Registry
@@ -202,6 +211,35 @@ def _target(signal: str, default: float) -> float:
     return default
 
 
+DEFAULT_TTP_TARGET_S = 120.0
+
+
+def _ttp_target_default() -> float:
+    """``BST_SLO_TTP_P99_S`` — the placement-SLO p99 target every tier
+    inherits unless overridden (parse-guarded)."""
+    raw = os.environ.get("BST_SLO_TTP_P99_S", "").strip()
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return DEFAULT_TTP_TARGET_S
+
+
+def _ttp_target_for_tier(tier: str) -> float:
+    """Per-tier override: ``BST_SLO_TTP_P99_T<tier>_S`` (e.g.
+    BST_SLO_TTP_P99_T2_S for priority tier 2) beats the base target — a
+    guaranteed tier can be held to seconds while best-effort tolerates
+    minutes. Parse-guarded like every knob."""
+    raw = os.environ.get(f"BST_SLO_TTP_P99_T{tier}_S", "").strip()
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return _ttp_target_default()
+
+
 class PendingGangTracker:
     """Pending-gang aging: how long denied gangs have been waiting, and
     how many consecutive denials each has eaten.
@@ -223,11 +261,23 @@ class PendingGangTracker:
     is an operator signal, not a process failure (never a breach)."""
 
     DEFAULT_TARGET_S = 120.0
+    # placed-gang first-seen memory bound: enough to cover every gang a
+    # 5120-node sim can hold placed at once, small enough to never matter
+    PLACED_MEMORY = 4096
 
     def __init__(self, registry: Optional[Registry] = None):
         self._lock = threading.Lock()
         # gang -> (first_deny_monotonic, consecutive denials)
         self._pending: Dict[str, tuple] = {}  # guarded-by: _lock
+        # gang -> first_deny_monotonic retained past placement, so a
+        # preemption EVICTION re-arms the pending clock at the ORIGINAL
+        # anchor — a spot gang that waited 90s, placed, and was evicted
+        # has not stopped waiting; without the carry its pending age (and
+        # the TTP fed from it) would restart from the eviction, hiding
+        # exactly the churn the placement SLO exists to count
+        self._placed_first: "OrderedDict[str, float]" = (
+            OrderedDict()
+        )  # guarded-by: _lock
         self.resolved = 0  # guarded-by: _lock
         reg = registry or DEFAULT_REGISTRY
         self._hist = reg.histogram(
@@ -256,16 +306,36 @@ class PendingGangTracker:
             entry = self._pending.pop(gang, None)
             if entry is not None:
                 self.resolved += 1
+                self._placed_first.pop(gang, None)
+                self._placed_first[gang] = entry[0]
+                while len(self._placed_first) > self.PLACED_MEMORY:
+                    self._placed_first.popitem(last=False)
         if entry is not None:
             self._hist.observe(time.monotonic() - entry[0])
+
+    def note_evicted(self, gang: str) -> None:
+        """Preemption evicted a placed gang: it is pending again, and its
+        clock is the ORIGINAL first-seen (carried across note_placed), not
+        now — pending age and time-to-placement include preemption churn.
+        A gang already pending keeps its running clock untouched. The
+        respawned gang's next placement observes the full span and
+        re-arms the carry, so repeated evict/respawn cycles accumulate."""
+        now = time.monotonic()
+        with self._lock:
+            if gang in self._pending:
+                return  # clock never stopped
+            first = self._placed_first.pop(gang, now)
+            self._pending[gang] = (first, 0)
 
     def forget(self, gang: str) -> None:
         with self._lock:
             self._pending.pop(gang, None)
+            self._placed_first.pop(gang, None)
 
     def reset(self) -> None:
         with self._lock:
             self._pending.clear()
+            self._placed_first.clear()
             self.resolved = 0
         self._oldest.set(0.0)
         self._streak.set(0.0)
@@ -330,6 +400,10 @@ class HealthModel:
         self._burn_snaps: Dict[str, deque] = {
             name: deque() for name, _, _, _ in QUANTILE_SIGNALS
         }
+        # placement-TTP burn history: (ts, {labelkey: snapshot}) over the
+        # LABELLED bst_gang_ttp_seconds family — per-(tenant,tier) series
+        # are judged against per-TIER targets, then folded into one burn
+        self._ttp_snaps: deque = deque()
         self._last_verdict: Dict[str, str] = {}
         self._identity_mismatch: Optional[dict] = None
         self._breaches = self._reg.counter(
@@ -373,6 +447,9 @@ class HealthModel:
                 self._snaps[name].append((now, snap))
                 self._burn_snaps[name].clear()
                 self._burn_snaps[name].append((now, snap))
+            ttp = self._ttp_hist()
+            self._ttp_snaps.clear()
+            self._ttp_snaps.append((now, ttp.snapshots()))
             self._last_verdict.clear()
             self._identity_mismatch = None
 
@@ -455,6 +532,116 @@ class HealthModel:
             "reason": reason,
         }
 
+    def _ttp_hist(self):
+        """The gang lifecycle ledger's TTP histogram, created with its
+        observation-site bucket preset if health touches it first."""
+        return self._reg.histogram(
+            "bst_gang_ttp_seconds", buckets=LONG_OP_BUCKETS
+        )
+
+    def _ttp_burn_signal(
+        self, now: float, fast_s: float, slow_s: float
+    ) -> dict:  # lock-held: _lock
+        """Placement-TTP multi-window burn over the LABELLED
+        ``bst_gang_ttp_seconds{tenant,tier}`` family. Each (tenant, tier)
+        series' windowed observations are judged against that TIER's p99
+        target (``BST_SLO_TTP_P99_S`` / ``BST_SLO_TTP_P99_T<tier>_S``)
+        and the violating/total counts are summed across series before
+        the burn division — one budget, spent by whichever tenant or
+        tier is missing ITS target. Per-tier windowed p99s ride along in
+        the payload so /debug/health names the offender."""
+        hist = self._ttp_hist()
+        current = hist.snapshots()
+        dq = self._ttp_snaps
+        # same construction bound as _burn_signal: at most one retained
+        # snapshot per slow_s/1024 of wall-clock
+        if not dq or now - dq[-1][0] >= slow_s / 1024.0:
+            dq.append((now, current))
+        while len(dq) > 1 and now - dq[1][0] > slow_s:
+            dq.popleft()
+
+        def _at(window: float):
+            base = dq[0][1]
+            for ts, snap in dq:
+                if ts <= now - window:
+                    base = snap
+                else:
+                    break
+            return base
+
+        empty = ((0,) * len(hist.buckets), 0.0, 0)
+        burns = {}
+        observations = 0
+        fast_base = None
+        for window_name, window in (("fast", fast_s), ("slow", slow_s)):
+            base = _at(window)
+            if window_name == "fast":
+                fast_base = base
+            bad = total = 0
+            for key, snap in current.items():
+                target = _ttp_target_for_tier(dict(key).get("tier", ""))
+                b = base.get(key, empty)
+                # max(..., 0) guards a registry swapped under the model
+                # (tests): a shrunk counter is a new epoch, not negative
+                # traffic
+                bad += max(
+                    _violations(snap, hist.buckets, target)[0]
+                    - _violations(b, hist.buckets, target)[0],
+                    0,
+                )
+                total += max(snap[2] - b[2], 0)
+            frac = bad / total if total > 0 else 0.0
+            burns[window_name] = round(frac / BURN_ALLOWED_FRACTION, 3)
+            if window_name == "fast":
+                observations = total
+            self._burn_gauge.set(
+                burns[window_name], signal="ttp", window=window_name
+            )
+        verdict, reason, fast_thr, slow_thr = _burn_verdict(
+            burns, "placement time-to-bind budget"
+        )
+        self._note_transition("burn:ttp", verdict)
+
+        # per-tier fast-window p99 + target, merged across tenants
+        tiers: Dict[str, list] = {}
+        for key, snap in current.items():
+            tier = dict(key).get("tier", "")
+            b = (fast_base or {}).get(key, empty)
+            agg = tiers.setdefault(tier, [[0] * len(hist.buckets), 0])
+            agg[0] = [
+                a + max(c - c0, 0)
+                for a, c, c0 in zip(agg[0], snap[0], b[0])
+            ]
+            agg[1] += max(snap[2] - b[2], 0)
+        from .lifecycle import _quantile_from_counts
+
+        tier_p99 = {
+            tier or "-": {
+                "p99_s": round(
+                    _quantile_from_counts(hist.buckets, cnts, n, 0.99), 6
+                )
+                if n else 0.0,
+                "target_p99_s": _ttp_target_for_tier(tier),
+                "observations": n,
+            }
+            for tier, (cnts, n) in sorted(tiers.items())
+        }
+        return {
+            "kind": "burn",
+            "signal": "ttp",
+            "target_p99_s": _ttp_target_default(),
+            "burn_fast": burns["fast"],
+            "burn_slow": burns["slow"],
+            "fast_window_s": fast_s,
+            "slow_window_s": slow_s,
+            "fast_threshold": fast_thr,
+            "slow_threshold": slow_thr,
+            "observations": observations,
+            "tiers": tier_p99,
+            "verdict": verdict,
+            "reason": reason,
+        }
+
     def evaluate(self) -> dict:
         now = time.time()
         window = self.window_s
@@ -504,6 +691,13 @@ class HealthModel:
                 signals[f"burn:{name}"] = self._burn_signal(
                     name, hist, current, now, window, slow_window, default
                 )
+
+            # -- placement TTP burn (utils.lifecycle ledger) ----------------
+            # arrival->bind time-to-placement vs per-tier p99 targets,
+            # through the same fast/slow burn rule
+            signals["burn:ttp"] = self._ttp_burn_signal(
+                now, window, slow_window
+            )
 
             # -- structural states ------------------------------------------
             degraded = self._reg.gauge("bst_oracle_degraded").value()

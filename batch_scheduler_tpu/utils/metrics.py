@@ -182,6 +182,17 @@ class Histogram:
                 return ([0] * len(self.buckets), 0.0, 0)
             return (list(s[0]), s[1], s[2])
 
+    def snapshots(self) -> Dict[Tuple[Tuple[str, str], ...], Tuple[list, float, int]]:
+        """Every labeled series' (bucket counts, sum, count) in one
+        locked read — the TTP burn signal (utils.health) and the
+        per-tenant placement report (utils.lifecycle) fold series
+        without knowing the tenant/tier label values up front
+        (Gauge.values' contract, histogram-shaped)."""
+        with self._lock:
+            return {
+                k: (list(s[0]), s[1], s[2]) for k, s in self._series.items()
+            }
+
     def quantile(self, q: float, since=None, **labels: str) -> float:
         """Approximate quantile from the cumulative bucket counts (linear
         interpolation within the covering bucket — what Prometheus'
@@ -294,7 +305,16 @@ DEBUG_ENDPOINTS = {
     "/debug/": "this index",
     "/debug/trace": "the span ring as Chrome-trace JSON (utils.trace)",
     "/debug/decisions": "the gang decision flight recorder "
-                        "(?gang=ns/name scopes)",
+                        "(?gang=ns/name | ?tenant=T scope; ?limit=K caps "
+                        "to the K most recently active gangs)",
+    "/debug/gangs": "reconstructed gang lifecycle timelines "
+                    "(utils.lifecycle): arrival->bind events with phase "
+                    "decomposition and trace/audit cross-stamps; "
+                    "?gang=ns/name | ?tenant=T | ?limit=K",
+    "/debug/events": "the lifecycle event stream: ?since=CURSOR answers "
+                     "occurrences newer than the monotonic cursor "
+                     "(?limit=K, ?timeout_s=N long-polls) — push-shaped "
+                     "gang events for external consumers",
     "/debug/health": "the live SLO health model (utils.health)",
     "/debug/buckets": "per-bucket compiled HLO cost telemetry (ops.oracle)",
     "/debug/policy": "the active policy engine's terms/weights/counters",
@@ -324,6 +344,22 @@ DEBUG_ENDPOINTS = {
 }
 
 
+def _parse_limit(raw):
+    """Shared ``?limit=K`` validation for the gang-scoped debug
+    surfaces: None passes through (no cap); otherwise a non-negative
+    int or a 400-able error string — a malformed limit must answer 400,
+    never dump the unbounded payload."""
+    if raw is None:
+        return None, None
+    try:
+        limit = int(raw)
+        if limit < 0:
+            raise ValueError(raw)
+    except (TypeError, ValueError):
+        return None, f"malformed limit={raw!r}"
+    return limit, None
+
+
 class _MetricsHandler(BaseHTTPRequestHandler):
     registry: Registry = None
 
@@ -350,14 +386,78 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         elif path == "/debug/decisions":
             # the gang decision flight recorder: per-gang rings of
             # structured decision records (docs/observability.md).
-            # ?gang=<ns/name> scopes to one gang.
+            # ?gang=<ns/name> or ?tenant=<label> scopes; ?limit=K caps
+            # to the K most recently active gangs (malformed -> 400,
+            # the /debug/profile convention — a bad limit must not dump
+            # the whole ring).
+            import json
             from urllib.parse import parse_qs, urlparse
 
             from . import trace as trace_mod
 
             q = parse_qs(urlparse(self.path).query)
             gang = (q.get("gang") or [None])[0]
-            body = trace_mod.DEFAULT_FLIGHT_RECORDER.to_json(gang)
+            tenant = (q.get("tenant") or [None])[0]
+            limit, err = _parse_limit((q.get("limit") or [None])[0])
+            if err is not None:
+                status = 400
+                body = json.dumps({"ok": False, "error": err}).encode()
+            else:
+                body = trace_mod.DEFAULT_FLIGHT_RECORDER.to_json(
+                    gang, tenant=tenant, limit=limit
+                )
+            ctype = "application/json"
+        elif path == "/debug/gangs":
+            # reconstructed gang lifecycle timelines (utils.lifecycle):
+            # the gang observatory's answer to "tell me this gang's whole
+            # story" — arrival/deny-streaks/evict/permit/bind with phase
+            # decomposition, cross-stamped into the evidence chain
+            import json
+            from urllib.parse import parse_qs, urlparse
+
+            from . import lifecycle as lifecycle_mod
+
+            q = parse_qs(urlparse(self.path).query)
+            gang = (q.get("gang") or [None])[0]
+            tenant = (q.get("tenant") or [None])[0]
+            limit, err = _parse_limit((q.get("limit") or [None])[0])
+            if err is not None:
+                status = 400
+                payload = {"ok": False, "error": err}
+            else:
+                payload = lifecycle_mod.DEFAULT_LEDGER.snapshot(
+                    gang=gang, tenant=tenant, limit=limit
+                )
+            body = json.dumps(payload, default=str).encode()
+            ctype = "application/json"
+        elif path == "/debug/events":
+            # the lifecycle event stream (utils.lifecycle): bounded
+            # long-poll over the monotonic cursor — ?since=C answers
+            # occurrences with cursor > C; ?timeout_s=N blocks (clamped)
+            # until something newer lands, so a consumer gets push-shaped
+            # events without holding a persistent connection
+            import json
+            from urllib.parse import parse_qs, urlparse
+
+            from . import lifecycle as lifecycle_mod
+
+            q = parse_qs(urlparse(self.path).query)
+            try:
+                since = int((q.get("since") or ["0"])[0])
+                limit = int((q.get("limit") or ["256"])[0])
+                timeout_s = float((q.get("timeout_s") or ["0"])[0])
+                if since < 0 or limit < 0 or not (timeout_s >= 0):
+                    raise ValueError("negative")
+                payload = lifecycle_mod.DEFAULT_LEDGER.events_since(
+                    since, limit=limit, timeout_s=timeout_s
+                )
+            except (TypeError, ValueError):
+                status = 400
+                payload = {
+                    "ok": False,
+                    "error": "malformed since=/limit=/timeout_s=",
+                }
+            body = json.dumps(payload, default=str).encode()
             ctype = "application/json"
         elif path == "/debug/health":
             # the live SLO health model (utils.health): per-signal

@@ -409,27 +409,55 @@ class FlightRecorder:
                     return
             ring.append(rec)
 
-    def snapshot(self, gang: Optional[str] = None) -> Dict[str, List[dict]]:
-        return self._snapshot_with_dropped(gang)[0]
+    def snapshot(
+        self,
+        gang: Optional[str] = None,
+        tenant: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> Dict[str, List[dict]]:
+        return self._snapshot_with_dropped(gang, tenant, limit)[0]
 
-    def _snapshot_with_dropped(self, gang: Optional[str] = None):
+    def _snapshot_with_dropped(
+        self,
+        gang: Optional[str] = None,
+        tenant: Optional[str] = None,
+        limit: Optional[int] = None,
+    ):
         # one locked read so a payload and its drop count cohere (the
-        # TraceRecorder helper's pattern)
+        # TraceRecorder helper's pattern). ``tenant`` scopes to gangs
+        # whose records carry that tenant label; ``limit`` caps to the
+        # K most recently active gangs (the rings are already bounded
+        # per gang — the unbounded payload dimension is gang count).
         with self._lock:
             if gang is not None:
                 ring = self._gangs.get(gang)
-                decisions = {gang: list(ring)} if ring is not None else {}
+                items = [(gang, list(ring))] if ring is not None else []
             else:
-                decisions = {g: list(r) for g, r in self._gangs.items()}
-            return decisions, self.dropped_gangs
+                items = [(g, list(r)) for g, r in self._gangs.items()]
+            dropped = self.dropped_gangs
+        if tenant is not None:
+            items = [
+                (g, recs)
+                for g, recs in items
+                if any(r.get("tenant") == tenant for r in recs)
+            ]
+        if limit is not None and limit >= 0:
+            # LRU order puts the most recently active gangs LAST
+            items = items[-limit:] if limit else []
+        return dict(items), dropped
 
     def last(self, gang: str) -> Optional[dict]:
         with self._lock:
             ring = self._gangs.get(gang)
             return ring[-1] if ring else None
 
-    def to_json(self, gang: Optional[str] = None) -> bytes:
-        decisions, dropped = self._snapshot_with_dropped(gang)
+    def to_json(
+        self,
+        gang: Optional[str] = None,
+        tenant: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> bytes:
+        decisions, dropped = self._snapshot_with_dropped(gang, tenant, limit)
         return json.dumps(
             {
                 "decisions": decisions,

@@ -319,6 +319,27 @@ else
     fi
 fi
 
+echo "== gang-lifecycle / placement-SLO gate on hardware (SLO_${TAG}) =="
+# the bench-slo gate with the oracle on the real backend: the lifecycle
+# ledger's per-note cost against real batch cadence (the overhead phase
+# keeps its CPU steady-batch denominator — noting is pure host work),
+# plus the same live-vs-recorded timeline byte-identity and
+# burn:ttp deny-storm flip/recovery checks as CI
+# (docs/observability.md "Gang lifecycle & placement SLOs")
+if timeout 900 \
+        python benchmarks/slo_gate.py "SLO_${TAG}.json" \
+        > /tmp/slo_gate.out 2>&1; then
+    echo "slo gate captured: SLO_${TAG}.json"
+    tail -1 /tmp/slo_gate.out
+else
+    if [ -s "SLO_${TAG}.json" ]; then
+        echo "slo gate reported failure — evidence kept: SLO_${TAG}.json"
+        tail -4 /tmp/slo_gate.out
+    else
+        echo "slo gate failed:"; tail -4 /tmp/slo_gate.out; fail=1
+    fi
+fi
+
 echo "== lockcheck-enabled sim cycle (LOCKCHECK_${TAG}) =="
 # one short sim cycle with the runtime lock-discipline checker armed
 # (BST_LOCKCHECK=1, docs/static_analysis.md): TPU batch times shift every

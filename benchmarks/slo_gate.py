@@ -1,0 +1,396 @@
+"""Gang-lifecycle / placement-SLO CI gate (``make bench-slo``,
+docs/observability.md "Gang lifecycle & placement SLOs").
+
+Three phases, every one a hard assertion:
+
+1. **Hot-path overhead** — at the 5k-node/10k-pod acceptance bucket, a
+   worst-case lifecycle load (a deny-storm publish touching every one of
+   the 2048 parked gangs, the coalesced-streak model) costs <= 1% of the
+   steady batch wall-clock, and the coalescer actually held: every gang's
+   storm compacts to a bounded ring instead of churning its arrival
+   anchor out.
+2. **Timeline byte-consistency** — a recorded sim's live ``/debug/gangs``
+   snapshot equals, byte-for-byte per gang, the offline re-fold of the
+   audit ring's ``gang_lifecycle`` records through
+   ``GangLifecycleLedger.fold`` (the ``timeline --audit-dir`` path).
+3. **TTP burn flip** — a real deny storm (gangs parked on an
+   impossible cluster) resolved late against a tightened
+   ``BST_SLO_TTP_P99_S`` flips ``burn:ttp`` to breach with the
+   ``bst_slo_burn_rate{signal="ttp"}`` gauge elevated; fast binds after
+   the storm slide the fast window clear (the budget stays visibly
+   burned in the slow window — warn, never breach).
+
+Writes SLO_gate.json (or argv[1]) with the bst-bench envelope and
+appends to PERF_LEDGER.jsonl; exits non-zero on any failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("BST_BUCKET_COST", "0")
+# CPU by default (CI gate); the hardware capture may set
+# BST_SLO_GATE_PLATFORM=default to keep the probed backend
+_platform = os.environ.get("BST_SLO_GATE_PLATFORM", "cpu")
+
+import jax  # noqa: E402
+
+if _platform == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+OVERHEAD_CEILING = 0.01  # the acceptance bound
+OVERHEAD_SLACK = 1.25  # timing noise on the microsecond note path
+OVERHEAD_BATCHES = 5
+# the acceptance bucket: 5k nodes / 10k pods (2048 gangs x 5 members)
+NODES = 5120
+GROUPS = 2048
+MEMBERS = 5
+
+
+def phase_overhead(report: dict, failures: list) -> None:
+    """Worst-case per-publish lifecycle load vs the steady batch."""
+    from batch_scheduler_tpu.ops.oracle import execute_batch_host
+    from batch_scheduler_tpu.ops.snapshot import ClusterSnapshot, GroupDemand
+    from batch_scheduler_tpu.sim.scenarios import make_sim_node
+    from batch_scheduler_tpu.utils.lifecycle import GangLifecycleLedger
+    from batch_scheduler_tpu.utils.metrics import Registry
+
+    nodes = [
+        make_sim_node(
+            f"slo{i:05d}", {"cpu": "64", "memory": "256Gi", "pods": "110"}
+        )
+        for i in range(NODES)
+    ]
+    gang_names = [f"tenant-{g % 4}/gang-{g:04d}" for g in range(GROUPS)]
+    groups = [
+        GroupDemand(
+            name, MEMBERS,
+            member_request={"cpu": 2000, "memory": 4 * 1024**3},
+            creation_ts=float(g),
+        )
+        for g, name in enumerate(gang_names)
+    ]
+    snap = ClusterSnapshot(nodes, {}, groups)
+    args, progress = snap.device_args(), snap.progress_args()
+    execute_batch_host(args, progress)  # compile off the clock
+
+    # a private ledger in default configuration (no audit, no export):
+    # the bound claims the always-on scheduling hot path
+    led = GangLifecycleLedger(registry=Registry())
+    for i, name in enumerate(gang_names):
+        led.note_arrival(name, tier=i % 4, pods=MEMBERS)
+
+    ledger_s = 0.0
+    t_start = time.perf_counter()
+    for _ in range(OVERHEAD_BATCHES):
+        execute_batch_host(args, progress)
+        # the storm publish: every parked gang gets one coalesced deny
+        t0 = time.perf_counter()
+        for name in gang_names:
+            led.note_deny(name, "lane cpu deficit")
+        ledger_s += time.perf_counter() - t0
+    elapsed = time.perf_counter() - t_start
+
+    frac = ledger_s / max(elapsed, 1e-9)
+    notes = OVERHEAD_BATCHES * GROUPS
+    view = led.snapshot()
+    rings = [len(tv["events"]) for tv in view["gangs"].values()]
+    streaks = [
+        next(
+            (e.get("repeats", 1) for e in tv["events"] if e["event"] == "deny"),
+            0,
+        )
+        for tv in view["gangs"].values()
+    ]
+    report["phases"]["overhead"] = {
+        "batches": OVERHEAD_BATCHES,
+        "elapsed_s": round(elapsed, 4),
+        "ledger_s": round(ledger_s, 4),
+        "overhead_frac": round(frac, 5),
+        "notes": notes,
+        "per_note_us": round(ledger_s / notes * 1e6, 3),
+        "max_ring_len": max(rings),
+        "min_deny_repeats": min(streaks),
+    }
+    report["metrics_extra"]["lifecycle_overhead_frac"] = round(frac, 5)
+    report["metrics_extra"]["lifecycle_note_us"] = round(
+        ledger_s / notes * 1e6, 3
+    )
+    if frac > OVERHEAD_CEILING * OVERHEAD_SLACK:
+        failures.append(
+            f"lifecycle hot path cost {frac:.4f} of the {NODES}-node "
+            f"steady stream exceeds {OVERHEAD_CEILING:.2f}"
+        )
+    if view["count"] != GROUPS:
+        failures.append(
+            f"overhead: ledger tracked {view['count']} gangs, "
+            f"expected {GROUPS}"
+        )
+    if max(rings) > 2:
+        failures.append(
+            f"overhead: deny storm grew a gang ring to {max(rings)} "
+            "entries — coalescing did not hold"
+        )
+    if min(streaks) != OVERHEAD_BATCHES:
+        failures.append(
+            f"overhead: a gang's deny streak shows {min(streaks)} repeats, "
+            f"expected {OVERHEAD_BATCHES}"
+        )
+
+
+def phase_timeline_identity(report: dict, failures: list, base: str) -> None:
+    """Live /debug/gangs snapshot == offline audit-ring re-fold."""
+    from batch_scheduler_tpu.sim import (
+        SimCluster,
+        make_member_pods,
+        make_sim_group,
+        make_sim_node,
+    )
+    from batch_scheduler_tpu.utils.audit import AuditLog, AuditReader
+    from batch_scheduler_tpu.utils.lifecycle import (
+        DEFAULT_LEDGER,
+        GangLifecycleLedger,
+    )
+
+    audit_dir = os.path.join(base, "ring")
+    log = AuditLog(audit_dir)
+    cluster = SimCluster(scorer="oracle", audit_log=log)
+    # AFTER construction: ScheduleOperation resets DEFAULT_LEDGER (per-run
+    # isolation), which detaches sinks — the cmd/main.py wiring contract
+    DEFAULT_LEDGER.attach_audit(log)
+    try:
+        cluster.add_nodes(
+            [
+                make_sim_node(f"t{i}", {"cpu": "16", "pods": "110"})
+                for i in range(8)
+            ]
+        )
+        pods = []
+        for t in range(3):
+            name, ns = f"slo-gang-{t}", f"team-{t}"
+            cluster.create_group(make_sim_group(name, 3, namespace=ns))
+            pods += make_member_pods(name, 3, {"cpu": "2"}, namespace=ns)
+        cluster.start()
+        cluster.create_pods(pods)
+        ok = cluster.wait_for(
+            lambda: all(
+                cluster.group_phase(f"slo-gang-{t}", f"team-{t}").value
+                == "Running"
+                for t in range(3)
+            ),
+            timeout=90.0,
+        )
+        if not ok:
+            failures.append("timeline: recorded sim did not settle")
+    finally:
+        cluster.stop()
+        log.flush()
+        log.stop()
+
+    live = DEFAULT_LEDGER.snapshot()
+    records = [
+        r
+        for r in AuditReader(audit_dir).records()
+        if r.get("kind") == "event" and r.get("event") == "gang_lifecycle"
+    ]
+    # seq is assigned under the ledger lock (global, monotonic) — it IS
+    # the authoritative order; audit emission happens outside the lock,
+    # so concurrent writers may land a hair out of order on disk
+    records.sort(key=lambda r: (r.get("seq", 0), r.get("ts", 0.0)))
+    folded = GangLifecycleLedger.fold(records, per_gang=DEFAULT_LEDGER.per_gang)
+
+    compared = divergent = 0
+    for gang, live_view in live["gangs"].items():
+        compared += 1
+        rec = folded.get(gang)
+        fold_view = (
+            GangLifecycleLedger.timeline_view(rec) if rec is not None else None
+        )
+        a = json.dumps(live_view, sort_keys=True, default=str)
+        b = json.dumps(fold_view, sort_keys=True, default=str)
+        if a != b:
+            divergent += 1
+            failures.append(
+                f"timeline: {gang} diverges live-vs-fold "
+                f"(live {a[:160]}… fold {b[:160]}…)"
+            )
+    bound = sum(
+        1
+        for tv in live["gangs"].values()
+        if any(e["event"] == "bind" for e in tv["events"])
+    )
+    report["phases"]["timeline_identity"] = {
+        "records": len(records),
+        "gangs_compared": compared,
+        "divergent": divergent,
+        "gangs_bound": bound,
+    }
+    if compared < 3:
+        failures.append(
+            f"timeline: only {compared} gangs to compare (expected >= 3)"
+        )
+    if bound < 3:
+        failures.append(
+            f"timeline: only {bound} gangs reached bind in the recording"
+        )
+
+
+def phase_burn_flip(report: dict, failures: list) -> None:
+    """Deny storm -> late binds breach burn:ttp; recovery clears it."""
+    from batch_scheduler_tpu.sim import (
+        SimCluster,
+        make_member_pods,
+        make_sim_group,
+        make_sim_node,
+    )
+    from batch_scheduler_tpu.utils.health import DEFAULT_HEALTH
+    from batch_scheduler_tpu.utils.lifecycle import DEFAULT_LEDGER
+    from batch_scheduler_tpu.utils.metrics import DEFAULT_REGISTRY
+
+    # tight target + short fast window: the storm's late binds must flip
+    # the NOW-signal, and the post-storm fast window must slide clear in
+    # gate-time; the slow window keeps the burned budget visible
+    os.environ["BST_SLO_TTP_P99_S"] = "0.5"
+    os.environ["BST_SLO_WINDOW_S"] = "2"
+    os.environ["BST_SLO_BURN_WINDOW_S"] = "60"
+    STORM_GANGS = 4
+    cluster = SimCluster(scorer="oracle")
+    phase: dict = {}
+    try:
+        # one node no storm gang can fit: every cycle is a deny
+        cluster.add_nodes([make_sim_node("tiny", {"cpu": "2", "pods": "8"})])
+        # baseline AFTER construction: the registry's TTP series carries
+        # earlier phases' observations; re-seeding the snapshot deque
+        # excludes them from every window (counter-reuse discipline)
+        DEFAULT_HEALTH.reset()
+        pods = []
+        for g in range(STORM_GANGS):
+            name = f"storm-{g}"
+            cluster.create_group(make_sim_group(name, 2))
+            pods += make_member_pods(name, 2, {"cpu": "3"})
+        cluster.start()
+        cluster.create_pods(pods)
+        time.sleep(1.5)  # park past the 0.5s target, denied every cycle
+        denied = sum(
+            1
+            for tv in DEFAULT_LEDGER.snapshot()["gangs"].values()
+            if any(e["event"] == "deny" for e in tv["events"])
+        )
+        phase["gangs_denied"] = denied
+        if denied < STORM_GANGS:
+            failures.append(
+                f"burn: only {denied}/{STORM_GANGS} gangs show a deny "
+                "streak under the storm"
+            )
+        # relieve the storm: every bind lands with TTP > target
+        cluster.add_nodes(
+            [
+                make_sim_node(f"big{i}", {"cpu": "16", "pods": "64"})
+                for i in range(4)
+            ]
+        )
+        for g in range(STORM_GANGS):
+            if not cluster.wait_for_bound(f"storm-{g}", 2, timeout=60.0):
+                failures.append(f"burn: storm-{g} never bound after relief")
+        deadline = time.monotonic() + 30.0
+        storm = DEFAULT_HEALTH.evaluate()
+        while (
+            storm["signals"]["burn:ttp"]["verdict"] != "breach"
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.3)
+            storm = DEFAULT_HEALTH.evaluate()
+        sig = storm["signals"]["burn:ttp"]
+        phase["storm_burn"] = sig
+        if sig["verdict"] != "breach":
+            failures.append(f"burn:ttp did not breach under the storm: {sig}")
+        gauge = DEFAULT_REGISTRY.gauge("bst_slo_burn_rate")
+        fast_gauge = gauge.value(signal="ttp", window="fast")
+        phase["storm_gauge_fast"] = fast_gauge
+        if fast_gauge < sig["fast_threshold"]:
+            failures.append(
+                f"bst_slo_burn_rate ttp/fast gauge {fast_gauge} below "
+                "threshold during the storm"
+            )
+        # recovery: fast binds while the fast window slides past the
+        # storm — the breach must clear; the slow window may keep warning
+        # (budget burned earlier), which is the distinction
+        quick = 0
+        deadline = time.monotonic() + 30.0
+        recovered = DEFAULT_HEALTH.evaluate()
+        while (
+            recovered["signals"]["burn:ttp"]["verdict"] == "breach"
+            and time.monotonic() < deadline
+        ):
+            name = f"quick-{quick}"
+            quick += 1
+            cluster.create_group(make_sim_group(name, 1))
+            cluster.create_pods(make_member_pods(name, 1, {"cpu": "1"}))
+            cluster.wait_for_bound(name, 1, timeout=30.0)
+            time.sleep(0.7)
+            recovered = DEFAULT_HEALTH.evaluate()
+        rec_sig = recovered["signals"]["burn:ttp"]
+        phase["recovered_burn"] = rec_sig
+        phase["recovery_binds"] = quick
+        if rec_sig["verdict"] == "breach":
+            failures.append(
+                f"burn:ttp breach did not clear after recovery: {rec_sig}"
+            )
+    finally:
+        for knob in (
+            "BST_SLO_TTP_P99_S", "BST_SLO_WINDOW_S", "BST_SLO_BURN_WINDOW_S",
+        ):
+            os.environ.pop(knob, None)
+        cluster.stop()
+        DEFAULT_HEALTH.reset()
+    report["phases"]["burn_flip"] = phase
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "SLO_gate.json"
+    report = {
+        "gate": "slo",
+        "platform": jax.default_backend(),
+        "devices": len(jax.devices()),
+        "phases": {},
+        "metrics_extra": {},
+    }
+    failures: list = []
+    base = tempfile.mkdtemp(prefix="bst-slo-gate-")
+    try:
+        phase_overhead(report, failures)
+        phase_timeline_identity(report, failures, base)
+        phase_burn_flip(report, failures)
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+    report["failures"] = failures
+    report["ok"] = not failures
+    from benchmarks import artifact
+
+    metrics = report.pop("metrics_extra", {})
+    doc = artifact.envelope(report, metrics=metrics)
+    artifact.append_ledger(doc)
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True, default=str)
+        f.write("\n")
+    print(json.dumps(doc, indent=2, sort_keys=True, default=str))
+    from batch_scheduler_tpu.ops.oracle import drain_telemetry_threads
+
+    drain_telemetry_threads(timeout=60.0)
+    if failures:
+        print(f"SLO GATE FAILED: {failures}", file=sys.stderr)
+        return 1
+    print("slo gate OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,526 @@
+"""Gang lifecycle observatory (docs/observability.md "Gang lifecycle").
+
+The ledger's contract has three load-bearing edges this file pins down:
+coalesce/respawn mechanics must be byte-reproducible from the flat
+evidence chain (fold == live snapshot — the slo_gate invariant), the
+streaming cursor must never silently skip or re-serve an occurrence,
+and the burn:ttp SLO signal must stay quiet on no-traffic windows while
+flipping decisively on a deny storm. Plus the satellite regression: a
+preemption eviction must NOT reset the pending/TTP clock.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from batch_scheduler_tpu.utils.lifecycle import GangLifecycleLedger
+from batch_scheduler_tpu.utils.metrics import Registry
+
+
+def _drive(led: GangLifecycleLedger) -> None:
+    """A canonical two-gang story: acme's gang waits through a deny
+    streak, gets evicted once, respawns and binds; beta's gang sails."""
+    led.note_arrival("acme/train", tier=2, pods=1)
+    led.note_arrival("acme/train", tier=2, pods=1)
+    led.note_admitted("acme/train")
+    for _ in range(3):
+        led.note_deny("acme/train", "lane cpu deficit")
+    led.note_batch_context(
+        "aid-1", {"coalesce": {"queue_wait_seconds": 0.02}}
+    )
+    led.note_deny("acme/train", "lane cpu deficit")
+    led.note_evicted("acme/train", preemptor="beta/urgent")
+    led.note_arrival("acme/train", tier=2, pods=1)  # the respawn
+    led.note_permit("acme/train")
+    led.note_bind("acme/train", members=1)
+    led.note_bind("acme/train", members=1)
+    led.note_arrival("beta/urgent", tier=3, pods=1)
+    led.note_permit("beta/urgent")
+    led.note_bind("beta/urgent", members=1)
+
+
+class _FakeAudit:
+    def __init__(self):
+        self.records = []
+
+    def record_event(self, event, **fields):
+        self.records.append({"kind": "event", "event": event, **fields})
+
+
+def test_coalesce_streaks_respawn_and_ttp():
+    led = GangLifecycleLedger(registry=Registry())
+    _drive(led)
+    snap = led.snapshot()
+    assert snap["count"] == 2
+    tv = snap["gangs"]["acme/train"]
+    events = [(e["event"], e.get("repeats", 1)) for e in tv["events"]]
+    # member arrivals coalesce; denies coalesce per blame string; the
+    # post-eviction arrival is relabeled respawn; binds coalesce
+    assert events == [
+        ("arrival", 2),
+        ("admitted", 1),
+        ("deny", 4),
+        ("evicted", 1),
+        ("respawn", 1),
+        ("permit", 1),
+        ("bind", 2),
+    ]
+    deny = tv["events"][2]
+    assert deny["reason"] == "lane cpu deficit"
+    assert deny["audit_id"] == "aid-1"  # cross-stamped mid-streak
+    assert deny["sidecar_wait_s"] == pytest.approx(0.02)
+    assert "first_ts" in deny and deny["first_ts"] <= deny["ts"]
+    # phase decomposition: anchors ordered, sidecar wait attributed
+    assert tv["phases"]["sidecar_wait"] == pytest.approx(0.02)
+    assert tv["ttp_s"] >= 0
+    a = tv["anchors"]
+    assert a["arrival"] <= a["sched"] <= a["bind"]
+    # TTP observed ONCE per bind streak, tagged tenant+tier
+    rep = led.report()
+    assert rep["tenants"]["acme"]["count"] == 1
+    assert rep["tenants"]["beta"]["count"] == 1
+
+
+def test_tenant_scope_and_limit():
+    led = GangLifecycleLedger(registry=Registry())
+    _drive(led)
+    assert list(led.snapshot(tenant="beta")["gangs"]) == ["beta/urgent"]
+    assert led.snapshot(gang="acme/train")["count"] == 1
+    # limit keeps the MOST RECENTLY ACTIVE gangs; 0 is empty, not all
+    assert list(led.snapshot(limit=1)["gangs"]) == ["beta/urgent"]
+    assert led.snapshot(limit=0)["count"] == 0
+
+
+def test_retry_ping_pong_compacts_to_two_ring_slots():
+    """A parked gang alternates admitted<->deny every scheduling cycle;
+    the ledger must fold that ping-pong into two entries, not churn the
+    arrival/eviction story out of the bounded ring."""
+    audit = _FakeAudit()
+    led = GangLifecycleLedger(per_gang=8, registry=Registry())
+    led.attach_audit(audit)
+    led.note_arrival("ns/parked", tier=0, pods=1)
+    for _ in range(50):
+        led.note_admitted("ns/parked")
+        led.note_deny("ns/parked", "cluster full")
+    tv = led.snapshot()["gangs"]["ns/parked"]
+    events = [(e["event"], e.get("repeats", 1)) for e in tv["events"]]
+    assert events == [
+        ("arrival", 1), ("admitted", 50), ("deny", 50),
+    ]
+    assert tv["dropped_events"] == 0
+    # a terminal event is a hard boundary: denies after a bind are a NEW
+    # streak, never merged back across it
+    led.note_bind("ns/parked", members=1)
+    led.note_deny("ns/parked", "cluster full")
+    events = [e["event"] for e in led.snapshot()["gangs"]["ns/parked"]["events"]]
+    assert events == ["arrival", "admitted", "deny", "bind", "deny"]
+    # and the skip-merge is fold-reproducible from the flat records
+    folded = GangLifecycleLedger.fold(audit.records, per_gang=8)
+    assert json.dumps(
+        GangLifecycleLedger.timeline_view(folded["ns/parked"]),
+        sort_keys=True,
+    ) == json.dumps(led.snapshot()["gangs"]["ns/parked"], sort_keys=True)
+
+
+def test_per_gang_ring_bound_counts_drops():
+    led = GangLifecycleLedger(per_gang=4, registry=Registry())
+    led.note_arrival("ns/g", tier=0, pods=1)
+    for i in range(10):
+        led.note_deny("ns/g", f"reason-{i}")  # distinct: no coalesce
+    tv = led.snapshot()["gangs"]["ns/g"]
+    assert len(tv["events"]) == 4
+    assert tv["dropped_events"] == 7
+    # arrival_ts anchor survives the ring evicting the arrival event
+    assert tv["anchors"]["arrival"] is not None
+
+
+def test_fold_is_byte_identical_to_live_snapshot():
+    """The offline half of every surface: re-folding the flat audit
+    records must reproduce the live per-gang event lists byte-for-byte
+    (same coalesce rule, same ring bound) — `timeline --audit-dir` and
+    the slo_gate byte-consistency phase both stand on this."""
+    audit = _FakeAudit()
+    led = GangLifecycleLedger(registry=Registry())
+    led.attach_audit(audit)
+    _drive(led)
+    assert all(r["event"] == "gang_lifecycle" for r in audit.records)
+    folded = GangLifecycleLedger.fold(audit.records)
+    live = led.snapshot()["gangs"]
+    assert set(folded) == set(live)
+    for gang, rec in folded.items():
+        view = GangLifecycleLedger.timeline_view(rec)
+        assert json.dumps(view, sort_keys=True) == json.dumps(
+            live[gang], sort_keys=True
+        ), gang
+
+
+def test_fold_applies_ring_bound():
+    audit = _FakeAudit()
+    led = GangLifecycleLedger(per_gang=4, registry=Registry())
+    led.attach_audit(audit)
+    led.note_arrival("ns/g", tier=0, pods=1)
+    for i in range(10):
+        led.note_deny("ns/g", f"reason-{i}")
+    folded = GangLifecycleLedger.fold(audit.records, per_gang=4)
+    assert json.dumps(
+        GangLifecycleLedger.timeline_view(folded["ns/g"]), sort_keys=True
+    ) == json.dumps(led.snapshot()["gangs"]["ns/g"], sort_keys=True)
+
+
+def test_export_jsonl_round_trips(tmp_path):
+    led = GangLifecycleLedger(registry=Registry())
+    led.set_export_dir(str(tmp_path))
+    _drive(led)
+    lines = [
+        json.loads(line)
+        for line in (tmp_path / "events.jsonl").read_text().splitlines()
+    ]
+    # export lines fold through the same rule as audit records
+    folded = GangLifecycleLedger.fold(lines)
+    assert json.dumps(
+        GangLifecycleLedger.timeline_view(folded["acme/train"]),
+        sort_keys=True,
+    ) == json.dumps(led.snapshot()["gangs"]["acme/train"], sort_keys=True)
+
+
+def test_export_rotation_bounds_disk(tmp_path, monkeypatch):
+    monkeypatch.setenv("BST_LIFECYCLE_EXPORT_MAX_MB", "0.002")  # ~2 KB
+    led = GangLifecycleLedger(registry=Registry())
+    led.set_export_dir(str(tmp_path))
+    for i in range(80):  # push well past the cap
+        led.note_deny("ns/filler", f"r-{i}")
+    main = tmp_path / "events.jsonl"
+    rolled = tmp_path / "events.jsonl.1"
+    assert main.exists() and rolled.exists()
+    # at most the live file + ONE rotated generation survive, each capped
+    assert not (tmp_path / "events.jsonl.2").exists()
+    assert rolled.stat().st_size <= 3 * 1024
+    # every surviving line is intact JSON (rotation never tears a line)
+    for f in (rolled, main):
+        for line in f.read_text().splitlines():
+            assert json.loads(line)["gang"] == "ns/filler"
+
+
+def test_events_since_cursor_semantics():
+    led = GangLifecycleLedger(stream_capacity=8, registry=Registry())
+    for i in range(5):
+        led.note_deny("ns/g", f"r-{i}")
+    out = led.events_since(0)
+    assert [e["cursor"] for e in out["events"]] == [1, 2, 3, 4, 5]
+    assert out["cursor"] == 5 and out["dropped"] == 0
+    # resume from the returned cursor: nothing new, cursor unchanged
+    again = led.events_since(out["cursor"])
+    assert again["events"] == [] and again["cursor"] == 5
+    # limit truncates but the cursor only advances past SERVED events
+    page = led.events_since(0, limit=2)
+    assert [e["cursor"] for e in page["events"]] == [1, 2]
+    assert page["cursor"] == 2
+    # limit=0 with events available must NOT advance (no silent skip)
+    peek = led.events_since(0, limit=0)
+    assert peek["events"] == [] and peek["cursor"] == 0
+    # ring overflow reports the evicted span as dropped
+    for i in range(10):
+        led.note_deny("ns/h", f"s-{i}")
+    tail = led.events_since(0)
+    assert tail["dropped"] == 15 - 8
+    assert len(tail["events"]) == 8
+    # a coalesced repeat gets a NEW cursor but keeps its stable seq
+    led.note_deny("ns/h", "s-9")
+    bump = led.events_since(tail["cursor"])
+    assert len(bump["events"]) == 1
+    assert bump["events"][0]["seq"] == tail["events"][-1]["seq"]
+    assert bump["events"][0]["cursor"] == tail["cursor"] + 1
+
+
+def test_events_since_long_poll_times_out_quickly():
+    led = GangLifecycleLedger(registry=Registry())
+    t0 = time.monotonic()
+    out = led.events_since(0, timeout_s=0.05)
+    assert out["events"] == []
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_debug_endpoints_serve_and_reject(tmp_path):
+    """/debug/gangs, /debug/events, and the /debug/decisions filters —
+    including the 400-on-malformed convention."""
+    from batch_scheduler_tpu.utils import lifecycle as lifecycle_mod
+    from batch_scheduler_tpu.utils.metrics import (
+        DEFAULT_REGISTRY,
+        serve_metrics,
+    )
+    from batch_scheduler_tpu.utils.trace import DEFAULT_FLIGHT_RECORDER
+
+    led = lifecycle_mod.DEFAULT_LEDGER
+    led.reset()
+    DEFAULT_FLIGHT_RECORDER.clear()
+    _drive(led)
+    DEFAULT_FLIGHT_RECORDER.record(
+        "acme/train", "prefilter", "deny", "lane cpu deficit"
+    )
+    DEFAULT_FLIGHT_RECORDER.record("beta/urgent", "bind", "ok")
+    server = serve_metrics(DEFAULT_REGISTRY, port=0)
+    try:
+        port = server.server_address[1]
+
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5
+            ) as r:
+                return json.loads(r.read().decode()), r.status
+
+        doc, status = get("/debug/gangs")
+        assert status == 200 and doc["count"] == 2
+        doc, _ = get("/debug/gangs?gang=acme/train")
+        assert list(doc["gangs"]) == ["acme/train"]
+        assert doc["gangs"]["acme/train"]["phases"]["sidecar_wait"] > 0
+        doc, _ = get("/debug/gangs?tenant=beta&limit=5")
+        assert list(doc["gangs"]) == ["beta/urgent"]
+        doc, _ = get("/debug/events?since=0&limit=4")
+        assert len(doc["events"]) == 4 and doc["cursor"] == 4
+        doc, _ = get(f"/debug/events?since={doc['cursor']}")
+        assert doc["events"][0]["cursor"] == 5
+        doc, _ = get("/debug/decisions?tenant=acme")
+        assert list(doc["decisions"]) == ["acme/train"]
+        doc, _ = get("/debug/decisions?gang=beta/urgent&limit=1")
+        assert list(doc["decisions"]) == ["beta/urgent"]
+        for bad in (
+            "/debug/gangs?limit=bogus",
+            "/debug/gangs?limit=-1",
+            "/debug/decisions?limit=1.5",
+            "/debug/events?since=xyz",
+            "/debug/events?limit=-3",
+        ):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                get(bad)
+            assert exc.value.code == 400, bad
+            assert json.loads(exc.value.read().decode())["ok"] is False
+    finally:
+        server.shutdown()
+        led.reset()
+        DEFAULT_FLIGHT_RECORDER.clear()
+
+
+def test_timeline_cli_offline_folds_audit_ring(tmp_path, capsys):
+    """`timeline --audit-dir`: the explain/capacity offline pattern over
+    the gang_lifecycle evidence chain."""
+    from batch_scheduler_tpu.cmd.main import main
+    from batch_scheduler_tpu.utils.audit import AuditLog
+
+    log = AuditLog(str(tmp_path), cap_bytes=1 << 20)
+    led = GangLifecycleLedger(registry=Registry())
+    led.attach_audit(log)
+    _drive(led)
+    log.flush()
+    log.stop()
+    assert main(["timeline", "acme/train", "--audit-dir", str(tmp_path)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert list(doc["gangs"]) == ["acme/train"]
+    assert doc["gangs"]["acme/train"]["ttp_s"] >= 0
+    # tenant scoping + the nothing-matches exit contract
+    assert main(["timeline", "--audit-dir", str(tmp_path),
+                 "--tenant", "beta"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert list(doc["gangs"]) == ["beta/urgent"]
+    assert main(["timeline", "ns/ghost", "--audit-dir", str(tmp_path)]) == 2
+    capsys.readouterr()
+
+
+# -- the pending-clock eviction carry (satellite regression) ---------------
+
+
+def test_pending_clock_survives_preemption_eviction():
+    """An evicted-then-respawned gang (same name, new uids) must NOT
+    reset its pending clock: the original first-seen is carried across
+    note_placed -> note_evicted, so pending age and the next placement's
+    observed span include the preemption churn."""
+    from batch_scheduler_tpu.utils.health import PendingGangTracker
+
+    reg = Registry()
+    t = PendingGangTracker(registry=reg)
+    t.note_deny("spot/victim")
+    time.sleep(0.03)
+    t.note_placed("spot/victim")
+    first_span = reg.histogram("bst_gang_pending_seconds").snapshot()[1]
+    assert first_span >= 0.03
+    # a guaranteed gang preempts the spot gang; the spot gang respawns
+    t.note_evicted("spot/victim")
+    rep = t.report()
+    assert rep["pending_gangs"] == 1
+    assert rep["oldest_age_s"] >= 0.03, "eviction reset the pending clock"
+    time.sleep(0.02)
+    t.note_placed("spot/victim")
+    total_span = reg.histogram("bst_gang_pending_seconds").snapshot()[1]
+    # the second observation spans the ORIGINAL first-seen -> now
+    assert total_span - first_span >= 0.05
+    # an eviction while still pending leaves the running clock alone
+    t.note_deny("ns/waiting")
+    time.sleep(0.02)
+    t.note_evicted("ns/waiting")
+    assert t.report()["oldest_age_s"] >= 0.02
+    # forget (gang deleted) drops the carry: no ghost re-arm later
+    t.note_deny("ns/gone")
+    t.note_placed("ns/gone")
+    t.forget("ns/gone")
+    t.note_evicted("ns/gone")
+    assert t.report()["pending_gangs"] == 2  # re-armed at NOW, age ~0
+
+
+def test_operation_eviction_rearms_pending_via_tracker():
+    """The wiring end of the satellite: ScheduleOperation.note_gang_evicted
+    must re-arm the pending tracker (operation -> tracker), not just flip
+    group phase."""
+    from batch_scheduler_tpu.core.operation import ScheduleOperation
+
+    assert hasattr(ScheduleOperation, "note_gang_evicted")
+    src = open(
+        "batch_scheduler_tpu/core/operation.py", encoding="utf-8"
+    ).read()
+    assert "pending_tracker.note_evicted" in src
+
+
+def test_ledger_arrival_anchor_survives_respawn():
+    """The TTP half of the same regression: the ledger's arrival anchor
+    (and so ttp_s) spans the eviction."""
+    led = GangLifecycleLedger(registry=Registry())
+    led.note_arrival("spot/victim", tier=1, pods=1)
+    time.sleep(0.03)
+    led.note_evicted("spot/victim", preemptor="guar/winner")
+    led.note_arrival("spot/victim", tier=1, pods=1)  # respawn
+    led.note_bind("spot/victim", members=1)
+    tv = led.snapshot()["gangs"]["spot/victim"]
+    assert [e["event"] for e in tv["events"]] == [
+        "arrival", "evicted", "respawn", "bind",
+    ]
+    assert tv["ttp_s"] >= 0.03, "respawn reset the TTP anchor"
+
+
+# -- burn:ttp windowed edge cases (satellite 3) ----------------------------
+
+
+def _model_and_hist():
+    from batch_scheduler_tpu.utils.health import HealthModel
+
+    reg = Registry()
+    model = HealthModel(registry=reg)
+    model.reset()
+    return model, reg.histogram("bst_gang_ttp_seconds")
+
+
+def test_burn_ttp_quiet_on_no_traffic_windows():
+    model, _ = _model_and_hist()
+    for _ in range(3):
+        sig = model.evaluate()["signals"]["burn:ttp"]
+        assert sig["verdict"] == "ok"
+        assert sig["observations"] == 0
+        assert sig["burn_fast"] == 0.0 and sig["burn_slow"] == 0.0
+
+
+def test_burn_ttp_deny_storm_breaches_and_reset_recovers(monkeypatch):
+    monkeypatch.setenv("BST_SLO_TTP_P99_S", "0.5")
+    model, hist = _model_and_hist()
+    for _ in range(50):
+        hist.observe(5.0, tenant="acme", tier="1")
+    sig = model.evaluate()["signals"]["burn:ttp"]
+    assert sig["verdict"] == "breach"
+    assert sig["tiers"]["1"]["p99_s"] > 0.5
+    assert sig["tiers"]["1"]["observations"] == 50
+    # recovery: re-baselining scopes the next verdict to new traffic
+    model.reset()
+    for _ in range(50):
+        hist.observe(0.01, tenant="acme", tier="1")
+    sig = model.evaluate()["signals"]["burn:ttp"]
+    assert sig["verdict"] == "ok"
+
+
+def test_burn_ttp_per_tier_targets(monkeypatch):
+    """Per-tier overrides: the same latency breaches the strict tier and
+    passes the lax default; malformed overrides are ignored (the knobs
+    parse-guard contract)."""
+    from batch_scheduler_tpu.utils.health import _ttp_target_for_tier
+
+    monkeypatch.setenv("BST_SLO_TTP_P99_S", "100")
+    monkeypatch.setenv("BST_SLO_TTP_P99_T3_S", "0.05")
+    monkeypatch.setenv("BST_SLO_TTP_P99_T7_S", "not-a-number")
+    assert _ttp_target_for_tier("3") == 0.05
+    assert _ttp_target_for_tier("7") == 100.0  # malformed -> base
+    assert _ttp_target_for_tier("0") == 100.0
+    monkeypatch.setenv("BST_SLO_TTP_P99_S", "")
+    assert _ttp_target_for_tier("0") == 120.0  # baked-in default
+
+    model, hist = _model_and_hist()
+    monkeypatch.setenv("BST_SLO_TTP_P99_S", "100")
+    for _ in range(90):
+        hist.observe(1.0, tenant="acme", tier="3")  # breaches T3's 0.05
+    for _ in range(10):
+        hist.observe(1.0, tenant="acme", tier="0")  # well under 100
+    sig = model.evaluate()["signals"]["burn:ttp"]
+    # 90 of 100 observations violate THEIR tier's target -> burn 18x,
+    # past both thresholds; the default tier contributes only its total
+    assert sig["verdict"] == "breach"
+    assert sig["observations"] == 100
+    assert sig["tiers"]["3"]["target_p99_s"] == 0.05
+    assert sig["tiers"]["0"]["target_p99_s"] == 100.0
+    # the same latency on the LAX tier alone would not have breached:
+    # tier 0's windowed p99 is far under its target
+    assert sig["tiers"]["0"]["p99_s"] < 100.0
+
+
+def test_burn_ttp_counter_reuse_never_goes_negative(monkeypatch):
+    """A histogram epoch restarting under the model (registry swapped or
+    series cleared — tests do this) must clamp to zero traffic, not
+    produce negative burns."""
+    monkeypatch.setenv("BST_SLO_TTP_P99_S", "0.5")
+    model, hist = _model_and_hist()
+    for _ in range(20):
+        hist.observe(5.0, tenant="t", tier="0")
+    assert model.evaluate()["signals"]["burn:ttp"]["verdict"] == "breach"
+    hist._series.clear()  # the counter-reuse epoch break
+    sig = model.evaluate()["signals"]["burn:ttp"]
+    assert sig["observations"] == 0
+    assert sig["burn_fast"] >= 0.0 and sig["burn_slow"] >= 0.0
+    assert sig["verdict"] == "ok"
+
+
+def test_burn_ttp_snapshot_deque_bounded_under_fast_polling(monkeypatch):
+    """A 10Hz /debug/health poller must not grow the TTP history: the
+    deque retains at most ~1k entries per slow window by construction."""
+    model, hist = _model_and_hist()
+    hist.observe(0.01, tenant="t", tier="0")
+    for _ in range(200):
+        model.evaluate()
+    assert len(model._ttp_snaps) <= 1100
+
+
+def test_burn_capacity_downsampled_span_overlap(monkeypatch):
+    """The capacity burn admits downsampled entries by span OVERLAP and
+    weights by merged count — a ring that has downsampled must not
+    underweight the slow window (the same window math burn:ttp's deque
+    granularity bound leans on)."""
+    from batch_scheduler_tpu.ops import capacity as capacity_mod
+    from batch_scheduler_tpu.utils.health import HealthModel
+
+    class _FakeSampler:
+        def series(self):
+            now = time.time()
+            return [
+                # merged entry: 8 raw samples, half violating, whose span
+                # STARTS outside the fast window but overlaps into it
+                {"ts": now - 400, "span_s": 200.0, "merged": 8,
+                 "data": {"capacity_violation": 0.5}},
+                {"ts": now - 1, "merged": 1,
+                 "data": {"capacity_violation": 1.0}},
+            ]
+
+    model = HealthModel(registry=Registry())
+    model.reset()
+    monkeypatch.setattr(capacity_mod, "active_sampler", _FakeSampler)
+    monkeypatch.setenv("BST_SLO_WINDOW_S", "300")
+    sig = model.evaluate()["signals"]["burn:capacity"]
+    # both entries admitted: 8*0.5 + 1*1.0 = 5 bad of 9 -> fraction 5/9
+    assert sig["observations"] == 9
+    assert sig["burn_fast"] == pytest.approx((5 / 9) / 0.05, rel=1e-3)

@@ -473,6 +473,27 @@ def test_spot_vs_guaranteed_preemption_e2e():
             if not (now_uids & uids_before[name]):
                 respawned_gangs += 1
         assert respawned_gangs >= 1, "no spot gang was evicted+respawned"
+
+        # lifecycle-clock regression: the eviction must not reset the
+        # victim's story — its ledger record keeps the ORIGINAL arrival
+        # anchor (TTP includes preemption churn) and the post-eviction
+        # re-arrivals are relabeled `respawn`, ordered after `evicted`
+        from batch_scheduler_tpu.utils.lifecycle import DEFAULT_LEDGER
+
+        def evicted_with_respawn():
+            out = []
+            for g, tv in DEFAULT_LEDGER.snapshot()["gangs"].items():
+                evs = [e["event"] for e in tv["events"]]
+                if "evicted" in evs and "respawn" in evs:
+                    out.append((g, evs, tv["anchors"]["arrival"]))
+            return out
+
+        assert sim.wait_for(
+            lambda: len(evicted_with_respawn()) >= 1, timeout=30
+        ), "no evicted+respawned gang reached the lifecycle ledger"
+        for g, evs, arrival in evicted_with_respawn():
+            assert evs.index("evicted") < evs.index("respawn"), (g, evs)
+            assert arrival is not None, g
     finally:
         sim.stop()
 
